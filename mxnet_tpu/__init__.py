@@ -1,0 +1,107 @@
+"""mxnet_tpu — a TPU-native framework with Apache MXNet 2.x capabilities.
+
+Built from scratch on JAX/XLA/Pallas (see SURVEY.md for the structural map of
+the reference this follows). Typical use mirrors MXNet::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import np, npx, autograd, gluon
+
+    net = gluon.nn.Dense(10)
+    net.initialize(ctx=mx.tpu())
+    net.hybridize()                      # trace -> compiled XLA executable
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+"""
+from __future__ import annotations
+
+# dtype parity with the reference (INT64_TENSOR_SIZE / float64 ops in the
+# numpy op suite) requires 64-bit types enabled in JAX.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .base import MXNetError, NotSupportedForTPUError, __version__  # noqa: E402
+from .device import (  # noqa: E402
+    Context,
+    Device,
+    cpu,
+    cpu_pinned,
+    current_context,
+    current_device,
+    gpu,
+    gpu_memory_info,
+    num_devices,
+    num_gpus,
+    num_tpus,
+    tpu,
+)
+from . import base  # noqa: E402
+from . import device  # noqa: E402
+from . import engine  # noqa: E402
+from . import autograd  # noqa: E402
+from . import random  # noqa: E402
+from . import numpy as np  # noqa: E402
+from . import ndarray  # noqa: E402
+from . import ndarray as nd  # noqa: E402
+from . import numpy_extension as npx  # noqa: E402
+from .engine import wait_all as waitall  # noqa: E402
+
+context = device  # legacy module alias: mx.context.Context
+
+
+def cpu_count():
+    import os
+
+    return os.cpu_count() or 1
+
+
+# Heavier subsystems are imported lazily on attribute access so that core
+# array use doesn't pay for gluon/model imports (and to keep import cycles
+# impossible). ``import mxnet_tpu as mx; mx.gluon`` works either way.
+_LAZY_SUBMODULES = (
+    "initializer",
+    "init",
+    "optimizer",
+    "lr_scheduler",
+    "kvstore",
+    "kv",
+    "gluon",
+    "parallel",
+    "profiler",
+    "runtime",
+    "util",
+    "test_utils",
+    "recordio",
+    "image",
+    "io",
+    "operator",
+    "library",
+    "rtc",
+    "amp",
+    "dlpack",
+    "models",
+    "symbol",
+    "sym",
+    "metric",
+)
+
+_LAZY_ALIASES = {"kv": "kvstore", "sym": "symbol", "init": "initializer"}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _LAZY_SUBMODULES:
+        target = _LAZY_ALIASES.get(name, name)
+        if target == "metric":
+            mod = importlib.import_module(".gluon.metric", __name__)
+        else:
+            mod = importlib.import_module("." + target, __name__)
+        globals()[name] = mod
+        return mod
+    if name in ("set_np", "set_np_shape", "is_np_array", "is_np_shape", "use_np"):
+        from . import util
+
+        return getattr(util, name)
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -1,0 +1,100 @@
+"""Core shared definitions for the TPU-native MXNet-style framework.
+
+Plays the role of MXNet's ``python/mxnet/base.py`` (error types, handle
+helpers) without any C-handle plumbing: the "backend" here is JAX/XLA, so the
+only cross-language boundary is the optional native I/O helpers in
+``mxnet_tpu._native`` (cf. reference ``include/mxnet/c_api.h``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+
+class MXNetError(RuntimeError):
+    """Default error type raised by framework internals.
+
+    Mirrors ``mxnet.base.MXNetError`` (reference ``python/mxnet/base.py``);
+    in the reference this carries the C++ stack trace across the C ABI. Here
+    errors originate in Python/XLA directly, so it is a plain exception.
+    """
+
+
+class NotSupportedForTPUError(MXNetError):
+    """Raised for reference APIs with no TPU analog (e.g. ``dist_async``).
+
+    SURVEY.md §7 "hard parts" (5): parameter-server async semantics have no
+    clean TPU mapping — we keep the API surface but raise with an
+    explanation rather than silently doing something else.
+    """
+
+
+# Sentinel used by generated op signatures, mirroring mxnet.base._Null
+class _NullType(object):
+    """Placeholder for arguments the caller did not supply."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "_Null"
+
+    def __bool__(self):
+        return False
+
+
+_Null = _NullType()
+
+string_types = (str,)
+numeric_types = (float, int)
+integer_types = (int,)
+
+# Version of this framework. The reference checkout identifies as the 2.0.0
+# development master (``python/mxnet/libinfo.py:149``).
+__version__ = "2.0.0.tpu1"
+
+
+class _ThreadLocalState(threading.local):
+    """Thread-local knobs shared across the package (np-shape etc.)."""
+
+    def __init__(self):
+        super().__init__()
+        # NumPy-semantics switches. The reference gates zero-dim/zero-size
+        # shape semantics behind ``mx.util.set_np_shape`` for legacy-code
+        # compat; the TPU build is numpy-semantics-native so both default on.
+        self.np_shape = True
+        self.np_array = True
+
+
+_thread_state = _ThreadLocalState()
+
+
+def env_flag(name: str, default: int = 0) -> int:
+    """Read an integer ``MXNET_*`` environment flag (dmlc::GetEnv analog)."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def check_call(ret):  # pragma: no cover - compat shim
+    """Compat shim for code written against the reference's ctypes idiom."""
+    if ret:
+        raise MXNetError(str(ret))
+
+
+_all__ = [
+    "MXNetError",
+    "NotSupportedForTPUError",
+    "_Null",
+    "env_flag",
+    "env_str",
+]

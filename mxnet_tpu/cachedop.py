@@ -1,0 +1,252 @@
+"""CachedOp: the compiled executor behind ``HybridBlock.hybridize()``.
+
+Reference: ``src/imperative/cached_op.cc`` — wraps an nnvm graph, re-plans or
+reuses static buffers per call (``DynamicForward``/``StaticForward``), and
+registers itself as a single ``_CachedOp`` node on the autograd tape with a
+matching ``Backward`` executor.
+
+TPU design: the "graph" is obtained by *replaying the block's forward* with
+tracer-backed NDArrays inside ``jax.jit`` (the deferred-compute move of
+Gluon 2, ``python/mxnet/_deferred_compute.py``, collapsed onto jax tracing).
+Per input signature (shapes/dtypes/train-mode/grad-mode) we build and cache:
+
+  * ``fwd_jit(param_data, state_data, key, *args) -> (outs, new_states, vjp)``
+    — one XLA executable containing the whole forward (+ residual saving
+    when grads are needed). ``vjp`` is a ``jax.tree_util.Partial`` pytree of
+    residual arrays.
+  * ``bwd_jit(vjp, cotangents) -> (param_grads, arg_grads)`` — one XLA
+    executable for the whole backward, compiled on first backward call.
+
+Static buffer reuse, memory planning, and op fusion — the reason the
+reference has ``static_alloc``/``static_shape`` (``cached_op.h:415-436``) —
+are XLA's job; ``static_alloc`` maps to donating the state buffers.
+
+Mutable state (BatchNorm running stats, any ``grad_req='null'`` parameter a
+layer rebinds during forward) is handled structurally: state params enter as
+traced inputs and their (possibly rebound) values are returned as extra
+outputs, then written back after the call — giving the reference's
+aux-state mutation semantics without mutation inside the compiled graph.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Sequence
+
+from . import autograd
+from . import random as _rng
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, _slot_of, _tracked
+
+_trace_state = threading.local()
+
+
+def in_trace() -> bool:
+    return getattr(_trace_state, "depth", 0) > 0
+
+
+class _ParamBinding:
+    """Temporarily rebind parameter NDArrays to tracers during tracing."""
+
+    def __init__(self, arrays: Sequence[NDArray], tracers):
+        self.arrays = arrays
+        self.tracers = tracers
+        self.saved = None
+
+    def __enter__(self):
+        self.saved = [(a._data, a._tape, a._leaf) for a in self.arrays]
+        for a, t in zip(self.arrays, self.tracers):
+            a._data = t
+        _trace_state.depth = getattr(_trace_state, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _trace_state.depth -= 1
+        for a, (data, tape, leaf) in zip(self.arrays, self.saved):
+            a._data = data
+            a._tape = tape
+            a._leaf = leaf
+        return False
+
+
+class CachedOp:
+    """Compiled, signature-cached executor for a HybridBlock."""
+
+    def __init__(self, block, static_alloc=False, static_shape=False,
+                 flags=()):  # pylint: disable=unused-argument
+        self.block = block
+        self.static_alloc = static_alloc
+        self.static_shape = static_shape
+        self._cache = {}
+        self._bwd_cache = {}
+
+    # -- helpers ----------------------------------------------------------
+    def _split_params(self):
+        params = list(self.block.collect_params().values())
+        train = [p for p in params if p.grad_req != "null"]
+        state = [p for p in params if p.grad_req == "null"]
+        return train, state
+
+    @staticmethod
+    def _sig_of(datas):
+        return tuple((tuple(d.shape), str(d.dtype)) for d in datas)
+
+    def _key(self, arg_datas, grad_mode, args_tracked):
+        train, state = self._split_params()
+        return (
+            self._sig_of(arg_datas),
+            self._sig_of([p.data()._data for p in train]),
+            self._sig_of([p.data()._data for p in state]),
+            autograd.is_training(),
+            grad_mode,
+            tuple(args_tracked),
+        )
+
+    def _build(self, key, grad_mode, args_tracked):
+        import jax
+
+        train_params, state_params = self._split_params()
+        train_arrays = [p.data() for p in train_params]
+        state_arrays = [p.data() for p in state_params]
+        block = self.block
+        is_training = autograd.is_training()
+        out_tree_box = {}
+
+        def replay(tp_datas, st_datas, rng_key, arg_datas):
+            """Re-run block.forward with tracer-backed NDArrays."""
+            all_arrays = train_arrays + state_arrays
+            all_tracers = list(tp_datas) + list(st_datas)
+            wrapped_args = [NDArray.__new__(NDArray) for _ in arg_datas]
+            for w, d in zip(wrapped_args, arg_datas):
+                w._data = d
+                w._tape = None
+                w._leaf = None
+                w._version = 0
+                w._stype = "default"
+            with _ParamBinding(all_arrays, all_tracers):
+                _rng.push_trace_rng(rng_key)
+                prev_rec = autograd.set_recording(False)
+                prev_train = autograd.set_training(is_training)
+                try:
+                    outs = block.forward(*wrapped_args)
+                finally:
+                    autograd.set_training(prev_train)
+                    autograd.set_recording(prev_rec)
+                    _rng.pop_trace_rng()
+                new_states = [a._data for a in state_arrays]
+            flat_outs, tree = jax.tree_util.tree_flatten(
+                outs, is_leaf=lambda x: isinstance(x, NDArray))
+            out_tree_box["tree"] = tree
+            out_datas = [o._data if isinstance(o, NDArray) else o for o in flat_outs]
+            return out_datas, new_states
+
+        n_args = len(key[0])
+        diff_arg_idx = [i for i, t in enumerate(args_tracked) if t]
+
+        if grad_mode:
+            def fwd(tp_datas, st_datas, rng_key, *arg_datas):
+                diff_args = tuple(arg_datas[i] for i in diff_arg_idx)
+
+                def for_vjp(tp, *dargs):
+                    full_args = list(arg_datas)
+                    for i, d in zip(diff_arg_idx, dargs):
+                        full_args[i] = d
+                    return replay(tp, st_datas, rng_key, full_args)
+
+                (out_datas, new_states), vjp = jax.vjp(for_vjp, tuple(tp_datas), *diff_args)
+                return out_datas, new_states, vjp
+
+            fwd_jit = jax.jit(fwd)
+        else:
+            def fwd(tp_datas, st_datas, rng_key, *arg_datas):
+                out_datas, new_states = replay(tp_datas, st_datas, rng_key,
+                                               list(arg_datas))
+                return out_datas, new_states, None
+
+            donate = (1,) if self.static_alloc else ()
+            fwd_jit = jax.jit(fwd, donate_argnums=donate)
+
+        def bwd(vjp, out_cts, state_shapes_dtypes):
+            import jax.numpy as jnp
+
+            zero_states = [jnp.zeros(s, d) for s, d in state_shapes_dtypes]
+            grads = vjp((list(out_cts), zero_states))
+            return grads  # (param_grads_tuple, *diff_arg_grads)
+
+        bwd_jit = jax.jit(bwd, static_argnums=(2,))
+        return {
+            "fwd": fwd_jit,
+            "bwd": bwd_jit,
+            "out_tree": out_tree_box,
+            "train_params": train_params,
+            "state_params": state_params,
+            "diff_arg_idx": diff_arg_idx,
+        }
+
+    # -- call -------------------------------------------------------------
+    def __call__(self, *args):
+        args = list(args)
+        arg_datas = []
+        for a in args:
+            if isinstance(a, NDArray):
+                arg_datas.append(a._data)
+            else:
+                arg_datas.append(NDArray(a)._data)
+
+        grad_mode = autograd.is_recording()
+        args_tracked = tuple(
+            isinstance(a, NDArray) and _tracked(a) for a in args
+        ) if grad_mode else tuple(False for _ in args)
+
+        key = self._key(arg_datas, grad_mode, args_tracked)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(key, grad_mode, args_tracked)
+            self._cache[key] = entry
+
+        train_params = entry["train_params"]
+        state_params = entry["state_params"]
+        tp_datas = tuple(p.data()._data for p in train_params)
+        st_datas = tuple(p.data()._data for p in state_params)
+        rng_key = _rng.next_key()
+
+        out_datas, new_states, vjp = entry["fwd"](tp_datas, st_datas, rng_key,
+                                                  *arg_datas)
+
+        # write back mutated state (BatchNorm running stats etc.)
+        for p, ns in zip(state_params, new_states):
+            arr = p.data()
+            if arr._data is not ns:
+                arr._set_data_internal(ns)
+
+        wrapped = [NDArray(d) for d in out_datas]
+
+        if grad_mode and vjp is not None:
+            state_sd = tuple((tuple(s.shape), str(s.dtype)) for s in new_states)
+            bwd_jit = entry["bwd"]
+            diff_arg_idx = entry["diff_arg_idx"]
+
+            def vjp_fn(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                grads = bwd_jit(vjp, tuple(cts), state_sd)
+                param_grads = grads[0]
+                arg_grads = grads[1:]
+                return tuple(param_grads) + tuple(arg_grads)
+
+            in_slots = [_slot_of(p.data()) for p in train_params]
+            in_slots += [_slot_of(args[i]) for i in diff_arg_idx]
+            node = autograd.TapeNode(
+                vjp_fn,
+                in_slots,
+                [(tuple(d.shape), d.dtype) for d in out_datas],
+                name=f"CachedOp({type(self.block).__name__})",
+            )
+            for i, w in enumerate(wrapped):
+                w._tape = (node, i)
+
+        tree = entry["out_tree"].get("tree")
+        if tree is None:
+            return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+        import jax
+
+        return jax.tree_util.tree_unflatten(tree, wrapped)

@@ -1,0 +1,185 @@
+"""Device / Context abstraction over JAX devices.
+
+TPU-native analog of the reference's ``python/mxnet/context.py`` (Context over
+dev types ``{cpu:1, gpu:2, cpu_pinned:3, cpu_shared:5}``; C++ ``Context`` in
+``include/mxnet/base.h``). The TPU build adds ``mx.tpu()`` as the accelerator
+device type; ``mx.gpu()`` is kept as an alias for "the accelerator" so that
+reference scripts written with ``mx.gpu()`` run unchanged on a TPU host.
+
+A Context maps 1:1 onto a ``jax.Device``; placement is done with
+``jax.device_put`` and computation follows operand placement (XLA semantics),
+which subsumes the reference's per-device stream/worker machinery
+(``src/engine/threaded_engine_perdevice.cc``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .base import MXNetError
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class Context:
+    """A device context. Use :func:`cpu`, :func:`tpu`, :func:`gpu` to create.
+
+    Also usable as a ``with`` block to set the default creation context,
+    mirroring ``mxnet.Context.__enter__`` (reference ``context.py:139-199``).
+    """
+
+    # dev-type enumeration kept value-compatible with the reference
+    # (``context.py:65-66``) with ``tpu`` appended as a new type.
+    devtype2id = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5, "tpu": 6}
+    devid2type = {v: k for k, v in devtype2id.items()}
+
+    _default = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in self.devtype2id:
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def device_typeid(self) -> int:
+        return self.devtype2id[self.device_type]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    # -- mapping onto JAX devices ----------------------------------------
+    def jax_device(self):
+        """Resolve this context to a concrete ``jax.Device``."""
+        jax = _jax()
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = jax.devices("cpu") if jax.default_backend() != "cpu" else jax.devices()
+            if self.device_type == "cpu":
+                return devs[min(self.device_id, len(devs) - 1)]
+            return devs[0]
+        # accelerator types: tpu, or gpu-used-as-accelerator-alias
+        accel = _accelerator_devices()
+        if not accel:
+            if self.device_type == "gpu":
+                raise MXNetError("no accelerator devices available for gpu()")
+            raise MXNetError("no TPU devices available; is JAX seeing the chip?")
+        if self.device_id >= len(accel):
+            raise MXNetError(
+                f"device_id {self.device_id} out of range: "
+                f"{len(accel)} accelerator device(s) visible"
+            )
+        return accel[self.device_id]
+
+    def real_device_type(self) -> str:
+        """'tpu' | 'gpu' | 'cpu' of the underlying jax device platform."""
+        return self.jax_device().platform
+
+    # -- default-context management --------------------------------------
+    def __enter__(self):
+        if not hasattr(Context._default, "stack"):
+            Context._default.stack = []
+        Context._default.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Context._default.stack.pop()
+        return False
+
+    @classmethod
+    def default_ctx(cls) -> "Context":
+        stack = getattr(cls._default, "stack", None)
+        if stack:
+            return stack[-1]
+        return _CPU0
+
+
+# Device is the 2.x name for Context (reference ``python/mxnet/device.py``
+# aliases in master); keep both spellings.
+Device = Context
+
+_CPU0 = Context("cpu", 0)
+
+
+def _accelerator_devices():
+    jax = _jax()
+    if jax.default_backend() == "cpu":
+        return []
+    return jax.devices()
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Accelerator alias: on a TPU host this resolves to the TPU chip."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    return Context.default_ctx()
+
+
+current_device = current_context
+
+
+def num_gpus() -> int:
+    """Number of accelerator devices (reference ``mx.context.num_gpus``)."""
+    devs = _accelerator_devices()
+    return len([d for d in devs if d.platform == "gpu"])
+
+
+def num_tpus() -> int:
+    devs = _accelerator_devices()
+    return len([d for d in devs if d.platform != "gpu"])
+
+
+def num_devices() -> int:
+    return len(_jax().devices())
+
+
+def gpu_memory_info(device_id: int = 0):
+    """(free, total) bytes on the accelerator, via PJRT memory stats."""
+    dev = tpu(device_id).jax_device() if num_tpus() else gpu(device_id).jax_device()
+    stats = dev.memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return (total - used, total)
+
+
+def from_jax_device(dev) -> Context:
+    """Map a ``jax.Device`` back to a Context."""
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    # accelerator index is its position among accelerator devices
+    accel = _accelerator_devices()
+    try:
+        idx = accel.index(dev)
+    except ValueError:
+        idx = dev.id
+    return Context("tpu" if dev.platform != "gpu" else "gpu", idx)

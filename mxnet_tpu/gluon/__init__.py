@@ -1,0 +1,19 @@
+"""Gluon — the imperative/hybrid modeling API (reference ``python/mxnet/gluon``)."""
+from __future__ import annotations
+
+from . import loss, metric, nn, utils
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict
+from .trainer import Trainer
+
+
+def __getattr__(name):
+    # heavier submodules load lazily: data (multiprocessing), rnn (scan
+    # layers), model_zoo (vision nets), contrib (estimator), probability
+    import importlib
+
+    if name in ("data", "rnn", "model_zoo", "contrib", "probability"):
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

@@ -1,0 +1,354 @@
+"""Gluon losses (reference ``python/mxnet/gluon/loss.py``, 15 classes)."""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ops import nn as _nn
+from ..ops.registry import apply as _apply
+from .block import HybridBlock
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _reshape_like(pred, label):
+    if isinstance(label, NDArray) and label.shape != pred.shape:
+        return label.reshape(pred.shape)
+    return label
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+class Loss(HybridBlock):
+    """Base loss: scalar weighting + batch-axis mean semantics."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def _mean_nonbatch(self, loss):
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_axis={self._batch_axis})"
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = _jnp_square(pred - label)
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+def _jnp_square(x):
+    return x.square() if isinstance(x, NDArray) else x * x
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = (pred - label).abs()
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Softmax + CE fused (reference SoftmaxCrossEntropyLoss).
+
+    ``sparse_label=True`` takes class indices; otherwise one-hot/probs.
+    """
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            logp = _nn.log_softmax(pred, axis=self._axis)
+        else:
+            logp = pred
+        if self._sparse_label:
+            loss = -_nn.pick(logp, label, axis=self._axis, keepdims=False)
+        else:
+            label = _reshape_like(logp, label)
+            loss = -(logp * label).sum(axis=self._axis)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = _reshape_like(pred, label)
+        if not self._from_sigmoid:
+            def f(p, l, *pw):
+                import jax
+
+                jnp = _jnp()
+                relu_neg = jnp.maximum(-p, 0.0)
+                if pw:
+                    w = 1.0 + (pw[0] - 1.0) * l
+                    return (1.0 - l) * p + w * (
+                        jnp.log1p(jnp.exp(-jnp.abs(p))) + relu_neg)
+                return relu_neg + p * (1.0 - l) + jnp.log1p(jnp.exp(-jnp.abs(p)))
+
+            args = (pred, label) + ((pos_weight,) if pos_weight is not None else ())
+            loss = _apply(f, args, name="sigmoid_bce")
+        else:
+            eps = 1e-12
+
+            def f(p, l, *pw):
+                jnp = _jnp()
+                if pw:
+                    return -(jnp.log(p + eps) * l * pw[0]
+                             + jnp.log(1 - p + eps) * (1 - l))
+                return -(jnp.log(p + eps) * l + jnp.log(1 - p + eps) * (1 - l))
+
+            args = (pred, label) + ((pos_weight,) if pos_weight is not None else ())
+            loss = _apply(f, args, name="sigmoid_bce")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = _nn.log_softmax(pred, axis=self._axis)
+
+        def f(p, l):
+            jnp = _jnp()
+            return l * (jnp.log(l + 1e-12) - p)
+
+        loss = _apply(f, (pred, label), name="kldiv")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class CTCLoss(Loss):
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        if layout not in ("NTC", "TNC"):
+            raise MXNetError(f"bad layout {layout!r}")
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        if self._layout == "NTC":
+            pred = pred.swapaxes(0, 1)
+        if self._label_layout == "TN":
+            label = label.swapaxes(0, 1)
+        loss = _nn.ctc_loss(pred, label, pred_lengths, label_lengths,
+                            use_data_lengths=pred_lengths is not None,
+                            use_label_lengths=label_lengths is not None,
+                            blank_label="last")
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        rho = self._rho
+
+        def f(p, l):
+            jnp = _jnp()
+            d = jnp.abs(p - l)
+            return jnp.where(d > rho, d - 0.5 * rho, (0.5 / rho) * d * d)
+
+        loss = _apply(f, (pred, label), name="huber")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        m = self._margin
+
+        def f(p, l):
+            jnp = _jnp()
+            return jnp.maximum(0.0, m - p * l)
+
+        loss = _apply(f, (pred, label), name="hinge")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        m = self._margin
+
+        def f(p, l):
+            jnp = _jnp()
+            return _jnp().square(jnp.maximum(0.0, m - p * l))
+
+        loss = _apply(f, (pred, label), name="sq_hinge")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed", **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        if label_format not in ("signed", "binary"):
+            raise MXNetError(f"bad label_format {label_format!r}")
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        fmt = self._label_format
+
+        def f(p, l):
+            jnp = _jnp()
+            if fmt == "signed":
+                l2 = (l + 1.0) / 2.0
+            else:
+                l2 = l
+            return jnp.maximum(-p, 0.0) + p * (1.0 - l2) + jnp.log1p(jnp.exp(-jnp.abs(p)))
+
+        loss = _apply(f, (pred, label), name="logistic")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        m = self._margin
+
+        def f(p, pos, neg):
+            jnp = _jnp()
+            d = jnp.sum(jnp.square(p - pos) - jnp.square(p - neg),
+                        axis=tuple(range(1, p.ndim)))
+            return jnp.maximum(d + m, 0.0)
+
+        loss = _apply(f, (pred, positive, negative), name="triplet")
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, label, sample_weight=None, epsilon=1e-08):
+        label = _reshape_like(pred, label)
+        from_logits = self._from_logits
+        full = self._compute_full
+
+        def f(p, l):
+            jnp = _jnp()
+            if from_logits:
+                loss = jnp.exp(p) - l * p
+            else:
+                loss = p - l * jnp.log(p + epsilon)
+            if full:
+                stirling = (l * jnp.log(l + 1e-12) - l
+                            + 0.5 * jnp.log(2.0 * _onp.pi * (l + 1e-12)))
+                loss = loss + jnp.where(l > 1, stirling, 0.0)
+            return loss
+
+        loss = _apply(f, (pred, label), name="poisson_nll")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss.mean()
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        m = self._margin
+
+        def f(a, b, l):
+            jnp = _jnp()
+            ab = jnp.sum(a * b, axis=-1)
+            na = jnp.sqrt(jnp.sum(a * a, axis=-1) + 1e-12)
+            nb = jnp.sqrt(jnp.sum(b * b, axis=-1) + 1e-12)
+            cos = ab / (na * nb)
+            lr = l.reshape(cos.shape)
+            return jnp.where(lr == 1, 1.0 - cos, jnp.maximum(0.0, cos - m))
+
+        loss = _apply(f, (input1, input2, label), name="cosine_embedding")
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference SDMLLoss)."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._sp = smoothing_parameter
+
+    def forward(self, x1, x2):
+        sp = self._sp
+
+        def f(a, b):
+            import jax
+
+            jnp = _jnp()
+            n = a.shape[0]
+            dist = jnp.sqrt(
+                jnp.sum(jnp.square(a[:, None, :] - b[None, :, :]), axis=-1) + 1e-12)
+            neg_log = jax.nn.log_softmax(-dist, axis=1)
+            smoothed = (1 - sp) * jnp.eye(n) + sp / max(n - 1, 1) * (1 - jnp.eye(n))
+            return -jnp.sum(smoothed * neg_log, axis=1)
+
+        return _apply(f, (x1, x2), name="sdml")

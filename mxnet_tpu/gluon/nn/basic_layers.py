@@ -1,0 +1,428 @@
+"""Basic Gluon layers (reference ``python/mxnet/gluon/nn/basic_layers.py``).
+
+Dense/Dropout/BatchNorm/LayerNorm/GroupNorm/InstanceNorm/Embedding/Flatten/
+activations + Sequential containers. Layers resolve deferred input-dim
+shapes at first forward (the reference's deferred-init + shape-inference
+flow) and lower to the ``npx`` op family.
+"""
+from __future__ import annotations
+
+from ... import autograd
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ...ops import nn as _nn
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+
+class Sequential(Block):
+    """Stack of Blocks run sequentially."""
+
+    def __init__(self, *blocks):
+        super().__init__()
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            out = type(self)()
+            for b in list(self._children.values())[idx]:
+                out.add(b)
+            return out
+        return list(self._children.values())[idx]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(HybridBlock, Sequential):
+    """Hybridizable Sequential."""
+
+    def __init__(self, *blocks):
+        HybridBlock.__init__(self)
+        for b in blocks:
+            self.add(b)
+
+    forward = Sequential.forward
+    add = Sequential.add
+    __len__ = Sequential.__len__
+    __getitem__ = Sequential.__getitem__
+    __iter__ = Sequential.__iter__
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer: ``activation(dot(x, W^T) + b)``.
+
+    Reference ``gluon/nn/basic_layers.py`` Dense → ``npx.fully_connected``
+    (kernel ``src/operator/nn/fully_connected.cc``).
+    """
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None, bias_initializer="zeros",
+                 in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._act_type = activation
+        self.weight = Parameter("weight", shape=(units, in_units), dtype=dtype,
+                                init=weight_initializer)
+        self.bias = (
+            Parameter("bias", shape=(units,), dtype=dtype, init=bias_initializer)
+            if use_bias else None
+        )
+
+    def forward(self, x):
+        if self.weight.shape[1] == 0:
+            in_units = (
+                int(x.size // x.shape[0]) if self._flatten else int(x.shape[-1]))
+            self.weight.shape = (self._units, in_units)
+        out = _nn.fully_connected(
+            x, self.weight.data(), self.bias.data() if self.bias is not None else None,
+            num_hidden=self._units, no_bias=self.bias is None,
+            flatten=self._flatten)
+        if self._act_type:
+            out = _nn.activation(out, self._act_type)
+        return out
+
+    def __repr__(self):
+        return (f"Dense({self._units}"
+                f"{', ' + self._act_type if self._act_type else ''})")
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        if not autograd.is_training() or self._rate <= 0:
+            return x
+        return _nn.dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return f"Dropout(p={self._rate})"
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self._act_type = activation
+
+    def forward(self, x):
+        return _nn.activation(x, self._act_type)
+
+    def __repr__(self):
+        return f"Activation({self._act_type})"
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return _nn.leaky_relu(x, act_type="leaky", slope=self._alpha)
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return _nn.leaky_relu(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return _nn.leaky_relu(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf", **kwargs):
+        super().__init__(**kwargs)
+        self._approx = approximation
+
+    def forward(self, x):
+        return _nn.activation(
+            x, "gelu_tanh" if self._approx == "tanh" else "erf_gelu")
+
+
+class SiLU(HybridBlock):
+    def forward(self, x):
+        return _nn.activation(x, "silu")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def forward(self, x):
+        if self._beta == 1.0:
+            return _nn.activation(x, "silu")
+        return x * _nn.sigmoid(self._beta * x)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, in_channels=1, **kwargs):
+        super().__init__(**kwargs)
+        from ...initializer import Constant
+
+        self.alpha = Parameter("alpha", shape=(in_channels,),
+                               init=alpha_initializer or Constant(0.25))
+
+    def forward(self, x):
+        return _nn.leaky_relu(x, gamma=self.alpha.data(), act_type="prelu")
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.reshape((x.shape[0], -1))
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Lambda(Block):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(function, str):
+            from ... import numpy as _np
+            from ... import numpy_extension as _npx
+
+            fn = getattr(_npx, function, None) or getattr(_np, function)
+            self._func = fn
+        else:
+            self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class Embedding(HybridBlock):
+    """Index → vector lookup (reference Embedding; sparse_grad supported as
+    dense-on-TPU with row-sparse conversion available on the grad)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer)
+
+    def forward(self, x):
+        return _nn.embedding(x, self.weight.data(), input_dim=self._input_dim,
+                             output_dim=self._output_dim,
+                             sparse_grad=self._sparse_grad)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class _NormBase(HybridBlock):
+    pass
+
+
+class BatchNorm(_NormBase):
+    """Batch normalization with running-stat state.
+
+    State update happens functionally: in training the op returns batch
+    stats; the layer folds them into ``running_*`` parameters under
+    ``autograd.pause`` (the reference mutates aux states inside the CUDA
+    kernel, ``src/operator/nn/batch_norm.cc``). Inside a hybridized trace
+    the rebound state values become extra executable outputs (see CachedOp).
+    """
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._eps = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              differentiable=center)
+        self.running_mean = Parameter("running_mean", shape=shape,
+                                      init=running_mean_initializer,
+                                      differentiable=False)
+        self.running_var = Parameter("running_var", shape=shape,
+                                     init=running_variance_initializer,
+                                     differentiable=False)
+
+    def _finalize(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if p.shape[0] == 0:
+                p.shape = (c,)
+
+    def forward(self, x):
+        self._finalize(x)
+        training = autograd.is_training() and not self._use_global_stats
+        if training:
+            out, mean, var = _nn.batch_norm(
+                x, self.gamma.data(), self.beta.data(),
+                self.running_mean.data(), self.running_var.data(),
+                eps=self._eps, momentum=self._momentum,
+                fix_gamma=not self._scale, output_mean_var=True,
+                axis=self._axis)
+            m = self._momentum
+            with autograd.pause():
+                rm = self.running_mean.data()
+                rv = self.running_var.data()
+                n = x.size / x.shape[self._axis]
+                unbiased = var.detach() * (n / max(n - 1, 1))
+                new_rm = m * rm + (1 - m) * mean.detach()
+                new_rv = m * rv + (1 - m) * unbiased
+                rm._set_data_internal(new_rm._data)
+                rv._set_data_internal(new_rv._data)
+            return out
+        return _nn.batch_norm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._eps, momentum=self._momentum,
+            fix_gamma=not self._scale, use_global_stats=True,
+            axis=self._axis)
+
+    def __repr__(self):
+        return f"BatchNorm(axis={self._axis}, momentum={self._momentum})"
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm.
+
+    On TPU, batch stats are computed over the *global* batch automatically
+    when the batch axis is sharded over the mesh and the reduction runs in
+    jit (XLA inserts the collective) — so this is BatchNorm plus a mesh
+    assertion, replacing the reference's NCCL-based implementation
+    (``src/operator/contrib/sync_batch_norm.cc``).
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[self._axis]
+        if self.gamma.shape[0] == 0:
+            self.gamma.shape = (c,)
+            self.beta.shape = (c,)
+        return _nn.layer_norm(x, self.gamma.data(), self.beta.data(),
+                              axis=self._axis, eps=self._eps)
+
+    def __repr__(self):
+        return f"LayerNorm(eps={self._eps})"
+
+
+class RMSNorm(HybridBlock):
+    """Root-mean-square norm (for the LLM model family; no reference analog)."""
+
+    def __init__(self, axis=-1, epsilon=1e-6, gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer)
+
+    def forward(self, x):
+        if self.gamma.shape[0] == 0:
+            self.gamma.shape = (x.shape[self._axis],)
+        return _nn.rms_norm(x, self.gamma.data(), axis=self._axis, eps=self._eps)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._ngroups = num_groups
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[1]
+        if self.gamma.shape[0] == 0:
+            self.gamma.shape = (c,)
+            self.beta.shape = (c,)
+        return _nn.group_norm(x, self.gamma.data(), self.beta.data(),
+                              num_groups=self._ngroups, eps=self._eps)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        if axis != 1:
+            raise MXNetError("InstanceNorm supports axis=1 (NC...)")
+        self._eps = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[1]
+        if self.gamma.shape[0] == 0:
+            self.gamma.shape = (c,)
+            self.beta.shape = (c,)
+        return _nn.instance_norm(x, self.gamma.data(), self.beta.data(),
+                                 eps=self._eps)

@@ -1,0 +1,249 @@
+"""Convolution / pooling Gluon layers.
+
+Reference: ``python/mxnet/gluon/nn/conv_layers.py`` over
+``src/operator/nn/{convolution,deconvolution,pooling}.cc``. NCHW-family
+layouts at the API; XLA picks internal layouts for the MXU.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ops import nn as _nn
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+
+def _pair(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="convolution", adj=None, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        ndim = len(kernel_size) if isinstance(kernel_size, (tuple, list)) else None
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._strides = strides
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._layout = layout
+        self._act_type = activation
+        self._op_name = op_name
+        self._adj = adj
+        ndim = len(self._kernel)
+        if op_name == "convolution":
+            wshape = (channels, in_channels // groups if in_channels else 0) + tuple(self._kernel)
+        else:  # deconvolution weight is (in, out//groups, *k)
+            wshape = (in_channels if in_channels else 0, channels // groups) + tuple(self._kernel)
+        self.weight = Parameter("weight", shape=wshape, dtype=dtype,
+                                init=weight_initializer)
+        self.bias = (Parameter("bias", shape=(channels,), dtype=dtype,
+                               init=bias_initializer) if use_bias else None)
+
+    def forward(self, x):
+        if 0 in self.weight.shape:
+            cin = x.shape[1]
+            if self._op_name == "convolution":
+                self.weight.shape = (self._channels, cin // self._groups) + tuple(self._kernel)
+            else:
+                self.weight.shape = (cin, self._channels // self._groups) + tuple(self._kernel)
+        bias = self.bias.data() if self.bias is not None else None
+        if self._op_name == "convolution":
+            out = _nn.convolution(
+                x, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._strides, dilate=self._dilation, pad=self._padding,
+                num_filter=self._channels, num_group=self._groups,
+                no_bias=bias is None, layout=self._layout)
+        else:
+            out = _nn.deconvolution(
+                x, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._strides, dilate=self._dilation, pad=self._padding,
+                adj=self._adj, num_filter=self._channels,
+                num_group=self._groups, no_bias=bias is None,
+                layout=self._layout)
+        if self._act_type:
+            out = _nn.activation(out, self._act_type)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, kernel={self._kernel}, "
+                f"stride={self._strides})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, _pair(kernel_size, 1), _pair(strides, 1),
+                         _pair(padding, 1), _pair(dilation, 1), groups, layout,
+                         **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, _pair(kernel_size, 2), _pair(strides, 2),
+                         _pair(padding, 2), _pair(dilation, 2), groups, layout,
+                         **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", **kwargs):
+        super().__init__(channels, _pair(kernel_size, 3), _pair(strides, 3),
+                         _pair(padding, 3), _pair(dilation, 3), groups, layout,
+                         **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, _pair(kernel_size, 1), _pair(strides, 1),
+                         _pair(padding, 1), _pair(dilation, 1), groups, layout,
+                         op_name="deconvolution", adj=_pair(output_padding, 1),
+                         **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", **kwargs):
+        super().__init__(channels, _pair(kernel_size, 2), _pair(strides, 2),
+                         _pair(padding, 2), _pair(dilation, 2), groups, layout,
+                         op_name="deconvolution", adj=_pair(output_padding, 2),
+                         **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", **kwargs):
+        super().__init__(channels, _pair(kernel_size, 3), _pair(strides, 3),
+                         _pair(padding, 3), _pair(dilation, 3), groups, layout,
+                         op_name="deconvolution", adj=_pair(output_padding, 3),
+                         **kwargs)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode=False,
+                 global_pool=False, pool_type="max", layout="NCHW",
+                 count_include_pad=True, **kwargs):
+        super().__init__(**kwargs)
+        self._kernel = pool_size
+        self._strides = strides if strides is not None else pool_size
+        self._padding = padding
+        self._ceil = ceil_mode
+        self._global = global_pool
+        self._pool_type = pool_type
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return _nn.pooling(
+            x, kernel=self._kernel, pool_type=self._pool_type,
+            global_pool=self._global, stride=self._strides, pad=self._padding,
+            pooling_convention="full" if self._ceil else "valid",
+            count_include_pad=self._count_include_pad)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(size={self._kernel}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_pair(pool_size, 1), _pair(strides, 1) if strides is not None else None,
+                         _pair(padding, 1), ceil_mode, pool_type="max", **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_pair(pool_size, 2), _pair(strides, 2) if strides is not None else None,
+                         _pair(padding, 2), ceil_mode, pool_type="max", **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(_pair(pool_size, 3), _pair(strides, 3) if strides is not None else None,
+                         _pair(padding, 3), ceil_mode, pool_type="max", **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_pair(pool_size, 1), _pair(strides, 1) if strides is not None else None,
+                         _pair(padding, 1), ceil_mode, pool_type="avg",
+                         count_include_pad=count_include_pad, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_pair(pool_size, 2), _pair(strides, 2) if strides is not None else None,
+                         _pair(padding, 2), ceil_mode, pool_type="avg",
+                         count_include_pad=count_include_pad, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_pair(pool_size, 3), _pair(strides, 3) if strides is not None else None,
+                         _pair(padding, 3), ceil_mode, pool_type="avg",
+                         count_include_pad=count_include_pad, **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), global_pool=True, pool_type="max",
+                         **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), global_pool=True,
+                         pool_type="max", **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), global_pool=True,
+                         pool_type="max", **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), global_pool=True, pool_type="avg",
+                         **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), global_pool=True,
+                         pool_type="avg", **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), global_pool=True,
+                         pool_type="avg", **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        self._padding = _pair(padding, 2)
+
+    def forward(self, x):
+        from ... import numpy as _np
+
+        p = self._padding
+        return _np.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                       mode="reflect")

@@ -1,0 +1,243 @@
+"""Gluon Trainer — the data-parallel optimization driver.
+
+Reference: ``python/mxnet/gluon/trainer.py:78-440`` — decides
+``update_on_kvstore``, allreduces grads through the KVStore, then applies
+the optimizer per parameter.
+
+TPU redesign: ``step()`` = (1) optional grad allreduce via the KVStore
+backend (identity on one device; psum over the mesh for ``dist_tpu_sync``),
+(2) ONE jitted multi-tensor optimizer update over all parameters with donated
+param/state buffers — the whole update is a single fused XLA executable,
+playing the role of the reference's aggregated optimizer kernels
+(``src/operator/optimizer_op.cc`` multi-tensor paths).
+"""
+from __future__ import annotations
+
+from .. import autograd
+from ..base import MXNetError
+from ..kvstore import base as kv_base
+from ..ndarray.ndarray import NDArray
+from ..optimizer import Optimizer, create as create_optimizer
+from .parameter import Parameter
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)):
+            self._ordered_names = list(params.keys())
+            params = list(params.values())
+        else:
+            params = list(params)
+            self._ordered_names = [p.name for p in params]
+        for p in params:
+            if not isinstance(p, Parameter):
+                raise MXNetError("Trainer expects Parameters")
+        self._params = [p for p in params if p.grad_req != "null"]
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._optimizer: Optimizer = (
+            create_optimizer(optimizer, **optimizer_params)
+            if isinstance(optimizer, str) else optimizer)
+        self._optimizer.param_dict = dict(enumerate(self._params))
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._states = None
+        self._fused = None
+        self._step_count = 0
+
+    # -- properties -------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- kvstore ----------------------------------------------------------
+    def _init_kvstore(self):
+        if self._kv_initialized:
+            return
+        kvstore = self._kvstore_type
+        if kvstore is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kv = kv_base.create(kvstore) if isinstance(kvstore, str) else kvstore
+            self._kvstore = kv
+            if self._update_on_kvstore is None:
+                # reference default: update on kvstore iff backend supports it
+                # and multi-device replicas exist; native TPU path updates on
+                # worker (identical replicas after allreduce)
+                self._update_on_kvstore = False
+            if self._compression_params and hasattr(kv, "set_gradient_compression"):
+                kv.set_gradient_compression(self._compression_params)
+            if self._update_on_kvstore:
+                if not kv.is_capable(kv_base.KVStoreBase.OPTIMIZER):
+                    raise MXNetError(
+                        f"kvstore {kv.type} cannot run the optimizer")
+                kv.set_optimizer(self._optimizer)
+                for i, p in enumerate(self._params):
+                    kv.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def kvstore(self):
+        self._init_kvstore()
+        return self._kvstore
+
+    # -- state ------------------------------------------------------------
+    def _init_states(self):
+        if self._states is None:
+            self._states = [
+                self._optimizer.create_state_multi_precision(i, p.data())
+                for i, p in enumerate(self._params)
+            ]
+
+    # -- core step --------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce grads, then optimizer update; grads scaled by
+        ``rescale_grad/batch_size`` (reference semantics)."""
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            # optimizer runs on the store (reference server-side update):
+            # push grads, pull updated weights — no local update
+            self._optimizer.rescale_grad = self._scale / batch_size
+            for i, p in enumerate(self._params):
+                kv = self._kvstore
+                kv.pushpull(i, p.list_grad(), out=p.list_data())
+            return
+        self._allreduce_grads()
+        self._update(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self):
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "allreduce_grads() is not applicable when update_on_kvstore")
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        kv = self._kvstore
+        if kv is None:
+            return
+        for i, p in enumerate(self._params):
+            grads = p.list_grad()
+            if len(grads) > 1:
+                if self._compression_params and hasattr(kv, "_compression"):
+                    compressed = [kv._compression.compress((i, j), g)
+                                  for j, g in enumerate(grads)]
+                    kv.pushpull(i, compressed, out=grads)
+                else:
+                    kv.pushpull(i, grads, out=grads)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._init_kvstore()
+        self._update(batch_size, ignore_stale_grad)
+
+    def _update(self, batch_size, ignore_stale_grad=False):  # pylint: disable=unused-argument
+        self._init_states()
+        scale = self._scale / batch_size
+        opt = self._optimizer
+        import numpy as _onp
+
+        fused_safe = getattr(opt, "fused_safe", True) and not (
+            opt.multi_precision
+            and any(p.dtype == _onp.float16 for p in self._params))
+        if not fused_safe:
+            # eager per-param path (reference semantics; needed for
+            # optimizers with python-side state or per-step RNG). The
+            # optimizer applies rescale_grad itself in _prep_grad, so hand
+            # it the combined scale instead of pre-multiplying.
+            self._step_count += 1
+            prev_rescale = opt.rescale_grad
+            opt.rescale_grad = scale
+            try:
+                for i, p in enumerate(self._params):
+                    opt.update_multi_precision(i, p.data(), p.grad(),
+                                               self._states[i])
+            finally:
+                opt.rescale_grad = prev_rescale
+            return
+        # one fused jitted update across all params (multi-tensor path)
+        import jax
+
+        if getattr(self, "_fused_scale", None) != scale:
+            self._fused = None  # batch size changed: rebuild closure
+        if self._fused is None:
+            def fused(pdatas, gdatas, sdatas, lrs, wds, t):
+                new_p = []
+                new_s = []
+                for pd, gd, sd, lr, wd in zip(pdatas, gdatas, sdatas, lrs, wds):
+                    g = gd.astype(pd.dtype) * scale
+                    if opt.clip_gradient is not None:
+                        import jax.numpy as jnp
+
+                        g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+                    np_, ns_ = opt._update_raw(pd, g, sd, lr, wd, t)
+                    new_p.append(np_)
+                    new_s.append(ns_)
+                return new_p, new_s
+
+            self._fused = jax.jit(fused, donate_argnums=(0, 2))
+            self._fused_scale = scale
+
+        self._step_count += 1
+        t = self._step_count
+        for i in range(len(self._params)):
+            opt._index_update_count[i] = t
+        pdatas = [p.data()._data for p in self._params]
+        gdatas = [p.grad()._data for p in self._params]
+        sdatas = [tuple(s._data for s in _flatten_state(st))
+                  for st in self._states]
+        lrs = [opt._get_lr(i) for i in range(len(self._params))]
+        wds = [opt._get_wd(i) for i in range(len(self._params))]
+        new_p, new_s = self._fused(pdatas, gdatas, sdatas, lrs, wds, t)
+        for p, np_ in zip(self._params, new_p):
+            p.data()._set_data_internal(np_)
+        for st, ns in zip(self._states, new_s):
+            for s, nsd in zip(_flatten_state(st), ns):
+                s._set_data_internal(nsd)
+
+    # -- persistence ------------------------------------------------------
+    def save_states(self, fname):
+        self._init_states()
+        import pickle
+
+        blob = {
+            "step": self._step_count,
+            "states": [
+                [s.asnumpy() for s in _flatten_state(st)] for st in self._states
+            ],
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(blob, f)
+
+    def load_states(self, fname):
+        self._init_states()
+        import pickle
+
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._step_count = blob["step"]
+        for st, arrs in zip(self._states, blob["states"]):
+            for s, a in zip(_flatten_state(st), arrs):
+                s._set_data_internal(NDArray(a)._data)
+
+
+def _flatten_state(st):
+    if st is None:
+        return ()
+    if isinstance(st, NDArray):
+        return (st,)
+    out = []
+    for s in st:
+        out.extend(_flatten_state(s))
+    return tuple(out)

@@ -1,0 +1,88 @@
+"""Gluon utilities (reference ``python/mxnet/gluon/utils.py``):
+``split_data``/``split_and_load`` for multi-device DP, grad clipping."""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..device import Context
+from ..ndarray.ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"cannot evenly split batch of {size} into {num_slice} slices")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(lo, hi)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch along batch_axis and load one slice per context."""
+    if not isinstance(data, NDArray):
+        data = NDArray(_onp.asarray(data))
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so their global L2 norm <= max_norm (reference util)."""
+    import jax.numpy as jnp
+
+    total = None
+    for a in arrays:
+        n = jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+        total = n if total is None else total + n
+    norm = float(jnp.sqrt(total))
+    if check_isfinite and not _onp.isfinite(norm):
+        import warnings
+
+        warnings.warn("nan or inf in clip_global_norm")
+        return norm
+    scale = max_norm / max(norm, max_norm)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data_internal(a._data * scale)
+    return norm
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):  # pragma: no cover
+    raise MXNetError(
+        "download() is unavailable in this zero-egress build; place files "
+        "locally and pass their path instead")
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+class HookHandle:
+    """Compat alias (reference gluon.utils.HookHandle)."""
+
+    def __init__(self, table=None, hid=None):
+        self._table = table
+        self._hid = hid
+
+    def detach(self):
+        if self._table is not None:
+            self._table.pop(self._hid, None)

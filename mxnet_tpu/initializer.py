@@ -1,0 +1,245 @@
+"""Weight initializers (reference ``python/mxnet/initializer.py``, 14 classes).
+
+Each initializer fills an NDArray in place given a fresh RNG key; shapes are
+interpreted with the reference's conventions (conv weight OIHW fan
+computation etc.).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _onp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    try:
+        return _REGISTRY[name.lower()](**kwargs)
+    except KeyError:
+        raise MXNetError(f"unknown initializer {name!r}") from None
+
+
+class Initializer:
+    """Base initializer; call via ``init(name_or_desc, arr)`` like reference."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr: NDArray):
+        self.init_weight(name, arr)
+
+    def init_weight(self, name, arr):
+        if name is None:
+            name = ""
+        if name.endswith("gamma"):
+            self._init_one(arr)
+        elif name.endswith("beta") or name.endswith("bias"):
+            self._init_zero(arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(arr)
+        else:
+            self._init_weight(name, arr)
+
+    @staticmethod
+    def _init_zero(arr):
+        import jax.numpy as jnp
+
+        arr._set_data_internal(jnp.zeros(arr.shape, arr.dtype))
+
+    @staticmethod
+    def _init_one(arr):
+        import jax.numpy as jnp
+
+        arr._set_data_internal(jnp.ones(arr.shape, arr.dtype))
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+def _fans(shape):
+    if len(shape) < 2:
+        return (shape[0] if shape else 1,) * 2
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+def _fill_random(arr, sampler):
+    from . import random as _rng
+    import jax.random as jr
+
+    key = _rng.next_key()
+    arr._set_data_internal(sampler(jr, key))
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(arr)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(arr)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        import jax.numpy as jnp
+
+        v = self.value
+        if isinstance(v, NDArray):
+            arr._set_data_internal(jnp.broadcast_to(v._data, arr.shape).astype(arr.dtype))
+        else:
+            arr._set_data_internal(jnp.full(arr.shape, v, arr.dtype))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        _fill_random(arr, lambda jr, k: jr.uniform(
+            k, arr.shape, arr.dtype if _onp.issubdtype(arr.dtype, _onp.floating) else _onp.float32,
+            -self.scale, self.scale))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        _fill_random(arr, lambda jr, k: jr.normal(k, arr.shape, arr.dtype) * self.sigma)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        import jax.random as jr
+        from . import random as _rng
+
+        key = _rng.next_key()
+        flat = (arr.shape[0], int(_onp.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1)
+        q = jr.orthogonal(key, max(flat)).astype(arr.dtype)
+        q = q[: flat[0], : flat[1]]
+        arr._set_data_internal((self.scale * q).reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        fan_in, fan_out = _fans(arr.shape)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"bad factor_type {self.factor_type!r}")
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            _fill_random(arr, lambda jr, k: jr.uniform(k, arr.shape, arr.dtype,
+                                                       -scale, scale))
+        elif self.rnd_type == "gaussian":
+            _fill_random(arr, lambda jr, k: jr.normal(k, arr.shape, arr.dtype) * scale)
+        else:
+            raise MXNetError(f"bad rnd_type {self.rnd_type!r}")
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        import jax.numpy as jnp
+
+        weight = _onp.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = shape[3] / 2.0
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data_internal(jnp.asarray(weight, arr.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        import jax.numpy as jnp
+
+        b = _onp.zeros(arr.shape, "float32")
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden: 2 * num_hidden] = self.forget_bias
+        arr._set_data_internal(jnp.asarray(b, arr.dtype))
+
+
+@register
+class InitDesc(str):  # pragma: no cover - reference API surface
+    pass
+
+
+# name-style aliases the reference accepts in create()
+_REGISTRY.update(
+    zeros=Zero,
+    ones=One,
+    xavier=Xavier,
+    msra=MSRAPrelu,
+    uniform=Uniform,
+    normal=Normal,
+    orthogonal=Orthogonal,
+    bilinear=Bilinear,
+    constant=Constant,
+    lstmbias=LSTMBias,
+)

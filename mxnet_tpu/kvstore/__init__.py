@@ -1,0 +1,9 @@
+"""``mx.kv`` — key-value stores for distributed training (SURVEY.md §2.3)."""
+from __future__ import annotations
+
+from .base import KVStoreBase, create
+from .kvstore_local import KVStoreDevice, KVStoreLocal
+from .dist_tpu import KVStoreDistTPUSync, measure_pushpull_bandwidth
+from .gradient_compression import GradientCompression
+
+KVStore = KVStoreBase

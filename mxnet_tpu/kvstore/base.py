@@ -1,0 +1,80 @@
+"""KVStore plugin ABI (reference ``python/mxnet/kvstore/base.py:74-329``).
+
+``KVStoreBase.register`` string-dispatches backends; the reference ships
+``local/device/nccl/dist_sync/...`` in C++ plus Horovod/BytePS Python
+plugins. The TPU build's backends:
+
+  * ``local`` / ``device`` — single-process reduce (``kvstore_local.py``)
+  * ``dist_tpu_sync`` / ``dist_device_sync`` / ``dist_sync`` — XLA
+    collectives over the device mesh (``dist_tpu.py``), replacing the
+    ps-lite parameter server (SURVEY.md §3.4 TPU mapping)
+  * ``horovod`` / ``byteps`` — present-but-gated stubs
+  * ``dist_async`` — raises ``NotSupportedForTPUError`` (no TPU analog)
+"""
+from __future__ import annotations
+
+from ..base import MXNetError, NotSupportedForTPUError
+
+_BACKENDS = {}
+
+
+class KVStoreBase:
+    """Abstract key-value store for parameter synchronization."""
+
+    OPTIMIZER = "optimizer"
+
+    # -- plugin registry --------------------------------------------------
+    @staticmethod
+    def register(klass):
+        name = getattr(klass, "NAME", klass.__name__).lower()
+        _BACKENDS[name] = klass
+        return klass
+
+    # -- required API -----------------------------------------------------
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability):  # pylint: disable=unused-argument
+        return False
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+
+def create(name="local", **kwargs):
+    """Create a KVStore backend by name (reference ``kvstore.cc:55-85``)."""
+    name = name.lower()
+    if name == "dist_async" or name == "p3":
+        raise NotSupportedForTPUError(
+            f"KVStore type {name!r} (asynchronous parameter server) has no "
+            "TPU analog: SPMD training over ICI is synchronous by "
+            "construction. Use 'dist_tpu_sync'. (SURVEY.md §7 hard-parts 5)")
+    # aliases: all dist-sync flavors map to the mesh-collective store
+    aliases = {
+        "dist_sync": "dist_tpu_sync",
+        "dist_device_sync": "dist_tpu_sync",
+        "dist": "dist_tpu_sync",
+        "nccl": "device",
+    }
+    name = aliases.get(name, name)
+    if name not in _BACKENDS:
+        # lazy-import built-in backends
+        from . import kvstore_local  # noqa: F401
+        from . import dist_tpu  # noqa: F401
+        from . import horovod  # noqa: F401
+        from . import byteps  # noqa: F401
+    try:
+        klass = _BACKENDS[name]
+    except KeyError:
+        raise MXNetError(f"unknown KVStore type {name!r}; "
+                         f"registered: {sorted(_BACKENDS)}") from None
+    return klass(**kwargs)

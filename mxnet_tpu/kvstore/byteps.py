@@ -1,0 +1,19 @@
+"""BytePS KVStore backend stub (reference ``python/mxnet/kvstore/byteps.py``).
+
+RDMA-optimized parameter server; meaningless on a TPU pod (ICI replaces the
+PS fabric). Registered for ABI parity, raises with guidance.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .base import KVStoreBase
+
+
+@KVStoreBase.register
+class BytePS(KVStoreBase):
+    NAME = "byteps"
+
+    def __init__(self):
+        raise MXNetError(
+            "byteps is not available in this build; on TPU use "
+            "kv.create('dist_tpu_sync')")

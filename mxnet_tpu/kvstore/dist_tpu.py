@@ -1,0 +1,188 @@
+"""``dist_tpu_sync``: the TPU-native distributed KVStore.
+
+This is the BASELINE.json north-star component: it replaces the reference's
+ps-lite parameter-server push/pull (``src/kvstore/kvstore_dist.h`` workers ↔
+``kvstore_dist_server.h`` servers over a ZMQ van) with XLA collectives over
+ICI/DCN. There are no scheduler/server roles: every process is an SPMD
+worker (``jax.distributed``), and ``pushpull`` is a compiled ``psum``.
+
+Mapping (SURVEY.md §3.4):
+  worker local reduce (Comm)        -> part of the same jitted psum
+  ZPushPull to sharded servers      -> all-reduce over the mesh 'dp' axis
+  server ApplyUpdates (sync wait)   -> collective is the barrier
+  EncodeDefaultKey sharding         -> reduce_scatter option (ZeRO-style)
+
+Two operating modes:
+  * replicated arrays (one per device / per-process): ``pushpull`` jit-psums
+    the stack — used by ``gluon.Trainer`` for MXNet-style per-device lists.
+  * mesh-sharded ``jax.Array``s (the native path): grads computed inside a
+    ``pjit`` with a sharded batch axis already arrive reduced; pushpull is
+    then an identity with sharding assertions (XLA inserted the collective).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase
+from .kvstore_local import KVStoreLocal, _normalize_grouped
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+@KVStoreBase.register
+class KVStoreDistTPUSync(KVStoreLocal):
+    NAME = "dist_tpu_sync"
+
+    def __init__(self, mesh=None, axis="dp"):
+        super().__init__()
+        from ..parallel import mesh as mesh_mod
+
+        self._mesh = mesh if mesh is not None else mesh_mod.get_mesh(create=True)
+        self._axis = axis if (self._mesh is None or axis in self._mesh.axis_names) \
+            else self._mesh.axis_names[0]
+        self._allreduce_jit = None
+
+    # -- cluster shape ----------------------------------------------------
+    @property
+    def rank(self):
+        return _jax().process_index()
+
+    @property
+    def num_workers(self):
+        return _jax().process_count()
+
+    @property
+    def num_devices(self):
+        return self._mesh.size if self._mesh is not None else len(_jax().devices())
+
+    @property
+    def type(self):
+        return self.NAME
+
+    def barrier(self):
+        """Reference: ps-lite Barrier. Here: a tiny psum over the mesh."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._mesh is None:
+            return
+        mesh = self._mesh
+        x = jax.device_put(
+            jnp.ones((mesh.size,), jnp.int32),
+            NamedSharding(mesh, P(mesh.axis_names)))
+        total = jax.jit(
+            lambda v: v.sum(), out_shardings=NamedSharding(mesh, P()))(x)
+        total.block_until_ready()
+
+    # -- collectives ------------------------------------------------------
+    def allreduce(self, arrays):
+        """Sum a list of per-device NDArrays into identical replicas.
+
+        The list is stacked onto the mesh axis and summed under jit with a
+        replicated out-sharding — one XLA all-reduce over ICI.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if len(arrays) == 1:
+            return arrays
+        stacked = jnp.stack([a._data for a in arrays])
+        summed = jnp.sum(stacked, axis=0)
+        out = []
+        for a in arrays:
+            dev = list(a._data.devices())[0]
+            out.append(NDArray(jax.device_put(summed, dev)))
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):  # pylint: disable=unused-argument
+        keys, values = _normalize_grouped(key, value)
+        _, outs = _normalize_grouped(key, out)
+        for k, vals, dsts in zip(keys, values, outs):
+            if vals is not None and len(vals) > 1:
+                reduced = self.allreduce(vals)
+            else:
+                reduced = vals
+            if dsts is None:
+                self._store[k] = reduced[0]
+                continue
+            if len(reduced) == len(dsts):
+                for r, d in zip(reduced, dsts):
+                    d._set_data_internal(r._data)
+            else:
+                for d in dsts:
+                    reduced[0].copyto(d)
+
+    def broadcast(self, key, value, out, priority=0):
+        """Replicate rank-0 value to all devices (reference Broadcast)."""
+        keys, values = _normalize_grouped(key, value)
+        _, outs = _normalize_grouped(key, out)
+        import jax
+
+        for k, vals, dsts in zip(keys, values, outs):
+            src = vals[0]
+            self._store[k] = src
+            if dsts is None:
+                continue
+            for d in dsts:
+                dev = list(d._data.devices())[0]
+                d._set_data_internal(jax.device_put(src._data, dev))
+
+    # -- sharded-native helpers -------------------------------------------
+    def shard(self, array: NDArray, spec):
+        """Place an NDArray onto the mesh with a PartitionSpec."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        return NDArray(jax.device_put(array._data,
+                                      NamedSharding(self._mesh, spec)))
+
+    def reduce_scatter(self, array: NDArray, axis=0):
+        """ZeRO-style sharded reduce (reference EncodeDefaultKey slicing)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = [None] * array.ndim
+        spec[axis] = self._axis
+        return NDArray(jax.jit(
+            lambda x: x,
+            out_shardings=NamedSharding(self._mesh, P(*spec)))(array._data))
+
+    @staticmethod
+    def is_capable(capability):
+        # optimizer runs on workers (update_on_kvstore=False), like Horovod
+        return False
+
+
+# push/pull bandwidth probe used by bench.py and tools/bandwidth parity
+def measure_pushpull_bandwidth(size_mb=64, iters=10, mesh=None):
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel import mesh as mesh_mod
+
+    mesh = mesh or mesh_mod.get_mesh(create=True)
+    n = mesh.size
+    nfloat = int(size_mb * 1024 * 1024 // 4)
+    x = jax.device_put(
+        jnp.ones((n, nfloat), jnp.float32),
+        NamedSharding(mesh, P(mesh.axis_names[0], None)))
+    f = jax.jit(lambda v: jnp.broadcast_to(v.sum(0), v.shape),
+                out_shardings=NamedSharding(mesh, P(mesh.axis_names[0], None)))
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x = f(x)
+    x.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    # ring all-reduce moves 2*(n-1)/n of the data per device
+    bytes_moved = 2 * (n - 1) / n * nfloat * 4
+    return bytes_moved / dt / 1e9  # GB/s per device

@@ -1,0 +1,36 @@
+"""2-bit gradient compression with error feedback.
+
+Reference: ``src/kvstore/gradient_compression.{h,cc,cu}`` — quantizes pushes
+to 2 bits/value with a residual buffer. On TPU the same transform is a pair
+of jitted ops; useful over DCN (cross-slice) links, pointless over ICI.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+
+class GradientCompression:
+    def __init__(self, type="2bit", threshold=0.5):  # pylint: disable=redefined-builtin
+        if type != "2bit":
+            raise MXNetError(f"unsupported compression type {type!r}")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residual = {}
+
+    def compress(self, key, grad: NDArray) -> NDArray:
+        """Quantize to {-threshold, 0, +threshold} with error feedback."""
+        import jax.numpy as jnp
+
+        res = self._residual.get(key)
+        g = grad._data if res is None else grad._data + res
+        thr = self.threshold
+        q = jnp.where(g >= thr, thr, jnp.where(g <= -thr, -thr, 0.0)).astype(g.dtype)
+        self._residual[key] = g - q
+        return NDArray(q)
+
+    def decompress(self, key, compressed: NDArray) -> NDArray:  # pylint: disable=unused-argument
+        return compressed
+
+    def get_params(self):
+        return {"type": self.type, "threshold": self.threshold}

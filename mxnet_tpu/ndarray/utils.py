@@ -1,0 +1,74 @@
+"""NDArray serialization: ``mx.nd.save`` / ``mx.nd.load``.
+
+Reference format: magic-tagged binary written by ``MXNDArraySave``
+(``src/c_api/c_api.cc:1859``, ``src/ndarray/ndarray.cc`` Save/Load). The TPU
+build defines its own container — a zip of raw little-endian tensors plus a
+JSON manifest (shape/dtype/name) — readable without the framework. The file
+extension/semantics (list or dict of arrays) match the reference API.
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import numpy as _np
+
+from ..base import MXNetError
+
+_MAGIC = "MXTPU_NDARRAY_V1"
+
+
+def save(fname, data):
+    """Save a list or str->NDArray dict of arrays to ``fname``."""
+    from .ndarray import NDArray
+
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        items = [(str(i), a) for i, a in enumerate(data)]
+        keyed = False
+    elif isinstance(data, dict):
+        items = list(data.items())
+        keyed = True
+    else:
+        raise MXNetError("save expects NDArray, list, or dict of NDArrays")
+
+    manifest = {"magic": _MAGIC, "keyed": keyed, "tensors": []}
+    with zipfile.ZipFile(fname, "w", zipfile.ZIP_STORED) as zf:
+        for i, (name, arr) in enumerate(items):
+            host = arr.asnumpy()
+            manifest["tensors"].append(
+                {"name": name, "shape": list(host.shape),
+                 "dtype": host.dtype.name, "file": f"t{i}.bin"}
+            )
+            zf.writestr(f"t{i}.bin", host.tobytes())
+        zf.writestr("manifest.json", json.dumps(manifest))
+
+
+def load(fname):
+    """Load arrays saved by :func:`save`; returns list or dict as saved."""
+    from .ndarray import NDArray
+
+    with zipfile.ZipFile(fname, "r") as zf:
+        manifest = json.loads(zf.read("manifest.json"))
+        if manifest.get("magic") != _MAGIC:
+            raise MXNetError(f"{fname}: not a mxnet_tpu NDArray file")
+        out = []
+        for t in manifest["tensors"]:
+            raw = zf.read(t["file"])
+            host = _np.frombuffer(raw, dtype=t["dtype"]).reshape(t["shape"])
+            out.append((t["name"], NDArray(host.copy())))
+    if manifest["keyed"]:
+        return dict(out)
+    return [a for _, a in out]
+
+
+def save_parameters_buffer(params: dict) -> bytes:
+    buf = io.BytesIO()
+    save(buf, params)
+    return buf.getvalue()
+
+
+def load_parameters_buffer(raw: bytes) -> dict:
+    return load(io.BytesIO(raw))

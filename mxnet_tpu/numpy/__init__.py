@@ -1,0 +1,391 @@
+"""``mx.np``: the NumPy-compatible array API.
+
+Reference: ``python/mxnet/numpy/multiarray.py`` (12k LoC of hand-written
+wrappers over the ``_npi`` C ops) plus the ``src/operator/numpy/`` kernels
+(43k LoC, SURVEY.md §2.2). Here every op lowers to ``jax.numpy`` — kernel
+selection/fusion is XLA's job — so the namespace is *generated* from a table,
+the same move the reference makes when it synthesizes ``mx.nd.*`` from the C
+op registry at import (``python/mxnet/ndarray/register.py:115-265``).
+
+All functions accept/return :class:`~mxnet_tpu.ndarray.ndarray.NDArray` and
+participate in autograd recording through the dispatch layer
+(``mxnet_tpu.ops.registry.apply``).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..device import Context, current_context
+from ..ndarray.ndarray import NDArray, _to_jax
+from ..ops import registry as _registry
+
+ndarray = NDArray
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# dtype aliases (jax dtypes; x64 is enabled at package init for parity with
+# the reference's int64/float64 tensor support, libinfo INT64_TENSOR_SIZE)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+
+
+def _bfloat16():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16
+
+
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+euler_gamma = _onp.euler_gamma
+
+_dtype = _onp.dtype
+dtype = _onp.dtype
+
+
+def _pop_ctx(kwargs):
+    ctx = kwargs.pop("ctx", None)
+    dev = kwargs.pop("device", None)
+    return ctx if ctx is not None else dev
+
+
+# ---------------------------------------------------------------------------
+# Creation ops (run eagerly on the target device)
+# ---------------------------------------------------------------------------
+
+
+def array(object, dtype=None, ctx=None, device=None, copy=True):  # pylint: disable=redefined-builtin,unused-argument
+    return NDArray(_to_jax(object, dtype=dtype, ctx=ctx or device))
+
+
+def _creation(fn_name):
+    def f(*args, **kwargs):
+        ctx = _pop_ctx(kwargs)
+        import jax
+
+        jfn = getattr(_jnp(), fn_name)
+        out = jfn(*args, **kwargs)
+        if ctx is not None:
+            out = jax.device_put(out, ctx.jax_device())
+        else:
+            out = jax.device_put(out, current_context().jax_device())
+        return NDArray(out)
+
+    f.__name__ = fn_name
+    return f
+
+
+def zeros(shape, dtype=float32, order="C", ctx=None, device=None):  # pylint: disable=unused-argument
+    return _eager_create(_jnp().zeros, shape, dtype or float32, ctx or device)
+
+
+def ones(shape, dtype=float32, order="C", ctx=None, device=None):  # pylint: disable=unused-argument
+    return _eager_create(_jnp().ones, shape, dtype or float32, ctx or device)
+
+
+def empty(shape, dtype=float32, order="C", ctx=None, device=None):  # pylint: disable=unused-argument
+    return _eager_create(_jnp().zeros, shape, dtype or float32, ctx or device)
+
+
+def _eager_create(jfn, shape, dt, ctx):
+    import jax
+
+    out = jfn(shape, dt)
+    out = jax.device_put(out, (ctx or current_context()).jax_device())
+    return NDArray(out)
+
+
+def full(shape, fill_value, dtype=None, ctx=None, device=None, out=None):
+    import jax
+
+    fv = fill_value._data if isinstance(fill_value, NDArray) else fill_value
+    res = _jnp().full(shape, fv, dtype)
+    res = jax.device_put(res, ((ctx or device) or current_context()).jax_device())
+    res = NDArray(res)
+    if out is not None:
+        out._set_data_internal(res._data)
+        return out
+    return res
+
+
+def zeros_like(a, dtype=None, ctx=None, device=None):
+    return _like(_jnp().zeros_like, a, dtype, ctx or device)
+
+
+def ones_like(a, dtype=None, ctx=None, device=None):
+    return _like(_jnp().ones_like, a, dtype, ctx or device)
+
+
+def empty_like(a, dtype=None, ctx=None, device=None):
+    return _like(_jnp().zeros_like, a, dtype, ctx or device)
+
+
+def full_like(a, fill_value, dtype=None, ctx=None, device=None):
+    import jax
+
+    d = a._data if isinstance(a, NDArray) else _to_jax(a)
+    out = _jnp().full_like(d, fill_value, dtype)
+    if ctx is not None:
+        out = jax.device_put(out, ctx.jax_device())
+    return NDArray(out)
+
+
+def _like(jfn, a, dt, ctx):
+    import jax
+
+    d = a._data if isinstance(a, NDArray) else _to_jax(a)
+    out = jfn(d, dt)
+    if ctx is not None:
+        out = jax.device_put(out, ctx.jax_device())
+    return NDArray(out)
+
+
+arange = _creation("arange")
+linspace = _creation("linspace")
+logspace = _creation("logspace")
+eye = _creation("eye")
+identity = _creation("identity")
+tri = _creation("tri")
+
+
+def meshgrid(*xi, **kwargs):
+    datas = [x._data if isinstance(x, NDArray) else _to_jax(x) for x in xi]
+    return [NDArray(o) for o in _jnp().meshgrid(*datas, **kwargs)]
+
+
+def indices(dimensions, dtype=int64, ctx=None, device=None):  # pylint: disable=unused-argument
+    return NDArray(_jnp().indices(dimensions, dtype))
+
+
+# ---------------------------------------------------------------------------
+# Generic wrapper machinery
+# ---------------------------------------------------------------------------
+
+
+def _wrap(jfn, name, record=True):
+    """Wrap a jax.numpy function into an NDArray-aware, autograd-aware op."""
+
+    def f(*args, **kwargs):
+        import jax
+
+        out = kwargs.pop("out", None)
+        where = kwargs.pop("where", None)
+        if where is not None:
+            kwargs["where"] = where._data if isinstance(where, NDArray) else where
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, NDArray)
+        )
+        arr_pos = [i for i, l in enumerate(leaves) if isinstance(l, NDArray)]
+
+        def closed(*xs):
+            nl = list(leaves)
+            for p, x in zip(arr_pos, xs):
+                nl[p] = x
+            a, k = jax.tree_util.tree_unflatten(treedef, nl)
+            return jfn(*a, **k)
+
+        arrays = tuple(leaves[i] for i in arr_pos)
+        if out is not None:
+            return _registry.apply_out(closed, arrays, name=name, out=out)
+        return _registry.apply(closed, arrays, name=name, record=record)
+
+    f.__name__ = name
+    f.__qualname__ = name
+    f.__doc__ = f"NumPy-compatible `{name}` (lowers to jax.numpy.{name})."
+    return f
+
+
+# Differentiable math/shape ops generated straight from jax.numpy.
+_DIFF_OPS = """
+add subtract multiply divide true_divide floor_divide mod remainder power
+float_power fmod negative positive reciprocal abs absolute fabs sign
+rint fix trunc
+exp expm1 exp2 log log2 log10 log1p sqrt cbrt square
+sin cos tan arcsin arccos arctan arctan2 sinh cosh tanh arcsinh arccosh
+arctanh hypot deg2rad rad2deg degrees radians
+maximum minimum fmax fmin clip
+sum mean prod std var amax amin max min nansum nanmean nanprod
+cumsum cumprod nancumsum nancumprod
+dot vdot inner outer matmul tensordot einsum kron cross trace
+reshape ravel transpose swapaxes moveaxis rollaxis expand_dims squeeze
+concatenate stack vstack hstack dstack column_stack atleast_1d atleast_2d
+atleast_3d broadcast_to broadcast_arrays
+split array_split vsplit hsplit dsplit
+flip fliplr flipud roll rot90 repeat tile pad
+diag diagonal diagflat tril triu
+take take_along_axis compress
+where
+real imag conj conjugate
+heaviside copysign nan_to_num
+ldexp
+logaddexp logaddexp2
+sinc i0
+ediff1d gradient diff interp
+average median nanmedian percentile nanpercentile quantile nanquantile
+ptp round around floor ceil
+matvec vecdot vecmat
+"""
+
+# Non-differentiable / index-valued / predicate ops.
+_NONDIFF_OPS = """
+argmax argmin nanargmax nanargmin argsort sort lexsort searchsorted
+count_nonzero nonzero flatnonzero
+equal not_equal less less_equal greater greater_equal
+logical_and logical_or logical_not logical_xor
+isnan isinf isfinite isneginf isposinf isclose allclose array_equal
+bitwise_and bitwise_or bitwise_xor bitwise_not invert left_shift right_shift
+floor_divide_nondiff
+all any
+signbit
+unique bincount digitize histogram histogram2d
+may_share_memory shares_memory
+result_type can_cast promote_types
+isscalar ndim size shape iscomplexobj isrealobj
+topk_absent
+"""
+
+
+def _install(namespace, names, record):
+    jnp = _jnp()
+    for nm in names.split():
+        if nm.endswith("_absent") or nm.endswith("_nondiff"):
+            continue
+        jfn = getattr(jnp, nm, None)
+        if jfn is None:
+            continue
+        if nm not in namespace:
+            namespace[nm] = _wrap(jfn, nm, record=record)
+
+
+_install(globals(), _DIFF_OPS, record=True)
+_install(globals(), _NONDIFF_OPS, record=False)
+
+
+# a few names needing special handling -------------------------------------
+
+
+def asnumpy(a):
+    return a.asnumpy() if isinstance(a, NDArray) else _onp.asarray(a)
+
+
+def asarray(a, dtype=None, ctx=None, device=None):
+    if isinstance(a, NDArray) and dtype is None and ctx is None and device is None:
+        return a
+    return array(a, dtype=dtype, ctx=ctx, device=device)
+
+
+ascontiguousarray = asarray
+
+
+def copy(a):
+    return a.copy() if isinstance(a, NDArray) else array(a)
+
+
+def astype(a, dtype):
+    return a.astype(dtype)
+
+
+def may_broadcast(*shapes):
+    try:
+        _onp.broadcast_shapes(*shapes)
+        return True
+    except ValueError:
+        return False
+
+
+broadcast_shapes = _onp.broadcast_shapes
+
+
+def delete(arr, obj, axis=None):
+    o = obj._data if isinstance(obj, NDArray) else obj
+    return _wrap(_jnp().delete, "delete")(arr, o, axis=axis)
+
+
+def insert(arr, obj, values, axis=None):
+    return _wrap(_jnp().insert, "insert")(arr, obj, values, axis=axis)
+
+
+def append(arr, values, axis=None):
+    return _wrap(_jnp().append, "append")(arr, values, axis=axis)
+
+
+def squeeze(a, axis=None):
+    return a.squeeze(axis) if isinstance(a, NDArray) else array(a).squeeze(axis)
+
+
+def tril_indices(n, k=0, m=None):
+    r, c = _jnp().tril_indices(n, k, m)
+    return NDArray(r), NDArray(c)
+
+
+def triu_indices(n, k=0, m=None):
+    r, c = _jnp().triu_indices(n, k, m)
+    return NDArray(r), NDArray(c)
+
+
+def unravel_index(indices_, shape):
+    idx = indices_._data if isinstance(indices_, NDArray) else indices_
+    return tuple(NDArray(x) for x in _jnp().unravel_index(idx, shape))
+
+
+def ravel_multi_index(multi_index, dims, mode="raise", order="C"):
+    mi = tuple(m._data if isinstance(m, NDArray) else m for m in multi_index)
+    return NDArray(_jnp().ravel_multi_index(mi, dims, order=order))
+
+
+def bool_mask(data, mask):
+    """Boolean masking (dynamic output shape — forces host sync on shape)."""
+    return data[mask]
+
+
+def moveaxis_(a, source, destination):
+    return _wrap(_jnp().moveaxis, "moveaxis")(a, source, destination)
+
+
+def swapaxes(a, axis1, axis2):
+    return a.swapaxes(axis1, axis2)
+
+
+def expand_dims_(a, axis):
+    return a.expand_dims(axis)
+
+
+def flatnonzero_(a):
+    return _wrap(_jnp().flatnonzero, "flatnonzero", record=False)(a)
+
+
+def apply_along_axis(func1d, axis, arr, *args, **kwargs):
+    res = _onp.apply_along_axis(
+        lambda x: asnumpy(func1d(array(x), *args, **kwargs)), axis, asnumpy(arr))
+    return array(res)
+
+
+# linalg / random / fft submodules ------------------------------------------
+from . import linalg  # noqa: E402
+from . import random  # noqa: E402
+from . import fft  # noqa: E402
+
+_sys.modules[__name__ + ".linalg"] = linalg
+_sys.modules[__name__ + ".random"] = random
+_sys.modules[__name__ + ".fft"] = fft

@@ -1,0 +1,36 @@
+"""``mx.np.fft`` — lowers to ``jax.numpy.fft``.
+
+The reference has no FFT operator family (SURVEY.md §2.2 notes "fft-absent");
+included here because XLA provides it natively and the NumPy API expects it.
+"""
+from __future__ import annotations
+
+
+def _wrap(name):
+    from ..ops import registry as _registry
+    from ..ndarray.ndarray import NDArray
+
+    def f(a, *args, **kwargs):
+        import jax.numpy as jnp
+
+        jfn = getattr(jnp.fft, name)
+        return _registry.apply(
+            lambda x: jfn(x, *args, **kwargs),
+            (a if isinstance(a, tuple) else (a,)),
+            name="fft." + name,
+        )
+
+    f.__name__ = name
+    return f
+
+
+fft = _wrap("fft")
+ifft = _wrap("ifft")
+fft2 = _wrap("fft2")
+ifft2 = _wrap("ifft2")
+fftn = _wrap("fftn")
+ifftn = _wrap("ifftn")
+rfft = _wrap("rfft")
+irfft = _wrap("irfft")
+fftshift = _wrap("fftshift")
+ifftshift = _wrap("ifftshift")

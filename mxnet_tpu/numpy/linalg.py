@@ -1,0 +1,60 @@
+"""``mx.np.linalg`` — lowers to ``jax.numpy.linalg`` / ``jax.lax.linalg``.
+
+Reference kernels: ``src/operator/numpy/linalg/`` and the legacy ``la_op``
+family (potrf/gelqf/syrk..., ``src/operator/tensor/la_op.cc``). On TPU these
+are XLA's decomposition ops; no hand-written kernels needed.
+"""
+from __future__ import annotations
+
+
+def _jla():
+    import jax.numpy as jnp
+
+    return jnp.linalg
+
+
+def _wrap(name, record=True):
+    from ..ops import registry as _registry
+    from ..ndarray.ndarray import NDArray
+    import jax
+
+    def f(*args, **kwargs):
+        jfn = getattr(_jla(), name)
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, NDArray))
+        arr_pos = [i for i, l in enumerate(leaves) if isinstance(l, NDArray)]
+
+        def closed(*xs):
+            nl = list(leaves)
+            for p, x in zip(arr_pos, xs):
+                nl[p] = x
+            a, k = jax.tree_util.tree_unflatten(treedef, nl)
+            return jfn(*a, **k)
+
+        return _registry.apply(closed, tuple(leaves[i] for i in arr_pos),
+                               name="linalg." + name, record=record)
+
+    f.__name__ = name
+    return f
+
+
+norm = _wrap("norm")
+svd = _wrap("svd")
+cholesky = _wrap("cholesky")
+qr = _wrap("qr")
+inv = _wrap("inv")
+pinv = _wrap("pinv")
+det = _wrap("det")
+slogdet = _wrap("slogdet")
+solve = _wrap("solve")
+lstsq = _wrap("lstsq", record=False)
+eig = _wrap("eig", record=False)
+eigh = _wrap("eigh")
+eigvals = _wrap("eigvals", record=False)
+eigvalsh = _wrap("eigvalsh")
+matrix_rank = _wrap("matrix_rank", record=False)
+matrix_power = _wrap("matrix_power")
+multi_dot = _wrap("multi_dot")
+tensorinv = _wrap("tensorinv")
+tensorsolve = _wrap("tensorsolve")
+cond = _wrap("cond", record=False)

@@ -1,0 +1,206 @@
+"""``mx.np.random`` — stateful sampling API over ``jax.random``.
+
+Reference: ``python/mxnet/numpy/random.py`` + sampler kernels
+``src/operator/random/`` (3,919 LoC) drawing from per-device engine RNG
+resources. Here each draw consumes a fresh split of the global key
+(``mxnet_tpu.random``); inside a hybridized trace draws come from a traced
+key input so compiled graphs stay stochastic across calls.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .. import random as _rng
+from ..device import current_context
+from ..ndarray.ndarray import NDArray
+
+
+def _jr():
+    import jax.random as jr
+
+    return jr
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _place(data, ctx):
+    import jax
+
+    if ctx is not None and not _rng.in_trace():
+        data = jax.device_put(data, ctx.jax_device())
+    return NDArray(data)
+
+
+def _size(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def seed(s):
+    _rng.seed(s)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    dtype = dtype or _onp.float32
+    low_ = low._data if isinstance(low, NDArray) else low
+    high_ = high._data if isinstance(high, NDArray) else high
+    data = _jr().uniform(_rng.next_key(), _size(size), dtype=dtype,
+                         minval=low_, maxval=high_)
+    res = _place(data, ctx or device or current_context())
+    if out is not None:
+        out._set_data_internal(res._data)
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    dtype = dtype or _onp.float32
+    loc_ = loc._data if isinstance(loc, NDArray) else loc
+    scale_ = scale._data if isinstance(scale, NDArray) else scale
+    data = _jr().normal(_rng.next_key(), _size(size), dtype=dtype) * scale_ + loc_
+    res = _place(data, ctx or device or current_context())
+    if out is not None:
+        out._set_data_internal(res._data)
+        return out
+    return res
+
+
+def randn(*size, dtype=None, ctx=None, device=None):
+    return normal(0.0, 1.0, size=size, dtype=dtype, ctx=ctx, device=device)
+
+
+def rand(*size, dtype=None, ctx=None):
+    return uniform(0.0, 1.0, size=size, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, device=None):
+    if high is None:
+        low, high = 0, low
+    dtype = dtype or _onp.int64
+    data = _jr().randint(_rng.next_key(), _size(size), low, high, dtype=dtype)
+    return _place(data, ctx or device or current_context())
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, device=None):
+    a_ = a._data if isinstance(a, NDArray) else a
+    if isinstance(a_, int):
+        a_ = _jnp().arange(a_)
+    p_ = p._data if isinstance(p, NDArray) else p
+    data = _jr().choice(_rng.next_key(), a_, _size(size), replace=replace, p=p_)
+    return _place(data, ctx or device or current_context())
+
+
+def permutation(x, ctx=None):
+    x_ = x._data if isinstance(x, NDArray) else x
+    if isinstance(x_, int):
+        x_ = _jnp().arange(x_)
+    return _place(_jr().permutation(_rng.next_key(), x_), ctx or current_context())
+
+
+def shuffle(x: NDArray):
+    """In-place shuffle along the first axis (reference ``_npi_shuffle``)."""
+    x._set_data_internal(_jr().permutation(_rng.next_key(), x._data, axis=0))
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or _onp.float32
+    sh = shape._data if isinstance(shape, NDArray) else shape
+    sc = scale._data if isinstance(scale, NDArray) else scale
+    data = _jr().gamma(_rng.next_key(), sh, _size(size), dtype=dtype) * sc
+    return _place(data, ctx or device or current_context())
+
+
+def beta(a, b, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or _onp.float32
+    a_ = a._data if isinstance(a, NDArray) else a
+    b_ = b._data if isinstance(b, NDArray) else b
+    return _place(_jr().beta(_rng.next_key(), a_, b_, _size(size), dtype=dtype),
+                  ctx or device or current_context())
+
+
+def exponential(scale=1.0, size=None, ctx=None, device=None):
+    data = _jr().exponential(_rng.next_key(), _size(size)) * scale
+    return _place(data, ctx or device or current_context())
+
+
+def poisson(lam=1.0, size=None, ctx=None, device=None):
+    lam_ = lam._data if isinstance(lam, NDArray) else lam
+    return _place(_jr().poisson(_rng.next_key(), lam_, _size(size)),
+                  ctx or device or current_context())
+
+
+def multinomial(n, pvals, size=None):
+    pv = pvals._data if isinstance(pvals, NDArray) else _jnp().asarray(pvals)
+    shape = _size(size)
+    counts = _jr().multinomial(_rng.next_key(), n, pv,
+                               shape=shape + pv.shape[:-1] if shape else None)
+    return NDArray(counts)
+
+
+def bernoulli(prob=0.5, size=None, dtype=None, ctx=None, device=None):
+    p_ = prob._data if isinstance(prob, NDArray) else prob
+    data = _jr().bernoulli(_rng.next_key(), p_, _size(size) or None)
+    if dtype is not None:
+        data = data.astype(dtype)
+    return _place(data, ctx or device or current_context())
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or _onp.float32
+    data = _jr().laplace(_rng.next_key(), _size(size), dtype=dtype) * scale + loc
+    return _place(data, ctx or device or current_context())
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or _onp.float32
+    data = _jr().gumbel(_rng.next_key(), _size(size), dtype=dtype) * scale + loc
+    return _place(data, ctx or device or current_context())
+
+
+def logistic(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or _onp.float32
+    data = _jr().logistic(_rng.next_key(), _size(size), dtype=dtype) * scale + loc
+    return _place(data, ctx or device or current_context())
+
+
+def chisquare(df, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or _onp.float32
+    df_ = df._data if isinstance(df, NDArray) else df
+    data = 2.0 * _jr().gamma(_rng.next_key(), df_ / 2.0, _size(size), dtype=dtype)
+    return _place(data, ctx or device or current_context())
+
+
+def pareto(a, size=None, ctx=None, device=None):
+    a_ = a._data if isinstance(a, NDArray) else a
+    data = _jr().pareto(_rng.next_key(), a_, _size(size)) - 1.0
+    return _place(data, ctx or device or current_context())
+
+
+def power(a, size=None, ctx=None, device=None):
+    a_ = a._data if isinstance(a, NDArray) else a
+    u = _jr().uniform(_rng.next_key(), _size(size))
+    return _place(u ** (1.0 / a_), ctx or device or current_context())
+
+
+def rayleigh(scale=1.0, size=None, ctx=None, device=None):
+    u = _jr().uniform(_rng.next_key(), _size(size))
+    data = scale * _jnp().sqrt(-2.0 * _jnp().log1p(-u))
+    return _place(data, ctx or device or current_context())
+
+
+def weibull(a, size=None, ctx=None, device=None):
+    a_ = a._data if isinstance(a, NDArray) else a
+    return _place(_jr().weibull_min(_rng.next_key(), 1.0, a_, _size(size)),
+                  ctx or device or current_context())
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None, device=None):
+    data = _jnp().exp(_jr().normal(_rng.next_key(), _size(size)) * sigma + mean)
+    return _place(data, ctx or device or current_context())

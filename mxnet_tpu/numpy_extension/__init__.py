@@ -1,0 +1,30 @@
+"""``mx.npx`` — NumPy-extension namespace (NN ops and framework extras).
+
+Reference: ``python/mxnet/numpy_extension/`` exposing the ``_npx_*`` operator
+family (``fully_connected``, ``batch_norm``, ``convolution``, ... registered
+with aliases in e.g. ``src/operator/nn/fully_connected.cc:251``). Here these
+are implemented TPU-first in ``mxnet_tpu.ops.nn`` on lax/jnp (and Pallas for
+attention) and re-exported.
+"""
+from __future__ import annotations
+
+from ..ops.nn import *  # noqa: F401,F403
+from ..ops import nn as _nn
+from ..util import is_np_array, is_np_shape, set_np, reset_np  # noqa: F401
+
+
+def seed(s):
+    from .. import random as _rng
+
+    _rng.seed(s)
+
+
+def waitall():
+    from .. import engine
+
+    engine.wait_all()
+
+
+__all__ = [n for n in dir(_nn) if not n.startswith("_")] + [
+    "seed", "waitall", "set_np", "reset_np", "is_np_array", "is_np_shape",
+]

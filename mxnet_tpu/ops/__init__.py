@@ -1,0 +1,6 @@
+"""Operator layer: registry + op families (math via jax.numpy, nn via lax,
+hot kernels via Pallas). TPU analog of the reference's ``src/operator/``."""
+from __future__ import annotations
+
+from . import registry
+from .registry import apply, get, list_ops, register

@@ -1,0 +1,857 @@
+"""Neural-network operators (the ``npx`` op family), TPU-first.
+
+Reference: ``src/operator/nn/`` (31k LoC of hand-written CPU/cuDNN/oneDNN
+kernels — convolution, fully_connected, batch_norm, pooling, softmax,
+dropout, ...; e.g. ``fully_connected.cc:251`` registers ``_npx_fully_connected``).
+
+TPU design: every op is a pure JAX function lowering to ``lax`` primitives —
+XLA maps conv/matmul onto the MXU and fuses the elementwise epilogues, which
+is the role cuDNN autotuning + pointwise fusion (``src/operator/fusion/``)
+play in the reference. Layout is NCHW at the API (reference default) but
+convolutions compute through XLA's layout-agnostic ``conv_general_dilated``
+so the compiler picks the MXU-friendly internal layout.
+
+All public functions accept NDArray (or raw jax arrays) and route through the
+dispatch layer for autograd.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as _onp
+
+from .. import autograd
+from .. import random as _rng
+from ..base import MXNetError
+from .registry import apply as _apply
+from .registry import register as _register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _lax():
+    import jax.lax as lax
+
+    return lax
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+
+def _j_relu(x):
+    return _jnp().maximum(x, 0)
+
+
+def _j_sigmoid(x):
+    import jax
+
+    return jax.nn.sigmoid(x)
+
+
+def _j_softrelu(x):
+    import jax
+
+    return jax.nn.softplus(x)
+
+
+def _j_softsign(x):
+    return x / (1 + _jnp().abs(x))
+
+
+_ACTS = {}
+
+
+def _act_fn(name):
+    import jax
+
+    if not _ACTS:
+        _ACTS.update(
+            relu=_j_relu,
+            sigmoid=_j_sigmoid,
+            log_sigmoid=jax.nn.log_sigmoid,
+            tanh=_jnp().tanh,
+            softrelu=_j_softrelu,
+            softsign=_j_softsign,
+            silu=jax.nn.silu,
+            swish=jax.nn.silu,
+            mish=lambda x: x * _jnp().tanh(jax.nn.softplus(x)),
+            gelu=jax.nn.gelu,
+            gelu_tanh=lambda x: jax.nn.gelu(x, approximate=True),
+            erf_gelu=lambda x: jax.nn.gelu(x, approximate=False),
+            identity=lambda x: x,
+        )
+    try:
+        return _ACTS[name]
+    except KeyError:
+        raise MXNetError(f"unknown activation {name!r}") from None
+
+
+def activation(data, act_type="relu", **kwargs):  # pylint: disable=unused-argument
+    fn = _act_fn(act_type)
+    return _apply(fn, (data,), name=f"activation:{act_type}")
+
+
+def relu(data):
+    return _apply(_j_relu, (data,), name="relu")
+
+
+def sigmoid(data):
+    return _apply(_j_sigmoid, (data,), name="sigmoid")
+
+
+def tanh(data):
+    return _apply(_jnp().tanh, (data,), name="tanh")
+
+
+def softsign(data):
+    return _apply(_j_softsign, (data,), name="softsign")
+
+
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, **kwargs):  # pylint: disable=unused-argument
+    """LeakyReLU family (reference ``src/operator/leaky_relu.cc``)."""
+    import jax
+
+    jnp = _jnp()
+    if act_type == "leaky":
+        return _apply(lambda x: jnp.where(x >= 0, x, slope * x), (data,),
+                      name="leaky_relu")
+    if act_type == "elu":
+        return _apply(lambda x: jax.nn.elu(x, alpha=slope), (data,), name="elu")
+    if act_type == "selu":
+        return _apply(jax.nn.selu, (data,), name="selu")
+    if act_type == "gelu":
+        return _apply(jax.nn.gelu, (data,), name="gelu")
+    if act_type == "prelu":
+        return _apply(lambda x, g: jnp.where(x >= 0, x, g * x), (data, gamma),
+                      name="prelu")
+    if act_type == "rrelu":
+        if autograd.is_training():
+            import jax.random as jr
+
+            key = _rng.next_key()
+            def f(x):
+                s = jr.uniform(key, x.shape, x.dtype, lower_bound, upper_bound)
+                return jnp.where(x >= 0, x, s * x)
+            return _apply(f, (data,), name="rrelu")
+        mid = (lower_bound + upper_bound) / 2
+        return _apply(lambda x: jnp.where(x >= 0, x, mid * x), (data,), name="rrelu")
+    raise MXNetError(f"unknown leaky_relu act_type {act_type!r}")
+
+
+# ---------------------------------------------------------------------------
+# softmax family (reference src/operator/nn/softmax.cc, log_softmax.cc)
+# ---------------------------------------------------------------------------
+
+
+def softmax(data, axis=-1, length=None, temperature=None, use_length=False, dtype=None):
+    import jax
+
+    jnp = _jnp()
+
+    def f(x, *rest):
+        xx = x if temperature in (None, 1.0) else x / temperature
+        if use_length and rest:
+            ln = rest[0]
+            idx = jnp.arange(xx.shape[axis])
+            shape = [1] * xx.ndim
+            shape[axis] = xx.shape[axis]
+            mask = idx.reshape(shape) < jnp.expand_dims(ln, axis=axis)
+            xx = jnp.where(mask, xx, -jnp.inf)
+            out = jax.nn.softmax(xx, axis=axis)
+            out = jnp.where(mask, out, 0.0)
+        else:
+            out = jax.nn.softmax(xx, axis=axis)
+        return out.astype(dtype) if dtype else out
+
+    args = (data, length) if (use_length and length is not None) else (data,)
+    return _apply(f, args, name="softmax")
+
+
+def log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False, length=None):  # pylint: disable=unused-argument
+    import jax
+
+    def f(x):
+        xx = x if temperature in (None, 1.0) else x / temperature
+        out = jax.nn.log_softmax(xx, axis=axis)
+        return out.astype(dtype) if dtype else out
+
+    return _apply(f, (data,), name="log_softmax")
+
+
+def masked_softmax(data, mask, axis=-1, temperature=1.0):
+    import jax
+
+    jnp = _jnp()
+
+    def f(x, m):
+        xx = x / temperature if temperature != 1.0 else x
+        xx = jnp.where(m, xx, -1e30)
+        out = jax.nn.softmax(xx, axis=axis)
+        return jnp.where(m, out, 0.0)
+
+    return _apply(f, (data, mask), name="masked_softmax")
+
+
+def masked_log_softmax(data, mask, axis=-1, temperature=1.0):
+    import jax
+
+    jnp = _jnp()
+
+    def f(x, m):
+        xx = x / temperature if temperature != 1.0 else x
+        xx = jnp.where(m, xx, -1e30)
+        return jax.nn.log_softmax(xx, axis=axis)
+
+    return _apply(f, (data, mask), name="masked_log_softmax")
+
+
+# ---------------------------------------------------------------------------
+# dense / conv / pooling
+# ---------------------------------------------------------------------------
+
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    """y = x @ W^T + b (reference ``src/operator/nn/fully_connected.cc``).
+
+    ``flatten=True`` collapses all non-batch dims (reference semantics);
+    ``flatten=False`` applies to the trailing dim.
+    """
+    jnp = _jnp()
+
+    def f(xx, ww, *mb):
+        if flatten and xx.ndim > 2:
+            xx = xx.reshape(xx.shape[0], -1)
+        out = jnp.matmul(xx, ww.T)
+        if mb:
+            out = out + mb[0]
+        return out
+
+    args = (x, weight) if (no_bias or bias is None) else (x, weight, bias)
+    return _apply(f, args, name="fully_connected")
+
+
+_CONV_LAYOUTS = {
+    1: ("NCW", "OIW", "NCW"),
+    2: ("NCHW", "OIHW", "NCHW"),
+    3: ("NCDHW", "OIDHW", "NCDHW"),
+}
+
+
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None, **kwargs):  # pylint: disable=unused-argument
+    """N-D convolution via ``lax.conv_general_dilated`` (MXU path).
+
+    Reference: ``src/operator/nn/convolution.cc`` + cuDNN wrappers. XLA owns
+    algorithm choice/layout; grouped conv maps to ``feature_group_count``.
+    """
+    lax = _lax()
+    ksize = len(kernel) if kernel is not None else None
+
+    def f(x, w, *mb):
+        nd = x.ndim - 2
+        lhs_spec, rhs_spec, out_spec = _CONV_LAYOUTS[nd]
+        strides = _tup(stride, nd)
+        dil = _tup(dilate, nd)
+        pads = _tup(pad, nd) if pad is not None else (0,) * nd
+        padding = [(p, p) for p in pads]
+        out = lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            rhs_dilation=dil, feature_group_count=num_group,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+        )
+        if mb:
+            b = mb[0].reshape((1, -1) + (1,) * nd)
+            out = out + b
+        return out
+
+    del ksize
+    args = (data, weight) if (no_bias or bias is None) else (data, weight, bias)
+    return _apply(f, args, name="convolution")
+
+
+def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, num_filter=None, num_group=1,
+                  no_bias=True, layout=None, target_shape=None, **kwargs):  # pylint: disable=unused-argument
+    """Transposed convolution (reference ``src/operator/nn/deconvolution.cc``).
+
+    Implemented as the gradient of convolution (``lax.conv_transpose`` with
+    IOW-spec weights), matching the reference's definition.
+    """
+    lax = _lax()
+
+    def f(x, w, *mb):
+        nd = x.ndim - 2
+        strides = _tup(stride, nd)
+        dil = _tup(dilate, nd)
+        pads = _tup(pad, nd) if pad is not None else (0,) * nd
+        adjs = _tup(adj, nd) if adj is not None else (0,) * nd
+        # output padding handled by asymmetric padding on the transpose
+        padding = []
+        kernel_shape = w.shape[2:]
+        for i in range(nd):
+            k = (kernel_shape[i] - 1) * dil[i] + 1
+            lo = k - 1 - pads[i]
+            hi = k - 1 - pads[i] + adjs[i]
+            padding.append((lo, hi))
+        lhs_spec, rhs_spec, out_spec = _CONV_LAYOUTS[nd]
+        # IOW-style spec: swap I/O in rhs for transpose semantics
+        rhs_spec_t = rhs_spec.replace("O", "X").replace("I", "O").replace("X", "I")
+        out = lax.conv_general_dilated(
+            x, _jnp().flip(w, axis=tuple(range(2, w.ndim))),
+            window_strides=(1,) * nd, padding=padding,
+            lhs_dilation=strides, rhs_dilation=dil,
+            feature_group_count=num_group,
+            dimension_numbers=(lhs_spec, rhs_spec_t, out_spec),
+        )
+        if mb:
+            out = out + mb[0].reshape((1, -1) + (1,) * nd)
+        return out
+
+    args = (data, weight) if (no_bias or bias is None) else (data, weight, bias)
+    return _apply(f, args, name="deconvolution")
+
+
+def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
+            pad=None, pooling_convention="valid", count_include_pad=True,
+            layout=None, **kwargs):  # pylint: disable=unused-argument
+    """Pooling via ``lax.reduce_window`` (reference ``src/operator/nn/pooling.cc``)."""
+    lax = _lax()
+    jnp = _jnp()
+
+    def f(x):
+        nd = x.ndim - 2
+        if global_pool:
+            axes = tuple(range(2, x.ndim))
+            if pool_type == "max":
+                return jnp.max(x, axis=axes, keepdims=True)
+            if pool_type == "sum":
+                return jnp.sum(x, axis=axes, keepdims=True)
+            return jnp.mean(x, axis=axes, keepdims=True)
+        ker = _tup(kernel, nd)
+        strides = _tup(stride, nd) if stride is not None else ker
+        pads = _tup(pad, nd) if pad is not None else (0,) * nd
+        window = (1, 1) + ker
+        wstrides = (1, 1) + strides
+        if pooling_convention == "full":
+            # ceil-mode: pad high side enough to cover a final partial window
+            wpad = [(0, 0), (0, 0)]
+            for i in range(nd):
+                size = x.shape[2 + i] + 2 * pads[i]
+                out_f = max(0, math.ceil((size - ker[i]) / strides[i])) + 1
+                needed = (out_f - 1) * strides[i] + ker[i] - size
+                wpad.append((pads[i], pads[i] + max(0, needed)))
+        else:
+            wpad = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+        if pool_type == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            return lax.reduce_window(x, init, lax.max, window, wstrides, wpad)
+        if pool_type in ("avg", "sum"):
+            s = lax.reduce_window(x, 0.0, lax.add, window, wstrides, wpad)
+            if pool_type == "sum":
+                return s
+            if count_include_pad:
+                denom = float(_onp.prod(ker))
+                return s / denom
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, wstrides, wpad)
+            return s / cnt
+        if pool_type == "lp":
+            p = kwargs.get("p_value", 2)
+            s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, wstrides, wpad)
+            return s ** (1.0 / p)
+        raise MXNetError(f"unknown pool_type {pool_type!r}")
+
+    return _apply(f, (data,), name=f"pooling:{pool_type}")
+
+
+def adaptive_avg_pooling(data, output_size=1):
+    """``_contrib_AdaptiveAvgPooling2D`` analog."""
+    jnp = _jnp()
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+
+    def f(x):
+        n, c, h, w = x.shape
+        oh, ow = output_size
+        if h % oh == 0 and w % ow == 0:
+            x4 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+            return x4.mean(axis=(3, 5))
+        import jax
+
+        x_resized = jax.image.resize(x, (n, c, oh, ow), method="linear")
+        return x_resized
+
+    return _apply(f, (data,), name="adaptive_avg_pooling")
+
+
+# ---------------------------------------------------------------------------
+# normalization (reference src/operator/nn/{batch_norm,layer_norm,...}.cc)
+# ---------------------------------------------------------------------------
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False, **kwargs):  # pylint: disable=unused-argument
+    """Batch normalization.
+
+    Training mode (autograd.is_training() and not use_global_stats): uses
+    batch statistics and returns updated running stats via the layer (see
+    ``gluon.nn.BatchNorm`` which rebinds its state params — the reference
+    mutates aux states inside the op instead).
+    """
+    jnp = _jnp()
+    training = autograd.is_training() and not use_global_stats
+
+    def f_train(xx, g, b):
+        axes = tuple(i for i in range(xx.ndim) if i != axis)
+        mean = jnp.mean(xx, axis=axes)
+        var = jnp.var(xx, axis=axes)
+        shape = [1] * xx.ndim
+        shape[axis] = xx.shape[axis]
+        gg = jnp.ones_like(g) if fix_gamma else g
+        inv = gg.reshape(shape) / jnp.sqrt(var.reshape(shape) + eps)
+        out = (xx - mean.reshape(shape)) * inv + b.reshape(shape)
+        return out, mean, var
+
+    def f_eval(xx, g, b, rm, rv):
+        shape = [1] * xx.ndim
+        shape[axis] = xx.shape[axis]
+        gg = jnp.ones_like(g) if fix_gamma else g
+        inv = gg.reshape(shape) / jnp.sqrt(rv.reshape(shape) + eps)
+        return (xx - rm.reshape(shape)) * inv + b.reshape(shape)
+
+    if training:
+        out, mean, var = _apply(f_train, (x, gamma, beta), name="batch_norm")
+        # state update is the caller's job (the layer folds batch stats into
+        # its running_* parameters), so stats are only returned on request
+        if output_mean_var:
+            return out, mean, var
+        return out
+    out = _apply(f_eval, (x, gamma, beta, running_mean, running_var),
+                 name="batch_norm_inference")
+    if output_mean_var:
+        return out, running_mean, running_var
+    return out
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    jnp = _jnp()
+
+    def f(x, g, b):
+        mean = jnp.mean(x, axis=axis, keepdims=True)
+        var = jnp.var(x, axis=axis, keepdims=True)
+        out = (x - mean) / jnp.sqrt(var + eps)
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        return out * g.reshape(shape) + b.reshape(shape)
+
+    return _apply(f, (data, gamma, beta), name="layer_norm")
+
+
+def rms_norm(data, gamma, axis=-1, eps=1e-6):
+    """RMSNorm (no reference analog; required by the Llama model family)."""
+    jnp = _jnp()
+
+    def f(x, g):
+        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
+        out = x * (1.0 / jnp.sqrt(ms + eps)).astype(x.dtype)
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        return out * g.reshape(shape)
+
+    return _apply(f, (data, gamma), name="rms_norm")
+
+
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    jnp = _jnp()
+
+    def f(x, g, b):
+        n, c = x.shape[:2]
+        rest = x.shape[2:]
+        xg = x.reshape((n, num_groups, c // num_groups) + rest)
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        out = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+        shape = (1, c) + (1,) * len(rest)
+        return out * g.reshape(shape) + b.reshape(shape)
+
+    return _apply(f, (data, gamma, beta), name="group_norm")
+
+
+def instance_norm(data, gamma, beta, eps=1e-5):
+    jnp = _jnp()
+
+    def f(x, g, b):
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        out = (x - mean) / jnp.sqrt(var + eps)
+        shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+        return out * g.reshape(shape) + b.reshape(shape)
+
+    return _apply(f, (data, gamma, beta), name="instance_norm")
+
+
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    jnp = _jnp()
+
+    def f(x):
+        if mode == "instance":
+            axes = tuple(range(1, x.ndim))
+        elif mode == "channel":
+            axes = (1,)
+        else:
+            axes = tuple(range(x.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+        return x / norm
+
+    return _apply(f, (data,), name="l2_normalization")
+
+
+# ---------------------------------------------------------------------------
+# dropout (reference src/operator/nn/dropout.cc; RNG via engine resource)
+# ---------------------------------------------------------------------------
+
+
+def dropout(data, p=0.5, mode="training", axes=(), **kwargs):  # pylint: disable=unused-argument
+    if p <= 0 or (mode == "training" and not autograd.is_training()):
+        return data if hasattr(data, "_data") else data
+    import jax.random as jr
+
+    jnp = _jnp()
+    key = _rng.next_key()
+
+    def f(x):
+        shape = list(x.shape)
+        for ax in axes:
+            shape[ax] = 1
+        keep = 1.0 - p
+        mask = jr.bernoulli(key, keep, tuple(shape)).astype(x.dtype)
+        return x * mask / keep
+
+    return _apply(f, (data,), name="dropout")
+
+
+# ---------------------------------------------------------------------------
+# embedding / one-hot / indexing ops
+# ---------------------------------------------------------------------------
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False, **kwargs):  # pylint: disable=unused-argument
+    """Embedding lookup (reference ``src/operator/tensor/indexing_op.cc``)."""
+    jnp = _jnp()
+
+    def f(idx, w):
+        return jnp.take(w, idx.astype(jnp.int32), axis=0)
+
+    return _apply(f, (data, weight), name="embedding")
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    import jax
+
+    def f(idx):
+        oh = jax.nn.one_hot(idx, depth, dtype=dtype)
+        if on_value != 1.0 or off_value != 0.0:
+            oh = oh * (on_value - off_value) + off_value
+        return oh
+
+    return _apply(f, (data,), name="one_hot", record=False)
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):  # pylint: disable=unused-argument
+    """Pick per-row elements by index (reference ``pick`` op)."""
+    jnp = _jnp()
+
+    def f(x, idx):
+        out = jnp.take_along_axis(
+            x, jnp.expand_dims(idx.astype(jnp.int32), axis=axis), axis=axis)
+        return out if keepdims else jnp.squeeze(out, axis=axis)
+
+    return _apply(f, (data, index), name="pick")
+
+
+def gather_nd(data, indices):
+    jnp = _jnp()
+
+    def f(x, idx):
+        idx = idx.astype(jnp.int32)
+        return x[tuple(idx[i] for i in range(idx.shape[0]))]
+
+    return _apply(f, (data, indices), name="gather_nd")
+
+
+def scatter_nd(data, indices, shape):
+    jnp = _jnp()
+
+    def f(v, idx):
+        idx = idx.astype(jnp.int32)
+        out = jnp.zeros(shape, v.dtype)
+        return out.at[tuple(idx[i] for i in range(idx.shape[0]))].set(v)
+
+    return _apply(f, (data, indices), name="scatter_nd")
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Top-k (reference ``src/operator/tensor/ordering_op.cc``)."""
+    import jax
+
+    jnp = _jnp()
+
+    def f(x):
+        xm = jnp.moveaxis(x, axis, -1)
+        vals, idx = jax.lax.top_k(-xm if is_ascend else xm, k)
+        if is_ascend:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+        if ret_typ == "value":
+            return vals
+        if ret_typ == "both":
+            return vals, idx.astype(dtype)
+        return idx.astype(dtype)
+
+    return _apply(f, (data,), name="topk", record=(ret_typ == "value"))
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (reference src/operator/sequence_*.cc)
+# ---------------------------------------------------------------------------
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    jnp = _jnp()
+    if not use_sequence_length or sequence_length is None:
+        return data
+
+    def f(x, slen):
+        idx = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        batch_axis = 1 if axis == 0 else 0
+        bshape = [1] * x.ndim
+        bshape[batch_axis] = x.shape[batch_axis]
+        mask = idx.reshape(shape) < slen.reshape(bshape)
+        return jnp.where(mask, x, value)
+
+    return _apply(f, (data, sequence_length), name="sequence_mask")
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    jnp = _jnp()
+
+    def f(x, *rest):
+        if rest:
+            idx = (rest[0].astype(jnp.int32) - 1)
+            return jnp.take_along_axis(
+                x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=axis
+            ).squeeze(axis)
+        return jnp.take(x, x.shape[axis] - 1, axis=axis)
+
+    args = (data, sequence_length) if (use_sequence_length and sequence_length is not None) else (data,)
+    return _apply(f, args, name="sequence_last")
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    jnp = _jnp()
+
+    def f(x, *rest):
+        if not rest:
+            return jnp.flip(x, axis=axis)
+        slen = rest[0].astype(jnp.int32)
+        t = x.shape[axis]
+        idx = jnp.arange(t)
+        rev = slen[None, :] - 1 - idx[:, None]
+        rev = jnp.where(rev >= 0, rev, idx[:, None])
+        return jnp.take_along_axis(x, rev.reshape((t, -1) + (1,) * (x.ndim - 2)), axis=0)
+
+    args = (data, sequence_length) if (use_sequence_length and sequence_length is not None) else (data,)
+    return _apply(f, args, name="sequence_reverse")
+
+
+# ---------------------------------------------------------------------------
+# losses as ops
+# ---------------------------------------------------------------------------
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    """CTC loss (reference ``src/operator/nn/ctc_loss.cc`` / WarpCTC).
+
+    Lowered through optax's ctc_loss (pure-JAX forward-backward) with
+    logit layout conversion: reference layout is (seq, batch, alphabet).
+    """
+    import optax
+
+    jnp = _jnp()
+
+    def f(logits, labels, *rest):
+        sl, b, a = logits.shape
+        lg = jnp.transpose(logits, (1, 0, 2))  # (B, T, A)
+        lab = labels.astype(jnp.int32)
+        if blank_label == "first":
+            # optax uses blank=0 by default; reference 'first' means blank==0
+            blank_id = 0
+        else:
+            blank_id = a - 1
+        if rest and use_data_lengths:
+            dl = rest[0].astype(jnp.int32)
+        else:
+            dl = jnp.full((b,), sl, jnp.int32)
+        logit_pad = (jnp.arange(sl)[None, :] >= dl[:, None]).astype(jnp.float32)
+        if use_label_lengths and len(rest) > (1 if use_data_lengths else 0):
+            ll = rest[-1].astype(jnp.int32)
+        else:
+            ll = jnp.sum((lab > 0).astype(jnp.int32), axis=-1)
+        label_pad = (jnp.arange(lab.shape[1])[None, :] >= ll[:, None]).astype(jnp.float32)
+        return optax.ctc_loss(lg, logit_pad, lab, label_pad, blank_id=blank_id)
+
+    args = [data, label]
+    if use_data_lengths and data_lengths is not None:
+        args.append(data_lengths)
+    if use_label_lengths and label_lengths is not None:
+        args.append(label_lengths)
+    return _apply(f, tuple(args), name="ctc_loss")
+
+
+def smooth_l1(data, scalar=1.0):
+    jnp = _jnp()
+
+    def f(x):
+        s2 = scalar * scalar
+        return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
+                         jnp.abs(x) - 0.5 / s2)
+
+    return _apply(f, (data,), name="smooth_l1")
+
+
+# ---------------------------------------------------------------------------
+# attention (TPU flagship path — Pallas flash attention with XLA fallback)
+# ---------------------------------------------------------------------------
+
+
+def attention(query, key, value, mask=None, causal=False, scale=None,
+              use_flash=True):
+    """Scaled dot-product attention over (B, H, T, D) tensors.
+
+    Replaces the reference's fused matmul helpers
+    (``src/operator/contrib/transformer.cc`` interleaved_matmul_selfatt_*)
+    with a real attention op: Pallas flash-attention kernel on TPU,
+    XLA-fused reference path elsewhere. See
+    ``mxnet_tpu/ops/pallas/flash_attention.py``.
+    """
+    from .pallas import flash_attention as fa
+
+    def f(q, k, v, *m):
+        return fa.attention(q, k, v, m[0] if m else None, causal=causal,
+                            scale=scale, use_flash=use_flash)
+
+    args = (query, key, value) if mask is None else (query, key, value, mask)
+    return _apply(f, args, name="attention")
+
+
+# ---------------------------------------------------------------------------
+# misc framework extras
+# ---------------------------------------------------------------------------
+
+
+def reshape(data, newshape, reverse=False, order="C"):  # pylint: disable=unused-argument
+    return data.reshape(newshape)
+
+
+def shape_array(data):
+    from ..ndarray.ndarray import NDArray
+
+    return NDArray(_onp.asarray(data.shape, _onp.int64))
+
+
+def cast(data, dtype):
+    return data.astype(dtype)
+
+
+def slice(data, begin, end, step=None):  # pylint: disable=redefined-builtin
+    import builtins
+
+    nd = len(begin)
+    step = step or (1,) * nd
+    idx = tuple(builtins.slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+def slice_axis(data, axis, begin, end):
+    import builtins
+
+    idx = [builtins.slice(None)] * data.ndim
+    idx[axis] = builtins.slice(begin, end)
+    return data[tuple(idx)]
+
+
+def slice_like(data, shape_like, axes=None):
+    import builtins
+
+    target = shape_like.shape
+    idx = [builtins.slice(None)] * data.ndim
+    for ax in (axes if axes is not None else range(data.ndim)):
+        idx[ax] = builtins.slice(0, target[ax])
+    return data[tuple(idx)]
+
+
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):  # pylint: disable=unused-argument
+    return lhs.broadcast_to(rhs.shape)
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    jnp = _jnp()
+
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    return _apply(f, (lhs, rhs), name="batch_dot")
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):  # pylint: disable=unused-argument
+    jnp = _jnp()
+    from ..ndarray.ndarray import NDArray
+
+    n = data.size if axis is None else data.shape[axis]
+    return NDArray(jnp.arange(n) * step + start)
+
+
+# register the public ops in the global registry for list_ops parity
+for _name in (
+    "activation", "fully_connected", "convolution", "deconvolution", "pooling",
+    "batch_norm", "layer_norm", "group_norm", "instance_norm", "rms_norm",
+    "dropout", "softmax", "log_softmax", "masked_softmax", "embedding",
+    "one_hot", "pick", "topk", "sequence_mask", "sequence_last",
+    "sequence_reverse", "ctc_loss", "attention", "leaky_relu", "relu",
+    "sigmoid", "tanh", "batch_dot", "gather_nd", "scatter_nd",
+):
+    _register(_name, globals()[_name])

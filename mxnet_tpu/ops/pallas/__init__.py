@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for fusion-critical ops (SURVEY.md §7: attention,
+normalization, optimizer fusions). Each kernel has an XLA fallback so the
+same op runs on the CPU test mesh."""
+from __future__ import annotations

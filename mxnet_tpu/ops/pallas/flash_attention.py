@@ -1,0 +1,175 @@
+"""Flash attention: Pallas TPU kernel + XLA reference fallback.
+
+The reference framework has no attention op at all — only fused matmul
+helpers (``src/operator/contrib/transformer.cc``); SURVEY.md §5 requires the
+TPU build to introduce memory-efficient attention natively.
+
+Design (standard flash-attention-2 schedule adapted to TPU tiling):
+  grid over (batch*heads, q_blocks, k_blocks); K/V blocks stream from HBM
+  through VMEM with running max/sum accumulators in fp32 scratch.
+Backward currently recomputes through the XLA path via ``jax.custom_vjp``
+(numerically identical, still fused by XLA); a Pallas backward kernel is the
+next optimization step.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+
+def _reference_attention(q, k, v, mask=None, causal=False, scale=None):
+    """XLA attention: materializes scores; fine for short T, CPU tests."""
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        scores = jnp.where(cm, scores, -1e30)
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, -1e30)
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def _flash_attention_tpu(q, k, v, causal=False, scale=None,
+                         block_q=128, block_k=128):
+    """Pallas flash-attention forward for (B, H, T, D) inputs."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    n_q = tq // block_q
+    n_k = tk // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+        qi = pl.program_id(1)
+
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        ki = pl.program_id(2)
+
+        run = True
+        if causal:
+            # skip fully-masked K blocks above the diagonal
+            run = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+        @pl.when(run if causal else True)
+        def _body():
+            qb = q_ref[0].astype(jnp.float32) * s           # (bq, d)
+            kb = k_ref[0].astype(jnp.float32)               # (bk, d)
+            vb = v_ref[0].astype(jnp.float32)               # (bk, d)
+            sc = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # (bq, bk)
+            if causal:
+                qpos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                kpos = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                sc = jnp.where(qpos >= kpos, sc, -jnp.inf)
+            m_prev = m_scr[:]                                # (bq, 1)
+            m_cur = jnp.max(sc, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(sc - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[:] = m_new
+
+        @pl.when(pl.program_id(2) == n_k - 1)
+        def _finish():
+            l = l_scr[:]
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+    grid = (b * h, n_q, n_k)
+
+    def qidx(bh, qi, ki):  # noqa: ANN001
+        del ki
+        return (bh, qi, 0)
+
+    def kidx(bh, qi, ki):
+        del qi
+        return (bh, ki, 0)
+
+    q3 = q.reshape(b * h, tq, d)
+    k3 = k.reshape(b * h, tk, d)
+    v3 = v.reshape(b * h, tk, d)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), qidx),
+            pl.BlockSpec((1, block_k, d), kidx),
+            pl.BlockSpec((1, block_k, d), kidx),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), qidx),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q3, k3, v3)
+    return out.reshape(b, h, tq, d)
+
+
+def _supports_pallas(q, causal_ok=True):  # pylint: disable=unused-argument
+    import jax
+
+    if jax.default_backend() not in ("tpu",):
+        return False
+    b, h, t, d = q.shape
+    return t % 128 == 0 and d % 128 == 0 and d <= 256
+
+
+@functools.partial(
+    __import__("jax").custom_vjp, nondiff_argnums=(4, 5, 6)
+)
+def _attention_core(q, k, v, mask, causal, scale, use_flash):
+    if mask is None and use_flash and _supports_pallas(q):
+        return _flash_attention_tpu(q, k, v, causal=causal, scale=scale)
+    return _reference_attention(q, k, v, mask, causal=causal, scale=scale)
+
+
+def _attention_fwd(q, k, v, mask, causal, scale, use_flash):
+    out = _attention_core(q, k, v, mask, causal, scale, use_flash)
+    return out, (q, k, v, mask)
+
+
+def _attention_bwd(causal, scale, use_flash, res, g):  # pylint: disable=unused-argument
+    import jax
+
+    q, k, v, mask = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, mask, causal, scale),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_attention_core.defvjp(_attention_fwd, _attention_bwd)
+
+
+def attention(q, k, v, mask=None, causal=False, scale=None, use_flash=True):
+    """Public entry: (B, H, T, D) scaled-dot-product attention."""
+    return _attention_core(q, k, v, mask, causal, scale, use_flash)

@@ -1,0 +1,145 @@
+"""Operator dispatch: the TPU analog of the imperative invoke path.
+
+Reference call stack (SURVEY.md §3.1): Python op → FFI → ``Imperative::Invoke``
+→ shape/type inference → ``PushFCompute`` closure → engine → kernel.
+
+TPU call stack: Python op → :func:`apply` → (optionally ``jax.vjp`` for
+autograd) → XLA async dispatch. Shape/dtype inference, memory planning and
+kernel selection are XLA's job; what remains here is (a) unwrap/wrap of the
+mutable NDArray handles, (b) tape recording, (c) the NaiveEngine sync hook.
+
+Ops are plain JAX-traceable functions. :func:`register` places them in a
+global table by name — the analog of ``NNVM_REGISTER_OP`` — which the
+``mx.np``/``mx.npx``/``mx.nd`` namespace generators read at import, the way
+the reference synthesizes its Python op modules from the C registry
+(``python/mxnet/ndarray/register.py:115-265``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .. import autograd, engine
+from ..base import MXNetError
+
+# global op table: name -> Op
+_OPS: Dict[str, "Op"] = {}
+
+
+class Op:
+    """A registered operator backed by a JAX-traceable callable."""
+
+    __slots__ = ("name", "fn", "ndarray_inputs", "wrap_output", "doc")
+
+    def __init__(self, name: str, fn: Callable, ndarray_inputs=None, doc=""):
+        self.name = name
+        self.fn = fn
+        self.ndarray_inputs = ndarray_inputs
+        self.doc = doc or fn.__doc__
+
+    def __call__(self, *args, **kwargs):
+        return apply(self.fn, args, kwargs, name=self.name)
+
+
+def register(name: str, fn: Optional[Callable] = None, **meta):
+    """Register an op (decorator or direct). Analog of NNVM_REGISTER_OP."""
+    if fn is None:
+        def deco(f):
+            _OPS[name] = Op(name, f, **meta)
+            return f
+        return deco
+    _OPS[name] = Op(name, fn, **meta)
+    return fn
+
+
+def get(name: str) -> Op:
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise MXNetError(f"operator {name!r} is not registered") from None
+
+
+def list_ops():
+    """All registered op names (``MXListAllOpNames`` analog)."""
+    return sorted(_OPS)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+
+
+def _ndarray_cls():
+    from ..ndarray.ndarray import NDArray
+
+    return NDArray
+
+
+def apply(fn, args, kwargs=None, name="", record=True, sync_outputs=True):
+    """Invoke ``fn`` on a mix of NDArray / scalar / array args.
+
+    NDArray positions become differentiable primal inputs; everything else is
+    closed over as a constant. When autograd is recording and any NDArray
+    input is tracked, forward runs under ``jax.vjp`` and a tape node is
+    created (``Imperative::RecordOp`` analog).
+    """
+    import jax
+
+    NDArray = _ndarray_cls()
+    kwargs = kwargs or {}
+    arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    arrays = [args[i] for i in arr_pos]
+    datas = tuple(a._data for a in arrays)
+
+    if arr_pos and len(arr_pos) == len(args) and not kwargs:
+        closed = fn
+    else:
+        template = list(args)
+
+        def closed(*xs):
+            for pos, x in zip(arr_pos, xs):
+                template[pos] = x
+            return fn(*template, **kwargs)
+
+    from ..ndarray.ndarray import _tracked, _slot_of
+
+    recording = (
+        record
+        and autograd.is_recording()
+        and any(_tracked(a) for a in arrays)
+    )
+    if recording:
+        outs, vjp_fn = jax.vjp(closed, *datas)
+    else:
+        outs = closed(*datas)
+
+    single = not isinstance(outs, (tuple, list))
+    flat = [outs] if single else list(outs)
+    wrapped = [NDArray(o) for o in flat]
+
+    if recording:
+        node = autograd.TapeNode(
+            vjp_fn,
+            [_slot_of(a) for a in arrays],
+            [(o.shape, o.dtype) for o in flat],
+            name=name or getattr(fn, "__name__", "op"),
+        )
+        for i, w in enumerate(wrapped):
+            w._tape = (node, i)
+
+    if sync_outputs:
+        engine.maybe_sync(flat)
+    return wrapped[0] if single else type(outs)(wrapped)
+
+
+def apply_out(fn, args, kwargs=None, out=None, name=""):
+    """Like :func:`apply` but honoring an ``out=`` destination NDArray."""
+    res = apply(fn, args, kwargs, name=name)
+    if out is None:
+        return res
+    if isinstance(out, (tuple, list)):
+        for o, r in zip(out, res):
+            o._set_data_internal(r._data)
+        return out
+    out._set_data_internal(res._data)
+    out._tape = getattr(res, "_tape", None)
+    return out

@@ -1,0 +1,28 @@
+"""``mx.optimizer`` (reference ``python/mxnet/optimizer/``)."""
+from __future__ import annotations
+
+from .optimizer import (
+    DCASGD,
+    FTML,
+    LAMB,
+    LANS,
+    LARS,
+    NAG,
+    SGD,
+    SGLD,
+    AdaDelta,
+    AdaGrad,
+    Adam,
+    AdamW,
+    Adamax,
+    Ftrl,
+    Nadam,
+    Optimizer,
+    RMSProp,
+    SignSGD,
+    Signum,
+    Updater,
+    create,
+    get_updater,
+    register,
+)

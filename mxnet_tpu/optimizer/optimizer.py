@@ -1,0 +1,661 @@
+"""Optimizers (reference ``python/mxnet/optimizer/`` — 18 classes, fused C++
+kernels in ``src/operator/optimizer_op*.cc`` / ``contrib/{adamw,lamb}``).
+
+TPU design: every optimizer defines a *pure* update rule
+``_update_raw(p, g, states, lr, wd, t) -> (new_p, new_states)`` on jax
+arrays. The eager ``update()`` API applies it per-parameter (MXNet
+semantics); ``gluon.Trainer`` compiles ONE jitted multi-tensor update over
+all parameters with buffer donation — the role of the reference's
+multi-tensor/aggregate update kernels (``aggregate_num`` batching).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+_OPT_REGISTRY = {}
+
+
+def register(cls):
+    _OPT_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    try:
+        return _OPT_REGISTRY[name.lower()](**kwargs)
+    except KeyError:
+        raise MXNetError(f"unknown optimizer {name!r}") from None
+
+
+class Optimizer:
+    """Base optimizer."""
+
+    # False for optimizers with python-side state or per-step host RNG that
+    # cannot be baked into one compiled multi-tensor update (Trainer falls
+    # back to the reference's eager per-parameter path).
+    fused_safe = True
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, aggregate_num=0,
+                 use_fused_step=True, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self.use_fused_step = use_fused_step
+        self.param_dict = param_dict or {}
+        self.idx2name = param_idx2name or {}
+        self._index_update_count = {}
+        self._all_kwargs = dict(kwargs)
+
+    # -- bookkeeping ------------------------------------------------------
+    def _update_count(self, index):
+        self._index_update_count[index] = self._index_update_count.get(index, 0) + 1
+        return self._index_update_count[index]
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            t = max(self._index_update_count.values(), default=0)
+            return self.lr_scheduler(t)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.lr = lr
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self._index_update_count.get(index, 0))
+        else:
+            lr = self.lr
+        p = self.param_dict.get(index)
+        if p is not None:
+            lr *= getattr(p, "lr_mult", 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        p = self.param_dict.get(index)
+        if p is not None:
+            wd *= getattr(p, "wd_mult", 1.0)
+        return wd
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):  # pylint: disable=unused-argument
+        return ()
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _onp.float16:
+            master = NDArray(weight._data.astype(_onp.float32))
+            return (master, self.create_state(index, NDArray(master._data)))
+        return self.create_state(index, weight)
+
+    # -- pure rule (jax arrays) -------------------------------------------
+    def _update_raw(self, p, g, states, lr, wd, t):
+        raise NotImplementedError
+
+    def _prep_grad(self, g):
+        import jax.numpy as jnp
+
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    # -- eager per-param API (MXNet semantics) ----------------------------
+    def update(self, index, weight, grad, state):
+        if isinstance(index, (list, tuple)):
+            for i, w, g, s in zip(index, weight, grad, state):
+                self._update_one(i, w, g, s)
+        else:
+            self._update_one(index, weight, grad, state)
+
+    def _update_one(self, index, weight, grad, state):
+        t = self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = self._prep_grad(grad._data.astype(weight.dtype))
+        states = _states_tuple(state)
+        sdatas = tuple(s._data for s in states)
+        new_p, new_s = self._update_raw(weight._data, g, sdatas, lr, wd, t)
+        weight._set_data_internal(new_p)
+        for s, ns in zip(states, new_s):
+            s._set_data_internal(ns)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if (self.multi_precision and isinstance(state, tuple) and len(state) == 2
+                and isinstance(state[0], NDArray)
+                and state[0].dtype == _onp.float32
+                and weight.dtype == _onp.float16):
+            master, inner = state
+            t = self._update_count(index)
+            lr = self._get_lr(index)
+            wd = self._get_wd(index)
+            g = self._prep_grad(grad._data.astype(_onp.float32))
+            states = _states_tuple(inner)
+            sdatas = tuple(s._data for s in states)
+            new_p, new_s = self._update_raw(master._data, g, sdatas, lr, wd, t)
+            master._set_data_internal(new_p)
+            for s, ns in zip(states, new_s):
+                s._set_data_internal(ns)
+            weight._set_data_internal(new_p.astype(_onp.float16))
+        else:
+            self.update(index, weight, grad, state)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr})"
+
+
+def _states_tuple(state):
+    if state is None:
+        return ()
+    if isinstance(state, NDArray):
+        return (state,)
+    return tuple(state)
+
+
+def _zeros_like(weight):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.zeros(weight.shape, weight.dtype))
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference ``optimizer/sgd.py``)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (_zeros_like(weight),)
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        g = g + wd * p
+        if self.momentum == 0.0:
+            return p - lr * g, ()
+        (mom,) = states
+        mom = self.momentum * mom - lr * g
+        return p + mom, (mom,)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight),)
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        g = g + wd * p
+        (mom,) = states
+        mom = self.momentum * mom + g
+        return p - lr * (g + self.momentum * mom), (mom,)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.correct_bias = correct_bias
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        g = g + wd * p
+        m, v = states
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        if self.correct_bias:
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = m, v
+        return p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v)
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay (reference ``contrib/adamw``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.correct_bias = correct_bias
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        m, v = states
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        if self.correct_bias:
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = m, v
+        return p - lr * (mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * p), (m, v)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum, self.epsilon = rho, momentum, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like(weight), _zeros_like(weight), _zeros_like(weight))
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        g = g + wd * p
+        if self.centered:
+            n, mg, mom = states
+            n = self.rho * n + (1 - self.rho) * g * g
+            mg = self.rho * mg + (1 - self.rho) * g
+            mom = self.momentum * mom - lr * g / jnp.sqrt(n - mg * mg + self.epsilon)
+            p = p + mom
+            if self.clip_weights:
+                p = jnp.clip(p, -self.clip_weights, self.clip_weights)
+            return p, (n, mg, mom)
+        n, mom = states
+        n = self.rho * n + (1 - self.rho) * g * g
+        mom = self.momentum * mom - lr * g / (jnp.sqrt(n) + self.epsilon)
+        p = p + mom
+        if self.clip_weights:
+            p = jnp.clip(p, -self.clip_weights, self.clip_weights)
+        return p, (n, mom)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight),)
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        g = g + wd * p
+        (h,) = states
+        h = h + g * g
+        return p - lr * g / (jnp.sqrt(h) + self.epsilon), (h,)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        g = g + wd * p
+        acc_g, acc_d = states
+        acc_g = self.rho * acc_g + (1 - self.rho) * g * g
+        delta = jnp.sqrt(acc_d + self.epsilon) / jnp.sqrt(acc_g + self.epsilon) * g
+        acc_d = self.rho * acc_d + (1 - self.rho) * delta * delta
+        return p - lr * delta, (acc_g, acc_d)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        g = g + wd * p
+        m, u = states
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        lr_t = lr / (1 - self.beta1 ** t)
+        return p - lr_t * m / (u + 1e-8), (m, u)
+
+
+@register
+class Nadam(Optimizer):
+    fused_safe = False  # python-side m_schedule state
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        g = g + wd * p
+        m, v = states
+        mt = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mt1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * mt
+        sched1 = self.m_schedule
+        sched2 = self.m_schedule * mt1
+        gp = g / (1 - sched1)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        mhat = m / (1 - sched2)
+        vhat = v / (1 - self.beta2 ** t)
+        mbar = (1 - mt) * gp + mt1 * mhat
+        return p - lr * mbar / (jnp.sqrt(vhat) + self.epsilon), (m, v)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        z, n = states
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * p
+        n = n + g * g
+        p_new = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1)
+            / ((self.beta + jnp.sqrt(n)) / lr + wd),
+            0.0,
+        )
+        return p_new, (z, n)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight), _zeros_like(weight))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        g = g + wd * p
+        d, v, z = states
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma * p
+        return -z / d_t, (d_t, v, z)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (_zeros_like(weight),)
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        if self.momentum == 0.0:
+            return p * (1 - lr * self.wd_lh) - lr * jnp.sign(g + wd * p), ()
+        (mom,) = states
+        mom = self.momentum * mom - (1 - self.momentum) * (g + wd * p)
+        return p * (1 - lr * self.wd_lh) + lr * jnp.sign(mom), (mom,)
+
+
+SignSGD = Signum
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference ``optimizer/lars.py``)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight),)
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        (mom,) = states
+        w_norm = jnp.sqrt(jnp.sum(p * p))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon), 1.0)
+        g = g + wd * p
+        mom = self.momentum * mom + trust * lr * g
+        return p - mom, (mom,)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for batch training (reference lamb)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        m, v = states
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        if self.bias_correction:
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = m, v
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * p
+        w_norm = jnp.sqrt(jnp.sum(p * p))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * ratio * r, (m, v)
+
+
+@register
+class LANS(Optimizer):
+    """Accelerated large-batch (normalized gradients) variant of LAMB."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        g = jnp.where(g_norm > 0, g / g_norm, g)
+        m, v = states
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        w_norm = jnp.sqrt(jnp.sum(p * p))
+        r1 = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * p
+        r1n = jnp.sqrt(jnp.sum(r1 * r1))
+        ratio1 = jnp.where((w_norm > 0) & (r1n > 0), w_norm / r1n, 1.0)
+        r2 = g / (jnp.sqrt(vhat) + self.epsilon) + wd * p
+        r2n = jnp.sqrt(jnp.sum(r2 * r2))
+        ratio2 = jnp.where((w_norm > 0) & (r2n > 0), w_norm / r2n, 1.0)
+        p = p - lr * (self.beta1 * ratio1 * r1 + (1 - self.beta1) * ratio2 * r2)
+        return p, (m, v)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (adds gaussian noise)."""
+
+    fused_safe = False  # fresh RNG draw per step
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        import jax.random as jr
+
+        from .. import random as _rng
+
+        g = g + wd * p
+        noise = jr.normal(_rng.next_key(), p.shape, p.dtype) * math.sqrt(lr)
+        return p - 0.5 * lr * g + noise, ()
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference dcasgd)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), NDArray(weight._data))
+
+    def _update_raw(self, p, g, states, lr, wd, t):
+        g = g + wd * p
+        mom, prev_w = states
+        mom = self.momentum * mom - lr * (
+            g + self.lamda * g * g * (p - prev_w))
+        return p + mom, (mom, p + mom)
+
+
+# name aliases matching reference create() strings
+_OPT_REGISTRY.update(
+    sgd=SGD, nag=NAG, adam=Adam, adamw=AdamW, rmsprop=RMSProp,
+    adagrad=AdaGrad, adadelta=AdaDelta, adamax=Adamax, nadam=Nadam,
+    ftrl=Ftrl, ftml=FTML, signum=Signum, signsgd=Signum, lars=LARS,
+    lamb=LAMB, lans=LANS, sgld=SGLD, dcasgd=DCASGD,
+)
+
+
+class Updater:
+    """Applies an optimizer to (index, grad, weight) triples — the object
+    serialized to KVStore servers in the reference (``updater.py``)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):  # pylint: disable=unused-argument
+        import pickle
+
+        def to_host(s):
+            if s is None:
+                return None
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            return tuple(to_host(x) for x in s)
+
+        return pickle.dumps({k: to_host(v) for k, v in self.states.items()})
+
+    def set_states(self, states_blob):
+        import pickle
+
+        def to_dev(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(to_dev(x) for x in s)
+            return NDArray(s)
+
+        loaded = pickle.loads(states_blob)
+        for k, v in loaded.items():
+            self.states[k] = to_dev(v)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,10 @@
+"""``mxnet_tpu.parallel`` — SPMD parallelism over device meshes.
+
+The subsystems the reference lacks and SURVEY.md requires designed fresh:
+tensor/pipeline/sequence/expert parallelism and ZeRO-style sharding, built
+on ``jax.sharding`` + XLA collectives.
+"""
+from __future__ import annotations
+
+from . import mesh
+from .mesh import get_mesh, initialize_distributed, make_mesh, mesh_scope, set_mesh

@@ -1,0 +1,94 @@
+"""Device-mesh management for SPMD parallelism.
+
+No reference analog (the reference's parallelism is PS/NCCL data-parallel
+only, SURVEY.md §2.3 "absent" list) — this module is the foundation the TPU
+build adds: a global ``jax.sharding.Mesh`` with named axes (``dp``, ``fsdp``,
+``tp``, ``sp``, ``ep``...) that KVStore, Trainer, and the model zoo's
+sharding rules all reference.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as _onp
+
+from ..base import MXNetError
+
+_state = threading.local()
+
+
+def make_mesh(shape: Dict[str, int] = None, devices=None):
+    """Create a Mesh from an axis-name->size dict, e.g. {'dp': 2, 'tp': 4}."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = {"dp": len(devices)}
+    sizes = list(shape.values())
+    total = int(_onp.prod(sizes))
+    if total > len(devices):
+        raise MXNetError(
+            f"mesh shape {shape} needs {total} devices, have {len(devices)}")
+    arr = _onp.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(shape.keys()))
+
+
+def set_mesh(mesh):
+    _state.mesh = mesh
+    return mesh
+
+
+def get_mesh(create=False):
+    mesh = getattr(_state, "mesh", None)
+    if mesh is None and create:
+        import jax
+
+        if len(jax.devices()) >= 1:
+            mesh = make_mesh({"dp": len(jax.devices())})
+            _state.mesh = mesh
+    return mesh
+
+
+class mesh_scope:
+    """``with mesh_scope({'dp': 4, 'tp': 2}):`` — set + restore global mesh."""
+
+    def __init__(self, shape_or_mesh):
+        from jax.sharding import Mesh
+
+        if isinstance(shape_or_mesh, Mesh):
+            self._mesh = shape_or_mesh
+        else:
+            self._mesh = make_mesh(shape_or_mesh)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_state, "mesh", None)
+        _state.mesh = self._mesh
+        return self._mesh
+
+    def __exit__(self, *exc):
+        _state.mesh = self._prev
+        return False
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Multi-host init (reference: ps-lite scheduler env / dmlc tracker).
+
+    Maps ``DMLC_*``-style launch to ``jax.distributed.initialize``: no
+    scheduler/server roles — every process is a worker (SPMD
+    multi-controller, SURVEY.md §7 translation table).
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,92 @@
+"""Random number generation: stateful MXNet-style API over ``jax.random``.
+
+Reference: per-device seeded generator pools shared through the resource
+manager (``include/mxnet/random_generator.h``, ``src/resource.cc:93-138``),
+seeded by ``mx.random.seed``.
+
+TPU design: a process-global :class:`RandomState` holds a ``jax.random`` key
+and splits it per draw (eager mode). Inside a traced/compiled forward
+(``hybridize``), stateful splitting would bake one key into the executable,
+so the CachedOp installs a *trace RNG* whose draws are ``fold_in``s of a key
+that is an ordinary traced input — every compiled call gets fresh
+randomness, matching the reference where dropout re-draws per call via the
+engine's RNG resource (``kRandom`` in ``include/mxnet/resource.h``).
+"""
+from __future__ import annotations
+
+import threading
+
+
+def _jr():
+    import jax.random as jr
+
+    return jr
+
+
+class RandomState:
+    """Splittable stateful RNG."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = None
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = _jr().PRNGKey(self._seed)
+
+    def seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = _jr().PRNGKey(self._seed)
+
+    def next_key(self):
+        self._ensure()
+        self._key, sub = _jr().split(self._key)
+        return sub
+
+
+class TraceRNG:
+    """RNG used during jit tracing: folds a counter into a traced base key."""
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.counter = 0
+
+    def next_key(self):
+        self.counter += 1
+        return _jr().fold_in(self.base_key, self.counter)
+
+
+class _RNGStack(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.stack = []
+
+
+_global_state = RandomState(0)
+_trace_stack = _RNGStack()
+
+
+def seed(seed_state, ctx="all"):  # pylint: disable=unused-argument
+    """Seed the global generator (``mx.random.seed``)."""
+    _global_state.seed(seed_state)
+
+
+def next_key():
+    """Fresh PRNG key from the active generator (trace-aware)."""
+    if _trace_stack.stack:
+        return _trace_stack.stack[-1].next_key()
+    return _global_state.next_key()
+
+
+def push_trace_rng(base_key) -> TraceRNG:
+    rng = TraceRNG(base_key)
+    _trace_stack.stack.append(rng)
+    return rng
+
+
+def pop_trace_rng():
+    _trace_stack.stack.pop()
+
+
+def in_trace() -> bool:
+    return bool(_trace_stack.stack)

@@ -1,0 +1,87 @@
+"""Utility decorators / numpy-semantics switches.
+
+Reference: ``python/mxnet/util.py`` (1,179 LoC) whose main job is toggling
+legacy-vs-numpy shape/array semantics per thread. The TPU build is
+numpy-native, so the switches exist for API parity and always default on;
+``set_np(False)`` is honored for the flag readers but legacy zero-dim
+behavior is not re-created.
+"""
+from __future__ import annotations
+
+import functools
+
+from .base import _thread_state
+
+
+def is_np_shape() -> bool:
+    return _thread_state.np_shape
+
+
+def is_np_array() -> bool:
+    return _thread_state.np_array
+
+
+def set_np_shape(active: bool) -> bool:
+    prev = _thread_state.np_shape
+    _thread_state.np_shape = bool(active)
+    return prev
+
+
+def set_np(shape=True, array=True):
+    set_np_shape(shape)
+    prev = _thread_state.np_array
+    _thread_state.np_array = bool(array)
+    return prev
+
+
+def reset_np():
+    set_np(True, True)
+
+
+class _NumpyShapeScope:
+    def __init__(self, active):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_np_shape(self._active)
+
+    def __exit__(self, *exc):
+        set_np_shape(self._prev)
+        return False
+
+
+def np_shape(active=True):
+    return _NumpyShapeScope(active)
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func(*args, **kwargs)
+
+    return wrapper
+
+
+def use_np_array(func):
+    return func
+
+
+def use_np(func):
+    """Class/function decorator forcing numpy semantics (always-on here)."""
+    return func
+
+
+def np_array(active=True):  # pylint: disable=unused-argument
+    return _NumpyShapeScope(True)
+
+
+def get_cuda_compute_capability(ctx):  # pragma: no cover - API parity
+    return None
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    from . import numpy as _np
+
+    return _np.array(source_array, dtype=dtype, ctx=ctx)
