@@ -107,10 +107,26 @@ def apply(fn, args, kwargs=None, name="", record=True, sync_outputs=True):
         and autograd.is_recording()
         and any(_tracked(a) for a in arrays)
     )
+    was_list = False
+
+    def normalized(*xs):
+        # multi-output ops (split, qr, slogdet...) may return lists or
+        # namedtuples; the tape's cotangent convention is plain tuples, so
+        # normalize at the vjp boundary
+        r = closed(*xs)
+        if isinstance(r, list) or (isinstance(r, tuple) and hasattr(r, "_fields")):
+            return tuple(r)
+        return r
+
     if recording:
-        outs, vjp_fn = jax.vjp(closed, *datas)
+        outs, vjp_fn = jax.vjp(normalized, *datas)
     else:
         outs = closed(*datas)
+        if isinstance(outs, list):
+            was_list = True
+            outs = tuple(outs)
+        elif isinstance(outs, tuple) and hasattr(outs, "_fields"):
+            outs = tuple(outs)
 
     single = not isinstance(outs, (tuple, list))
     flat = [outs] if single else list(outs)
@@ -128,7 +144,9 @@ def apply(fn, args, kwargs=None, name="", record=True, sync_outputs=True):
 
     if sync_outputs:
         engine.maybe_sync(flat)
-    return wrapped[0] if single else type(outs)(wrapped)
+    if single:
+        return wrapped[0]
+    return list(wrapped) if was_list else type(outs)(wrapped)
 
 
 def apply_out(fn, args, kwargs=None, out=None, name=""):

@@ -1,0 +1,40 @@
+"""Test harness configuration.
+
+Reference test strategy (SURVEY.md §4): pytest with per-test seeds and
+reproducibility logging. TPU adaptation: all tests run on a virtual
+8-device CPU mesh (``xla_force_host_platform_device_count``) so sharding /
+collective paths execute without TPU hardware — the reference's
+multi-process-on-one-host trick done the JAX way.
+"""
+import os
+
+# must be set before jax initializes
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+# the axon sitecustomize pins JAX_PLATFORMS=axon; override to CPU for tests
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as _np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def seed_rngs(request):
+    """Seed numpy + framework RNGs per test (reference conftest.py:40-91)."""
+    seed = abs(hash(request.node.nodeid)) % (2**31)
+    marker = request.node.get_closest_marker("seed")
+    if marker is not None:
+        seed = marker.args[0]
+    _np.random.seed(seed)
+    import mxnet_tpu as mx
+
+    mx.random.seed(seed)
+    yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "seed(n): fix the RNG seed for a test")
+    config.addinivalue_line("markers", "serial: run without xdist")
+    config.addinivalue_line("markers", "integration: slower end-to-end test")

@@ -1,0 +1,151 @@
+"""Autograd tests (reference tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np
+
+
+def test_simple_grad():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x + 2 * x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_chain_and_fanout():
+    w = np.array([2.0])
+    w.attach_grad()
+    with autograd.record():
+        a = w * 3
+        b = w * 5
+        y = a * b  # y = 15 w^2, dy/dw = 30w = 60
+    y.backward()
+    onp.testing.assert_allclose(w.grad.asnumpy(), [60.0])
+
+
+def test_grad_req_modes():
+    x = np.ones((3,))
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            (x * x).sum().backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 4.0)  # accumulated
+
+    y = np.ones((3,))
+    y.attach_grad(grad_req="write")
+    for _ in range(2):
+        with autograd.record():
+            (y * y).sum().backward()
+    onp.testing.assert_allclose(y.grad.asnumpy(), 2.0)  # overwritten
+
+    z = np.ones((3,))
+    z.attach_grad(grad_req="null")
+    with autograd.record():
+        (z * z).sum().backward()
+    onp.testing.assert_allclose(z.grad.asnumpy(), 0.0)  # untouched
+
+
+def test_head_grads():
+    x = np.ones((2, 2))
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(np.array([[1.0, 2.0], [3.0, 4.0]]))
+    onp.testing.assert_allclose(x.grad.asnumpy(), [[3, 6], [9, 12]])
+
+
+def test_grad_function():
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (np.exp(x)).sum()
+    (g,) = autograd.grad([y], [x])
+    onp.testing.assert_allclose(g.asnumpy(), onp.exp(x.asnumpy()), rtol=1e-5)
+
+
+def test_pause_inside_record():
+    x = np.ones((2,))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = x * 100  # not recorded
+        out = (y + z.detach()).sum()
+    out.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2.0)
+
+
+def test_training_flags():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training() and autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.pause(train_mode=True):
+        assert autograd.is_training() and not autograd.is_recording()
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            import mxnet_tpu.numpy as mnp
+
+            y = 1 / (1 + mnp.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = np.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward(np.ones((3,)))
+    s = 1 / (1 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_multi_output_op_grad():
+    x = np.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        a, b = np.split(x, 2, axis=0)
+        y = (a * 2 + b * 3).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                [[2, 2, 2], [3, 3, 3]])
+
+
+def test_exception_on_disconnected():
+    x = np.ones((2,))
+    y = x * 2  # outside record
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_gradient_through_setitem():
+    x = np.zeros((3,))
+    v = np.array([1.0, 2.0, 3.0])
+    v.attach_grad()
+    with autograd.record():
+        x[:] = v * 2
+        loss = (x * x).sum()
+    loss.backward()
+    onp.testing.assert_allclose(v.grad.asnumpy(), 8 * v.asnumpy())
+
+
+def test_retain_graph():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), g1)

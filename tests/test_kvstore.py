@@ -1,0 +1,97 @@
+"""KVStore tests (reference test_kvstore_custom.py + dist_sync_kvstore.py
+exact-numeric style, run on the virtual 8-device CPU mesh)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+
+
+def test_local_init_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("w", np.ones((2, 2)))
+    out = np.zeros((2, 2))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 1)
+    kv.push("w", [np.ones((2, 2)) * 2, np.ones((2, 2)) * 3])
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 6)  # 1 + (2+3)
+
+
+def test_local_update_on_kvstore():
+    kv = mx.kv.create("device")
+    assert kv.is_capable(mx.kv.KVStoreBase.OPTIMIZER)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.init(0, np.ones((3,)))
+    kv.push(0, [np.ones((3,))])
+    out = np.zeros((3,))
+    kv.pull(0, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 0.5)  # 1 - 0.5*1
+
+
+def test_dist_tpu_sync_pushpull_exact():
+    kv = mx.kv.create("dist_tpu_sync")
+    n = 4
+    vals = [np.ones((8,)) * (i + 1) for i in range(n)]
+    outs = [np.zeros((8,)) for _ in range(n)]
+    kv.pushpull("g", vals, out=outs)
+    for o in outs:
+        onp.testing.assert_allclose(o.asnumpy(), 10.0)  # 1+2+3+4 exact
+
+
+def test_dist_tpu_sync_broadcast_and_barrier():
+    kv = mx.kv.create("dist_tpu_sync")
+    outs = [np.zeros((4,)) for _ in range(3)]
+    kv.broadcast("p", np.arange(4).astype("float32"), out=outs)
+    for o in outs:
+        onp.testing.assert_allclose(o.asnumpy(), [0, 1, 2, 3])
+    kv.barrier()
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_dist_aliases_and_async_rejection():
+    kv = mx.kv.create("dist_sync")
+    assert kv.type == "dist_tpu_sync"
+    with pytest.raises(mx.NotSupportedForTPUError):
+        mx.kv.create("dist_async")
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("no_such_store")
+
+
+def test_gradient_compression_error_feedback():
+    gc = mx.kvstore.GradientCompression(threshold=1.0)
+    g = np.array([0.6, -0.6, 0.2, 1.5])
+    c1 = gc.compress("k", g).asnumpy()
+    onp.testing.assert_allclose(c1, [0, 0, 0, 1.0])  # |0.6|<1 -> 0 + residual
+    c2 = gc.compress("k", g).asnumpy()
+    # residual 0.6 + new 0.6 = 1.2 -> quantizes to 1.0 now
+    onp.testing.assert_allclose(c2, [1.0, -1.0, 0, 1.0])
+
+
+def test_optimizer_states_save_load(tmp_path):
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.Adam())
+    kv.init(0, np.ones((2,)))
+    kv.push(0, [np.ones((2,))])
+    f = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(f)
+    kv.load_optimizer_states(f)
+
+
+def test_trainer_with_dist_tpu_sync():
+    from mxnet_tpu import autograd, gluon
+
+    net = gluon.nn.Dense(2, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="dist_tpu_sync")
+    x = np.ones((8, 4))
+    y = np.zeros((8,))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        l = loss_fn(net(x), y)
+    l.backward()
+    w0 = net.weight.data().asnumpy().copy()
+    tr.step(8)
+    assert onp.abs(net.weight.data().asnumpy() - w0).sum() > 0

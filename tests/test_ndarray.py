@@ -1,0 +1,129 @@
+"""NDArray semantics tests (reference tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+
+
+def test_creation_and_dtype():
+    a = np.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.int64  # numpy default-int parity
+    b = np.array([1.0, 2.0])
+    assert b.dtype == onp.float32  # MXNet default float dtype
+    c = np.zeros((3, 4), dtype="float64")
+    assert c.dtype == onp.float64
+
+
+def test_arithmetic_matches_numpy():
+    x = onp.random.rand(5, 7).astype("float32")
+    y = onp.random.rand(5, 7).astype("float32")
+    a, b = np.array(x), np.array(y)
+    onp.testing.assert_allclose((a + b).asnumpy(), x + y, rtol=1e-6)
+    onp.testing.assert_allclose((a - b).asnumpy(), x - y, rtol=1e-6)
+    onp.testing.assert_allclose((a * b).asnumpy(), x * y, rtol=1e-6)
+    onp.testing.assert_allclose((a / (b + 1)).asnumpy(), x / (y + 1), rtol=1e-6)
+    onp.testing.assert_allclose((a ** 2).asnumpy(), x ** 2, rtol=1e-6)
+    onp.testing.assert_allclose((a @ b.T).asnumpy(), x @ y.T, rtol=1e-5)
+    onp.testing.assert_allclose((2 - a).asnumpy(), 2 - x, rtol=1e-6)
+
+
+def test_inplace_and_version():
+    a = np.zeros((3,))
+    v0 = a._version
+    a += 1
+    assert a._version > v0
+    onp.testing.assert_allclose(a.asnumpy(), [1, 1, 1])
+
+
+def test_setitem_getitem():
+    a = np.zeros((4, 4))
+    a[1] = 7.0
+    a[2, 3] = 1.5
+    a[0, 1:3] = np.array([9.0, 8.0])
+    host = a.asnumpy()
+    assert host[1].sum() == 28
+    assert host[2, 3] == 1.5
+    assert host[0, 1] == 9 and host[0, 2] == 8
+    # advanced indexing
+    idx = np.array([0, 2])
+    sel = a[idx]
+    assert sel.shape == (2, 4)
+    # boolean mask: four 7s + 9 + 8
+    m = a > 5
+    assert int((a[m]).size) == 6
+
+
+def test_reductions_and_methods():
+    x = onp.random.rand(3, 4, 5).astype("float32")
+    a = np.array(x)
+    onp.testing.assert_allclose(a.sum(axis=1).asnumpy(), x.sum(1), rtol=1e-5)
+    onp.testing.assert_allclose(a.mean().asnumpy(), x.mean(), rtol=1e-5)
+    onp.testing.assert_allclose(a.max(axis=(0, 2)).asnumpy(), x.max((0, 2)))
+    onp.testing.assert_allclose(a.transpose(2, 0, 1).asnumpy(),
+                                x.transpose(2, 0, 1))
+    assert a.reshape(12, 5).shape == (12, 5)
+    assert a.reshape((-1,)).shape == (60,)
+    assert a.argmax(axis=2).shape == (3, 4)
+
+
+def test_scalar_protocol():
+    a = np.array(3.5)
+    assert float(a) == 3.5
+    assert a.item() == 3.5
+    with pytest.raises(ValueError):
+        bool(np.ones((2,)))
+    assert int(np.array(7)) == 7
+
+
+def test_copyto_and_context():
+    a = np.ones((2, 2))
+    b = np.zeros((2, 2))
+    a.copyto(b)
+    onp.testing.assert_allclose(b.asnumpy(), 1)
+    assert a.ctx.device_type == "cpu"
+    c = a.as_in_context(mx.cpu(0))
+    assert c is a  # same-context returns self
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.bin")
+    arrs = {"w": np.ones((3, 2)), "b": np.arange(4)}
+    mx.nd.save(fname, arrs)
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    onp.testing.assert_allclose(loaded["w"].asnumpy(), 1)
+    onp.testing.assert_allclose(loaded["b"].asnumpy(), [0, 1, 2, 3])
+    # list form
+    mx.nd.save(fname, [np.zeros((2,))])
+    assert isinstance(mx.nd.load(fname), list)
+
+
+def test_wait_to_read_and_waitall():
+    a = np.ones((16, 16)) @ np.ones((16, 16))
+    a.wait_to_read()
+    mx.waitall()
+    assert a.asnumpy()[0, 0] == 16
+
+
+def test_astype_detach():
+    a = np.ones((2,), dtype="float32")
+    b = a.astype("float16")
+    assert b.dtype == onp.float16
+    a.attach_grad()
+    d = a.detach()
+    assert d.grad is None
+
+
+def test_sparse_roundtrip():
+    dense = onp.zeros((5, 4), "float32")
+    dense[1] = 2.0
+    dense[3, 2] = 5.0
+    a = np.array(dense)
+    rs = a.tostype("row_sparse")
+    assert rs.stype == "row_sparse"
+    onp.testing.assert_allclose(rs.tostype("default").asnumpy(), dense)
+    csr = a.tostype("csr")
+    assert csr.stype == "csr"
+    onp.testing.assert_allclose(csr.tostype("default").asnumpy(), dense)

@@ -1,0 +1,162 @@
+"""Op correctness vs the NumPy oracle (reference test_numpy_op.py style)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+
+UNARY = ["exp", "log1p", "sqrt", "square", "sin", "cos", "tanh", "abs",
+         "floor", "ceil", "sign", "arctan", "log", "expm1", "cbrt"]
+BINARY = ["add", "subtract", "multiply", "maximum", "minimum", "arctan2",
+          "hypot", "logaddexp"]
+
+
+@pytest.mark.parametrize("name", UNARY)
+def test_unary_vs_numpy(name):
+    x = onp.random.rand(4, 5).astype("float32") + 0.5
+    got = getattr(np, name)(np.array(x)).asnumpy()
+    want = getattr(onp, name)(x)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_binary_vs_numpy(name):
+    x = onp.random.rand(4, 5).astype("float32") + 0.1
+    y = onp.random.rand(4, 5).astype("float32") + 0.1
+    got = getattr(np, name)(np.array(x), np.array(y)).asnumpy()
+    want = getattr(onp, name)(x, y)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_tensordot_einsum():
+    a = onp.random.rand(3, 4, 5).astype("float32")
+    b = onp.random.rand(5, 4, 2).astype("float32")
+    got = np.tensordot(np.array(a), np.array(b), axes=([2, 1], [0, 1])).asnumpy()
+    onp.testing.assert_allclose(got, onp.tensordot(a, b, axes=([2, 1], [0, 1])),
+                                rtol=1e-4)
+    got = np.einsum("ijk,kjl->il", np.array(a), np.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, onp.einsum("ijk,kjl->il", a, b), rtol=1e-4)
+
+
+def test_concat_stack_split():
+    x = onp.random.rand(2, 3).astype("float32")
+    y = onp.random.rand(2, 3).astype("float32")
+    onp.testing.assert_allclose(
+        np.concatenate([np.array(x), np.array(y)], axis=0).asnumpy(),
+        onp.concatenate([x, y], 0))
+    onp.testing.assert_allclose(
+        np.stack([np.array(x), np.array(y)], axis=1).asnumpy(),
+        onp.stack([x, y], 1))
+    parts = np.split(np.array(x), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+
+
+def test_where_clip_pad():
+    x = onp.random.randn(3, 3).astype("float32")
+    a = np.array(x)
+    onp.testing.assert_allclose(
+        np.where(a > 0, a, 0 * a).asnumpy(), onp.where(x > 0, x, 0))
+    onp.testing.assert_allclose(np.clip(a, -0.5, 0.5).asnumpy(),
+                                onp.clip(x, -0.5, 0.5))
+    onp.testing.assert_allclose(
+        np.pad(a, ((1, 1), (0, 0))).asnumpy(), onp.pad(x, ((1, 1), (0, 0))))
+
+
+def test_linalg():
+    x = onp.random.rand(4, 4).astype("float64")
+    spd = x @ x.T + 4 * onp.eye(4)
+    a = np.array(spd)
+    onp.testing.assert_allclose(np.linalg.cholesky(a).asnumpy(),
+                                onp.linalg.cholesky(spd), rtol=1e-6)
+    onp.testing.assert_allclose(np.linalg.inv(a).asnumpy(),
+                                onp.linalg.inv(spd), rtol=1e-5)
+    sign, logdet = np.linalg.slogdet(a)
+    s2, l2 = onp.linalg.slogdet(spd)
+    assert float(sign) == s2
+    onp.testing.assert_allclose(float(logdet), l2, rtol=1e-6)
+    onp.testing.assert_allclose(np.linalg.norm(a).asnumpy(),
+                                onp.linalg.norm(spd), rtol=1e-6)
+
+
+def test_fft():
+    x = onp.random.rand(8).astype("float64")
+    got = np.fft.fft(np.array(x)).asnumpy()
+    # jax fft computes in single precision on this backend
+    onp.testing.assert_allclose(got, onp.fft.fft(x), rtol=1e-5, atol=1e-5)
+
+
+def test_random_ops_shapes_and_ranges():
+    u = np.random.uniform(-2, 3, size=(100,))
+    assert u.shape == (100,)
+    host = u.asnumpy()
+    assert host.min() >= -2 and host.max() <= 3
+    n = np.random.normal(0, 1, size=(1000,))
+    assert abs(float(n.mean())) < 0.2
+    r = np.random.randint(0, 10, size=(50,))
+    assert r.dtype == onp.int64
+    assert (r.asnumpy() >= 0).all() and (r.asnumpy() < 10).all()
+    mx.random.seed(42)
+    a = np.random.uniform(size=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = np.random.uniform(size=(5,)).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+
+
+def test_npx_softmax_log_softmax():
+    x = onp.random.randn(4, 10).astype("float32")
+    s = npx.softmax(np.array(x)).asnumpy()
+    onp.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+    ls = npx.log_softmax(np.array(x)).asnumpy()
+    onp.testing.assert_allclose(onp.exp(ls).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_npx_one_hot_pick_topk():
+    idx = np.array([1, 0, 3])
+    oh = npx.one_hot(idx, 4).asnumpy()
+    assert oh.shape == (3, 4) and oh[0, 1] == 1 and oh[2, 3] == 1
+    data = np.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    picked = npx.pick(data, np.array([0, 1, 2])).asnumpy()
+    onp.testing.assert_allclose(picked, [0, 5, 10])
+    vals = npx.topk(data, k=2, ret_typ="value").asnumpy()
+    onp.testing.assert_allclose(vals[:, 0], [3, 7, 11])
+
+
+def test_npx_sequence_ops():
+    x = onp.arange(24, dtype="float32").reshape(4, 2, 3)  # (T,B,C)
+    slen = np.array([2, 4])
+    masked = npx.sequence_mask(np.array(x), slen, use_sequence_length=True,
+                               value=-1.0).asnumpy()
+    assert (masked[2:, 0] == -1).all() and (masked[:, 1] != -1).all()
+
+
+def test_convolution_vs_manual():
+    x = onp.random.rand(1, 1, 5, 5).astype("float32")
+    w = onp.random.rand(1, 1, 3, 3).astype("float32")
+    out = npx.convolution(np.array(x), np.array(w), kernel=(3, 3),
+                          num_filter=1).asnumpy()
+    # manual valid conv
+    want = onp.zeros((1, 1, 3, 3), "float32")
+    for i in range(3):
+        for j in range(3):
+            want[0, 0, i, j] = (x[0, 0, i:i+3, j:j+3] * w[0, 0]).sum()
+    onp.testing.assert_allclose(out, want, rtol=1e-4)
+
+
+def test_pooling_modes():
+    x = onp.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    mx_max = npx.pooling(np.array(x), kernel=(2, 2), stride=(2, 2)).asnumpy()
+    onp.testing.assert_allclose(mx_max[0, 0], [[5, 7], [13, 15]])
+    mx_avg = npx.pooling(np.array(x), kernel=(2, 2), stride=(2, 2),
+                         pool_type="avg").asnumpy()
+    onp.testing.assert_allclose(mx_avg[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    g = npx.pooling(np.array(x), global_pool=True, pool_type="max").asnumpy()
+    assert g[0, 0, 0, 0] == 15
+
+
+def test_batch_norm_inference_only_returns_out():
+    x = np.array(onp.random.rand(2, 3, 4, 4).astype("float32"))
+    g = np.ones((3,)); b = np.zeros((3,))
+    rm = np.zeros((3,)); rv = np.ones((3,))
+    out = npx.batch_norm(x, g, b, rm, rv)
+    assert not isinstance(out, tuple)
+    assert out.shape == x.shape

@@ -1,0 +1,111 @@
+"""Optimizer tests: each rule vs a NumPy re-implementation on one step."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.optimizer import create
+
+
+def _run_steps(opt_name, kwargs, steps=3):
+    onp.random.seed(0)
+    w0 = onp.random.rand(4, 3).astype("float32")
+    grads = [onp.random.rand(4, 3).astype("float32") - 0.5 for _ in range(steps)]
+    opt = create(opt_name, **kwargs)
+    w = np.array(w0)
+    state = opt.create_state_multi_precision(0, w)
+    for g in grads:
+        opt.update_multi_precision(0, w, np.array(g), state)
+    return w0, grads, w.asnumpy()
+
+
+def test_sgd_matches_manual():
+    w0, grads, got = _run_steps("sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                                        "wd": 0.01})
+    w = w0.copy()
+    mom = onp.zeros_like(w)
+    for g in grads:
+        g = g + 0.01 * w
+        mom = 0.9 * mom - 0.1 * g
+        w = w + mom
+    onp.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_adam_matches_manual():
+    w0, grads, got = _run_steps("adam", {"learning_rate": 0.01})
+    w = w0.copy()
+    m = onp.zeros_like(w)
+    v = onp.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9 ** t)
+        vh = v / (1 - 0.999 ** t)
+        w = w - 0.01 * mh / (onp.sqrt(vh) + 1e-8)
+    onp.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    w0, grads, got = _run_steps("adamw", {"learning_rate": 0.01, "wd": 0.1})
+    w = w0.copy()
+    m = onp.zeros_like(w)
+    v = onp.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9 ** t)
+        vh = v / (1 - 0.999 ** t)
+        w = w - 0.01 * (mh / (onp.sqrt(vh) + 1e-8) + 0.1 * w)
+    onp.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.05}),
+    ("adadelta", {}),
+    ("adamax", {"learning_rate": 0.05}),
+    ("nadam", {"learning_rate": 0.05}),
+    ("ftrl", {}),
+    ("ftml", {"learning_rate": 0.05}),
+    ("signum", {"learning_rate": 0.01}),
+    ("lars", {"learning_rate": 0.05}),
+    ("lamb", {"learning_rate": 0.05}),
+    ("lans", {"learning_rate": 0.05}),
+    ("sgld", {"learning_rate": 0.01}),
+    ("dcasgd", {"learning_rate": 0.01}),
+])
+def test_optimizer_decreases_quadratic(name, kwargs):
+    """Every optimizer must make progress on a simple quadratic."""
+    target = onp.array([1.0, -2.0, 3.0], "float32")
+    w = np.array(onp.zeros(3, "float32"))
+    opt = create(name, **kwargs)
+    state = opt.create_state(0, w)
+    loss0 = float(((w.asnumpy() - target) ** 2).sum())
+    for _ in range(400):
+        g = 2 * (w.asnumpy() - target)
+        opt.update(0, w, np.array(g), state)
+    loss1 = float(((w.asnumpy() - target) ** 2).sum())
+    assert loss1 < loss0 * 0.5, f"{name}: {loss0} -> {loss1}"
+
+
+def test_multi_precision_fp16():
+    opt = create("sgd", learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w = np.array(onp.ones(4, "float16"))
+    state = opt.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple) and state[0].dtype == onp.float32
+    opt.update_multi_precision(0, w, np.array(onp.ones(4, "float16")), state)
+    assert w.dtype == onp.float16
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert sched(0) == 1.0
+    assert sched(10) == 0.5
+    assert sched(25) == 0.25
+    cos = mx.lr_scheduler.CosineScheduler(100, base_lr=1.0, final_lr=0.0)
+    assert cos(0) == pytest.approx(1.0)
+    assert cos(50) == pytest.approx(0.5, abs=1e-6)
+    assert cos(100) == 0.0
+    warm = mx.lr_scheduler.PolyScheduler(100, base_lr=1.0, warmup_steps=10)
+    assert warm(5) < 1.0
