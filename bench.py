@@ -1,0 +1,72 @@
+"""Headline benchmark: ResNet-50 v1 inference throughput, batch 32.
+
+Reference baseline (BASELINE.md, ``docs/.../perf.md:193``): 1,076.81 img/s
+on a V100 (MXNet 1.2 + cuDNN, ``example/image-classification/
+benchmark_score.py`` protocol: synthetic data, fp32, batch 32). Same
+protocol here through the user-facing path: model-zoo net → ``hybridize()``
+→ one XLA executable per signature, run on the TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_IMG_S = 1076.81  # V100 fp32 bs32, perf.md:193
+BATCH = 32
+SIZE = 224
+WARMUP = 3
+ITERS = 30
+
+
+def main():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import np as mnp
+
+    try:
+        ctx = mx.tpu()
+        ctx.jax_device()
+    except Exception:
+        ctx = mx.cpu()
+
+    net = gluon.model_zoo.vision.resnet50_v1()
+    net.initialize(ctx=mx.cpu())
+    # materialize deferred param shapes with one cheap eager CPU forward,
+    # then move weights to the accelerator and compile there
+    small = mnp.array(onp.zeros((1, 3, 64, 64), dtype="float32"), ctx=mx.cpu())
+    with autograd.predict_mode():
+        net(small)
+    if ctx.device_type != "cpu":
+        net.reset_ctx(ctx)
+    net.hybridize(static_alloc=True)
+
+    x = mnp.array(
+        onp.random.uniform(-1, 1, (BATCH, 3, SIZE, SIZE)).astype("float32"),
+        ctx=ctx)
+    with autograd.predict_mode():
+        for _ in range(WARMUP):
+            out = net(x)
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = net(x)
+        out.wait_to_read()
+        dt = time.perf_counter() - t0
+
+    img_s = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_v1_infer_bs32_fp32",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,168 @@
+"""Inception V3 (reference:
+``python/mxnet/gluon/model_zoo/vision/inception.py``)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+from ....base import MXNetError
+from ....ops import nn as _ops
+
+
+def _make_basic_conv(**kwargs):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(use_bias=False, **kwargs))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+def _make_branch(use_pool, *conv_settings):
+    out = nn.HybridSequential()
+    if use_pool == "avg":
+        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif use_pool == "max":
+        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    for setting in conv_settings:
+        kwargs = {}
+        channels, kernel, stride, padding = setting
+        kwargs["channels"] = channels
+        kwargs["kernel_size"] = kernel
+        if stride is not None:
+            kwargs["strides"] = stride
+        if padding is not None:
+            kwargs["padding"] = padding
+        out.add(_make_basic_conv(**kwargs))
+    return out
+
+
+class _Concurrent(HybridBlock):
+    """Run child branches on the same input and concat on channels."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._branches = []
+
+    def add(self, block):
+        self._branches.append(block)
+        self.register_child(block, str(len(self._branches) - 1))
+
+    def forward(self, x):
+        return _ops.concat(*[b(x) for b in self._branches], dim=1)
+
+
+def _make_A(pool_features):
+    out = _Concurrent()
+    out.add(_make_branch(None, (64, 1, None, None)))
+    out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
+    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                         (96, 3, None, 1)))
+    out.add(_make_branch("avg", (pool_features, 1, None, None)))
+    return out
+
+
+def _make_B():
+    out = _Concurrent()
+    out.add(_make_branch(None, (384, 3, 2, None)))
+    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                         (96, 3, 2, None)))
+    out.add(_make_branch("max"))
+    return out
+
+
+def _make_C(channels_7x7):
+    out = _Concurrent()
+    out.add(_make_branch(None, (192, 1, None, None)))
+    out.add(_make_branch(None, (channels_7x7, 1, None, None),
+                         (channels_7x7, (1, 7), None, (0, 3)),
+                         (192, (7, 1), None, (3, 0))))
+    out.add(_make_branch(None, (channels_7x7, 1, None, None),
+                         (channels_7x7, (7, 1), None, (3, 0)),
+                         (channels_7x7, (1, 7), None, (0, 3)),
+                         (channels_7x7, (7, 1), None, (3, 0)),
+                         (192, (1, 7), None, (0, 3))))
+    out.add(_make_branch("avg", (192, 1, None, None)))
+    return out
+
+
+def _make_D():
+    out = _Concurrent()
+    out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
+    out.add(_make_branch(None, (192, 1, None, None),
+                         (192, (1, 7), None, (0, 3)),
+                         (192, (7, 1), None, (3, 0)),
+                         (192, 3, 2, None)))
+    out.add(_make_branch("max"))
+    return out
+
+
+class _SplitConcat(HybridBlock):
+    """Branch whose tail splits into parallel convs concat'd back (E block)."""
+
+    def __init__(self, head_settings, tails, **kwargs):
+        super().__init__(**kwargs)
+        self.head = (_make_branch(None, *head_settings) if head_settings
+                     else None)
+        self._tails = []
+        for i, t in enumerate(tails):
+            blk = _make_branch(None, t)
+            self._tails.append(blk)
+            self.register_child(blk, f"tail{i}")
+
+    def forward(self, x):
+        if self.head is not None:
+            x = self.head(x)
+        return _ops.concat(*[t(x) for t in self._tails], dim=1)
+
+
+def _make_E():
+    out = _Concurrent()
+    out.add(_make_branch(None, (320, 1, None, None)))
+    out.add(_SplitConcat([(384, 1, None, None)],
+                         [(384, (1, 3), None, (0, 1)),
+                          (384, (3, 1), None, (1, 0))]))
+    out.add(_SplitConcat([(448, 1, None, None), (384, 3, None, 1)],
+                         [(384, (1, 3), None, (0, 1)),
+                          (384, (3, 1), None, (1, 0))]))
+    out.add(_make_branch("avg", (192, 1, None, None)))
+    return out
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(_make_basic_conv(channels=32, kernel_size=3,
+                                           strides=2))
+        self.features.add(_make_basic_conv(channels=32, kernel_size=3))
+        self.features.add(_make_basic_conv(channels=64, kernel_size=3,
+                                           padding=1))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_basic_conv(channels=80, kernel_size=1))
+        self.features.add(_make_basic_conv(channels=192, kernel_size=3))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_A(32))
+        self.features.add(_make_A(64))
+        self.features.add(_make_A(64))
+        self.features.add(_make_B())
+        self.features.add(_make_C(128))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(192))
+        self.features.add(_make_D())
+        self.features.add(_make_E())
+        self.features.add(_make_E())
+        self.features.add(nn.AvgPool2D(pool_size=8))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.reshape(x.shape[0], -1)
+        return self.output(x)
+
+
+def inception_v3(pretrained=False, ctx=None, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights are not bundled; use "
+                         "load_parameters")
+    return Inception3(**kwargs)

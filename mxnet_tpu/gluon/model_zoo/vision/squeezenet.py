@@ -1,0 +1,90 @@
+"""SqueezeNet 1.0/1.1 (reference:
+``python/mxnet/gluon/model_zoo/vision/squeezenet.py``)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+from ....base import MXNetError
+from ....ops import nn as _ops
+
+
+class _Fire(HybridBlock):
+    def __init__(self, squeeze_channels, expand1x1_channels,
+                 expand3x3_channels, **kwargs):
+        super().__init__(**kwargs)
+        self.squeeze = nn.Conv2D(squeeze_channels, kernel_size=1,
+                                 activation="relu")
+        self.expand1x1 = nn.Conv2D(expand1x1_channels, kernel_size=1,
+                                   activation="relu")
+        self.expand3x3 = nn.Conv2D(expand3x3_channels, kernel_size=3,
+                                   padding=1, activation="relu")
+
+    def forward(self, x):
+        x = self.squeeze(x)
+        return _ops.concat(self.expand1x1(x), self.expand3x3(x), dim=1)
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        if version not in ("1.0", "1.1"):
+            raise MXNetError(f"unsupported SqueezeNet version {version}")
+        self.features = nn.HybridSequential()
+        if version == "1.0":
+            self.features.add(nn.Conv2D(96, kernel_size=7, strides=2,
+                                        activation="relu"))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True))
+            self.features.add(_Fire(16, 64, 64))
+            self.features.add(_Fire(16, 64, 64))
+            self.features.add(_Fire(32, 128, 128))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True))
+            self.features.add(_Fire(32, 128, 128))
+            self.features.add(_Fire(48, 192, 192))
+            self.features.add(_Fire(48, 192, 192))
+            self.features.add(_Fire(64, 256, 256))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True))
+            self.features.add(_Fire(64, 256, 256))
+        else:
+            self.features.add(nn.Conv2D(64, kernel_size=3, strides=2,
+                                        activation="relu"))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True))
+            self.features.add(_Fire(16, 64, 64))
+            self.features.add(_Fire(16, 64, 64))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True))
+            self.features.add(_Fire(32, 128, 128))
+            self.features.add(_Fire(32, 128, 128))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True))
+            self.features.add(_Fire(48, 192, 192))
+            self.features.add(_Fire(48, 192, 192))
+            self.features.add(_Fire(64, 256, 256))
+            self.features.add(_Fire(64, 256, 256))
+        self.features.add(nn.Dropout(0.5))
+
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(classes, kernel_size=1, activation="relu"))
+        self.output.add(nn.GlobalAvgPool2D())
+        self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_squeezenet(version, pretrained=False, ctx=None, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights are not bundled; use "
+                         "load_parameters")
+    return SqueezeNet(version, **kwargs)
+
+
+def squeezenet1_0(**kwargs):
+    return get_squeezenet("1.0", **kwargs)
+
+
+def squeezenet1_1(**kwargs):
+    return get_squeezenet("1.1", **kwargs)

@@ -837,6 +837,15 @@ def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
     return _apply(f, (lhs, rhs), name="batch_dot")
 
 
+def concat(*data, dim=1):
+    """Concatenate along ``dim`` (reference op ``Concat``/``concat``,
+    ``src/operator/nn/concat.cc``). Delegates to the numpy namespace so
+    there is a single concat implementation."""
+    from .. import numpy as _mxnp
+
+    return _mxnp.concatenate(list(data), axis=dim)
+
+
 def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):  # pylint: disable=unused-argument
     jnp = _jnp()
     from ..ndarray.ndarray import NDArray
@@ -852,6 +861,6 @@ for _name in (
     "dropout", "softmax", "log_softmax", "masked_softmax", "embedding",
     "one_hot", "pick", "topk", "sequence_mask", "sequence_last",
     "sequence_reverse", "ctc_loss", "attention", "leaky_relu", "relu",
-    "sigmoid", "tanh", "batch_dot", "gather_nd", "scatter_nd",
+    "sigmoid", "tanh", "batch_dot", "gather_nd", "scatter_nd", "concat",
 ):
     _register(_name, globals()[_name])

@@ -112,21 +112,21 @@ def apply(fn, args, kwargs=None, name="", record=True, sync_outputs=True):
     def normalized(*xs):
         # multi-output ops (split, qr, slogdet...) may return lists or
         # namedtuples; the tape's cotangent convention is plain tuples, so
-        # normalize at the vjp boundary
+        # normalize at the vjp boundary (remembering listness so the caller
+        # sees the same container type with or without recording)
+        nonlocal was_list
         r = closed(*xs)
-        if isinstance(r, list) or (isinstance(r, tuple) and hasattr(r, "_fields")):
+        if isinstance(r, list):
+            was_list = True
+            return tuple(r)
+        if isinstance(r, tuple) and hasattr(r, "_fields"):
             return tuple(r)
         return r
 
     if recording:
         outs, vjp_fn = jax.vjp(normalized, *datas)
     else:
-        outs = closed(*datas)
-        if isinstance(outs, list):
-            was_list = True
-            outs = tuple(outs)
-        elif isinstance(outs, tuple) and hasattr(outs, "_fields"):
-            outs = tuple(outs)
+        outs = normalized(*datas)
 
     single = not isinstance(outs, (tuple, list))
     flat = [outs] if single else list(outs)

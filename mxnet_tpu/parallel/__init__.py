@@ -8,3 +8,5 @@ from __future__ import annotations
 
 from . import mesh
 from .mesh import get_mesh, initialize_distributed, make_mesh, mesh_scope, set_mesh
+from . import functional
+from .functional import ShardedTrainer, ShardingRules, functionalize

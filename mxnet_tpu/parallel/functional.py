@@ -1,0 +1,337 @@
+"""Functionalization + SPMD sharded training step.
+
+The reference's distributed step (SURVEY.md §3.4) is imperative: per-param
+``kvstore.pushpull`` after backward, optimizer on worker or server. The
+TPU-native step is one compiled SPMD program: params/optimizer state laid out
+over a ``jax.sharding.Mesh`` by named rules, batch sharded over ``dp``(+``sp``),
+gradients reduced by XLA-inserted collectives over ICI, update fused into the
+same executable. This module provides:
+
+* :func:`functionalize` — pure ``fn(params, *args)`` view of any Gluon
+  ``Block`` (the deferred-compute trace collapsed onto jax tracing).
+* sharding rules — regex → ``PartitionSpec`` tables with an fsdp-style
+  default, the declarative replacement for ps-lite key sharding
+  (``EncodeDefaultKey``, ``src/kvstore/kvstore_dist.h:621``).
+* :class:`ShardedTrainer` — the ``gluon.Trainer`` analog whose ``step`` is a
+  single pjit'd (loss, grads, allreduce, update) program.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _P():
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec
+
+
+# ---------------------------------------------------------------------------
+# functionalize
+# ---------------------------------------------------------------------------
+
+
+def functionalize(block, train_mode=False):
+    """Return ``(apply_fn, params)`` for a Gluon block.
+
+    ``apply_fn(params_dict, *args)`` is pure and jittable: it replays
+    ``block.forward`` with the dict's arrays bound to the block's parameters
+    (the CachedOp trick, ``mxnet_tpu/cachedop.py``). Outputs are raw jax
+    arrays. Parameter shapes must already be materialized (run one eager
+    forward first for deferred-shape layers).
+
+    When ``train_mode`` and the block holds mutable state (BatchNorm running
+    stats — ``grad_req='null'`` parameters), ``apply_fn`` returns
+    ``(outputs, new_state_dict)`` so callers can carry state functionally.
+    """
+    from .. import autograd
+    from .. import random as _rng
+    from ..cachedop import _ParamBinding
+    from ..ndarray.ndarray import NDArray
+
+    params_od = block.collect_params()
+    names = list(params_od)
+    arrays = [params_od[n].data() for n in names]
+    state_names = [n for n in names if params_od[n].grad_req == "null"]
+
+    def apply_fn(param_datas, *arg_datas, rng_key=None):
+        import jax
+
+        tracers = [param_datas[n] for n in names]
+        wrapped_args = []
+        for d in arg_datas:
+            w = NDArray.__new__(NDArray)
+            w._data = d
+            w._tape = None
+            w._leaf = None
+            w._version = 0
+            w._stype = "default"
+            wrapped_args.append(w)
+        with _ParamBinding(arrays, tracers):
+            if rng_key is None:
+                rng_key = _rng.next_key()
+            _rng.push_trace_rng(rng_key)
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(train_mode)
+            try:
+                outs = block.forward(*wrapped_args)
+            finally:
+                autograd.set_training(prev_train)
+                autograd.set_recording(prev_rec)
+                _rng.pop_trace_rng()
+            new_state = {n: a._data for n, a in zip(names, arrays)
+                         if n in state_names}
+        flat, tree = jax.tree_util.tree_flatten(
+            outs, is_leaf=lambda x: isinstance(x, NDArray))
+        datas = [o._data if isinstance(o, NDArray) else o for o in flat]
+        out = jax.tree_util.tree_unflatten(tree, datas)
+        if train_mode and state_names:
+            return out, new_state
+        return out
+
+    params = {n: a._data for n, a in zip(names, arrays)}
+    return apply_fn, params
+
+
+# ---------------------------------------------------------------------------
+# sharding rules
+# ---------------------------------------------------------------------------
+
+
+class ShardingRules:
+    """Ordered ``(regex, PartitionSpec)`` table mapping param names to specs.
+
+    First match wins; no match → fsdp default (if an ``fsdp`` axis exists:
+    shard the largest divisible dim) else fully replicated.
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, object]] = (),
+                 default_axis: Optional[str] = "fsdp"):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.default_axis = default_axis
+
+    def spec_for(self, name, shape, mesh):
+        P = _P()
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return spec
+        if self.default_axis and self.default_axis in mesh.axis_names:
+            n = mesh.shape[self.default_axis]
+            # largest dim divisible by the fsdp axis size, else replicate
+            order = sorted(range(len(shape)), key=lambda i: -shape[i])
+            for i in order:
+                if shape[i] % n == 0 and shape[i] >= n:
+                    parts = [None] * len(shape)
+                    parts[i] = self.default_axis
+                    return P(*parts)
+        return P()
+
+    def shard(self, params: Dict[str, object], mesh):
+        """Place a param dict onto the mesh per the rules."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        out = {}
+        for name, arr in params.items():
+            spec = self.spec_for(name, arr.shape, mesh)
+            out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# sharded training step
+# ---------------------------------------------------------------------------
+
+
+class ShardedTrainer:
+    """SPMD trainer: the whole step is one compiled XLA program.
+
+    Replaces the reference's step (forward → backward → per-param
+    ``kvstore.pushpull`` → per-param optimizer kernels) with a single pjit:
+    data parallelism comes from sharding the batch (``batch_spec``), tensor
+    parallelism from the param rules, and gradient reduction from XLA's
+    automatic collective insertion — serving the role the `Comm`/ps-lite/NCCL
+    stack plays in `src/kvstore/` but riding ICI.
+
+    Usage::
+
+        trainer = ShardedTrainer(net, loss_fn, 'sgd',
+                                 {'learning_rate': 0.1}, mesh=mesh,
+                                 rules=ShardingRules([(r'dense\\d+.weight',
+                                                       P('tp', None))]))
+        loss = trainer.step(x, y)          # one fused SPMD step
+        trainer.sync_to_block()            # write weights back to the Block
+    """
+
+    def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
+                 mesh=None, rules: Optional[ShardingRules] = None,
+                 batch_spec=None):
+        import jax
+        from jax.sharding import NamedSharding
+
+        from ..optimizer import optimizer as opt_mod
+        from . import mesh as mesh_mod
+
+        self.block = block
+        self.loss_fn = loss_fn
+        if isinstance(optimizer, str):
+            self.optimizer = opt_mod.create(optimizer,
+                                            **(optimizer_params or {}))
+        else:
+            self.optimizer = optimizer
+        self.mesh = mesh if mesh is not None else mesh_mod.get_mesh(create=True)
+        if self.mesh is None:
+            raise MXNetError("ShardedTrainer needs a device mesh")
+        self.rules = rules or ShardingRules()
+        P = _P()
+        if batch_spec is None:
+            batch_spec = P("dp") if "dp" in self.mesh.axis_names else P()
+        self.batch_spec = batch_spec
+
+        self._apply_fn, params = functionalize(block, train_mode=True)
+        params_od = block.collect_params()
+        self._train_names = [n for n in params
+                             if params_od[n].grad_req != "null"]
+        self._state_names = [n for n in params
+                             if params_od[n].grad_req == "null"]
+        # placement: params + optimizer state onto the mesh by rule
+        self.params = self.rules.shard(params, self.mesh)
+        self._opt_states = self._init_opt_states()
+        self._step_jit = None
+        self._step_count = 0
+        self._key = jax.random.PRNGKey(0)
+
+    # -- optimizer state --------------------------------------------------
+    def _init_opt_states(self):
+        import jax
+        from jax.sharding import NamedSharding
+
+        from ..gluon.trainer import _flatten_state
+        from ..ndarray.ndarray import NDArray
+
+        states = {}
+        for i, n in enumerate(self._train_names):
+            w = NDArray(self.params[n])
+            st = self.optimizer.create_state_multi_precision(i, w)
+            flat = [s._data for s in _flatten_state(st)]
+            spec = self.rules.spec_for(n, self.params[n].shape, self.mesh)
+            placed = []
+            for s in flat:
+                sh = (NamedSharding(self.mesh, spec) if s.shape == w.shape
+                      else NamedSharding(self.mesh, _P()))
+                placed.append(jax.device_put(s, sh))
+            states[n] = tuple(placed)
+        return states
+
+    # -- the compiled step ------------------------------------------------
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        apply_fn = self._apply_fn
+        loss_fn = self.loss_fn
+        opt = self.optimizer
+        train_names = self._train_names
+        state_names = self._state_names
+        has_state = bool(state_names)
+
+        def loss_of(train_params, state_params, batch, labels, key):
+            params = dict(train_params)
+            params.update(state_params)
+            r = apply_fn(params, batch, rng_key=key)
+            if has_state:
+                out, new_state = r
+            else:
+                out, new_state = r, {}
+            from ..ndarray.ndarray import NDArray
+
+            out_nd = NDArray(out) if not isinstance(out, NDArray) else out
+            lbl_nd = NDArray(labels)
+            loss = loss_fn(out_nd, lbl_nd)
+            ldata = loss._data if isinstance(loss, NDArray) else loss
+            return jnp.mean(ldata), new_state
+
+        def step(train_params, state_params, opt_states, batch, labels, key,
+                 lr, t):
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_params, state_params, batch,
+                                       labels, key)
+            new_train = {}
+            new_opt = {}
+            for i, n in enumerate(train_names):
+                g = opt._prep_grad(grads[n].astype(train_params[n].dtype))
+                wd = opt._get_wd(i)
+                p_new, s_new = opt._update_raw(train_params[n], g,
+                                               opt_states[n], lr, wd, t)
+                new_train[n] = p_new
+                new_opt[n] = tuple(s_new) if isinstance(s_new, (list, tuple)) \
+                    else (s_new,)
+            return new_train, new_state, new_opt, loss
+
+        from jax.sharding import NamedSharding
+
+        mesh = self.mesh
+        p_shard = {
+            n: NamedSharding(mesh,
+                             self.rules.spec_for(n, self.params[n].shape,
+                                                 mesh))
+            for n in self.params
+        }
+        train_shard = {n: p_shard[n] for n in train_names}
+        state_shard = {n: p_shard[n] for n in state_names}
+        opt_shard = {
+            n: tuple(
+                NamedSharding(mesh, s.sharding.spec)
+                for s in self._opt_states[n])
+            for n in train_names
+        }
+        batch_shard = NamedSharding(mesh, self.batch_spec)
+        repl = NamedSharding(mesh, _P()())
+        self._step_jit = jax.jit(
+            step,
+            in_shardings=(train_shard, state_shard, opt_shard, batch_shard,
+                          batch_shard, repl, None, None),
+            out_shardings=(train_shard, state_shard, opt_shard, repl),
+            donate_argnums=(0, 1, 2),
+        )
+
+    def step(self, data, labels):
+        """Run one SPMD training step; returns scalar loss (host float)."""
+        import jax
+
+        from ..ndarray.ndarray import NDArray
+
+        if self._step_jit is None:
+            self._build_step()
+        d = data._data if isinstance(data, NDArray) else data
+        l = labels._data if isinstance(labels, NDArray) else labels
+        self._step_count += 1
+        t = self._step_count
+        for i in range(len(self._train_names)):
+            self.optimizer._index_update_count[i] = t
+        self._key, sub = jax.random.split(self._key)
+        train = {n: self.params[n] for n in self._train_names}
+        state = {n: self.params[n] for n in self._state_names}
+        new_train, new_state, new_opt, loss = self._step_jit(
+            train, state, self._opt_states, d, l, sub,
+            self.optimizer._get_lr(0), t)
+        self.params.update(new_train)
+        self.params.update(new_state)
+        self._opt_states = new_opt
+        return float(loss)
+
+    def sync_to_block(self):
+        """Copy trained weights back into the Block's Parameters."""
+        params_od = self.block.collect_params()
+        for n, arr in self.params.items():
+            params_od[n].data()._set_data_internal(arr)

@@ -29,6 +29,7 @@ class RandomState:
     def __init__(self, seed: int = 0):
         self._seed = seed
         self._key = None
+        self._counter = 0
 
     def _ensure(self):
         if self._key is None:
@@ -37,11 +38,16 @@ class RandomState:
     def seed(self, seed: int):
         self._seed = int(seed)
         self._key = _jr().PRNGKey(self._seed)
+        self._counter = 0
 
     def next_key(self):
+        # fold_in of a python counter rather than storing split() results:
+        # if next_key is reached inside someone's jit trace, the stored state
+        # (concrete key + int) must never become a tracer or it leaks out of
+        # the trace and poisons later draws
         self._ensure()
-        self._key, sub = _jr().split(self._key)
-        return sub
+        self._counter += 1
+        return _jr().fold_in(self._key, self._counter)
 
 
 class TraceRNG:

@@ -7,9 +7,13 @@ collective paths execute without TPU hardware — the reference's
 multi-process-on-one-host trick done the JAX way.
 """
 import os
+import zlib
 
-# must be set before jax initializes
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# must be set before jax initializes; append so a user-supplied XLA_FLAGS
+# (e.g. --xla_dump_to) doesn't silently collapse the virtual mesh to 1 device
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
 
 import jax  # noqa: E402
 
@@ -23,7 +27,9 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def seed_rngs(request):
     """Seed numpy + framework RNGs per test (reference conftest.py:40-91)."""
-    seed = abs(hash(request.node.nodeid)) % (2**31)
+    # crc32, not hash(): str hashing is randomized per process, which would
+    # defeat the reproducibility this fixture exists to provide
+    seed = zlib.crc32(request.node.nodeid.encode()) % (2**31)
     marker = request.node.get_closest_marker("seed")
     if marker is not None:
         seed = marker.args[0]

@@ -1,0 +1,73 @@
+"""Model zoo tests (reference: tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def _x(n=2, c=3, s=32):
+    return mx.np.array(np.random.randn(n, c, s, s).astype("float32"))
+
+
+@pytest.mark.parametrize("name", [
+    "resnet18_v1", "resnet18_v2", "mobilenet0.25", "mobilenetv2_0.25",
+])
+def test_small_models_forward(name):
+    net = gluon.model_zoo.get_model(name, classes=10)
+    net.initialize()
+    out = net(_x())
+    assert out.shape == (2, 10)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_resnet_thumbnail_train_step():
+    net = gluon.model_zoo.vision.get_resnet(1, 18, classes=10, thumbnail=True)
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = _x()
+    y = mx.np.array(np.array([1, 3]))
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    g = net.collect_params()["features.0.weight"].grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_resnet_hybridize_matches_eager():
+    net = gluon.model_zoo.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = _x(1)
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-4)
+
+
+def test_get_model_unknown_name():
+    with pytest.raises(mx.MXNetError):
+        gluon.model_zoo.get_model("resnet1000_v9")
+
+
+def test_pretrained_gated():
+    with pytest.raises(mx.MXNetError):
+        gluon.model_zoo.get_model("resnet18_v1", pretrained=True)
+
+
+def test_model_param_counts():
+    # canonical ImageNet parameter counts pin the architectures
+    expected = {
+        "resnet18_v1": 11_699_112,
+        "alexnet": 61_100_840,
+        "squeezenet1.1": 1_235_496,
+    }
+    for name, count in expected.items():
+        net = gluon.model_zoo.get_model(name)
+        net.initialize()
+        if name in ("resnet18_v1",):
+            net(_x(1, 3, 64))  # materialize deferred shapes
+        else:
+            net(_x(1, 3, 224))
+        total = sum(
+            int(np.prod(p.shape)) for p in net.collect_params().values())
+        assert total == count, (name, total, count)
