@@ -1,0 +1,17 @@
+"""Gluon data API (reference: ``python/mxnet/gluon/data/``)."""
+from . import vision
+from .dataloader import DataLoader, default_batchify_fn, default_mp_batchify_fn
+from .dataset import (
+    ArrayDataset,
+    Dataset,
+    RecordFileDataset,
+    SimpleDataset,
+)
+from .sampler import (
+    BatchSampler,
+    FilterSampler,
+    IntervalSampler,
+    RandomSampler,
+    Sampler,
+    SequentialSampler,
+)

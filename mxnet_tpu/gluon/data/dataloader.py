@@ -1,0 +1,183 @@
+"""DataLoader (reference: ``python/mxnet/gluon/data/dataloader.py``).
+
+The reference forks worker processes that allocate batches in POSIX shared
+memory (``cpu_shared`` context, ``src/storage/cpu_shared_storage_manager.h``)
+and ship NDArray FDs through a ForkingPickler. Here workers produce **numpy**
+batches (host memory is where decode/augment happens either way) via
+``multiprocessing.Pool``; the main process wraps them as NDArrays — the
+host→TPU transfer is the same single ``device_put`` either way, and XLA
+overlaps it with compute. ``pin_memory`` is accepted for API parity (no-op:
+TPU transfers stage through page-locked buffers managed by the runtime).
+"""
+from __future__ import annotations
+
+import multiprocessing
+import pickle
+
+import numpy as _onp
+
+from ...base import MXNetError
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference ``dataloader.py:145``)."""
+    from ...ndarray.ndarray import NDArray
+
+    elem = data[0]
+    if isinstance(elem, NDArray):
+        from ... import numpy as mnp
+
+        return mnp.stack(data)
+    if isinstance(elem, tuple):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    if isinstance(elem, _onp.ndarray):
+        return _onp.stack(data)
+    return _onp.asarray(data)
+
+
+def default_mp_batchify_fn(data):
+    """Worker-side batchify: keep numpy (cheap pickling)."""
+    elem = data[0]
+    if isinstance(elem, tuple):
+        return tuple(default_mp_batchify_fn(list(x)) for x in zip(*data))
+    from ...ndarray.ndarray import NDArray
+
+    if isinstance(elem, NDArray):
+        return _onp.stack([e.asnumpy() for e in data])
+    return _onp.stack(data) if isinstance(elem, _onp.ndarray) \
+        else _onp.asarray(data)
+
+
+def _as_ndarray(batch, pin_memory=False):  # pylint: disable=unused-argument
+    from ... import numpy as mnp
+    from ...ndarray.ndarray import NDArray
+
+    if isinstance(batch, tuple):
+        return tuple(_as_ndarray(b) for b in batch)
+    if isinstance(batch, NDArray):
+        return batch
+    return mnp.array(batch)
+
+
+_worker_dataset = None
+_worker_batchify = None
+
+
+def _worker_init(dataset_bytes, batchify):
+    global _worker_dataset, _worker_batchify
+    _worker_dataset = pickle.loads(dataset_bytes)
+    _worker_batchify = batchify
+
+
+def _worker_fn(indices):
+    return _worker_batchify([_worker_dataset[i] for i in indices])
+
+
+class DataLoader:
+    """Mini-batch loader with optional multiprocessing workers.
+
+    Mirrors the reference API: ``batch_size``, ``shuffle``, ``sampler``,
+    ``batch_sampler``, ``last_batch``, ``num_workers``, ``batchify_fn``,
+    ``prefetch`` (in-flight async batches per worker pool).
+    """
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError(
+                    "batch_size is required when batch_sampler is not given")
+            if sampler is None:
+                sampler = (RandomSampler(len(dataset)) if shuffle
+                           else SequentialSampler(len(dataset)))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with explicit sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError(
+                "batch_size/shuffle/sampler/last_batch are mutually "
+                "exclusive with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._thread_pool = thread_pool
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        if batchify_fn is None:
+            self._batchify_fn = (default_mp_batchify_fn if self._num_workers
+                                 else default_batchify_fn)
+        else:
+            self._batchify_fn = batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+
+                self._pool = ThreadPool(self._num_workers)
+            else:
+                # forkserver, not fork: the parent has JAX's thread pool
+                # running and forking a multithreaded process can deadlock a
+                # worker; the forkserver process is clean, and the dataset
+                # ships via pickle either way (the reference instead forks +
+                # relies on pthread_atfork handlers, src/initialize.cc:73-83)
+                ctx = multiprocessing.get_context("forkserver")
+                self._pool = ctx.Pool(
+                    self._num_workers, initializer=_worker_init,
+                    initargs=(pickle.dumps(dataset), self._batchify_fn))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._pool is None:
+            for indices in self._batch_sampler:
+                batch = self._batchify_fn(
+                    [self._dataset[i] for i in indices])
+                yield _as_ndarray(batch, self._pin_memory)
+            return
+
+        # async map with bounded in-flight queue (reference prefetch depth)
+        import collections
+
+        if self._thread_pool:
+            # thread workers share the process: close over this loader's own
+            # dataset/batchify rather than the forkserver globals so two
+            # thread-pool loaders never clobber each other
+            dataset, batchify = self._dataset, self._batchify_fn
+
+            def work(indices):
+                return batchify([dataset[i] for i in indices])
+        else:
+            work = _worker_fn
+
+        inflight = collections.deque()
+        it = iter(self._batch_sampler)
+        try:
+            for _ in range(self._prefetch or 1):
+                indices = next(it, None)
+                if indices is None:
+                    break
+                inflight.append(self._pool.apply_async(work, (indices,)))
+            while inflight:
+                res = inflight.popleft()
+                batch = res.get(self._timeout)
+                indices = next(it, None)
+                if indices is not None:
+                    inflight.append(self._pool.apply_async(work, (indices,)))
+                yield _as_ndarray(batch, self._pin_memory)
+        except multiprocessing.TimeoutError:
+            raise MXNetError(
+                f"DataLoader worker timed out after {self._timeout}s; "
+                "raise timeout= or reduce transform cost") from None
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

@@ -1,0 +1,177 @@
+"""Datasets (reference: ``python/mxnet/gluon/data/dataset.py``)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+
+
+class Dataset:
+    """Abstract dataset: ``__getitem__`` + ``__len__``."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def filter(self, fn):
+        """Keep samples where ``fn(sample)`` is truthy (eager scan)."""
+        return _FilteredDataset(self, fn)
+
+    def shard(self, num_shards, index):
+        """Every ``num_shards``-th sample starting at ``index`` (the
+        DataLoader-side analog of distributed data sharding)."""
+        if not 0 <= index < num_shards:
+            raise MXNetError(f"shard index {index} out of range "
+                             f"[0, {num_shards})")
+        return _ShardedDataset(self, num_shards, index)
+
+    def take(self, count):
+        return _TakenDataset(self, count)
+
+    def sample(self, sampler):
+        return _SampledDataset(self, sampler)
+
+    def transform(self, fn, lazy=True):
+        trans = _LazyTransformDataset(self, fn)
+        if lazy:
+            return trans
+        return SimpleDataset([trans[i] for i in range(len(trans))])
+
+    def transform_first(self, fn, lazy=True):
+        return self.transform(_TransformFirstClosure(fn), lazy)
+
+
+class SimpleDataset(Dataset):
+    """Wraps any indexable (list, array...)."""
+
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, data, fn):
+        self._data = data
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        item = self._data[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class _TransformFirstClosure:
+    """Picklable closure transforming only the first element."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x, *args):
+        if args:
+            return (self._fn(x),) + args
+        return self._fn(x)
+
+
+class _FilteredDataset(SimpleDataset):
+    def __init__(self, dataset, fn):
+        super().__init__([i for i in range(len(dataset)) if fn(dataset[i])])
+        self._dataset = dataset
+
+    def __getitem__(self, idx):
+        return self._dataset[self._data[idx]]
+
+
+class _ShardedDataset(Dataset):
+    def __init__(self, dataset, num_shards, index):
+        self._dataset = dataset
+        self._num = num_shards
+        self._index = index
+        # ceil split so all shards have equal length (shorter ones wrap),
+        # keeping SPMD steps in lockstep across processes
+        self._len = (len(dataset) + num_shards - 1) // num_shards
+
+    def __len__(self):
+        return self._len
+
+    def __getitem__(self, idx):
+        if idx >= self._len:
+            raise IndexError(idx)
+        i = idx * self._num + self._index
+        return self._dataset[i % len(self._dataset)]
+
+
+class _TakenDataset(Dataset):
+    def __init__(self, dataset, count):
+        self._dataset = dataset
+        self._count = min(count, len(dataset))
+
+    def __len__(self):
+        return self._count
+
+    def __getitem__(self, idx):
+        if idx >= self._count:
+            raise IndexError(idx)
+        return self._dataset[idx]
+
+
+class _SampledDataset(Dataset):
+    def __init__(self, dataset, sampler):
+        self._dataset = dataset
+        self._indices = list(sampler)
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._dataset[self._indices[idx]]
+
+
+class ArrayDataset(Dataset):
+    """Zips N equal-length indexables (reference ``dataset.py:316``)."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for i, data in enumerate(args):
+            assert len(data) == self._length, (
+                f"All arrays must have the same length; arg {i} has "
+                f"{len(data)} vs {self._length}")
+            if isinstance(data, (list, tuple)):
+                data = SimpleDataset(data)
+            self._data.append(data)
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+
+class RecordFileDataset(Dataset):
+    """Each sample is one raw record from a RecordIO file (reference
+    ``dataset.py:355`` over ``src/io/dataset.cc:117``)."""
+
+    def __init__(self, filename):
+        from ...recordio import MXIndexedRecordIO
+        import os
+
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        self._record = MXIndexedRecordIO(idx_file, filename, "r")
+
+    def __len__(self):
+        return len(self._record.keys)
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])

@@ -1,0 +1,10 @@
+"""Vision data (reference: ``python/mxnet/gluon/data/vision/``)."""
+from . import transforms
+from .datasets import (
+    CIFAR10,
+    CIFAR100,
+    FashionMNIST,
+    ImageFolderDataset,
+    ImageRecordDataset,
+    MNIST,
+)

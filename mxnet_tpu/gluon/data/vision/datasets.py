@@ -1,0 +1,195 @@
+"""Vision datasets (reference:
+``python/mxnet/gluon/data/vision/datasets.py``).
+
+Same file formats as the reference (MNIST idx / CIFAR binary batches /
+RecordIO packs / image folders) read from a local ``root`` — there is no
+download path in this environment (zero egress); point ``root`` at existing
+data or use ``ArrayDataset`` with synthetic arrays.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as _onp
+
+from ....base import MXNetError
+from ..dataset import ArrayDataset, Dataset, RecordFileDataset
+
+
+def _require(path, what):
+    if not os.path.exists(path):
+        raise MXNetError(
+            f"{what} not found at {path!r}. Downloads are disabled in this "
+            "build; place the files there manually.")
+    return path
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    zeros, dtype_code, ndim = struct.unpack(">HBB", data[:4])
+    if zeros != 0:
+        raise MXNetError(f"bad idx magic in {path}")
+    dims = struct.unpack(">" + "I" * ndim, data[4:4 + 4 * ndim])
+    dtype = {8: _onp.uint8, 9: _onp.int8, 11: _onp.int16, 12: _onp.int32,
+             13: _onp.float32, 14: _onp.float64}[dtype_code]
+    return _onp.frombuffer(data[4 + 4 * ndim:],
+                           dtype=dtype).reshape(dims)
+
+
+class MNIST(ArrayDataset):
+    """MNIST from idx files (reference ``datasets.py:37``); samples are
+    (HWC uint8 image, int32 label)."""
+
+    _files = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        root = os.path.expanduser(root)
+        imgf, lblf = self._files[train]
+        for cand in (imgf, imgf + ".gz"):
+            p = os.path.join(root, cand)
+            if os.path.exists(p):
+                imgf = p
+                break
+        else:
+            _require(os.path.join(root, imgf), type(self).__name__)
+        for cand in (lblf, lblf + ".gz"):
+            p = os.path.join(root, cand)
+            if os.path.exists(p):
+                lblf = p
+                break
+        else:
+            _require(os.path.join(root, lblf), type(self).__name__)
+        data = _read_idx(imgf)[..., None]  # HWC (C=1)
+        labels = _read_idx(lblf).astype(_onp.int32)
+        self._transform = transform
+        super().__init__(data, labels)
+
+    def __getitem__(self, idx):
+        img, lbl = self._data[0][idx], self._data[1][idx]
+        if self._transform is not None:
+            return self._transform(img, lbl)
+        return img, lbl
+
+
+class FashionMNIST(MNIST):
+    """Fashion-MNIST (same idx format, reference ``datasets.py:113``)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"), train=True,
+                 transform=None):
+        super().__init__(root=root, train=train, transform=transform)
+
+
+class CIFAR10(ArrayDataset):
+    """CIFAR-10 from the python pickle batches (reference
+    ``datasets.py:141``); samples are (HWC uint8, int32)."""
+
+    _train_batches = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_batches = ["test_batch"]
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        root = os.path.expanduser(root)
+        sub = os.path.join(root, "cifar-10-batches-py")
+        base = sub if os.path.isdir(sub) else root
+        batches = self._train_batches if train else self._test_batches
+        fine = getattr(self, "_fine", True)
+        label_keys = [b"labels", b"fine_labels" if fine else b"coarse_labels"]
+        imgs, lbls = [], []
+        for b in batches:
+            with open(_require(os.path.join(base, b), "CIFAR batch"),
+                      "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            imgs.append(d[b"data"])
+            for k in label_keys:
+                if k in d:
+                    lbls.extend(d[k])
+                    break
+        data = (_onp.concatenate(imgs).reshape(-1, 3, 32, 32)
+                .transpose(0, 2, 3, 1))
+        labels = _onp.asarray(lbls, dtype=_onp.int32)
+        self._transform = transform
+        super().__init__(data, labels)
+
+    def __getitem__(self, idx):
+        img, lbl = self._data[0][idx], self._data[1][idx]
+        if self._transform is not None:
+            return self._transform(img, lbl)
+        return img, lbl
+
+
+class CIFAR100(CIFAR10):
+    _train_batches = ["train"]
+    _test_batches = ["test"]
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"), fine_label=True,
+                 train=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root=root, train=train, transform=transform)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images packed in a RecordIO file (reference ``datasets.py:183``)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack_img
+
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, iscolor=self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """``root/class_x/*.jpg`` layout (reference ``datasets.py:223``)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = (".jpg", ".jpeg", ".png", ".bmp")
+        self.synsets = []
+        self.items = []
+        _require(self._root, "image folder")
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if fname.lower().endswith(self._exts):
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        path, label = self.items[idx]
+        img = Image.open(path)
+        img = img.convert("RGB" if self._flag else "L")
+        arr = _onp.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        if self._transform is not None:
+            return self._transform(arr, label)
+        return arr, label

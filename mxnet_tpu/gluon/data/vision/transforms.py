@@ -1,0 +1,301 @@
+"""Vision transforms (reference:
+``python/mxnet/gluon/data/vision/transforms.py`` over the C++ kernels in
+``src/operator/image/``).
+
+Transforms run on the host (numpy) inside DataLoader workers — the
+reference's image kernels are CPU-side too; TPU time is spent on the model,
+not the augmentation. Inputs/outputs are HWC uint8/float numpy arrays or
+NDArrays; ``ToTensor`` produces CHW float32 in [0, 1].
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as _onp
+
+from ....base import MXNetError
+
+
+def _to_numpy(x):
+    from ....ndarray.ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _onp.asarray(x)
+
+
+class Compose:
+    """Chain transforms (reference ``transforms.py:51``)."""
+
+    def __init__(self, transforms):
+        self._transforms = list(transforms)
+
+    def __call__(self, x, *args):
+        for t in self._transforms:
+            x = t(x)
+        if args:
+            return (x,) + args
+        return x
+
+
+class Cast:
+    def __init__(self, dtype="float32"):
+        self._dtype = dtype
+
+    def __call__(self, x):
+        return _to_numpy(x).astype(self._dtype)
+
+
+class ToTensor:
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference
+    ``transforms.py:91``)."""
+
+    def __call__(self, x):
+        x = _to_numpy(x)
+        if x.ndim == 2:
+            x = x[..., None]
+        x = x.astype(_onp.float32) / 255.0
+        if x.ndim == 3:
+            return x.transpose(2, 0, 1)
+        return x.transpose(0, 3, 1, 2)
+
+
+class Normalize:
+    """(x - mean) / std per channel on CHW float input (reference
+    ``transforms.py:126``)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        self._mean = _onp.asarray(mean, dtype=_onp.float32)
+        self._std = _onp.asarray(std, dtype=_onp.float32)
+
+    def __call__(self, x):
+        x = _to_numpy(x).astype(_onp.float32)
+        mean = self._mean.reshape(-1, 1, 1)
+        std = self._std.reshape(-1, 1, 1)
+        return (x - mean) / std
+
+
+def _resize_img(x, size, interpolation):
+    from PIL import Image
+
+    if isinstance(size, numbers.Number):
+        h, w = x.shape[:2]
+        if h < w:
+            size = (int(size * w / h), int(size))
+        else:
+            size = (int(size), int(size * h / w))
+    # PIL wants (W, H)
+    squeeze = x.shape[-1] == 1
+    img = Image.fromarray(x.squeeze(-1) if squeeze else x)
+    resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                3: Image.LANCZOS}.get(interpolation, Image.BILINEAR)
+    out = _onp.asarray(img.resize(tuple(size), resample))
+    if squeeze:
+        out = out[..., None]
+    return out
+
+
+class Resize:
+    """Resize to (w, h) or shorter-side int (reference
+    ``transforms.py:225``)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        if isinstance(size, numbers.Number) and not keep_ratio:
+            size = (int(size), int(size))  # reference: int + keep_ratio=False
+        self._size = size                  # means a square output
+        self._interp = interpolation
+
+    def __call__(self, x):
+        return _resize_img(_to_numpy(x), self._size, self._interp)
+
+
+class CenterCrop:
+    def __init__(self, size, interpolation=1):
+        self._size = ((size, size) if isinstance(size, numbers.Number)
+                      else tuple(size))
+        self._interp = interpolation
+
+    def __call__(self, x):
+        x = _to_numpy(x)
+        w_t, h_t = self._size
+        h, w = x.shape[:2]
+        if h < h_t or w < w_t:
+            x = _resize_img(x, (max(w, w_t), max(h, h_t)), self._interp)
+            h, w = x.shape[:2]
+        y0 = (h - h_t) // 2
+        x0 = (w - w_t) // 2
+        return x[y0:y0 + h_t, x0:x0 + w_t]
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop resized to target (reference
+    ``transforms.py:398``)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        self._size = ((size, size) if isinstance(size, numbers.Number)
+                      else tuple(size))
+        self._scale = scale
+        self._ratio = ratio
+        self._interp = interpolation
+
+    def __call__(self, x):
+        x = _to_numpy(x)
+        h, w = x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _onp.random.uniform(*self._scale) * area
+            aspect = _onp.random.uniform(*self._ratio)
+            w_c = int(round((target_area * aspect) ** 0.5))
+            h_c = int(round((target_area / aspect) ** 0.5))
+            if w_c <= w and h_c <= h:
+                x0 = _onp.random.randint(0, w - w_c + 1)
+                y0 = _onp.random.randint(0, h - h_c + 1)
+                crop = x[y0:y0 + h_c, x0:x0 + w_c]
+                return _resize_img(crop, self._size, self._interp)
+        return CenterCrop(self._size, self._interp)(x)
+
+
+class RandomCrop:
+    def __init__(self, size, pad=None, interpolation=1):
+        self._size = ((size, size) if isinstance(size, numbers.Number)
+                      else tuple(size))
+        self._pad = pad
+        self._interp = interpolation
+
+    def __call__(self, x):
+        x = _to_numpy(x)
+        if self._pad:
+            p = self._pad
+            x = _onp.pad(x, ((p, p), (p, p), (0, 0)), mode="constant")
+        w_t, h_t = self._size
+        h, w = x.shape[:2]
+        if h < h_t or w < w_t:
+            x = _resize_img(x, (max(w, w_t), max(h, h_t)), self._interp)
+            h, w = x.shape[:2]
+        y0 = _onp.random.randint(0, h - h_t + 1)
+        x0 = _onp.random.randint(0, w - w_t + 1)
+        return x[y0:y0 + h_t, x0:x0 + w_t]
+
+
+class RandomFlipLeftRight:
+    def __call__(self, x):
+        x = _to_numpy(x)
+        if _onp.random.rand() < 0.5:
+            x = x[:, ::-1]
+        return x
+
+
+class RandomFlipTopBottom:
+    def __call__(self, x):
+        x = _to_numpy(x)
+        if _onp.random.rand() < 0.5:
+            x = x[::-1]
+        return x
+
+
+def _blend(a, b, alpha):
+    return (alpha * a.astype(_onp.float32)
+            + (1 - alpha) * b.astype(_onp.float32))
+
+
+class RandomBrightness:
+    def __init__(self, brightness):
+        self._b = brightness
+
+    def __call__(self, x):
+        x = _to_numpy(x).astype(_onp.float32)
+        alpha = 1.0 + _onp.random.uniform(-self._b, self._b)
+        return x * alpha
+
+
+class RandomContrast:
+    def __init__(self, contrast):
+        self._c = contrast
+
+    def __call__(self, x):
+        x = _to_numpy(x).astype(_onp.float32)
+        alpha = 1.0 + _onp.random.uniform(-self._c, self._c)
+        gray = x.mean()
+        return _blend(x, _onp.full_like(x, gray), alpha)
+
+
+class RandomSaturation:
+    def __init__(self, saturation):
+        self._s = saturation
+
+    def __call__(self, x):
+        x = _to_numpy(x).astype(_onp.float32)
+        alpha = 1.0 + _onp.random.uniform(-self._s, self._s)
+        gray = x.mean(axis=-1, keepdims=True)
+        return _blend(x, _onp.broadcast_to(gray, x.shape), alpha)
+
+
+class RandomHue:
+    def __init__(self, hue):
+        self._h = hue
+
+    def __call__(self, x):
+        x = _to_numpy(x).astype(_onp.float32)
+        alpha = _onp.random.uniform(-self._h, self._h)
+        # approximate hue rotation via the YIQ rotation matrix
+        u = _onp.cos(alpha * _onp.pi)
+        w = _onp.sin(alpha * _onp.pi)
+        t_yiq = _onp.array([[0.299, 0.587, 0.114],
+                            [0.596, -0.274, -0.321],
+                            [0.211, -0.523, 0.311]], dtype=_onp.float32)
+        t_rgb = _onp.linalg.inv(t_yiq)
+        rot = _onp.array([[1, 0, 0], [0, u, -w], [0, w, u]],
+                         dtype=_onp.float32)
+        m = t_rgb @ rot @ t_yiq
+        return x @ m.T
+
+
+class RandomColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def __call__(self, x):
+        order = _onp.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting:
+    """AlexNet-style PCA noise (reference ``transforms.py:820``)."""
+
+    _eigval = _onp.array([55.46, 4.794, 1.148], dtype=_onp.float32)
+    _eigvec = _onp.array([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], dtype=_onp.float32)
+
+    def __init__(self, alpha):
+        self._alpha = alpha
+
+    def __call__(self, x):
+        x = _to_numpy(x).astype(_onp.float32)
+        alpha = _onp.random.normal(0, self._alpha, 3).astype(_onp.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return x + rgb
+
+
+class RandomGray:
+    def __init__(self, p=0.5):
+        self._p = p
+
+    def __call__(self, x):
+        x = _to_numpy(x)
+        if _onp.random.rand() < self._p:
+            gray = (_to_numpy(x).astype(_onp.float32)
+                    @ _onp.array([0.299, 0.587, 0.114], dtype=_onp.float32))
+            x = _onp.repeat(gray[..., None], 3, axis=-1)
+        return x

@@ -1,0 +1,463 @@
+"""Recurrent cells (reference: ``python/mxnet/gluon/rnn/rnn_cell.py``).
+
+Cells are per-timestep HybridBlocks: ``cell(x_t, states) -> (out, states)``.
+``unroll`` replays the cell over a time axis; under ``hybridize()`` the
+unrolled ops trace into one XLA program. For long sequences prefer the fused
+layers (``gluon.rnn.RNN/LSTM/GRU``) which lower to a single ``lax.scan``
+(one XLA while-loop, compiled once regardless of length).
+
+Gate layouts match the reference ops (``src/operator/rnn-inl.h``):
+LSTM ``[i, f, c, o]``, GRU ``[r, z, n]``.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ops import nn as _ops
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+
+class RecurrentCell(HybridBlock):
+    """Base class for recurrent cells."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial states (zeros by default), one NDArray per state_info."""
+        from ... import numpy as mnp
+
+        assert not self._modified, (
+            "After applying modifier cells the base cell cannot be called "
+            "directly. Call the modifier cell instead.")
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"]
+            if func is None:
+                states.append(mnp.zeros(shape, **kwargs))
+            else:
+                states.append(func(shape=shape, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell ``length`` steps (reference ``rnn_cell.py:305``)."""
+        from ... import numpy as mnp
+
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        inputs_list = [
+            x.squeeze(axis=axis)
+            for x in mnp.split(inputs, length, axis=axis)
+        ]
+        batch_size = inputs_list[0].shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch_size)
+
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs_list[i], states)
+            outputs.append(output)
+        if valid_length is not None:
+            stacked = mnp.stack(outputs, axis=axis)
+            outputs = _ops.sequence_mask(
+                stacked, sequence_length=valid_length, use_sequence_length=True,
+                axis=axis)
+            if merge_outputs is False:
+                outputs = [
+                    x.squeeze(axis=axis)
+                    for x in mnp.split(outputs, length, axis=axis)
+                ]
+        elif merge_outputs is None or merge_outputs:
+            outputs = mnp.stack(outputs, axis=axis)
+        return outputs, states
+
+    def __call__(self, inputs, states, **kwargs):
+        self._counter += 1
+        return super().__call__(inputs, states, **kwargs)
+
+
+class HybridRecurrentCell(RecurrentCell):
+    pass
+
+
+def _cell_fc(x, weight, bias):
+    return _ops.fully_connected(x, weight, bias,
+                                num_hidden=weight.shape[0],
+                                no_bias=bias is None)
+
+
+class RNNCell(HybridRecurrentCell):
+    """Elman RNN cell: ``h' = act(W_ih x + b_ih + W_hh h + b_hh)``."""
+
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(hidden_size, input_size),
+                                    init=i2h_weight_initializer)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(hidden_size, hidden_size),
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def forward(self, inputs, states):
+        if 0 in self.i2h_weight.shape:
+            self.i2h_weight.shape = (self._hidden_size, inputs.shape[-1])
+        i2h = _cell_fc(inputs, self.i2h_weight.data(), self.i2h_bias.data())
+        h2h = _cell_fc(states[0], self.h2h_weight.data(), self.h2h_bias.data())
+        output = _ops.activation(i2h + h2h, self._activation)
+        return output, [output]
+
+
+class LSTMCell(HybridRecurrentCell):
+    """LSTM cell (gates ``[i, f, c, o]``, reference ``rnn_cell.py:564``)."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(4 * hidden_size, input_size),
+                                    init=i2h_weight_initializer)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(4 * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def forward(self, inputs, states):
+        from ... import numpy as mnp
+
+        if 0 in self.i2h_weight.shape:
+            self.i2h_weight.shape = (4 * self._hidden_size, inputs.shape[-1])
+        h = self._hidden_size
+        gates = (_cell_fc(inputs, self.i2h_weight.data(), self.i2h_bias.data())
+                 + _cell_fc(states[0], self.h2h_weight.data(),
+                            self.h2h_bias.data()))
+        in_gate = _ops.sigmoid(gates[..., 0:h])
+        forget_gate = _ops.sigmoid(gates[..., h:2 * h])
+        in_transform = _ops.tanh(gates[..., 2 * h:3 * h])
+        out_gate = _ops.sigmoid(gates[..., 3 * h:4 * h])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * _ops.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(HybridRecurrentCell):
+    """GRU cell (gates ``[r, z, n]``, reference ``rnn_cell.py:719``)."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(3 * hidden_size, input_size),
+                                    init=i2h_weight_initializer)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(3 * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(3 * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(3 * hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def forward(self, inputs, states):
+        if 0 in self.i2h_weight.shape:
+            self.i2h_weight.shape = (3 * self._hidden_size, inputs.shape[-1])
+        h = self._hidden_size
+        prev_h = states[0]
+        i2h = _cell_fc(inputs, self.i2h_weight.data(), self.i2h_bias.data())
+        h2h = _cell_fc(prev_h, self.h2h_weight.data(), self.h2h_bias.data())
+        reset_gate = _ops.sigmoid(i2h[..., 0:h] + h2h[..., 0:h])
+        update_gate = _ops.sigmoid(i2h[..., h:2 * h] + h2h[..., h:2 * h])
+        next_h_tmp = _ops.tanh(i2h[..., 2 * h:3 * h]
+                               + reset_gate * h2h[..., 2 * h:3 * h])
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells sequentially (reference ``rnn_cell.py:843``)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        self.register_child(cell, str(len(self._cells) - 1))
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._cells, batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._cells, batch_size, **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        num_cells = len(self._cells)
+        if begin_state is None:
+            from ... import numpy as mnp  # noqa: F401 - shape probing
+
+            batch_axis = layout.find("N")
+            begin_state = self.begin_state(
+                batch_size=inputs.shape[batch_axis])
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info())
+            states = begin_state[p:p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs,
+                valid_length=valid_length)
+            next_states.extend(states)
+        return inputs, next_states
+
+    def __getitem__(self, i):
+        return self._cells[i]
+
+    def __len__(self):
+        return len(self._cells)
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, batch_size, **kwargs):
+    return sum([c.begin_state(batch_size=batch_size, **kwargs)
+                for c in cells], [])
+
+
+class DropoutCell(HybridRecurrentCell):
+    """Dropout on cell inputs (reference ``rnn_cell.py:928``)."""
+
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def forward(self, inputs, states):
+        if self._rate > 0:
+            inputs = _ops.dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(HybridRecurrentCell):
+    """Base for cells wrapping another cell (reference ``rnn_cell.py:997``)."""
+
+    def __init__(self, base_cell, **kwargs):
+        assert not base_cell._modified, (
+            "The base cell has already been modified")
+        base_cell._modified = True
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size=batch_size, func=func,
+                                           **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (Krueger et al. 2016; reference
+    ``rnn_cell.py:1052``)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        assert not isinstance(base_cell, BidirectionalCell)
+        super().__init__(base_cell, **kwargs)
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        from ... import numpy as mnp
+
+        next_output, next_states = self.base_cell(inputs, states)
+        p_outputs, p_states = self._zoneout_outputs, self._zoneout_states
+
+        def mask(p, like):
+            return _ops.dropout(mnp.ones_like(like), p=p)
+
+        prev_output = (self._prev_output if self._prev_output is not None
+                       else mnp.zeros_like(next_output))
+        output = (mnp.where(mask(p_outputs, next_output), next_output,
+                            prev_output)
+                  if p_outputs != 0.0 else next_output)
+        new_states = ([mnp.where(mask(p_states, new_s), new_s, old_s)
+                       for new_s, old_s in zip(next_states, states)]
+                      if p_states != 0.0 else next_states)
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """Adds the input to the output (reference ``rnn_cell.py:1119``)."""
+
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=True, valid_length=valid_length)
+        self.base_cell._modified = True
+        outputs = outputs + inputs
+        if merge_outputs is False:
+            from ... import numpy as mnp
+
+            axis = layout.find("T")
+            outputs = [x.squeeze(axis=axis)
+                       for x in mnp.split(outputs, length, axis=axis)]
+        return outputs, states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """Runs l/r cells over both directions; only usable via ``unroll``."""
+
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def __call__(self, inputs, states):
+        raise MXNetError(
+            "Bidirectional cells cannot be stepped; use unroll() instead")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info([self.l_cell, self.r_cell], batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        assert not self._modified
+        return _cells_begin_state([self.l_cell, self.r_cell], batch_size,
+                                  **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import numpy as mnp
+
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if begin_state is None:
+            begin_state = self.begin_state(
+                batch_size=inputs.shape[batch_axis])
+        n_l = len(self.l_cell.state_info())
+        l_outputs, l_states = self.l_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state[:n_l],
+            layout=layout, merge_outputs=True, valid_length=valid_length)
+        if valid_length is not None:
+            rev_inputs = _ops.sequence_reverse(
+                inputs, sequence_length=valid_length,
+                use_sequence_length=True, axis=axis)
+        else:
+            rev_inputs = mnp.flip(inputs, axis=axis)
+        r_outputs, r_states = self.r_cell.unroll(
+            length, inputs=rev_inputs, begin_state=begin_state[n_l:],
+            layout=layout, merge_outputs=True, valid_length=valid_length)
+        if valid_length is not None:
+            r_outputs = _ops.sequence_reverse(
+                r_outputs, sequence_length=valid_length,
+                use_sequence_length=True, axis=axis)
+        else:
+            r_outputs = mnp.flip(r_outputs, axis=axis)
+        outputs = mnp.concatenate([l_outputs, r_outputs], axis=2)
+        if merge_outputs is False:
+            outputs = [x.squeeze(axis=axis)
+                       for x in mnp.split(outputs, length, axis=axis)]
+        return outputs, l_states + r_states

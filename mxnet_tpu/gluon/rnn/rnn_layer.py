@@ -1,0 +1,177 @@
+"""Fused recurrent layers (reference: ``python/mxnet/gluon/rnn/rnn_layer.py``
+over the fused ``RNN`` op, ``src/operator/rnn.cc``).
+
+``RNN``/``LSTM``/``GRU`` hold per-layer/direction ``{l,r}{i}_{i2h,h2h}_
+{weight,bias}`` parameters (same naming as the reference so checkpoints map
+1:1) and execute through :func:`mxnet_tpu.ops.rnn.rnn_fused` — input
+projection hoisted to one MXU matmul per layer, recurrence in ``lax.scan``.
+"""
+from __future__ import annotations
+
+from ... import random as _rng
+from ...base import MXNetError
+from ...ops import registry as _registry
+from ...ops.rnn import rnn_fused
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout!r}; TNC or NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param(f"{j}{i}_i2h_weight", (ng * nh, ni),
+                                     i2h_weight_initializer)
+                self._register_param(f"{j}{i}_h2h_weight", (ng * nh, nh),
+                                     h2h_weight_initializer)
+                self._register_param(f"{j}{i}_i2h_bias", (ng * nh,),
+                                     i2h_bias_initializer)
+                self._register_param(f"{j}{i}_h2h_bias", (ng * nh,),
+                                     h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = Parameter(name, shape=shape, init=init)
+        self._reg_params[name] = p
+        setattr(self, name, p)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import numpy as mnp
+
+        states = []
+        for info in self.state_info(batch_size):
+            if func is None:
+                states.append(mnp.zeros(info["shape"], **kwargs))
+            else:
+                states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def _materialize(self, input_size):
+        ng, nh = self._gates, self._hidden_size
+        ni = input_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                p = self._reg_params[f"{j}{i}_i2h_weight"]
+                if 0 in p.shape:
+                    p.shape = (ng * nh, ni)
+            ni = nh * self._dir
+
+    def forward(self, inputs, states=None):
+        from ... import numpy as mnp
+
+        self._materialize(inputs.shape[-1])
+        skip_states = states is None
+        batch_axis = self._layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        if skip_states:
+            states = self.begin_state(batch_size)
+        if self._layout == "NTC":
+            inputs = mnp.swapaxes(inputs, 0, 1)
+
+        h0 = states[0]
+        c0 = states[1] if self._mode == "lstm" else None
+
+        weights = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                for part in ("i2h_weight", "h2h_weight", "i2h_bias",
+                             "h2h_bias"):
+                    weights.append(self._reg_params[f"{j}{i}_{part}"].data())
+
+        mode = self._mode
+        L, D = self._num_layers, self._dir
+        dropout = self._dropout
+        from ... import autograd as _ag
+
+        train = _ag.is_training()
+        key = _rng.next_key() if (dropout > 0 and train) else None
+
+        def f(x, h, *rest):
+            if mode == "lstm":
+                c, ws = rest[0], rest[1:]
+            else:
+                c, ws = None, rest
+            out, h_T, c_T = rnn_fused(
+                x, h, c, list(ws), mode, L, D == 2, dropout=dropout,
+                train=train, rng_key=key)
+            if c_T is None:
+                return out, h_T
+            return out, h_T, c_T
+
+        args = ([inputs, h0, c0] if mode == "lstm" else [inputs, h0]) + weights
+        res = _registry.apply(f, tuple(args), name=f"rnn_fused:{mode}")
+        out = res[0]
+        out_states = list(res[1:])
+        if self._layout == "NTC":
+            out = mnp.swapaxes(out, 0, 1)
+        if skip_states:
+            return out
+        return out, out_states
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"num_layers={self._num_layers}, layout={self._layout!r}, "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN with tanh/relu (reference ``rnn_layer.py:388``)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="tanh",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "rnn_" + activation,
+                         **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference ``rnn_layer.py:476``)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (reference ``rnn_layer.py:574``)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,370 @@
+"""Legacy data iterators (reference: ``python/mxnet/io/io.py`` over the C++
+``MXNET_REGISTER_IO_ITER`` iterators in ``src/io/``).
+
+The C++ threaded decode/prefetch pipeline maps to host-side numpy slicing
+plus the DataLoader's worker pool; iterators here keep the classic
+``DataIter`` protocol (``next() -> DataBatch`` with ``provide_data/label``)
+so reference training scripts run unchanged.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as _onp
+
+from ..base import MXNetError
+
+DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
+DataDesc.__new__.__defaults__ = (_onp.float32, "NCHW")
+
+
+class DataBatch:
+    """One batch (reference ``io.py:140``)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator protocol (reference ``io.py:207``)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+class NDArrayIter(DataIter):
+    """Iterate numpy/NDArray (+label) dicts (reference ``io.py:605``).
+
+    ``last_batch_handle``: 'pad' (wrap), 'discard', or 'roll_over'.
+    """
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(
+                f"invalid last_batch_handle {last_batch_handle!r}")
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self._leftover = []  # roll_over: tail carried into the next epoch
+        self.cursor = -batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            order = _onp.random.permutation(self.num_data).tolist()
+        else:
+            order = list(range(self.num_data))
+        bs = self.batch_size
+        if self.last_batch_handle == "discard":
+            self._epoch = order[:(len(order) // bs) * bs]
+        elif self.last_batch_handle == "roll_over":
+            # leftover from the previous epoch leads the new one; the new
+            # tail rolls forward (reference io.py roll_over semantics)
+            combined = self._leftover + order
+            n_full = (len(combined) // bs) * bs
+            self._epoch = combined[:n_full]
+            self._leftover = combined[n_full:]
+        else:  # pad
+            self._epoch = order
+        self.cursor = -bs
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < len(self._epoch)
+
+    def _slice(self, arrays):
+        from .. import numpy as mnp
+
+        out = []
+        start = self.cursor
+        end = self.cursor + self.batch_size
+        idx = self._epoch[start:end]
+        if len(idx) < self.batch_size:  # only reachable with pad: wrap
+            idx = idx + self._epoch[:self.batch_size - len(idx)]
+        idx = _onp.asarray(idx)
+        for _, v in arrays:
+            out.append(mnp.array(v[idx]))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" \
+                and self.cursor + self.batch_size > len(self._epoch):
+            return self.cursor + self.batch_size - len(self._epoch)
+        return 0
+
+
+class CSVIter(DataIter):
+    """CSV reader (reference C++ ``src/io/iter_csv.cc:218``)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = _onp.loadtxt(data_csv, delimiter=",", dtype=_onp.float32,
+                            ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _onp.loadtxt(label_csv, delimiter=",",
+                                 dtype=_onp.float32, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._iter = NDArrayIter(
+            data, label, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard", **kwargs)
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator (reference C++
+    ``src/io/iter_image_recordio_2.cc:887``): decode + resize + batch."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1, shuffle=False,
+                 label_width=1, resize=None, rand_crop=False,
+                 rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 scale=1.0, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        from ..gluon.data.vision.datasets import ImageRecordDataset
+
+        self._dataset = ImageRecordDataset(path_imgrec)
+        self._shape = tuple(data_shape)  # (C, H, W)
+        self._shuffle = shuffle
+        self._resize = resize
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._mean = _onp.array([mean_r, mean_g, mean_b],
+                                dtype=_onp.float32).reshape(3, 1, 1)
+        self._scale = scale
+        self._round = round_batch
+        self.reset()
+
+    def reset(self):
+        n = len(self._dataset)
+        self._order = (_onp.random.permutation(n) if self._shuffle
+                       else _onp.arange(n))
+        self._pos = 0
+
+    def _load(self, i):
+        from ..gluon.data.vision.transforms import (CenterCrop, RandomCrop,
+                                                    _resize_img)
+
+        img, label = self._dataset[int(i)]
+        c, h, w = self._shape
+        if self._resize:
+            img = _resize_img(img, self._resize, 1)
+        crop = (RandomCrop((w, h)) if self._rand_crop
+                else CenterCrop((w, h)))
+        img = crop(img)
+        if self._rand_mirror and _onp.random.rand() < 0.5:
+            img = img[:, ::-1]
+        chw = img.transpose(2, 0, 1).astype(_onp.float32)
+        chw = (chw - self._mean[:c]) * self._scale
+        return chw, _onp.float32(label)
+
+    def next(self):
+        from .. import numpy as mnp
+
+        n = len(self._order)
+        if self._pos >= n:
+            raise StopIteration
+        idx = self._order[self._pos:self._pos + self.batch_size]
+        self._pos += self.batch_size
+        if len(idx) < self.batch_size:
+            if self._round:
+                idx = _onp.concatenate(
+                    [idx, self._order[:self.batch_size - len(idx)]])
+            else:
+                raise StopIteration
+        imgs, labels = zip(*[self._load(i) for i in idx])
+        return DataBatch(data=[mnp.array(_onp.stack(imgs))],
+                         label=[mnp.array(_onp.stack(labels))],
+                         pad=0)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST iterator (reference C++ ``src/io/iter_mnist.cc:260``)."""
+
+    def __init__(self, image, label, batch_size=1, shuffle=False, flat=False,
+                 **kwargs):
+        from ..gluon.data.vision.datasets import _read_idx
+
+        imgs = _read_idx(image).astype(_onp.float32) / 255.0
+        lbls = _read_idx(label).astype(_onp.float32)
+        if flat:
+            imgs = imgs.reshape(len(imgs), -1)
+        else:
+            imgs = imgs[:, None]  # NCHW
+        super().__init__(imgs, lbls, batch_size=batch_size, shuffle=shuffle,
+                         **kwargs)
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (reference
+    ``io.py:415``)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur == self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch wrapper (reference ``io.py:463`` /
+    ``src/io/iter_prefetcher.h``)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import queue
+        import threading
+
+        if not isinstance(iters, list):
+            iters = [iters]
+        assert len(iters) == 1, "composite prefetch not supported"
+        self.data_iter = iters[0]
+        super().__init__(self.data_iter.batch_size)
+        self._queue = queue.Queue(maxsize=2)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        import threading
+
+        def run():
+            try:
+                for batch in self.data_iter:
+                    if self._stop.is_set():
+                        return
+                    self._queue.put(batch)
+            finally:
+                self._queue.put(None)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        while self._thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except Exception:  # pylint: disable=broad-except
+                pass
+            self._thread.join(timeout=0.1)
+        self._stop.clear()
+        self.data_iter.reset()
+        self._queue = __import__("queue").Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input to a list of (name, numpy array) (reference
+    ``io.py:576``)."""
+    from ..ndarray.ndarray import NDArray
+
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (NDArray, _onp.ndarray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {f"_{i}_{default_name}" if len(data) > 1 else default_name: d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _onp.asarray(v)))
+    return out

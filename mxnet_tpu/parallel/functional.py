@@ -68,15 +68,7 @@ def functionalize(block, train_mode=False):
         import jax
 
         tracers = [param_datas[n] for n in names]
-        wrapped_args = []
-        for d in arg_datas:
-            w = NDArray.__new__(NDArray)
-            w._data = d
-            w._tape = None
-            w._leaf = None
-            w._version = 0
-            w._stype = "default"
-            wrapped_args.append(w)
+        wrapped_args = [NDArray(d) for d in arg_datas]
         with _ParamBinding(arrays, tracers):
             if rng_key is None:
                 rng_key = _rng.next_key()
@@ -204,6 +196,11 @@ class ShardedTrainer:
                              if params_od[n].grad_req != "null"]
         self._state_names = [n for n in params
                              if params_od[n].grad_req == "null"]
+        # per-param lr_mult/wd_mult flow through the optimizer's param_dict,
+        # same wiring as the eager gluon.Trainer (trainer.py) — frozen layers
+        # (lr_mult=0) stay frozen under the SPMD step too
+        self.optimizer.param_dict = {
+            i: params_od[n] for i, n in enumerate(self._train_names)}
         # placement: params + optimizer state onto the mesh by rule
         self.params = self.rules.shard(params, self.mesh)
         self._opt_states = self._init_opt_states()
@@ -262,7 +259,7 @@ class ShardedTrainer:
             return jnp.mean(ldata), new_state
 
         def step(train_params, state_params, opt_states, batch, labels, key,
-                 lr, t):
+                 lrs, wds, t):
             (loss, new_state), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_params, state_params, batch,
                                        labels, key)
@@ -270,9 +267,9 @@ class ShardedTrainer:
             new_opt = {}
             for i, n in enumerate(train_names):
                 g = opt._prep_grad(grads[n].astype(train_params[n].dtype))
-                wd = opt._get_wd(i)
                 p_new, s_new = opt._update_raw(train_params[n], g,
-                                               opt_states[n], lr, wd, t)
+                                               opt_states[n], lrs[i], wds[i],
+                                               t)
                 new_train[n] = p_new
                 new_opt[n] = tuple(s_new) if isinstance(s_new, (list, tuple)) \
                     else (s_new,)
@@ -300,13 +297,14 @@ class ShardedTrainer:
         self._step_jit = jax.jit(
             step,
             in_shardings=(train_shard, state_shard, opt_shard, batch_shard,
-                          batch_shard, repl, None, None),
+                          batch_shard, repl, None, None, None),
             out_shardings=(train_shard, state_shard, opt_shard, repl),
             donate_argnums=(0, 1, 2),
         )
 
     def step(self, data, labels):
-        """Run one SPMD training step; returns scalar loss (host float)."""
+        """Run one SPMD training step; returns the scalar loss as an
+        NDArray (async — reading/printing it syncs, dispatch does not)."""
         import jax
 
         from ..ndarray.ndarray import NDArray
@@ -317,18 +315,20 @@ class ShardedTrainer:
         l = labels._data if isinstance(labels, NDArray) else labels
         self._step_count += 1
         t = self._step_count
-        for i in range(len(self._train_names)):
+        n_train = len(self._train_names)
+        for i in range(n_train):
             self.optimizer._index_update_count[i] = t
+        lrs = tuple(self.optimizer._get_lr(i) for i in range(n_train))
+        wds = tuple(self.optimizer._get_wd(i) for i in range(n_train))
         self._key, sub = jax.random.split(self._key)
         train = {n: self.params[n] for n in self._train_names}
         state = {n: self.params[n] for n in self._state_names}
         new_train, new_state, new_opt, loss = self._step_jit(
-            train, state, self._opt_states, d, l, sub,
-            self.optimizer._get_lr(0), t)
+            train, state, self._opt_states, d, l, sub, lrs, wds, t)
         self.params.update(new_train)
         self.params.update(new_state)
         self._opt_states = new_opt
-        return float(loss)
+        return NDArray(loss)
 
     def sync_to_block(self):
         """Copy trained weights back into the Block's Parameters."""

@@ -1,0 +1,235 @@
+"""RecordIO: MXNet's packed binary record format.
+
+Reference: ``python/mxnet/recordio.py`` + dmlc-core's writer (format:
+``[magic:u32][cflag:3b|length:29b][payload][pad to 4B]``; multi-part records
+use cflag start/middle/end) and the image header ``IRHeader``
+(``recordio.py:IRHeader``: flag, label, id, id2 — flag>0 means ``flag``
+float labels follow the header). Byte-compatible: files written here load in
+the reference and vice versa.
+
+This is the pure-Python implementation; ``mxnet_tpu.lib.recordio`` (C++)
+accelerates sequential scans when built (see ``native/``).
+"""
+from __future__ import annotations
+
+import ctypes
+import io
+import os
+import struct
+from collections import namedtuple
+
+import numpy as _onp
+
+from .base import MXNetError
+
+_MAGIC = 0xCED7230A
+_LREC_MASK = (1 << 29) - 1
+
+
+def _cflag(lrec):
+    return lrec >> 29
+
+
+def _length(lrec):
+    return lrec & _LREC_MASK
+
+
+class MXRecordIO:
+    """Sequential reader/writer (reference ``recordio.py:37``)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"invalid flag {self.flag!r} (use 'r'/'w')")
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.record.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["record"] = None
+        d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if not self.is_open:
+            self.open()
+            if self.flag == "r":
+                pass
+
+    def _write_part(self, data, cflag):
+        n = len(data)
+        self.record.write(struct.pack("<II", _MAGIC,
+                                      (cflag << 29) | (n & _LREC_MASK)))
+        self.record.write(data)
+        pad = (4 - (n & 3)) & 3
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def write(self, buf):
+        assert self.writable
+        data = bytes(buf)
+        if len(data) <= _LREC_MASK:
+            self._write_part(data, 0)
+            return
+        # multi-part record: cflag start=1 / middle=2 / end=3
+        chunks = [data[i:i + _LREC_MASK]
+                  for i in range(0, len(data), _LREC_MASK)]
+        for i, chunk in enumerate(chunks):
+            cflag = 1 if i == 0 else (3 if i == len(chunks) - 1 else 2)
+            self._write_part(chunk, cflag)
+
+    def read(self):
+        assert not self.writable
+        parts = []
+        while True:
+            head = self.record.read(8)
+            if len(head) < 8:
+                return b"".join(parts) if parts else None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _MAGIC:
+                raise MXNetError(
+                    f"invalid record magic {magic:#x} in {self.uri}")
+            n = _length(lrec)
+            flag = _cflag(lrec)
+            data = self.record.read(n)
+            if len(data) < n:
+                raise MXNetError(f"truncated record in {self.uri}")
+            pad = (4 - (n & 3)) & 3
+            if pad:
+                self.record.read(pad)
+            parts.append(data)
+            if flag in (0, 3):  # complete or end-of-multipart
+                return b"".join(parts)
+
+    def tell(self):
+        return self.record.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with a ``key\\tpos`` text index
+    (reference ``recordio.py:126``)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + byte payload into one record string
+    (reference ``recordio.py:211``)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id,
+                          header.id2)
+        return hdr + s
+    label = _onp.asarray(header.label, dtype=_onp.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload) (reference
+    ``recordio.py:237``)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    payload = s[_IR_SIZE:]
+    if flag > 0:
+        label = _onp.frombuffer(payload[:flag * 4], dtype=_onp.float32)
+        payload = payload[flag * 4:]
+    header = IRHeader(flag, label, id_, id2)
+    return header, payload
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack a record into (IRHeader, HWC uint8 image array)."""
+    header, payload = unpack(s)
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(payload))
+    if iscolor:
+        img = img.convert("RGB")
+    else:
+        img = img.convert("L")
+    return header, _onp.asarray(img)
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack a header + HWC uint8 image, JPEG/PNG-encoded."""
+    from PIL import Image
+
+    arr = _onp.asarray(img, dtype=_onp.uint8)
+    pil = Image.fromarray(arr)
+    buf = io.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    pil.save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())

@@ -1,0 +1,243 @@
+"""gluon.data + io + recordio tests (reference:
+tests/python/unittest/test_gluon_data.py, test_io.py, test_recordio.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
+                                  RandomSampler, SequentialSampler,
+                                  SimpleDataset)
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def test_array_dataset_and_transform():
+    X = np.random.randn(10, 3).astype("float32")
+    y = np.arange(10).astype("int32")
+    ds = ArrayDataset(X, y)
+    assert len(ds) == 10
+    x0, y0 = ds[3]
+    np.testing.assert_allclose(x0, X[3])
+    assert y0 == 3
+    ds2 = ds.transform_first(lambda x: x * 2)
+    np.testing.assert_allclose(ds2[3][0], X[3] * 2)
+
+
+def test_dataset_combinators():
+    ds = SimpleDataset(list(range(20)))
+    assert list(ds.take(5)) == [0, 1, 2, 3, 4]
+    assert list(ds.filter(lambda x: x % 2 == 0)) == list(range(0, 20, 2))
+    sh = ds.shard(3, 0)
+    assert len(sh) == 7  # ceil(20/3), wraps
+    assert sh[0] == 0 and sh[1] == 3
+
+
+def test_samplers():
+    assert list(SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    assert sorted(RandomSampler(7)) == list(range(7))
+    bs = BatchSampler(SequentialSampler(7), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 1]
+    bs = BatchSampler(SequentialSampler(7), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3]
+    bs = BatchSampler(SequentialSampler(7), 3, "rollover")
+    assert [len(b) for b in bs] == [3, 3]
+    assert [len(b) for b in bs] == [3, 3]  # 1 rolled + 7 = 8 -> 2 full + 2 roll
+
+
+def test_dataloader_single_process():
+    X = np.random.randn(11, 4).astype("float32")
+    y = np.arange(11).astype("int32")
+    loader = DataLoader(ArrayDataset(X, y), batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 4)
+    assert batches[2][0].shape == (3, 4)
+    np.testing.assert_allclose(batches[1][1].asnumpy(), y[4:8])
+
+
+def test_dataloader_shuffle_covers_all():
+    X = np.arange(16).astype("float32").reshape(16, 1)
+    loader = DataLoader(ArrayDataset(X), batch_size=4, shuffle=True)
+    seen = np.concatenate([b.asnumpy().ravel() for b in loader])
+    assert sorted(seen) == list(range(16))
+
+
+def test_dataloader_multiworker():
+    X = np.arange(24).astype("float32").reshape(24, 1)
+    y = np.arange(24).astype("int32")
+    loader = DataLoader(ArrayDataset(X, y), batch_size=5, num_workers=2)
+    seen = np.concatenate([b[1].asnumpy().ravel() for b in loader])
+    assert sorted(seen.tolist()) == list(range(24))
+
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record-{i}".encode() * (i + 1))
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == f"record-{i}".encode() * (i + 1)
+    assert r.read() is None
+
+
+def test_indexed_recordio_and_dataset(tmp_path):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.gluon.data import RecordFileDataset
+
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(7):
+        w.write_idx(i, f"payload{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(5) == b"payload5"
+    assert r.read_idx(0) == b"payload0"
+    ds = RecordFileDataset(rec)
+    assert len(ds) == 7
+    assert ds[3] == b"payload3"
+
+
+def test_pack_unpack_img(tmp_path):
+    from mxnet_tpu import recordio
+
+    img = (np.random.rand(32, 32, 3) * 255).astype("uint8")
+    header = recordio.IRHeader(0, 7.0, 42, 0)
+    s = recordio.pack_img(header, img, img_fmt=".png")
+    h2, img2 = recordio.unpack_img(s)
+    assert h2.label == 7.0 and h2.id == 42
+    np.testing.assert_array_equal(img, img2)
+    # multi-label pack
+    s = recordio.pack(recordio.IRHeader(0, [1.0, 2.0, 3.0], 1, 0), b"x")
+    h3, payload = recordio.unpack(s)
+    np.testing.assert_allclose(h3.label, [1, 2, 3])
+    assert payload == b"x"
+
+
+def test_transforms_pipeline():
+    img = (np.random.rand(40, 60, 3) * 255).astype("uint8")
+    t = transforms.Compose([
+        transforms.Resize((32, 32)),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25)),
+    ])
+    out = t(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+    cc = transforms.CenterCrop(24)(img)
+    assert cc.shape == (24, 24, 3)
+    rrc = transforms.RandomResizedCrop(16)(img)
+    assert rrc.shape == (16, 16, 3)
+    jit = transforms.RandomColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+    assert jit.shape == img.shape
+
+
+def test_ndarray_iter():
+    from mxnet_tpu.io import NDArrayIter
+
+    X = np.random.randn(10, 2, 2).astype("float32")
+    y = np.arange(10).astype("float32")
+    it = NDArrayIter(X, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 2, 2)
+    assert batches[3].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+    it2 = NDArrayIter(X, y, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+
+
+def test_csv_iter(tmp_path):
+    from mxnet_tpu.io import CSVIter
+
+    data = np.random.rand(8, 6).astype("float32")
+    path = str(tmp_path / "d.csv")
+    np.savetxt(path, data, delimiter=",")
+    it = CSVIter(path, data_shape=(6,), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4],
+                               rtol=1e-6)
+
+
+def test_image_record_iter(tmp_path):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(6):
+        img = (np.random.rand(36, 36, 3) * 255).astype("uint8")
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png"))
+    w.close()
+    it = ImageRecordIter(rec, data_shape=(3, 32, 32), batch_size=4)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4,)
+
+
+def test_dataloader_with_transform_end_to_end():
+    imgs = (np.random.rand(12, 28, 28, 1) * 255).astype("uint8")
+    labels = np.arange(12).astype("int32")
+    ds = ArrayDataset(imgs, labels).transform_first(
+        transforms.Compose([transforms.ToTensor()]))
+    loader = DataLoader(ds, batch_size=6)
+    x, y = next(iter(loader))
+    assert x.shape == (6, 1, 28, 28)
+    assert float(x.asnumpy().max()) <= 1.0
+
+
+def test_shard_iteration_terminates():
+    ds = SimpleDataset(list(range(20))).shard(3, 1)
+    items = [x for x in ds]
+    assert len(items) == 7
+    assert items[0] == 1
+
+
+def test_ndarray_iter_roll_over():
+    from mxnet_tpu.io import NDArrayIter
+
+    X = np.arange(10).astype("float32").reshape(10, 1)
+    it = NDArrayIter(X, None, batch_size=4, last_batch_handle="roll_over")
+    first = [b.data[0].asnumpy().ravel() for b in it]
+    assert [len(b) for b in first] == [4, 4]  # tail of 2 rolled over
+    it.reset()
+    second = np.concatenate(
+        [b.data[0].asnumpy().ravel() for b in it])
+    # second epoch leads with the rolled-over samples 8, 9
+    np.testing.assert_allclose(second[:2], [8, 9])
+    assert len(second) == 12  # 2 leftover + 10
+
+
+def test_dataloader_thread_pool_isolation():
+    ds1 = SimpleDataset([np.full((2,), 1.0, dtype="float32")] * 8)
+    ds2 = SimpleDataset([np.full((2,), 2.0, dtype="float32")] * 8)
+    a = DataLoader(ds1, batch_size=4, num_workers=2, thread_pool=True)
+    b = DataLoader(ds2, batch_size=4, num_workers=2, thread_pool=True)
+    assert float(next(iter(a)).asnumpy().mean()) == 1.0
+    assert float(next(iter(b)).asnumpy().mean()) == 2.0
+
+
+def test_recordio_multipart_write(tmp_path, monkeypatch):
+    from mxnet_tpu import recordio
+
+    # shrink the 29-bit length cap so multi-part splitting triggers cheaply
+    monkeypatch.setattr(recordio, "_LREC_MASK", 0xF)
+    path = str(tmp_path / "mp.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payload = bytes(range(50))  # 4 parts at cap 15
+    w.write(payload)
+    w.write(b"tail")
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == payload
+    assert r.read() == b"tail"

@@ -1,0 +1,108 @@
+"""gluon.rnn tests (reference: tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+
+
+def _x(*shape):
+    return mx.np.array(np.random.randn(*shape).astype("float32"))
+
+
+@pytest.mark.parametrize("cell_cls,n_states", [
+    (rnn.RNNCell, 1), (rnn.LSTMCell, 2), (rnn.GRUCell, 1)])
+def test_cell_step_and_unroll(cell_cls, n_states):
+    cell = cell_cls(16)
+    cell.initialize()
+    out, states = cell(_x(4, 8), cell.begin_state(4))
+    assert out.shape == (4, 16)
+    assert len(states) == n_states
+    outs, _ = cell.unroll(5, _x(4, 5, 8), layout="NTC")
+    assert outs.shape == (4, 5, 16)
+
+
+@pytest.mark.parametrize("layer_cls,cell_cls", [
+    (rnn.RNN, rnn.RNNCell), (rnn.LSTM, rnn.LSTMCell), (rnn.GRU, rnn.GRUCell)])
+def test_fused_matches_cell(layer_cls, cell_cls):
+    layer = layer_cls(16, input_size=8)
+    layer.initialize()
+    cell = cell_cls(16, input_size=8)
+    cell.initialize()
+    for part in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+        getattr(cell, part).set_data(
+            layer.collect_params()["l0_" + part].data())
+    seq = _x(5, 4, 8)  # TNC
+    fused = layer(seq).asnumpy()
+    cell_out, _ = cell.unroll(
+        5, mx.np.array(np.swapaxes(seq.asnumpy(), 0, 1)), layout="NTC")
+    np.testing.assert_allclose(
+        fused, np.swapaxes(cell_out.asnumpy(), 0, 1), rtol=2e-5, atol=2e-5)
+
+
+def test_bidirectional_multilayer_backward():
+    net = rnn.GRU(12, num_layers=2, bidirectional=True, layout="NTC")
+    net.initialize()
+    x = _x(3, 7, 8)
+    states = net.begin_state(3)
+    with autograd.record():
+        out, st = net(x, states)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (3, 7, 24)
+    assert st[0].shape == (4, 3, 12)
+    for name in ("l0_i2h_weight", "r0_i2h_weight", "l1_h2h_weight"):
+        g = net.collect_params()[name].grad().asnumpy()
+        assert np.abs(g).sum() > 0, name
+
+
+def test_lstm_hybridize_matches_eager():
+    net = rnn.LSTM(16, num_layers=2, layout="NTC")
+    net.initialize()
+    x = _x(2, 6, 8)
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_explicit_states_roundtrip():
+    net = rnn.LSTM(10, num_layers=1)
+    net.initialize()
+    x = _x(4, 2, 6)  # TNC
+    h0 = net.begin_state(2)
+    out, (h, c) = net(x, h0)
+    assert out.shape == (4, 2, 10)
+    assert h.shape == (1, 2, 10) and c.shape == (1, 2, 10)
+    # final hidden state equals last output step for LSTM layer 0
+    np.testing.assert_allclose(out.asnumpy()[-1], h.asnumpy()[0], rtol=1e-6)
+
+
+def test_sequential_cell_and_modifiers():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(12))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(12)))
+    stack.add(rnn.DropoutCell(0.2))
+    stack.initialize()
+    outs, states = stack.unroll(4, _x(2, 4, 12), layout="NTC")
+    assert outs.shape == (2, 4, 12)
+    assert len(states) == 4  # 2 lstm cells x (h, c)
+
+
+def test_bidirectional_cell_unroll():
+    cell = rnn.BidirectionalCell(rnn.GRUCell(8), rnn.GRUCell(8))
+    cell.initialize()
+    outs, states = cell.unroll(5, _x(3, 5, 6), layout="NTC")
+    assert outs.shape == (3, 5, 16)
+    with pytest.raises(mx.MXNetError):
+        cell(_x(3, 6), states)
+
+
+def test_zoneout_cell():
+    cell = rnn.ZoneoutCell(rnn.LSTMCell(8), zoneout_outputs=0.5,
+                           zoneout_states=0.5)
+    cell.initialize()
+    with autograd.record():  # zoneout active in train mode
+        outs, _ = cell.unroll(4, _x(2, 4, 6), layout="NTC")
+    assert outs.shape == (2, 4, 8)
