@@ -39,6 +39,19 @@ from .ndarray.ndarray import NDArray, _slot_of, _tracked
 
 _trace_state = threading.local()
 
+# sentinel marking a traced (array) position in a CachedOp call signature
+_TRACED = object()
+
+
+def _wrap_data(d):
+    w = NDArray.__new__(NDArray)
+    w._data = d
+    w._tape = None
+    w._leaf = None
+    w._version = 0
+    w._stype = "default"
+    return w
+
 
 def in_trace() -> bool:
     return getattr(_trace_state, "depth", 0) > 0
@@ -90,7 +103,7 @@ class CachedOp:
     def _sig_of(datas):
         return tuple((tuple(d.shape), str(d.dtype)) for d in datas)
 
-    def _key(self, arg_datas, grad_mode, args_tracked):
+    def _key(self, arg_datas, grad_mode, args_tracked, static_args):
         train, state = self._split_params()
         return (
             self._sig_of(arg_datas),
@@ -99,9 +112,10 @@ class CachedOp:
             autograd.is_training(),
             grad_mode,
             tuple(args_tracked),
+            static_args,
         )
 
-    def _build(self, key, grad_mode, args_tracked):
+    def _build(self, key, grad_mode, args_tracked, static_args):
         import jax
 
         train_params, state_params = self._split_params()
@@ -112,16 +126,13 @@ class CachedOp:
         out_tree_box = {}
 
         def replay(tp_datas, st_datas, rng_key, arg_datas):
-            """Re-run block.forward with tracer-backed NDArrays."""
+            """Re-run block.forward with tracer-backed NDArrays; static
+            (non-array) call args are spliced back into their positions."""
             all_arrays = train_arrays + state_arrays
             all_tracers = list(tp_datas) + list(st_datas)
-            wrapped_args = [NDArray.__new__(NDArray) for _ in arg_datas]
-            for w, d in zip(wrapped_args, arg_datas):
-                w._data = d
-                w._tape = None
-                w._leaf = None
-                w._version = 0
-                w._stype = "default"
+            wrapped = iter([_wrap_data(d) for d in arg_datas])
+            wrapped_args = [next(wrapped) if s is _TRACED else s
+                            for s in static_args]
             with _ParamBinding(all_arrays, all_tracers):
                 _rng.push_trace_rng(rng_key)
                 prev_rec = autograd.set_recording(False)
@@ -139,7 +150,6 @@ class CachedOp:
             out_datas = [o._data if isinstance(o, NDArray) else o for o in flat_outs]
             return out_datas, new_states
 
-        n_args = len(key[0])
         diff_arg_idx = [i for i, t in enumerate(args_tracked) if t]
 
         if grad_mode:
@@ -185,22 +195,50 @@ class CachedOp:
     # -- call -------------------------------------------------------------
     def __call__(self, *args):
         args = list(args)
+        # NDArrays (and raw arrays) become traced inputs; None/bools/ints and
+        # other non-array values are static and baked into the cache key —
+        # the role op attrs play in the reference's CachedOp signature
         arg_datas = []
+        traced_args = []
+        static_template = []
         for a in args:
             if isinstance(a, NDArray):
                 arg_datas.append(a._data)
+                traced_args.append(a)
+                static_template.append(_TRACED)
+            elif hasattr(a, "shape") and hasattr(a, "dtype"):
+                nd = NDArray(a)
+                arg_datas.append(nd._data)
+                traced_args.append(nd)
+                static_template.append(_TRACED)
+            elif (isinstance(a, (list, tuple)) and a
+                  and all(isinstance(e, (bool, int, float)) for e in a)):
+                # numeric sequence: array-convert (pre-static-args behavior,
+                # e.g. net([1.0, 2.0]))
+                nd = NDArray(a)
+                arg_datas.append(nd._data)
+                traced_args.append(nd)
+                static_template.append(_TRACED)
             else:
-                arg_datas.append(NDArray(a)._data)
+                try:
+                    hash(a)
+                except TypeError:
+                    raise MXNetError(
+                        f"hybridized call got unhashable non-array argument "
+                        f"of type {type(a).__name__}; pass NDArrays or "
+                        f"hashable static values") from None
+                static_template.append(a)
+        static_args = tuple(static_template)
 
         grad_mode = autograd.is_recording()
         args_tracked = tuple(
-            isinstance(a, NDArray) and _tracked(a) for a in args
-        ) if grad_mode else tuple(False for _ in args)
+            _tracked(a) for a in traced_args
+        ) if grad_mode else tuple(False for _ in traced_args)
 
-        key = self._key(arg_datas, grad_mode, args_tracked)
+        key = self._key(arg_datas, grad_mode, args_tracked, static_args)
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._build(key, grad_mode, args_tracked)
+            entry = self._build(key, grad_mode, args_tracked, static_args)
             self._cache[key] = entry
 
         train_params = entry["train_params"]
@@ -234,7 +272,7 @@ class CachedOp:
                 return tuple(param_grads) + tuple(arg_grads)
 
             in_slots = [_slot_of(p.data()) for p in train_params]
-            in_slots += [_slot_of(args[i]) for i in diff_arg_idx]
+            in_slots += [_slot_of(traced_args[i]) for i in diff_arg_idx]
             node = autograd.TapeNode(
                 vjp_fn,
                 in_slots,

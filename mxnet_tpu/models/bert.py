@@ -1,0 +1,145 @@
+"""BERT on the Gluon API (the "GluonNLP BERT-base" target in BASELINE.json).
+
+Architecture: Devlin et al. 1810.04805 — learned word/position/segment
+embeddings, post-norm transformer encoder, pooler, MLM + NSP heads. The
+encoder cells run the Pallas flash-attention path on TPU; the whole forward
+is one XLA program under ``hybridize()``.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ops import nn as _ops
+from .transformer import (MultiHeadAttention, PositionalEmbedding,
+                          TransformerEncoderCell, valid_length_mask)
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, units, hidden_size, num_layers, num_heads,
+                 dropout=0.1, layer_norm_eps=1e-12, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+        for i in range(num_layers):
+            cell = TransformerEncoderCell(
+                units, hidden_size, num_heads, dropout=dropout,
+                pre_norm=False, activation="gelu",
+                layer_norm_eps=layer_norm_eps)
+            self._layers.append(cell)
+            self.register_child(cell, f"layer{i}")
+
+    def forward(self, x, mask=None):
+        for layer in self._layers:
+            x = layer(x, mask=mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Backbone: returns (sequence_output, pooled_output)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 token_types=2, dropout=0.1, layer_norm_eps=1e-12, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.token_type_embed = nn.Embedding(token_types, units)
+        self.pos_embed = PositionalEmbedding(units, max_length, learned=True)
+        self.embed_layer_norm = nn.LayerNorm(epsilon=layer_norm_eps)
+        self.embed_dropout = nn.Dropout(dropout)
+        self.encoder = BERTEncoder(units, hidden_size, num_layers, num_heads,
+                                   dropout=dropout,
+                                   layer_norm_eps=layer_norm_eps)
+        self.pooler = nn.Dense(units, activation="tanh", flatten=False)
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        from .. import numpy as mnp
+
+        x = self.word_embed(inputs)
+        if token_types is None:
+            token_types = mnp.zeros_like(inputs)
+        x = x + self.token_type_embed(token_types)
+        x = self.pos_embed(x)
+        x = self.embed_dropout(self.embed_layer_norm(x))
+        mask = None
+        if valid_length is not None:
+            t = inputs.shape[1]
+            mask = valid_length_mask(valid_length, t, t)
+        seq = self.encoder(x, mask=mask)
+        pooled = self.pooler(seq[:, 0])
+        return seq, pooled
+
+
+class BERTForPretrain(HybridBlock):
+    """MLM + NSP heads over the backbone (training objective)."""
+
+    def __init__(self, bert: BERTModel, **kwargs):
+        super().__init__(**kwargs)
+        self.bert = bert
+        units = bert._units
+        self.mlm_dense = nn.Dense(units, activation="gelu", flatten=False)
+        self.mlm_norm = nn.LayerNorm(epsilon=1e-12)
+        self.nsp = nn.Dense(2, flatten=False)
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        from .. import numpy as mnp
+
+        seq, pooled = self.bert(inputs, token_types, valid_length)
+        h = self.mlm_norm(self.mlm_dense(seq))
+        # decoder tied to the word embedding (standard BERT weight tying)
+        w = self.bert.word_embed.weight.data()
+        mlm_scores = _ops.fully_connected(
+            h, w, None, num_hidden=w.shape[0], no_bias=True, flatten=False)
+        nsp_scores = self.nsp(pooled)
+        return mlm_scores, nsp_scores
+
+
+class BERTClassifier(HybridBlock):
+    """Sentence(-pair) classification head (fine-tuning)."""
+
+    def __init__(self, bert: BERTModel, num_classes=2, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.bert = bert
+        self.dropout = nn.Dropout(dropout)
+        self.classifier = nn.Dense(num_classes)
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        _, pooled = self.bert(inputs, token_types, valid_length)
+        return self.classifier(self.dropout(pooled))
+
+
+_BERT_CONFIGS = {
+    "bert_12_768_12": dict(units=768, hidden_size=3072, num_layers=12,
+                           num_heads=12),
+    "bert_24_1024_16": dict(units=1024, hidden_size=4096, num_layers=24,
+                            num_heads=16),
+}
+_BERT_CONFIGS["bert_base"] = _BERT_CONFIGS["bert_12_768_12"]
+_BERT_CONFIGS["bert_large"] = _BERT_CONFIGS["bert_24_1024_16"]
+
+
+def get_bert_model(model_name="bert_12_768_12", vocab_size=30522,
+                   max_length=512, dropout=0.1, pretrained=False, **kwargs):
+    """Construct a BERT backbone by config name (GluonNLP naming)."""
+    if pretrained:
+        raise MXNetError("pretrained weights are not bundled; use "
+                         "load_parameters")
+    if model_name not in _BERT_CONFIGS:
+        raise MXNetError(f"unknown bert config {model_name!r}; options "
+                         f"{sorted(_BERT_CONFIGS)}")
+    cfg = dict(_BERT_CONFIGS[model_name])
+    cfg.update(kwargs)
+    return BERTModel(vocab_size=vocab_size, max_length=max_length,
+                     dropout=dropout, **cfg)
+
+
+def bert_sharding_rules():
+    """dp×tp PartitionSpecs for the BERT param tree: the transformer rules
+    plus replication for the small heads (pooler/nsp/mlm norms)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .transformer import transformer_sharding_rules
+
+    return transformer_sharding_rules() + [
+        (r"(pooler|nsp|mlm_dense|mlm_norm)\.", P()),
+    ]

@@ -1,0 +1,255 @@
+"""Transformer building blocks + MT model on the Gluon API.
+
+Reference: the framework only ships fused attention matmul helpers
+(``src/operator/contrib/transformer.cc``); the model-level Transformer lives
+in GluonNLP, which BASELINE.json names as a target config
+("GluonNLP: BERT-base / Transformer-base MT"). Built TPU-first: attention
+runs the Pallas flash kernel (``mxnet_tpu/ops/pallas/flash_attention.py``),
+everything else is MXU matmuls that XLA fuses.
+
+Sharding: each block names its params so the canonical tensor-parallel
+rules (:func:`transformer_sharding_rules`) can map qkv/ffn weights over the
+``tp`` mesh axis and activations over ``dp``/``sp``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from ..ops import nn as _ops
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head scaled-dot-product attention (flash path on TPU)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 causal=False, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads != 0:
+            raise MXNetError(f"units {units} not divisible by heads "
+                             f"{num_heads}")
+        self._units = units
+        self._num_heads = num_heads
+        self._causal = causal
+        self.query_proj = nn.Dense(units, flatten=False, use_bias=use_bias)
+        self.key_proj = nn.Dense(units, flatten=False, use_bias=use_bias)
+        self.value_proj = nn.Dense(units, flatten=False, use_bias=use_bias)
+        self.out_proj = nn.Dense(units, flatten=False, use_bias=use_bias)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        x = x.reshape(b, t, self._num_heads, -1)
+        return x.transpose(0, 2, 1, 3)  # (B, H, T, D)
+
+    def forward(self, query, key=None, value=None, mask=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self._split(self.query_proj(query))
+        k = self._split(self.key_proj(key))
+        v = self._split(self.value_proj(value))
+        out = _ops.attention(q, k, v, mask=mask, causal=self._causal)
+        b, h, t, d = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+        out = self.out_proj(out)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    """The transformer FFN: expand → activation → contract."""
+
+    def __init__(self, units, hidden_size, activation="gelu", dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.ffn_1 = nn.Dense(hidden_size, flatten=False)
+        self.ffn_2 = nn.Dense(units, flatten=False)
+        self._activation = activation
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        h = _ops.activation(self.ffn_1(x), self._activation)
+        h = self.ffn_2(h)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return h
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-norm (BERT-style) or pre-norm encoder layer."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 pre_norm=False, activation="gelu", layer_norm_eps=1e-12,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._pre_norm = pre_norm
+        self.attention = MultiHeadAttention(units, num_heads, dropout=dropout)
+        self.ffn = PositionwiseFFN(units, hidden_size, activation=activation,
+                                   dropout=dropout)
+        self.layer_norm_att = nn.LayerNorm(epsilon=layer_norm_eps)
+        self.layer_norm_ffn = nn.LayerNorm(epsilon=layer_norm_eps)
+
+    def forward(self, x, mask=None):
+        # sublayer dropout lives inside MultiHeadAttention / PositionwiseFFN
+        # (after their output projections) — exactly once per sublayer
+        if self._pre_norm:
+            h = self.attention(self.layer_norm_att(x), mask=mask)
+            x = x + h
+            x = x + self.ffn(self.layer_norm_ffn(x))
+            return x
+        h = self.attention(x, mask=mask)
+        x = self.layer_norm_att(x + h)
+        x = self.layer_norm_ffn(x + self.ffn(x))
+        return x
+
+
+class TransformerDecoderCell(HybridBlock):
+    """Decoder layer: causal self-attn, cross-attn, FFN (post-norm)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 activation="relu", layer_norm_eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.self_attention = MultiHeadAttention(units, num_heads,
+                                                 dropout=dropout, causal=True)
+        self.cross_attention = MultiHeadAttention(units, num_heads,
+                                                  dropout=dropout)
+        self.ffn = PositionwiseFFN(units, hidden_size, activation=activation,
+                                   dropout=dropout)
+        self.layer_norm_self = nn.LayerNorm(epsilon=layer_norm_eps)
+        self.layer_norm_cross = nn.LayerNorm(epsilon=layer_norm_eps)
+        self.layer_norm_ffn = nn.LayerNorm(epsilon=layer_norm_eps)
+
+    def forward(self, x, mem, mem_mask=None):
+        x = self.layer_norm_self(x + self.self_attention(x))
+        x = self.layer_norm_cross(
+            x + self.cross_attention(x, mem, mem, mask=mem_mask))
+        x = self.layer_norm_ffn(x + self.ffn(x))
+        return x
+
+
+@functools.lru_cache(maxsize=32)
+def _sinusoid_table(t, units):
+    import numpy as onp
+
+    pos = onp.arange(t)[:, None]
+    dim = onp.arange(0, units, 2)[None]
+    angle = pos / onp.power(10000.0, dim / units)
+    enc = onp.zeros((t, units), dtype="float32")
+    enc[:, 0::2] = onp.sin(angle)
+    enc[:, 1::2] = onp.cos(angle[:, :units // 2])  # odd units: cos is shorter
+    return enc
+
+
+class PositionalEmbedding(HybridBlock):
+    """Learned positions (BERT) or sinusoidal (MT transformer)."""
+
+    def __init__(self, units, max_length=512, learned=True, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        self._learned = learned
+        if learned:
+            self.weight = Parameter("weight", shape=(max_length, units))
+
+    def forward(self, x):
+        from .. import numpy as mnp
+
+        t = x.shape[1]
+        if t > self._max_length:
+            raise MXNetError(f"sequence length {t} exceeds max_length "
+                             f"{self._max_length}")
+        if self._learned:
+            return x + self.weight.data()[:t]
+        return x + mnp.array(_sinusoid_table(t, self._units))
+
+
+def valid_length_mask(valid_length, tq, tk):
+    """(B,) valid lengths -> (B, 1, Tq, Tk) boolean attention mask."""
+    from .. import numpy as mnp
+
+    ar = mnp.arange(tk).reshape(1, 1, 1, tk)
+    vl = valid_length.reshape(-1, 1, 1, 1)
+    return (ar < vl).broadcast_to((valid_length.shape[0], 1, tq, tk))
+
+
+class Transformer(HybridBlock):
+    """Encoder-decoder MT transformer (base config by default —
+    the "Transformer-base MT" target in BASELINE.json)."""
+
+    def __init__(self, src_vocab_size, tgt_vocab_size=None, units=512,
+                 hidden_size=2048, num_heads=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dropout=0.1, max_length=1024,
+                 tie_embeddings=False, **kwargs):
+        super().__init__(**kwargs)
+        tgt_vocab_size = tgt_vocab_size or src_vocab_size
+        self._units = units
+        self.src_embed = nn.Embedding(src_vocab_size, units)
+        self.tgt_embed = (self.src_embed if tie_embeddings
+                          else nn.Embedding(tgt_vocab_size, units))
+        self.pos_embed = PositionalEmbedding(units, max_length, learned=False)
+        self.enc_layers = nn.HybridSequential()
+        for _ in range(num_encoder_layers):
+            self.enc_layers.add(TransformerEncoderCell(
+                units, hidden_size, num_heads, dropout=dropout,
+                activation="relu", layer_norm_eps=1e-5))
+        self._dec_layers = []
+        for i in range(num_decoder_layers):
+            cell = TransformerDecoderCell(units, hidden_size, num_heads,
+                                          dropout=dropout)
+            self._dec_layers.append(cell)
+            self.register_child(cell, f"dec{i}")
+        self.proj = nn.Dense(tgt_vocab_size, flatten=False)
+        self._scale = math.sqrt(units)
+
+    def encode(self, src, src_valid_length=None):
+        x = self.src_embed(src) * self._scale
+        x = self.pos_embed(x)
+        mask = None
+        if src_valid_length is not None:
+            t = src.shape[1]
+            mask = valid_length_mask(src_valid_length, t, t)
+        for layer in self.enc_layers:
+            x = layer(x, mask=mask)
+        return x
+
+    def decode(self, tgt, mem, src_valid_length=None):
+        y = self.tgt_embed(tgt) * self._scale
+        y = self.pos_embed(y)
+        mem_mask = None
+        if src_valid_length is not None:
+            mem_mask = valid_length_mask(src_valid_length, tgt.shape[1],
+                                         mem.shape[1])
+        for cell in self._dec_layers:
+            y = cell(y, mem, mem_mask=mem_mask)
+        return self.proj(y)
+
+    def forward(self, src, tgt, src_valid_length=None):
+        mem = self.encode(src, src_valid_length)
+        return self.decode(tgt, mem, src_valid_length)
+
+
+def transformer_sharding_rules(prefix=""):
+    """Canonical tensor-parallel PartitionSpecs for transformer params.
+
+    qkv/ffn-expand weights shard their output dim over ``tp`` (column
+    parallel); out-proj/ffn-contract shard the input dim (row parallel) —
+    the Megatron layout, expressed declaratively for
+    :class:`mxnet_tpu.parallel.ShardingRules`.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (prefix + r"(query|key|value)_proj\.weight", P("tp", None)),
+        (prefix + r"(query|key|value)_proj\.bias", P("tp")),
+        (prefix + r"out_proj\.weight", P(None, "tp")),
+        (prefix + r"ffn_1\.weight", P("tp", None)),
+        (prefix + r"ffn_1\.bias", P("tp")),
+        (prefix + r"ffn_2\.weight", P(None, "tp")),
+        (prefix + r"(?:embed.*weight|.*embedding.*weight)", P("tp", None)),
+        (prefix + r"(?:.*(gamma|beta|bias)$)", P()),
+    ]

@@ -129,14 +129,21 @@ class ShardingRules:
         return P()
 
     def shard(self, params: Dict[str, object], mesh):
-        """Place a param dict onto the mesh per the rules."""
+        """Place a param dict onto the mesh per the rules.
+
+        Copies rather than aliasing: device_put can reuse the source buffer
+        for the matching shard, and ShardedTrainer donates these arrays —
+        donation must never free a buffer the caller's Block still owns.
+        """
         import jax
+        import jax.numpy as jnp
         from jax.sharding import NamedSharding
 
         out = {}
         for name, arr in params.items():
             spec = self.spec_for(name, arr.shape, mesh)
-            out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+            out[name] = jax.device_put(jnp.array(arr, copy=True),
+                                       NamedSharding(mesh, spec))
         return out
 
 
@@ -331,7 +338,10 @@ class ShardedTrainer:
         return NDArray(loss)
 
     def sync_to_block(self):
-        """Copy trained weights back into the Block's Parameters."""
+        """Copy trained weights back into the Block's Parameters (a copy —
+        the trainer's own arrays get donated on the next step)."""
+        import jax.numpy as jnp
+
         params_od = self.block.collect_params()
         for n, arr in self.params.items():
-            params_od[n].data()._set_data_internal(arr)
+            params_od[n].data()._set_data_internal(jnp.array(arr, copy=True))

@@ -1,0 +1,105 @@
+"""Ring attention: context parallelism over a sequence-sharded mesh axis.
+
+No reference analog (SURVEY.md §5: "long-context / sequence parallelism —
+absent... design fresh"). Design follows the blockwise ring schedule (Liu &
+Abbeel 2310.01889): Q stays resident per device; K/V blocks rotate around
+the ``sp`` ring via ``lax.ppermute`` (ICI neighbor exchange) while a running
+online-softmax (m, l, acc) merges each visiting block — the same math as
+flash attention, distributed. Peak memory per device is O(T/n · T/n) and
+the K/V transfer overlaps with the block matmul, so sequence length scales
+linearly with ring size.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+from ..base import MXNetError
+
+
+def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
+    """Attention over (B, H, T, D) arrays whose T axis is sharded on
+    ``axis``. Returns the same sharding. Eager-safe: jit/shard_map inside."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from . import mesh as mesh_mod
+
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh(create=True)
+    if mesh is None or axis not in mesh.axis_names:
+        raise MXNetError(f"ring_attention needs a mesh with axis {axis!r}")
+
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    n = mesh.shape[axis]
+    if q.shape[2] % n != 0:
+        raise MXNetError(
+            f"sequence length {q.shape[2]} not divisible by {axis}={n}")
+
+    spec = P(None, None, axis, None)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_rep=False)
+    def inner(ql, kl, vl):
+        # ql/kl/vl: (B, H, Tl, D) local blocks
+        b, h, tl, dd = ql.shape
+        my = jax.lax.axis_index(axis)
+        qf = ql.astype(jnp.float32) * s
+        q_pos = my * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
+
+        def block_update(i, m, l, acc, kb, vb):
+            """Merge one visiting K/V block into the online softmax."""
+            src = (my - i) % n  # which global block kb currently holds
+            sc = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32))
+            if causal:
+                k_pos = src * tl + jax.lax.broadcasted_iota(
+                    jnp.int32, (tl, tl), 1)
+                sc = jnp.where(q_pos >= k_pos, sc, -jnp.inf)
+            m_cur = jnp.max(sc, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            # fully-masked rows keep m = -inf; guard the exp shift
+            shift = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+            p = jnp.exp(sc - shift)
+            alpha = jnp.where(jnp.isinf(m), 0.0, jnp.exp(m - shift))
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+            return m_new, l, acc
+
+        def step(i, carry):
+            m, l, acc, kb, vb = carry
+            m, l, acc = block_update(i, m, l, acc, kb, vb)
+            # rotate K/V to the next device on the ring (ICI neighbor hop)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            return m, l, acc, kb, vb
+
+        m0 = jnp.full((b, h, tl, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((b, h, tl, 1), jnp.float32)
+        a0 = jnp.zeros((b, h, tl, dd), jnp.float32)
+        # n-1 rotating steps, then the final visiting block without the
+        # rotation (its ppermute output would be discarded — dead ICI traffic)
+        m, l, acc, kb, vb = jax.lax.fori_loop(
+            0, n - 1, step, (m0, l0, a0, kl, vl))
+        m, l, acc = block_update(n - 1, m, l, acc, kb, vb)
+        l = jnp.where(l == 0.0, 1.0, l)
+        return (acc / l).astype(ql.dtype)
+
+    return inner(q, k, v)
+
+
+def sequence_sharded(x, mesh=None, axis="sp", dim=2):
+    """Place an array with dimension ``dim`` sharded over the ``axis`` ring."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from . import mesh as mesh_mod
+
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh(create=True)
+    parts = [None] * x.ndim
+    parts[dim] = axis
+    return jax.device_put(x, NamedSharding(mesh, P(*parts)))

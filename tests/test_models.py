@@ -1,0 +1,132 @@
+"""BERT / Transformer model tests (targets from BASELINE.json configs)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.models import (BERTClassifier, BERTForPretrain, Transformer,
+                              get_bert_model)
+
+
+def _bert_tiny(**kw):
+    cfg = dict(units=32, hidden_size=64, num_layers=2, num_heads=4,
+               vocab_size=100, max_length=64, dropout=0.0)
+    cfg.update(kw)
+    return get_bert_model(**cfg)
+
+
+def _ids(b=2, t=16, vocab=100):
+    return mx.np.array(np.random.randint(0, vocab, (b, t)))
+
+
+def test_bert_backbone_shapes():
+    bert = _bert_tiny()
+    bert.initialize()
+    seq, pooled = bert(_ids(), None, mx.np.array(np.array([16, 9])))
+    assert seq.shape == (2, 16, 32)
+    assert pooled.shape == (2, 32)
+
+
+def test_bert_valid_length_masks_padding():
+    bert = _bert_tiny()
+    bert.initialize()
+    ids = _ids(1, 8)
+    vl = mx.np.array(np.array([5]))
+    with autograd.predict_mode():
+        seq_full, _ = bert(ids, None, vl)
+        # changing tokens beyond valid_length must not change valid outputs
+        arr = ids.asnumpy().copy()
+        arr[0, 5:] = 1
+        seq_mod, _ = bert(mx.np.array(arr), None, vl)
+    np.testing.assert_allclose(seq_full.asnumpy()[0, :5],
+                               seq_mod.asnumpy()[0, :5], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bert_pretrain_backward_ties_embedding():
+    bert = _bert_tiny()
+    pre = BERTForPretrain(bert)
+    pre.initialize()
+    ids = _ids()
+    with autograd.record():
+        mlm, nsp = pre(ids)
+        loss = mlm.sum() + nsp.sum()
+    loss.backward()
+    assert mlm.shape == (2, 16, 100)
+    assert nsp.shape == (2, 2)
+    g = bert.collect_params()["word_embed.weight"].grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_bert_classifier_train_step():
+    bert = _bert_tiny()
+    net = BERTClassifier(bert, num_classes=3, dropout=0.0)
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    ids = _ids(4)
+    y = mx.np.array(np.random.randint(0, 3, (4,)))
+    losses = []
+    for _ in range(3):
+        with autograd.record():
+            l = loss_fn(net(ids), y).mean()
+        l.backward()
+        trainer.step(4)
+        losses.append(float(l.asnumpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_hybridize_matches_eager():
+    bert = _bert_tiny()
+    bert.initialize()
+    ids = _ids()
+    with autograd.predict_mode():
+        seq_e, pooled_e = bert(ids)
+    bert.hybridize()
+    with autograd.predict_mode():
+        seq_h, pooled_h = bert(ids)
+    np.testing.assert_allclose(pooled_e.asnumpy(), pooled_h.asnumpy(),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bert_config_registry():
+    with pytest.raises(mx.MXNetError):
+        get_bert_model("bert_nonexistent")
+    with pytest.raises(mx.MXNetError):
+        get_bert_model(pretrained=True)
+
+
+def test_transformer_mt_forward_backward():
+    net = Transformer(src_vocab_size=50, tgt_vocab_size=60, units=32,
+                      hidden_size=64, num_heads=4, num_encoder_layers=2,
+                      num_decoder_layers=2, dropout=0.0)
+    net.initialize()
+    src = _ids(2, 10, 50)
+    tgt = _ids(2, 7, 60)
+    svl = mx.np.array(np.array([10, 6]))
+    with autograd.record():
+        out = net(src, tgt, svl)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (2, 7, 60)
+    g = net.collect_params()["src_embed.weight"].grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_transformer_decoder_is_causal():
+    net = Transformer(src_vocab_size=50, units=32, hidden_size=64,
+                      num_heads=4, num_encoder_layers=1,
+                      num_decoder_layers=1, dropout=0.0)
+    net.initialize()
+    src = _ids(1, 6, 50)
+    tgt = _ids(1, 8, 50)
+    with autograd.predict_mode():
+        out1 = net(src, tgt).asnumpy()
+        # changing a later target token must not affect earlier outputs
+        arr = tgt.asnumpy().copy()
+        arr[0, 5] = (arr[0, 5] + 1) % 50
+        out2 = net(src, mx.np.array(arr)).asnumpy()
+    np.testing.assert_allclose(out1[0, :5], out2[0, :5], rtol=1e-4,
+                               atol=1e-5)
+    assert np.abs(out1[0, 5:] - out2[0, 5:]).max() > 1e-6
