@@ -1,0 +1,161 @@
+"""AMP — automatic mixed precision (reference:
+``python/mxnet/contrib/amp/amp.py`` + ``src/nnvm/low_precision_pass.cc``).
+
+The reference rewrites symbol graphs with cast nodes driven by per-op
+allow/deny lists and scales the loss dynamically for fp16. On TPU the
+natural policy is **bfloat16** (MXU-native, fp32 exponent range — loss
+scaling unnecessary): here AMP is a *dtype policy* applied to Gluon blocks —
+parameters stay fp32 master copies, compute casts to the low-precision
+dtype at block boundaries and accumulates in fp32 where it matters
+(XLA handles the epilogue fusion). ``LossScaler`` provides the reference's
+dynamic-scaling behavior for fp16 parity.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .base import MXNetError
+
+_state = {"enabled": False, "dtype": None}
+
+# ops that must stay fp32 (reference FP32_FUNCS lists, lists/symbol_fp16.py)
+FP32_OPS = frozenset({
+    "softmax", "log_softmax", "layer_norm", "batch_norm", "group_norm",
+    "instance_norm", "rms_norm", "ctc_loss", "norm", "mean", "sum", "exp",
+    "log",
+})
+# ops safe in low precision (reference FP16_FUNCS)
+TARGET_OPS = frozenset({
+    "fully_connected", "convolution", "deconvolution", "batch_dot",
+    "attention",
+})
+
+
+def init(target_dtype="bfloat16"):
+    """Enable the global AMP policy (reference ``amp.init``)."""
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError(f"amp target_dtype must be bfloat16/float16, got "
+                         f"{target_dtype}")
+    _state["enabled"] = True
+    _state["dtype"] = target_dtype
+    return _state["dtype"]
+
+
+def is_enabled():
+    return _state["enabled"]
+
+
+def target_dtype():
+    return _state["dtype"]
+
+
+def disable():
+    _state["enabled"] = False
+    _state["dtype"] = None
+
+
+def _low_dtype():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if _state["dtype"] == "bfloat16" else jnp.float16
+
+
+class _AmpBlock:
+    """Wrapper casting inputs low / outputs fp32 around a block."""
+
+    def __init__(self, block, dtype):
+        self._block = block
+        self._dtype = dtype
+
+    def __call__(self, *args):
+        from .ndarray.ndarray import NDArray
+
+        cast_args = [a.astype(self._dtype)
+                     if isinstance(a, NDArray)
+                     and _onp.issubdtype(_onp.dtype(a.dtype), _onp.floating)
+                     else a for a in args]
+        out = self._block(*cast_args)
+        def up(o):
+            if isinstance(o, NDArray) and str(o.dtype) in ("bfloat16",
+                                                           "float16"):
+                return o.astype("float32")
+            return o
+        if isinstance(out, tuple):
+            return tuple(up(o) for o in out)
+        return up(out)
+
+    def __getattr__(self, name):
+        return getattr(self._block, name)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", cast_params=False):
+    """Convert a block for mixed-precision inference/training.
+
+    ``cast_params=False`` (default) keeps fp32 master weights and casts
+    activations at the boundary — the reference's multi-precision mode.
+    ``cast_params=True`` casts the parameters themselves (pure low-precision
+    inference; halves weight HBM traffic).
+    """
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be bfloat16/float16")
+    if cast_params:
+        block.cast(target_dtype)
+        return block
+    return _AmpBlock(block, target_dtype)
+
+
+convert_model = convert_hybrid_block
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference ``contrib/amp/loss_scaler.py``):
+    scale up every ``scale_window`` clean steps, halve on inf/nan."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, min_scale=1.0):
+        self.loss_scale = float(init_scale)
+        self._factor = scale_factor
+        self._window = scale_window
+        self._min = min_scale
+        self._unskipped = 0
+
+    def scale(self, loss):
+        return loss * self.loss_scale
+
+    def unscale(self, grads):
+        inv = 1.0 / self.loss_scale
+        return [g * inv for g in grads]
+
+    def has_overflow(self, grads):
+        for g in grads:
+            a = g.asnumpy() if hasattr(g, "asnumpy") else _onp.asarray(g)
+            if not _onp.isfinite(a).all():
+                return True
+        return False
+
+    def update(self, overflow):
+        """Post-step bookkeeping; returns True if the step must be skipped."""
+        if overflow:
+            self.loss_scale = max(self._min, self.loss_scale / self._factor)
+            self._unskipped = 0
+            return True
+        self._unskipped += 1
+        if self._unskipped >= self._window:
+            self.loss_scale *= self._factor
+            self._unskipped = 0
+        return False
+
+
+def scale_loss(loss, scaler: LossScaler):
+    """Convenience: scale one loss (or list) before ``backward``."""
+    if isinstance(loss, (list, tuple)):
+        return type(loss)(scaler.scale(l) for l in loss)
+    return scaler.scale(loss)
+
+
+def list_fp16_ops():
+    return sorted(TARGET_OPS)
+
+
+def list_fp32_ops():
+    return sorted(FP32_OPS)

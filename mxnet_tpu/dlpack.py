@@ -1,0 +1,25 @@
+"""DLPack zero-copy tensor interchange (reference: ``python/mxnet/dlpack.py``
+over the 3rdparty/dlpack submodule)."""
+from __future__ import annotations
+
+
+def to_dlpack_for_read(array):
+    """NDArray -> DLPack capsule (shared, read-only semantics)."""
+    array.wait_to_read()
+    return array._data.__dlpack__()
+
+
+def to_dlpack_for_write(array):
+    """MXNet distinguishes read/write capsules for engine ordering; XLA
+    arrays are immutable so both hand out the same capsule."""
+    return to_dlpack_for_read(array)
+
+
+def from_dlpack(capsule_or_array):
+    """DLPack capsule (or any __dlpack__ object: torch/numpy/cupy tensors)
+    -> NDArray, zero-copy where the backend allows."""
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    return NDArray(jnp.from_dlpack(capsule_or_array))

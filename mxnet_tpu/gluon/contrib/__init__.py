@@ -1,0 +1,2 @@
+"""Gluon contrib (reference: ``python/mxnet/gluon/contrib/``)."""
+from . import estimator

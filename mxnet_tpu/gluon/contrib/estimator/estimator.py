@@ -1,0 +1,126 @@
+"""Estimator fit-loop (reference:
+``python/mxnet/gluon/contrib/estimator/estimator.py``)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ... import Trainer, loss as gloss, metric as gmetric
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            LoggingHandler, MetricHandler, StoppingHandler,
+                            TrainBegin, TrainEnd, ValidationHandler)
+
+
+class _LossMetric(gmetric.Loss):
+    _is_loss_metric = True
+
+
+class Estimator:
+    """Compact fit abstraction: ``Estimator(net, loss, ...).fit(train_data,
+    epochs=N)`` with composable event handlers."""
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 initializer=None, trainer=None, device=None, context=None):
+        self.net = net
+        self.loss = loss
+        self.device = device or context
+        if initializer is not None:
+            net.initialize(init=initializer, ctx=self.device,
+                           force_reinit=False)
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3})
+        self.train_metrics = train_metrics or [gmetric.Accuracy()]
+        self.val_metrics = val_metrics or [
+            type(m)() for m in self.train_metrics]
+        self.train_loss_metric = _LossMetric(name="train_loss")
+        self.val_loss_metric = _LossMetric(name="val_loss")
+
+    def _batch_fn(self, batch):
+        from ... import utils as gutils  # noqa: F401
+
+        data, label = batch[0], batch[1]
+        return data, label
+
+    def evaluate(self, val_data=None, **kwargs):
+        from .... import autograd
+
+        if val_data is None:
+            return
+        for m in self.val_metrics:
+            m.reset()
+        self.val_loss_metric.reset()
+        for batch in val_data:
+            data, label = self._batch_fn(batch)
+            with autograd.predict_mode():
+                pred = self.net(data)
+                l = self.loss(pred, label)
+            for m in self.val_metrics:
+                m.update(label, pred)
+            self.val_loss_metric.update(0, l)
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_size=None):
+        from .... import autograd
+
+        if epochs is None and batches is None:
+            epochs = 1
+        handlers = self._init_handlers(val_data, event_handlers,
+                                       epochs, batches)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize(handlers)
+
+        for h in train_begin:
+            h.train_begin(self)
+        stop = False
+        while not stop:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            for batch in train_data:
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                data, label = self._batch_fn(batch)
+                bsz = data.shape[0]
+                with autograd.record():
+                    pred = self.net(data)
+                    l = self.loss(pred, label).mean()
+                l.backward()
+                self.trainer.step(1)
+                for h in batch_end:
+                    h.batch_end(self, batch=batch, pred=pred, label=label,
+                                loss=l)
+                stop = any(getattr(h, "stop_training", False)
+                           for h in handlers)
+                if stop:
+                    break
+            for h in epoch_end:
+                h.epoch_end(self)
+            stop = stop or any(getattr(h, "stop_training", False)
+                               for h in handlers)
+        for h in train_end:
+            h.train_end(self)
+
+    def _init_handlers(self, val_data, event_handlers, epochs, batches):
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(
+                [self.train_loss_metric] + list(self.train_metrics)))
+        if val_data is not None and not any(
+                isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=[self.train_loss_metric] + list(self.train_metrics)))
+        # ascending priority: metric/validation handlers (priority -1000)
+        # must run before logging (priority +inf) sees their values
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return handlers
+
+    @staticmethod
+    def _categorize(handlers):
+        return ([h for h in handlers if isinstance(h, TrainBegin)],
+                [h for h in handlers if isinstance(h, EpochBegin)],
+                [h for h in handlers if isinstance(h, BatchBegin)],
+                [h for h in handlers if isinstance(h, BatchEnd)],
+                [h for h in handlers if isinstance(h, EpochEnd)],
+                [h for h in handlers if isinstance(h, TrainEnd)])

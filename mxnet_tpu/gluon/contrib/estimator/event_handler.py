@@ -1,0 +1,269 @@
+"""Estimator event handlers (reference:
+``python/mxnet/gluon/contrib/estimator/event_handler.py``)."""
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as _onp
+
+from ....base import MXNetError
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop at max_epoch/max_batch (reference ``event_handler.py:94``)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset metrics per epoch, update per batch (reference
+    ``event_handler.py:135``)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics or []
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for metric in self.metrics:
+            metric.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for metric in self.metrics:
+            if getattr(metric, "_is_loss_metric", False):
+                metric.update(0, loss)
+            else:
+                metric.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation every ``epoch_period`` epochs (reference
+    ``event_handler.py:182``)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    """Periodic logging (reference ``event_handler.py:250``)."""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=_onp.inf):
+        if log_interval != "epoch" and not isinstance(log_interval, int):
+            raise MXNetError("log_interval must be 'epoch' or an int")
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        t = time.time() - self.train_start
+        self.logger.info("Train finished in %.3fs: %s", t,
+                         self._metrics_str())
+
+    def _metrics_str(self):
+        parts = []
+        for m in self.metrics:
+            name, val = m.get()
+            parts.append(f"{name}={val:.4f}" if isinstance(val, float)
+                         else f"{name}={val}")
+        return " ".join(parts)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        t = time.time() - self.epoch_start
+        self.logger.info("Epoch %d finished in %.3fs: %s",
+                         self.current_epoch, t, self._metrics_str())
+        self.current_epoch += 1
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) \
+                and self.batch_index % self.log_interval == 0:
+            self.logger.info("Epoch %d batch %d: %s", self.current_epoch,
+                             self.batch_index, self._metrics_str())
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save params (+trainer states) periodically; keeps best by monitored
+    metric (reference ``event_handler.py:383``)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        import os
+
+        self.model_dir = model_dir
+        os.makedirs(model_dir, exist_ok=True)
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.saved = []
+        if mode == "auto" and monitor is not None:
+            name = monitor.get()[0]
+            mode = "max" if "acc" in name or "f1" in name else "min"
+        self.mode = mode
+        self.best = -_onp.inf if mode == "max" else _onp.inf
+
+    def _save(self, estimator, tag, rotate=True):
+        import os
+
+        path = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")
+        estimator.net.save_parameters(path + ".params")
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(path + ".states")
+        if not rotate:
+            return  # the 'best' checkpoint never enters the rotation
+        self.saved.append(path)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            for ext in (".params", ".states"):
+                try:
+                    os.remove(old + ext)
+                except OSError:
+                    pass
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save(estimator, f"batch{self.current_batch}")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save(estimator, f"epoch{self.current_epoch}")
+        if self.save_best and self.monitor is not None:
+            _, val = self.monitor.get()
+            better = val > self.best if self.mode == "max" else val < self.best
+            if better:
+                self.best = val
+                self._save(estimator, "best", rotate=False)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when the monitored metric stops improving (reference
+    ``event_handler.py:598``)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if mode == "auto":
+            name = monitor.get()[0]
+            mode = "max" if "acc" in name or "f1" in name else "min"
+        self.mode = mode
+        self.best = (baseline if baseline is not None
+                     else (-_onp.inf if mode == "max" else _onp.inf))
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, val = self.monitor.get()
+        improved = (val > self.best + self.min_delta if self.mode == "max"
+                    else val < self.best - self.min_delta)
+        if improved:
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                self.stop_training = True
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            logging.getLogger("mxnet_tpu.estimator").info(
+                "Early stop at epoch %d", self.stopped_epoch)

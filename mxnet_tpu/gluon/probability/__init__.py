@@ -1,0 +1,24 @@
+"""Probabilistic programming (reference: ``python/mxnet/gluon/probability/``,
+5,516 LoC: distributions, StochasticBlock, transformations).
+
+Distributions operate on NDArrays through the normal dispatch layer, so
+``log_prob`` participates in autograd and everything jits inside
+``hybridize``. Sampling draws from the framework RNG (trace-aware keys)."""
+from . import distributions
+from .distributions import (
+    Bernoulli,
+    Beta,
+    Categorical,
+    Dirichlet,
+    Distribution,
+    Exponential,
+    Gamma,
+    Laplace,
+    MultivariateNormal,
+    Normal,
+    Poisson,
+    Uniform,
+    kl_divergence,
+    register_kl,
+)
+from .stochastic_block import StochasticBlock, StochasticSequential

@@ -1,0 +1,574 @@
+"""Distributions (reference:
+``python/mxnet/gluon/probability/distributions/``)."""
+from __future__ import annotations
+
+import math
+
+from ... import random as _rng
+from ...base import MXNetError
+from ...ops.registry import apply as _apply
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _jr():
+    import jax.random as jr
+
+    return jr
+
+
+def _data(x):
+    from ...ndarray.ndarray import NDArray
+
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _wrap(fn, *args, name="dist"):
+    return _apply(fn, args, name=name)
+
+
+class Distribution:
+    """Base distribution (reference ``distribution.py``)."""
+
+    has_grad = True
+    support = None
+    arg_constraints = {}
+
+    def __init__(self, event_dim=0, validate_args=None):
+        self.event_dim = event_dim
+        self._validate_args = validate_args
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ... import numpy as mnp
+
+        return mnp.exp(self.log_prob(value))
+
+    def sample(self, size=None):
+        raise NotImplementedError
+
+    def sample_n(self, n):
+        return self.sample((n,))
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return self.variance.sqrt()
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def _shape(self, size, param):
+        base = tuple(param.shape)
+        if size is None:
+            return base
+        if isinstance(size, int):
+            size = (size,)
+        return tuple(size) + base
+
+
+class Normal(Distribution):
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.loc = mnp.array(loc) if not hasattr(loc, "_data") else loc
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, loc, scale):
+            var = scale ** 2
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+
+        return _wrap(f, value, self.loc, self.scale, name="normal_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.loc)
+
+        def f(loc, scale):
+            return loc + scale * jr.normal(key, shape)
+
+        return _wrap(f, self.loc, self.scale, name="normal_sample")
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    def entropy(self):
+        jnp = _jnp()
+
+        def f(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+
+        return _wrap(f, self.scale, name="normal_entropy")
+
+
+class Laplace(Distribution):
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.loc = mnp.array(loc) if not hasattr(loc, "_data") else loc
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, loc, scale):
+            return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+
+        return _wrap(f, value, self.loc, self.scale, name="laplace_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.loc)
+
+        def f(loc, scale):
+            return loc + scale * jr.laplace(key, shape)
+
+        return _wrap(f, self.loc, self.scale, name="laplace_sample")
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2.0 * self.scale ** 2
+
+
+class Bernoulli(Distribution):
+    def __init__(self, prob=None, logit=None, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        if (prob is None) == (logit is None):
+            raise MXNetError("give exactly one of prob=/logit=")
+        self._prob = (mnp.array(prob) if prob is not None
+                      and not hasattr(prob, "_data") else prob)
+        self._logit = (mnp.array(logit) if logit is not None
+                       and not hasattr(logit, "_data") else logit)
+
+    @property
+    def prob(self):
+        if self._prob is not None:
+            return self._prob
+        jnp = _jnp()
+        return _wrap(lambda l: 1 / (1 + jnp.exp(-l)), self._logit,
+                     name="sigmoid")
+
+    @property
+    def logit(self):
+        if self._logit is not None:
+            return self._logit
+        jnp = _jnp()
+        return _wrap(lambda p: jnp.log(p) - jnp.log1p(-p), self._prob,
+                     name="logit")
+
+    def log_prob(self, value):
+        jnp = _jnp()
+        logit = self.logit
+
+        def f(v, l):
+            # -softplus(-l)*v - softplus(l)*(1-v) stable form
+            return v * l - jnp.logaddexp(0.0, l)
+
+        return _wrap(f, value, logit, name="bernoulli_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        p = self.prob
+        shape = self._shape(size, p)
+
+        def f(pp):
+            return jr.bernoulli(key, pp, shape).astype("float32")
+
+        return _wrap(f, p, name="bernoulli_sample")
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        p = self.prob
+        return p * (1 - p)
+
+
+class Categorical(Distribution):
+    def __init__(self, num_events=None, prob=None, logit=None, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(event_dim=1, **kwargs)
+        if (prob is None) == (logit is None):
+            raise MXNetError("give exactly one of prob=/logit=")
+        self._prob = (mnp.array(prob) if prob is not None
+                      and not hasattr(prob, "_data") else prob)
+        self._logit = (mnp.array(logit) if logit is not None
+                       and not hasattr(logit, "_data") else logit)
+        self.num_events = num_events
+
+    @property
+    def logit(self):
+        if self._logit is not None:
+            return self._logit
+        jnp = _jnp()
+        return _wrap(lambda p: jnp.log(p), self._prob, name="log")
+
+    @property
+    def prob(self):
+        if self._prob is not None:
+            return self._prob
+        import jax
+
+        return _wrap(lambda l: jax.nn.softmax(l, axis=-1), self._logit,
+                     name="softmax")
+
+    def log_prob(self, value):
+        import jax
+        jnp = _jnp()
+        logit = self.logit
+
+        def f(v, l):
+            logp = jax.nn.log_softmax(l, axis=-1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+        return _wrap(f, value, logit, name="categorical_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        logit = self.logit
+        shape = (tuple(size) if isinstance(size, (tuple, list))
+                 else ((size,) if size else ())) + tuple(logit.shape[:-1])
+
+        def f(l):
+            return jr.categorical(key, l, shape=shape).astype("float32")
+
+        return _wrap(f, logit, name="categorical_sample")
+
+
+class Uniform(Distribution):
+    def __init__(self, low=0.0, high=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.low = mnp.array(low) if not hasattr(low, "_data") else low
+        self.high = mnp.array(high) if not hasattr(high, "_data") else high
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v <= hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+
+        return _wrap(f, value, self.low, self.high, name="uniform_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.low)
+
+        def f(lo, hi):
+            return lo + (hi - lo) * jr.uniform(key, shape)
+
+        return _wrap(f, self.low, self.high, name="uniform_sample")
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+
+class Exponential(Distribution):
+    def __init__(self, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, s):
+            return -v / s - jnp.log(s)
+
+        return _wrap(f, value, self.scale, name="exponential_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.scale)
+
+        def f(s):
+            return s * jr.exponential(key, shape)
+
+        return _wrap(f, self.scale, name="exponential_sample")
+
+    @property
+    def mean(self):
+        return self.scale
+
+
+class Gamma(Distribution):
+    def __init__(self, shape=1.0, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.shape_param = (mnp.array(shape) if not hasattr(shape, "_data")
+                            else shape)
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        import jax
+        jnp = _jnp()
+
+        def f(v, a, s):
+            return ((a - 1) * jnp.log(v) - v / s - jax.lax.lgamma(a)
+                    - a * jnp.log(s))
+
+        return _wrap(f, value, self.shape_param, self.scale,
+                     name="gamma_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.shape_param)
+
+        def f(a, s):
+            return s * jr.gamma(key, a, shape)
+
+        return _wrap(f, self.shape_param, self.scale, name="gamma_sample")
+
+    @property
+    def mean(self):
+        return self.shape_param * self.scale
+
+
+class Beta(Distribution):
+    def __init__(self, alpha=1.0, beta=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.alpha = mnp.array(alpha) if not hasattr(alpha, "_data") else alpha
+        self.beta = mnp.array(beta) if not hasattr(beta, "_data") else beta
+
+    def log_prob(self, value):
+        import jax
+        jnp = _jnp()
+
+        def f(v, a, b):
+            lbeta = (jax.lax.lgamma(a) + jax.lax.lgamma(b)
+                     - jax.lax.lgamma(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+
+        return _wrap(f, value, self.alpha, self.beta, name="beta_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.alpha)
+
+        def f(a, b):
+            return jr.beta(key, a, b, shape)
+
+        return _wrap(f, self.alpha, self.beta, name="beta_sample")
+
+
+class Poisson(Distribution):
+    def __init__(self, rate=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.rate = mnp.array(rate) if not hasattr(rate, "_data") else rate
+
+    def log_prob(self, value):
+        import jax
+        jnp = _jnp()
+
+        def f(v, r):
+            return v * jnp.log(r) - r - jax.lax.lgamma(v + 1)
+
+        return _wrap(f, value, self.rate, name="poisson_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.rate)
+
+        def f(r):
+            return jr.poisson(key, r, shape).astype("float32")
+
+        return _wrap(f, self.rate, name="poisson_sample")
+
+    @property
+    def mean(self):
+        return self.rate
+
+
+class Dirichlet(Distribution):
+    def __init__(self, alpha, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(event_dim=1, **kwargs)
+        self.alpha = mnp.array(alpha) if not hasattr(alpha, "_data") else alpha
+
+    def log_prob(self, value):
+        import jax
+        jnp = _jnp()
+
+        def f(v, a):
+            lnorm = (jnp.sum(jax.lax.lgamma(a), -1)
+                     - jax.lax.lgamma(jnp.sum(a, -1)))
+            return jnp.sum((a - 1) * jnp.log(v), -1) - lnorm
+
+        return _wrap(f, value, self.alpha, name="dirichlet_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        pre = (tuple(size) if isinstance(size, (tuple, list))
+               else ((size,) if size else ()))
+
+        def f(a):
+            return jr.dirichlet(key, a, pre + tuple(a.shape[:-1]))
+
+        return _wrap(f, self.alpha, name="dirichlet_sample")
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, cov=None, scale_tril=None, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(event_dim=1, **kwargs)
+        if (cov is None) == (scale_tril is None):
+            raise MXNetError("give exactly one of cov=/scale_tril=")
+        self.loc = mnp.array(loc) if not hasattr(loc, "_data") else loc
+        self._cov = mnp.array(cov) if cov is not None \
+            and not hasattr(cov, "_data") else cov
+        self._tril = mnp.array(scale_tril) if scale_tril is not None \
+            and not hasattr(scale_tril, "_data") else scale_tril
+
+    @property
+    def scale_tril(self):
+        if self._tril is not None:
+            return self._tril
+        jnp = _jnp()
+        return _wrap(lambda c: jnp.linalg.cholesky(c), self._cov,
+                     name="cholesky")
+
+    def log_prob(self, value):
+        jnp = _jnp()
+        tril = self.scale_tril
+
+        def f(v, loc, L):
+            d = loc.shape[-1]
+            diff = v - loc
+            sol = jnp.linalg.solve(L, diff[..., None])[..., 0]
+            maha = jnp.sum(sol ** 2, -1)
+            logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2,
+                                                      axis2=-1)), -1)
+            return -0.5 * (maha + d * math.log(2 * math.pi) + logdet)
+
+        return _wrap(f, value, self.loc, tril, name="mvn_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        jnp = _jnp()
+        key = _rng.next_key()
+        tril = self.scale_tril
+        pre = (tuple(size) if isinstance(size, (tuple, list))
+               else ((size,) if size else ()))
+
+        def f(loc, L):
+            eps = jr.normal(key, pre + tuple(loc.shape))
+            return loc + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return _wrap(f, self.loc, tril, name="mvn_sample")
+
+    @property
+    def mean(self):
+        return self.loc
+
+
+# -- KL divergence registry (reference ``divergence/``) ----------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise MXNetError(
+            f"no KL registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    from ... import numpy as mnp
+
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - mnp.log(var_ratio))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    jnp = _jnp()
+
+    def f(pp, qp):
+        eps = 1e-7
+        pp = jnp.clip(pp, eps, 1 - eps)
+        qp = jnp.clip(qp, eps, 1 - eps)
+        return (pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+    return _wrap(f, p.prob, q.prob, name="kl_bernoulli")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    import jax
+    jnp = _jnp()
+
+    def f(pl, ql):
+        plog = jax.nn.log_softmax(pl, -1)
+        qlog = jax.nn.log_softmax(ql, -1)
+        return jnp.sum(jnp.exp(plog) * (plog - qlog), -1)
+
+    return _wrap(f, p.logit, q.logit, name="kl_categorical")

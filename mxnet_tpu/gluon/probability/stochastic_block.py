@@ -1,0 +1,50 @@
+"""StochasticBlock (reference:
+``python/mxnet/gluon/probability/block/stochastic_block.py``): a HybridBlock
+that can collect intermediate losses (e.g. KL terms) during forward."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn.basic_layers import HybridSequential
+
+
+class StochasticBlock(HybridBlock):
+    """Adds ``add_loss``/``losses`` to HybridBlock for ELBO-style training."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._losscache = []
+
+    def add_loss(self, loss):
+        self._losscache.append(loss)
+
+    @property
+    def losses(self):
+        return self._losses
+
+    def __call__(self, *args, **kwargs):
+        self._losscache = []
+        out = super().__call__(*args, **kwargs)
+        self._losses = self._losscache
+        return out
+
+
+class StochasticSequential(StochasticBlock):
+    """Sequential whose children's collected losses aggregate."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._layers.append(b)
+            self.register_child(b, str(len(self._layers) - 1))
+
+    def forward(self, x):
+        for layer in self._layers:
+            x = layer(x)
+            if isinstance(layer, StochasticBlock):
+                for l in layer.losses:
+                    self.add_loss(l)
+        return x

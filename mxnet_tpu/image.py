@@ -1,0 +1,115 @@
+"""Legacy ``mx.image`` namespace (reference: ``python/mxnet/image/image.py``
+over ``src/operator/image/``). Functions operate on HWC uint8/float arrays
+or NDArrays; decoding uses PIL (host-side, like the reference's OpenCV)."""
+from __future__ import annotations
+
+import io as _io
+
+import numpy as _onp
+
+from .base import MXNetError
+from .gluon.data.vision.transforms import (CenterCrop, RandomCrop,
+                                           _resize_img, _to_numpy)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):  # pylint: disable=unused-argument
+    """Decode an encoded (jpeg/png) byte string to an HWC NDArray."""
+    from PIL import Image
+
+    from . import numpy as mnp
+
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = _onp.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    return mnp.array(arr)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    from . import numpy as mnp
+
+    return mnp.array(_resize_img(_to_numpy(src), (w, h), interp))
+
+
+def resize_short(src, size, interp=1):
+    """Resize the shorter edge to ``size``, preserving aspect."""
+    from . import numpy as mnp
+
+    return mnp.array(_resize_img(_to_numpy(src), size, interp))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    arr = _to_numpy(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None:
+        arr = _resize_img(arr, size, interp)
+    from . import numpy as mnp
+
+    return mnp.array(arr)
+
+
+def center_crop(src, size, interp=1):
+    arr = _to_numpy(src)
+    w_t, h_t = size if isinstance(size, (tuple, list)) else (size, size)
+    h, w = arr.shape[:2]
+    x0 = (w - w_t) // 2
+    y0 = (h - h_t) // 2
+    from . import numpy as mnp
+
+    return (mnp.array(CenterCrop((w_t, h_t), interp)(arr)),
+            (x0, y0, w_t, h_t))
+
+
+def random_crop(src, size, interp=1):
+    arr = _to_numpy(src)
+    w_t, h_t = size if isinstance(size, (tuple, list)) else (size, size)
+    h, w = arr.shape[:2]
+    if h < h_t or w < w_t:
+        arr = _resize_img(arr, (max(w, w_t), max(h, h_t)), interp)
+        h, w = arr.shape[:2]
+    # crop with the coordinates we return — callers use them for paired
+    # label images / bbox adjustment, so they must describe THIS crop
+    y0 = _onp.random.randint(0, h - h_t + 1)
+    x0 = _onp.random.randint(0, w - w_t + 1)
+    from . import numpy as mnp
+
+    return (mnp.array(arr[y0:y0 + h_t, x0:x0 + w_t]),
+            (x0, y0, w_t, h_t))
+
+
+def color_normalize(src, mean, std=None):
+    from . import numpy as mnp
+
+    arr = _to_numpy(src).astype(_onp.float32)
+    arr = arr - _onp.asarray(mean, dtype=_onp.float32)
+    if std is not None:
+        arr = arr / _onp.asarray(std, dtype=_onp.float32)
+    return mnp.array(arr)
+
+
+def random_flip_left_right(src, p=0.5):
+    arr = _to_numpy(src)
+    if _onp.random.rand() < p:
+        arr = arr[:, ::-1]
+    from . import numpy as mnp
+
+    return mnp.array(arr.copy())
+
+
+class ImageIter:
+    """Legacy augmenting image iterator — delegate to
+    ``mxnet_tpu.io.ImageRecordIter`` (same protocol)."""
+
+    def __new__(cls, batch_size, data_shape, path_imgrec=None, **kwargs):
+        if path_imgrec is None:
+            raise MXNetError("ImageIter requires path_imgrec in this build "
+                             "(use gluon.data.vision datasets otherwise)")
+        from .io import ImageRecordIter
+
+        return ImageRecordIter(path_imgrec, data_shape,
+                               batch_size=batch_size, **kwargs)

@@ -1,0 +1,39 @@
+"""Extension loading (reference: ``python/mxnet/library.py`` →
+``MXLoadLib``, ``src/c_api/c_api.cc:1491`` — dlopens a C++ library built
+against ``include/mxnet/lib_api.h`` to register external ops/passes).
+
+TPU design: external compiled ops target the C ABI of the reference's
+engine, which has no analog here — kernels are XLA/Pallas. The supported
+extension mechanism is a *Python plugin module* exporting
+``register_ops(registry)``; C++ runtime components (e.g. the recordio
+scanner in ``native/``) load via ctypes by their own modules."""
+from __future__ import annotations
+
+import importlib
+import os
+
+from .base import MXNetError, NotSupportedForTPUError
+
+
+def load(path, verbose=True):
+    """Load an extension. ``.py`` modules are imported and their
+    ``register_ops(registry)`` hook called; ``.so`` C++ ABI libraries are
+    rejected with guidance (no engine C ABI in a TPU build)."""
+    if path.endswith(".py"):
+        spec = importlib.util.spec_from_file_location(
+            os.path.splitext(os.path.basename(path))[0], path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        if hasattr(mod, "register_ops"):
+            from .ops import registry
+
+            mod.register_ops(registry)
+            if verbose:
+                print(f"loaded extension ops from {path}")
+        return mod
+    raise NotSupportedForTPUError(
+        "MXLoadLib loads libraries built against the reference engine's C "
+        "ABI (include/mxnet/lib_api.h); this TPU build has no such engine. "
+        "Write extensions as Python modules registering JAX-traceable ops "
+        "(see mxnet_tpu/ops/registry.py), or as native components with "
+        "their own ctypes bindings.")

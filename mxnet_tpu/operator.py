@@ -1,0 +1,153 @@
+"""Python custom operators (reference: ``python/mxnet/operator.py`` —
+``CustomOp``/``CustomOpProp`` + ``register``, trampolined into C++ via
+``MXCustomOpRegister`` and run async on the engine,
+``src/operator/custom/custom.cc``).
+
+TPU design: custom Python ops are host callbacks by nature (the reference
+runs them on a dedicated thread outside the engine). Here ``CustomOp.forward``
+runs eagerly on host NDArrays, with autograd wired through the tape via the
+op's own ``backward`` — the same contract, minus the C++ trampoline.
+Because they run on host, they cannot appear inside a hybridized/jitted
+graph (the reference has the same restriction for subgraph backends).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base for user ops: override ``forward`` and ``backward``."""
+
+    def __init__(self):
+        self._assigned = {}
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Honor grad_req semantics (write/add/null), reference
+        ``operator.py:assign``."""
+        if req in ("null", None):
+            return
+        if req in ("write", "inplace"):
+            dst._set_data_internal(
+                src._data if hasattr(src, "_data") else src)
+        elif req == "add":
+            dst._set_data_internal((dst + src)._data)
+        else:
+            raise MXNetError(f"invalid req {req!r}")
+
+
+class CustomOpProp:
+    """Declares the op's interface (reference ``operator.py:CustomOpProp``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        t = in_type[0]
+        return ([t] * len(self.list_arguments()),
+                [t] * len(self.list_outputs()),
+                [t] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp (reference
+    ``operator.py:register`` → ``MXCustomOpRegister``)."""
+
+    def do_register(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get(reg_name):
+    try:
+        return _REGISTRY[reg_name]
+    except KeyError:
+        raise MXNetError(f"custom op {reg_name!r} is not registered; "
+                         f"have {sorted(_REGISTRY)}") from None
+
+
+def invoke(reg_name, *inputs, **params):
+    """Run a registered custom op eagerly (the ``mx.nd.Custom`` path:
+    ``mx.nd.Custom(x, op_type='my_op')``)."""
+    from . import autograd
+    from .device import current_context
+    from .ndarray.ndarray import NDArray, _slot_of, _tracked
+    from . import numpy as mnp
+
+    prop = get(reg_name)(**params)
+    in_shapes = [list(x.shape) for x in inputs]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types, out_types, _ = prop.infer_type([x.dtype for x in inputs])
+    op = prop.create_operator(current_context(), in_shapes, in_types)
+
+    outs = [mnp.zeros(tuple(s), dtype=t)
+            for s, t in zip(out_shapes, out_types)]
+    is_train = autograd.is_training() or autograd.is_recording()
+    op.forward(is_train=is_train, req=["write"] * len(outs),
+               in_data=list(inputs), out_data=outs, aux=[])
+
+    if autograd.is_recording() and any(
+            isinstance(x, NDArray) and _tracked(x) for x in inputs):
+        inputs_l = list(inputs)
+        outs_l = list(outs)
+
+        def vjp_fn(cts):
+            # single-output nodes receive the bare cotangent array, not a
+            # tuple — never iterate an array's leading axis here
+            if not isinstance(cts, tuple):
+                cts = (cts,)
+            in_grads = [mnp.zeros_like(x) for x in inputs_l]
+            out_grads = [NDArray(c) for c in cts]
+            op.backward(req=["write"] * len(in_grads), out_grad=out_grads,
+                        in_data=inputs_l, out_data=outs_l,
+                        in_grad=in_grads, aux=[])
+            return tuple(g._data for g in in_grads)
+
+        node = autograd.TapeNode(
+            vjp_fn, [_slot_of(x) for x in inputs_l],
+            [(o.shape, o.dtype) for o in outs_l],
+            name=f"Custom({reg_name})")
+        for i, o in enumerate(outs):
+            o._tape = (node, i)
+    return outs[0] if len(outs) == 1 else outs
+
+
+class Custom:
+    """``mx.nd.Custom``-style callable entry."""
+
+    def __call__(self, *inputs, op_type=None, **params):
+        if op_type is None:
+            raise MXNetError("Custom requires op_type=")
+        return invoke(op_type, *inputs, **params)

@@ -863,4 +863,4 @@ for _name in (
     "sequence_reverse", "ctc_loss", "attention", "leaky_relu", "relu",
     "sigmoid", "tanh", "batch_dot", "gather_nd", "scatter_nd", "concat",
 ):
-    _register(_name, globals()[_name])
+    _register(_name, globals()[_name], wrapper=True)

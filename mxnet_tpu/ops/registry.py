@@ -26,17 +26,26 @@ _OPS: Dict[str, "Op"] = {}
 
 
 class Op:
-    """A registered operator backed by a JAX-traceable callable."""
+    """A registered operator.
 
-    __slots__ = ("name", "fn", "ndarray_inputs", "wrap_output", "doc")
+    ``wrapper=False`` (default): ``fn`` is a raw JAX-traceable callable and
+    calls dispatch through :func:`apply`. ``wrapper=True``: ``fn`` is a
+    public NDArray-level function that does its own dispatch (the ops in
+    ``ops/nn.py``) and is invoked directly — routing it through ``apply``
+    again would nest dispatch and leak NDArrays into jax.vjp.
+    """
 
-    def __init__(self, name: str, fn: Callable, ndarray_inputs=None, doc=""):
+    __slots__ = ("name", "fn", "wrapper", "doc")
+
+    def __init__(self, name: str, fn: Callable, wrapper=False, doc=""):
         self.name = name
         self.fn = fn
-        self.ndarray_inputs = ndarray_inputs
+        self.wrapper = wrapper
         self.doc = doc or fn.__doc__
 
     def __call__(self, *args, **kwargs):
+        if self.wrapper:
+            return self.fn(*args, **kwargs)
         return apply(self.fn, args, kwargs, name=self.name)
 
 

@@ -1,0 +1,151 @@
+"""Profiler facade (reference: ``python/mxnet/profiler.py`` over
+``src/profiler/`` — chrome://tracing JSON + aggregate tables).
+
+TPU mapping (SURVEY.md §5): ``jax.profiler`` produces XPlane/perfetto traces
+of XLA execution (the role of the engine's ``ProfileOperator``); this module
+keeps the reference's ``set_config/set_state/dump`` control surface and
+scoped range API (``profiler.scope``/``record_event``), plus a lightweight
+host-side aggregate table for per-call wall times.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import time
+
+from .base import MXNetError
+
+_config = {"filename": "profile.json", "profile_all": False,
+           "aggregate_stats": False}
+_running = False
+_trace_dir = None
+_agg = collections.defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+
+
+def set_config(filename="profile.json", profile_all=False, profile_symbolic=True,
+               profile_imperative=True, profile_memory=True, profile_api=True,
+               aggregate_stats=False, **kwargs):  # pylint: disable=unused-argument
+    """Configure output location (reference ``MXSetProcessProfilerConfig``)."""
+    _config["filename"] = filename
+    _config["profile_all"] = profile_all
+    _config["aggregate_stats"] = aggregate_stats
+
+
+def set_state(state="stop", profile_process="worker"):  # pylint: disable=unused-argument
+    """'run' starts a jax.profiler trace; 'stop' ends + writes it."""
+    global _running, _trace_dir
+    import jax
+
+    if state == "run" and not _running:
+        _trace_dir = os.path.splitext(_config["filename"])[0] + "_trace"
+        jax.profiler.start_trace(_trace_dir)
+        _running = True
+    elif state == "stop" and _running:
+        jax.profiler.stop_trace()
+        _running = False
+    elif state not in ("run", "stop"):
+        raise MXNetError(f"invalid profiler state {state!r}")
+
+
+def state():
+    return "run" if _running else "stop"
+
+
+def dump(finished=True, profile_process="worker"):  # pylint: disable=unused-argument
+    """Stop if needed; report where the trace lives."""
+    if _running:
+        set_state("stop")
+    return _trace_dir
+
+
+def dumps(reset=False):
+    """Aggregate host-side table (reference ``MXAggregateProfileStatsPrint``)."""
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    for name, (cnt, total) in sorted(_agg.items(),
+                                     key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<40}{cnt:>8}{total * 1e3:>12.3f}"
+                     f"{total / max(cnt, 1) * 1e3:>12.3f}")
+    if reset:
+        _agg.clear()
+    return "\n".join(lines)
+
+
+def pause(profile_process="worker"):  # pylint: disable=unused-argument
+    if _running:
+        set_state("stop")
+
+
+def resume(profile_process="worker"):  # pylint: disable=unused-argument
+    set_state("run")
+
+
+@contextlib.contextmanager
+def scope(name="<unk>:"):
+    """Named range: shows up in the jax trace and the aggregate table."""
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    dt = time.perf_counter() - t0
+    _agg[name][0] += 1
+    _agg[name][1] += dt
+
+
+class Task:
+    """API-parity profiler objects (reference ``profiler.Task/Frame/Event``):
+    named ranges you start/stop by hand."""
+
+    def __init__(self, domain=None, name="task"):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def start(self):
+        import jax
+
+        self._t0 = time.perf_counter()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            _agg[self.name][0] += 1
+            _agg[self.name][1] += time.perf_counter() - self._t0
+            self._ann = None
+
+
+Frame = Task
+Event = Task
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, f"{self.name}::{name}")
+
+
+class Counter:
+    """Host-side named counter (reference ``profiler.Counter``)."""
+
+    def __init__(self, domain=None, name="counter", value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+def start_server(*a, **k):  # pragma: no cover
+    raise MXNetError("profiler server mode has no TPU analog; use "
+                     "jax.profiler.start_server for live TensorBoard capture")

@@ -1,0 +1,50 @@
+"""Runtime kernel compilation (reference: ``python/mxnet/rtc.py`` —
+``CudaModule`` compiles user CUDA C via NVRTC, ``src/common/rtc.cc``).
+
+TPU analog: user kernels are **Pallas** Python functions, jit-compiled by
+Mosaic — no source-string C compilation step exists or is needed.
+``PallasModule`` keeps the CudaModule shape (source → get_kernel → launch)
+for scripts ported from the reference."""
+from __future__ import annotations
+
+from .base import MXNetError, NotSupportedForTPUError
+
+
+class CudaModule:  # pragma: no cover - gated
+    def __init__(self, source, options=(), exports=()):
+        raise NotSupportedForTPUError(
+            "CUDA RTC has no TPU analog; write kernels as Pallas functions "
+            "(see /opt/skills guide and mxnet_tpu/ops/pallas/) or use "
+            "rtc.PallasModule")
+
+
+class PallasKernel:
+    """Launchable kernel handle (CudaKernel analog)."""
+
+    def __init__(self, fn, name):
+        self._fn = fn
+        self.name = name
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):  # pylint: disable=unused-argument
+        """Run the kernel on NDArray args (grid/block dims are Mosaic's
+        job — accepted and ignored for API parity)."""
+        from .ndarray.ndarray import NDArray
+        from .ops.registry import apply
+
+        return apply(self._fn, tuple(args), name=f"pallas:{self.name}")
+
+
+class PallasModule:
+    """Register Python Pallas functions as launchable kernels."""
+
+    def __init__(self, **kernels):
+        self._kernels = {name: PallasKernel(fn, name)
+                         for name, fn in kernels.items()}
+
+    def get_kernel(self, name, signature=None):  # pylint: disable=unused-argument
+        try:
+            return self._kernels[name]
+        except KeyError:
+            raise MXNetError(f"no kernel {name!r}; have "
+                             f"{sorted(self._kernels)}") from None

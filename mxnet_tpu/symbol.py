@@ -1,0 +1,277 @@
+"""Legacy symbolic API (reference: ``python/mxnet/symbol/symbol.py``, ~5k
+LoC over the nnvm graph).
+
+In the reference, ``mx.sym`` builds an nnvm graph that CachedOp executes; in
+this build the compiled path is jax tracing, so ``Symbol`` is a *lazy
+expression DAG* over the same registered ops: building is cheap graph
+construction, ``bind``/``eval`` executes by replaying the DAG on NDArrays
+(through the normal dispatch layer, so jit/vjp compose), and
+``simple_bind`` returns an executor whose ``forward`` is the replay. This
+keeps reference scripts (compose → bind → forward) running while the real
+compilation story is ``HybridBlock.hybridize``/``export``.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+from .ops import registry as _registry
+
+# legacy CamelCase op names (mx.sym.FullyConnected ...) → registry names
+_LEGACY_ALIASES = {
+    "FullyConnected": "fully_connected",
+    "Activation": "activation",
+    "Convolution": "convolution",
+    "Deconvolution": "deconvolution",
+    "Pooling": "pooling",
+    "BatchNorm": "batch_norm",
+    "LayerNorm": "layer_norm",
+    "Dropout": "dropout",
+    "Embedding": "embedding",
+    "Concat": "concat",
+    "SoftmaxActivation": "softmax",
+    "LeakyReLU": "leaky_relu",
+    "SequenceMask": "sequence_mask",
+    "SequenceLast": "sequence_last",
+    "SequenceReverse": "sequence_reverse",
+}
+
+
+def _resolve_op(name):
+    """Registry op, mx.np function, or legacy alias — first match wins."""
+    name = _LEGACY_ALIASES.get(name, name)
+    try:
+        return _registry.get(name)
+    except MXNetError:
+        pass
+    from . import numpy as mnp
+
+    fn = getattr(mnp, name, None)
+    if callable(fn):
+        return fn
+    raise MXNetError(f"symbol op {name!r} not found in the op registry or "
+                     f"the numpy namespace")
+
+
+class Symbol:
+    """A lazy expression node."""
+
+    def __init__(self, op, args, kwargs, name=None):
+        self._op = op          # None for variables
+        self._args = args
+        self._kwargs = kwargs or {}
+        self.name = name or (op if isinstance(op, str) else "sym")
+
+    # -- graph introspection ---------------------------------------------
+    def list_arguments(self):
+        out = []
+        seen = set()
+
+        def walk(s):
+            if s._op is None:
+                if s.name not in seen:
+                    seen.add(s.name)
+                    out.append(s.name)
+                return
+            for a in s._args:
+                if isinstance(a, Symbol):
+                    walk(a)
+
+        walk(self)
+        return out
+
+    def list_outputs(self):
+        return [f"{self.name}_output"]
+
+    def infer_shape(self, **shapes):
+        """Infer by tracing with ShapeDtypeStructs (XLA shape inference)."""
+        import jax
+        import numpy as onp
+
+        names = self.list_arguments()
+        missing = [n for n in names if n not in shapes]
+        if missing:
+            raise MXNetError(f"infer_shape missing {missing}")
+
+        def f(*arrs):
+            return self._eval_with({n: a for n, a in zip(names, arrs)},
+                                   raw=True)
+
+        avals = [jax.ShapeDtypeStruct(tuple(shapes[n]), onp.float32)
+                 for n in names]
+        out = jax.eval_shape(f, *avals)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return ([tuple(shapes[n]) for n in names],
+                [tuple(o.shape) for o in outs], [])
+
+    # -- evaluation -------------------------------------------------------
+    def _eval_with(self, bindings, raw=False):
+        from .ndarray.ndarray import NDArray
+
+        memo = {}
+
+        def ev(s):
+            if id(s) in memo:
+                return memo[id(s)]
+            if s._op is None:
+                try:
+                    v = bindings[s.name]
+                except KeyError:
+                    raise MXNetError(
+                        f"unbound variable {s.name!r}") from None
+            else:
+                args = [ev(a) if isinstance(a, Symbol) else a
+                        for a in s._args]
+                op = _resolve_op(s._op)
+                wrapped = [NDArray(a) if not isinstance(a, NDArray)
+                           else a for a in args]
+                v = op(*wrapped, **s._kwargs)
+            memo[id(s)] = v
+            return v
+
+        out = ev(self)
+        if raw:
+            return out._data if isinstance(out, NDArray) else out
+        return out
+
+    def eval(self, ctx=None, **bindings):
+        """Evaluate eagerly with named NDArray bindings."""
+        out = self._eval_with(bindings)
+        return out if isinstance(out, list) else [out]
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write"):
+        return Executor(self, ctx, args or {}, args_grad, grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from . import numpy as mnp
+
+        args = {n: mnp.zeros(tuple(shapes[n]))
+                for n in self.list_arguments() if n in shapes}
+        return Executor(self, ctx, args, None, grad_req)
+
+    # -- serialization ----------------------------------------------------
+    def tojson(self):
+        nodes = []
+        memo = {}  # id(sym) -> node index; shared subexpressions emit once
+
+        def walk(s):
+            if id(s) in memo:
+                return memo[id(s)]
+            entry = {"op": s._op or "null", "name": s.name,
+                     "attrs": {k: str(v) for k, v in s._kwargs.items()}}
+            entry["inputs"] = [walk(a) for a in s._args
+                               if isinstance(a, Symbol)]
+            nodes.append(entry)
+            memo[id(s)] = len(nodes) - 1
+            return memo[id(s)]
+
+        walk(self)
+        return json.dumps({"nodes": nodes, "mxnet_tpu_symbol": 1}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- composition ------------------------------------------------------
+    def _binop(self, other, op):
+        return Symbol(op, (self, other), {}, name=op)
+
+    def __add__(self, other):
+        return self._binop(other, "add")
+
+    def __sub__(self, other):
+        return self._binop(other, "subtract")
+
+    def __mul__(self, other):
+        return self._binop(other, "multiply")
+
+    def __truediv__(self, other):
+        return self._binop(other, "divide")
+
+    def __neg__(self):
+        return Symbol("negative", (self,), {}, name="neg")
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    def __getattr__(self, op_name):
+        if op_name.startswith("_"):
+            raise AttributeError(op_name)
+
+        def method(*args, **kwargs):
+            return Symbol(op_name, (self,) + args, kwargs, name=op_name)
+
+        return method
+
+
+class Executor:
+    """Replay executor (reference ``python/mxnet/executor.py`` — retained
+    in 2.x only as a CachedOp wrapper)."""
+
+    def __init__(self, symbol, ctx, args, args_grad, grad_req):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad or {})
+        self._grad_req = grad_req
+        self.outputs = []
+
+    def forward(self, is_train=False, **kwargs):
+        from . import autograd
+
+        self.arg_dict.update(kwargs)
+        if is_train and self._grad_req != "null":
+            for a in self.arg_dict.values():
+                if a.grad is None:
+                    a.attach_grad(self._grad_req)
+            with autograd.record():
+                out = self._symbol._eval_with(self.arg_dict)
+            self._recorded = out
+        else:
+            out = self._symbol._eval_with(self.arg_dict)
+        self.outputs = out if isinstance(out, list) else [out]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if not self.outputs:
+            raise MXNetError("run forward(is_train=True) before backward")
+        from . import autograd
+
+        autograd.backward(self.outputs, head_grads=out_grads)
+        for name, arr in self.arg_dict.items():
+            if arr.grad is not None:
+                self.grad_dict[name] = arr.grad
+
+
+def var(name, shape=None, dtype=None, **kwargs):  # pylint: disable=unused-argument
+    """Create a placeholder variable (``mx.sym.var``/``mx.sym.Variable``)."""
+    return Symbol(None, (), {}, name=name)
+
+
+Variable = var
+
+
+def load(fname):
+    raise MXNetError(
+        "legacy symbol JSON cannot be re-executed in the TPU build (no nnvm "
+        "runtime); export models with HybridBlock.export (StableHLO) and "
+        "reload with SymbolBlock.imports")
+
+
+def _make_op(op_name):
+    def op_fn(*args, **kwargs):
+        name = kwargs.pop("name", op_name)
+        return Symbol(op_name, args, kwargs, name=name)
+
+    op_fn.__name__ = op_name
+    return op_fn
+
+
+def __getattr__(name):
+    """Expose every registered op as a symbol constructor (mirrors the
+    generated ``mx.sym.*`` namespace)."""
+    try:
+        _resolve_op(name)
+    except MXNetError:
+        raise AttributeError(name) from None
+    return _make_op(name)

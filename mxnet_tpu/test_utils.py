@@ -1,0 +1,228 @@
+"""Test utilities (reference: ``python/mxnet/test_utils.py``, 2,602 LoC —
+the numeric-comparison and gradient-checking helpers the whole reference
+test suite is written against; SURVEY.md §4 keeps (a) numpy-oracle tests,
+(b) finite-difference grad checks, (c) cross-backend consistency).
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .base import MXNetError
+from .device import cpu, current_context, num_tpus, tpu
+
+_DTYPE_TOL = {
+    _onp.dtype(_onp.float16): (1e-2, 1e-2),
+    _onp.dtype(_onp.float32): (1e-4, 1e-5),
+    _onp.dtype(_onp.float64): (1e-7, 1e-9),
+}
+
+
+def default_device():
+    """Accelerator if present else cpu (reference ``default_context``)."""
+    return tpu() if num_tpus() > 0 else cpu()
+
+
+default_context = default_device
+
+
+def _to_numpy(a):
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return _onp.asarray(a)
+
+
+def find_max_violation(a, b, rtol, atol):
+    """Location + value of the worst |a-b| vs tolerance violation."""
+    a, b = _onp.asarray(a, dtype=_onp.float64), _onp.asarray(b, _onp.float64)
+    err = _onp.abs(a - b) - (atol + rtol * _onp.abs(b))
+    idx = _onp.unravel_index(_onp.argmax(err), err.shape)
+    rel = _onp.abs(a - b) / (_onp.abs(b) + atol)
+    return idx, float(rel[idx])
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """dtype-aware allclose with a useful max-violation message
+    (reference ``test_utils.py:assert_almost_equal``)."""
+    a_np, b_np = _to_numpy(a), _to_numpy(b)
+    if rtol is None or atol is None:
+        dt = _onp.result_type(a_np.dtype, b_np.dtype)
+        d_rtol, d_atol = _DTYPE_TOL.get(_onp.dtype(dt), (1e-5, 1e-8))
+        rtol = rtol if rtol is not None else d_rtol
+        atol = atol if atol is not None else d_atol
+    if _onp.allclose(a_np, b_np, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    idx, rel = find_max_violation(a_np, b_np, rtol, atol)
+    raise AssertionError(
+        f"{names[0]} and {names[1]} differ: max rel-error {rel:.3e} at "
+        f"{idx}: {a_np[idx]!r} vs {b_np[idx]!r} (rtol={rtol}, atol={atol})")
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    try:
+        assert_almost_equal(a, b, rtol, atol, equal_nan=equal_nan)
+        return True
+    except AssertionError:
+        return False
+
+
+def same(a, b):
+    return _onp.array_equal(_to_numpy(a), _to_numpy(b))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
+    from . import numpy as mnp
+
+    dtype = dtype or _onp.float32
+    arr = _onp.random.uniform(-1.0, 1.0, shape).astype(dtype)
+    if stype != "default" and density is not None:
+        mask = _onp.random.rand(*shape) < density
+        arr = arr * mask
+    out = mnp.array(arr, ctx=ctx)
+    if stype == "row_sparse":
+        return out.tostype("row_sparse")
+    if stype == "csr":
+        return out.tostype("csr")
+    return out
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(_onp.random.randint(1, dim + 1, size=ndim).tolist())
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_onp.random.randint(1, dim0 + 1),
+            _onp.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_onp.random.randint(1, dim0 + 1),
+            _onp.random.randint(1, dim1 + 1),
+            _onp.random.randint(1, dim2 + 1))
+
+
+def check_numeric_gradient(f, inputs, grads=None, eps=1e-4, rtol=1e-2,
+                           atol=1e-4):
+    """Finite-difference check of ``f``'s gradients.
+
+    ``f`` maps NDArray inputs to a scalar-reducible NDArray output; the
+    analytic gradient comes from autograd, the numeric one from central
+    differences (reference ``check_numeric_gradient`` re-done functionally).
+    """
+    from . import autograd
+    from . import numpy as mnp
+
+    arrays = [mnp.array(_to_numpy(x).astype(_onp.float64)) for x in inputs]
+    for a in arrays:
+        a.attach_grad()
+    with autograd.record():
+        out = f(*arrays)
+        loss = out.sum()
+    loss.backward()
+    analytic = [a.grad.asnumpy() for a in arrays]
+
+    for i, a in enumerate(arrays):
+        base = a.asnumpy()
+        num = _onp.zeros_like(base)
+        it = _onp.nditer(base, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            pert = base.copy()
+            pert[idx] += eps
+            plus = float(f(*(arrays[:i] + [mnp.array(pert)]
+                             + arrays[i + 1:])).sum().asnumpy())
+            pert[idx] -= 2 * eps
+            minus = float(f(*(arrays[:i] + [mnp.array(pert)]
+                              + arrays[i + 1:])).sum().asnumpy())
+            num[idx] = (plus - minus) / (2 * eps)
+            it.iternext()
+        assert_almost_equal(analytic[i], num, rtol=rtol, atol=atol,
+                            names=(f"analytic[{i}]", f"numeric[{i}]"))
+
+
+def check_consistency(f, inputs, ctx_list=None, rtol=None, atol=None):
+    """Run ``f`` on each device and compare outputs — the reference's
+    CPU-vs-GPU ``check_consistency`` as CPU-vs-TPU."""
+    from . import numpy as mnp
+
+    if ctx_list is None:
+        ctx_list = [cpu()] + ([tpu()] if num_tpus() > 0 else [])
+    if len(ctx_list) < 2:
+        ctx_list = ctx_list * 2  # degenerate: still checks determinism
+    outs = []
+    for ctx in ctx_list:
+        arrs = [mnp.array(_to_numpy(x), ctx=ctx) for x in inputs]
+        o = f(*arrs)
+        outs.append(_to_numpy(o))
+    for o in outs[1:]:
+        assert_almost_equal(outs[0], o, rtol=rtol, atol=atol,
+                            names=("ctx0", "ctxN"))
+    return outs
+
+
+def numeric_grad(f, x, eps=1e-4):
+    """Plain central-difference gradient of scalar f at numpy x."""
+    x = _onp.asarray(x, dtype=_onp.float64)
+    g = _onp.zeros_like(x)
+    it = _onp.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        p = x.copy()
+        p[idx] += eps
+        m = x.copy()
+        m[idx] -= eps
+        g[idx] = (f(p) - f(m)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def assert_raises_cudnn_not_satisfied(*a, **k):  # pragma: no cover
+    """cuDNN-specific helper kept for API parity; no-op on TPU."""
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def assert_exception(fn, exception_type, *args, **kwargs):
+    try:
+        fn(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(f"{fn} did not raise {exception_type}")
+
+
+def simple_forward(net, *inputs):
+    from . import numpy as mnp
+
+    return net(*[mnp.array(_to_numpy(x)) for x in inputs]).asnumpy()
+
+
+def environment(*args):
+    """Context manager setting env vars for a block (reference
+    ``test_utils.environment``)."""
+    import contextlib
+    import os
+
+    @contextlib.contextmanager
+    def _env(pairs):
+        saved = {}
+        try:
+            for k, v in pairs:
+                saved[k] = os.environ.get(k)
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = str(v)
+            yield
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    if len(args) == 2:
+        return _env([(args[0], args[1])])
+    return _env(list(args[0].items()))
