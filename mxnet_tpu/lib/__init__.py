@@ -1,0 +1,82 @@
+"""Native runtime components (C++, ctypes-bound).
+
+The reference implements its IO/runtime hot paths in C++ (``src/io/``,
+``src/storage/``...); this package holds the TPU build's equivalents. Each
+component compiles on first use with g++ (no pip/cmake dependency at
+install time) and caches the .so next to the sources; set
+``MXNET_TPU_NO_NATIVE=1`` to force the pure-Python fallbacks.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_build_lock = threading.Lock()
+_libs = {}
+
+
+def _native_dir():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def native_disabled():
+    return os.environ.get("MXNET_TPU_NO_NATIVE", "0") == "1"
+
+
+def load(name, source, extra_flags=()):
+    """Compile (once) and dlopen native/<source> as lib<name>.so."""
+    if native_disabled():
+        return None
+    with _build_lock:
+        if name in _libs:
+            return _libs[name]
+        src = os.path.join(_native_dir(), source)
+        if not os.path.exists(src):
+            _libs[name] = None
+            return None
+        so = os.path.join(_native_dir(), f"lib{name}.so")
+        if not os.path.exists(so) or (os.path.getmtime(so)
+                                      < os.path.getmtime(src)):
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                   "-o", so, src, "-lpthread", *extra_flags]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+            except (subprocess.CalledProcessError, FileNotFoundError,
+                    subprocess.TimeoutExpired):
+                _libs[name] = None
+                return None
+        try:
+            _libs[name] = ctypes.CDLL(so)
+        except OSError:
+            _libs[name] = None
+        return _libs[name]
+
+
+def recordio_lib():
+    """The native recordio scanner/reader (see ``native/recordio.cc``)."""
+    lib = load("recordio", "recordio.cc")
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.rio_build_index.restype = ctypes.c_long
+        lib.rio_build_index.argtypes = [ctypes.c_char_p, i64p, i64p,
+                                        ctypes.c_long]
+        lib.rio_read_at.restype = ctypes.c_long
+        lib.rio_read_at.argtypes = [ctypes.c_char_p, ctypes.c_int64, u8p,
+                                    ctypes.c_long]
+        lib.rio_read_batch.restype = ctypes.c_long
+        lib.rio_read_batch.argtypes = [ctypes.c_char_p, i64p, ctypes.c_long,
+                                       u8p, ctypes.c_long, i64p]
+        lib.rio_prefetch_open.restype = ctypes.c_void_p
+        lib.rio_prefetch_open.argtypes = [ctypes.c_char_p, ctypes.c_long]
+        lib.rio_prefetch_next.restype = ctypes.c_long
+        lib.rio_prefetch_next.argtypes = [ctypes.c_void_p, u8p,
+                                          ctypes.c_long]
+        lib.rio_prefetch_close.restype = None
+        lib.rio_prefetch_close.argtypes = [ctypes.c_void_p]
+        lib._sigs_set = True
+    return lib

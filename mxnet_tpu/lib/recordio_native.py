@@ -1,0 +1,118 @@
+"""Python surface over the native recordio library (``native/recordio.cc``).
+
+Used by :mod:`mxnet_tpu.recordio` for index rebuilds and by sequential
+pipelines for background prefetch; everything degrades to the pure-Python
+implementation when the toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as _onp
+
+from ..base import MXNetError
+from . import recordio_lib
+
+
+def available():
+    return recordio_lib() is not None
+
+
+def build_index(path):
+    """Scan a .rec file, returning (offsets, sizes) int64 arrays."""
+    lib = recordio_lib()
+    if lib is None:
+        raise MXNetError("native recordio unavailable (no g++?)")
+    count = lib.rio_build_index(path.encode(), None, None, 0)
+    if count < 0:
+        raise MXNetError(f"corrupt recordio file {path}")
+    offsets = _onp.zeros(count, dtype=_onp.int64)
+    sizes = _onp.zeros(count, dtype=_onp.int64)
+    got = lib.rio_build_index(
+        path.encode(),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), count)
+    if got != count:
+        raise MXNetError(f"recordio file {path} changed during scan")
+    return offsets, sizes
+
+
+def read_at(path, offset, size_hint=1 << 16):
+    """Read one logical record's payload."""
+    lib = recordio_lib()
+    if lib is None:
+        raise MXNetError("native recordio unavailable")
+    buf = (ctypes.c_uint8 * size_hint)()
+    n = lib.rio_read_at(path.encode(), offset, buf, size_hint)
+    if n < 0:
+        raise MXNetError(f"read failed at {offset} in {path}")
+    if n > size_hint:
+        buf = (ctypes.c_uint8 * n)()
+        n = lib.rio_read_at(path.encode(), offset, buf, n)
+        if n < 0:
+            raise MXNetError(f"read failed at {offset} in {path}")
+    return bytes(bytearray(buf)[:n])
+
+
+def read_batch(path, offsets, sizes=None):
+    """Read many records in one native call; returns list of bytes."""
+    lib = recordio_lib()
+    if lib is None:
+        raise MXNetError("native recordio unavailable")
+    offs = _onp.ascontiguousarray(offsets, dtype=_onp.int64)
+    n_rec = len(offs)
+    cap = int(sizes.sum()) if sizes is not None else (1 << 20) * n_rec
+    buf = (ctypes.c_uint8 * cap)()
+    lengths = _onp.zeros(n_rec, dtype=_onp.int64)
+    used = lib.rio_read_batch(
+        path.encode(), offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n_rec, buf, cap, lengths.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)))
+    if used < 0:
+        # retry with exact sizes from the probe
+        return read_batch(path, offs, sizes=lengths)
+    raw = bytes(bytearray(buf)[:used])
+    out = []
+    pos = 0
+    for l in lengths:
+        out.append(raw[pos:pos + int(l)])
+        pos += int(l)
+    return out
+
+
+class NativePrefetchReader:
+    """Sequential reader with a C++ background thread filling a bounded
+    queue (reference ``src/io/iter_prefetcher.h``)."""
+
+    def __init__(self, path, queue_depth=16, max_record=1 << 24):
+        lib = recordio_lib()
+        if lib is None:
+            raise MXNetError("native recordio unavailable")
+        self._lib = lib
+        self._handle = lib.rio_prefetch_open(path.encode(), queue_depth)
+        if not self._handle:
+            raise MXNetError(f"cannot open {path}")
+        self._buf = (ctypes.c_uint8 * max_record)()
+        self._max = max_record
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n = self._lib.rio_prefetch_next(self._handle, self._buf, self._max)
+        if n == 0:
+            raise StopIteration
+        if n < 0:
+            raise MXNetError("record exceeds max_record buffer")
+        return bytes(bytearray(self._buf)[:n])
+
+    def close(self):
+        if self._handle:
+            self._lib.rio_prefetch_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pylint: disable=broad-except
+            pass

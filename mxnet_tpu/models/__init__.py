@@ -1,9 +1,10 @@
 """Model families beyond the vision zoo (BASELINE.json configs:
 BERT-base, Transformer-base MT, Llama; vision lives in
 ``gluon.model_zoo.vision``)."""
-from . import bert, transformer
+from . import bert, llama, transformer
 from .bert import (BERTClassifier, BERTEncoder, BERTForPretrain, BERTModel,
                    get_bert_model)
+from .llama import LlamaModel, get_llama, llama_sharding_rules
 from .transformer import (MultiHeadAttention, PositionwiseFFN, Transformer,
                           TransformerDecoderCell, TransformerEncoderCell,
                           transformer_sharding_rules)

@@ -1,0 +1,190 @@
+"""Llama-family decoder LM on the Gluon API (BASELINE.json stretch config:
+"Llama-3-8B — stretch the Gluon API to modern LLM").
+
+No reference analog (the reference predates LLMs); built TPU-first:
+- RMSNorm pre-normalization (``gluon.nn.RMSNorm``)
+- rotary position embeddings applied to Q/K
+- grouped-query attention (n_kv_heads < n_heads) through the Pallas flash
+  kernel (causal), or ring attention when a sequence-parallel mesh axis is
+  active
+- SwiGLU feed-forward
+- weight-tied or separate LM head
+
+``llama_sharding_rules`` lays qkv/gate/up column-parallel and o/down
+row-parallel over ``tp`` (Megatron layout), embeddings over ``tp``, and the
+ShardedTrainer shards the batch over ``dp``; long sequences shard over
+``sp`` with ring attention.
+"""
+from __future__ import annotations
+
+import math
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from ..ops import nn as _ops
+
+
+def _rope_tables(t, dim, theta=10000.0):
+    import numpy as onp
+
+    pos = onp.arange(t)[:, None]
+    freqs = 1.0 / (theta ** (onp.arange(0, dim, 2)[None] / dim))
+    ang = pos * freqs  # (T, dim/2)
+    return onp.cos(ang).astype("float32"), onp.sin(ang).astype("float32")
+
+
+def apply_rope(x, cos, sin):
+    """Rotate pairs of channels: x is (B, H, T, D); cos/sin are (T, D/2)."""
+    from .. import numpy as mnp
+
+    d = x.shape[-1]
+    x1 = x[..., 0:d:2]
+    x2 = x[..., 1:d:2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    # re-interleave (..., D/2, 2) -> (..., D)
+    stacked = mnp.stack([r1, r2], axis=-1)
+    return stacked.reshape(*x.shape)
+
+
+class LlamaAttention(HybridBlock):
+    """Causal GQA attention with RoPE."""
+
+    def __init__(self, units, num_heads, num_kv_heads=None, theta=10000.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        num_kv_heads = num_kv_heads or num_heads
+        if units % num_heads or num_heads % num_kv_heads:
+            raise MXNetError(
+                f"units {units} / heads {num_heads} / kv {num_kv_heads} "
+                "must divide")
+        self._units = units
+        self._heads = num_heads
+        self._kv_heads = num_kv_heads
+        self._head_dim = units // num_heads
+        self._theta = theta
+        kv_units = self._head_dim * num_kv_heads
+        self.q_proj = nn.Dense(units, flatten=False, use_bias=False)
+        self.k_proj = nn.Dense(kv_units, flatten=False, use_bias=False)
+        self.v_proj = nn.Dense(kv_units, flatten=False, use_bias=False)
+        self.o_proj = nn.Dense(units, flatten=False, use_bias=False)
+
+    def _heads_split(self, x, n):
+        b, t, _ = x.shape
+        return x.reshape(b, t, n, self._head_dim).transpose(0, 2, 1, 3)
+
+    def forward(self, x):
+        from .. import numpy as mnp
+
+        b, t, _ = x.shape
+        q = self._heads_split(self.q_proj(x), self._heads)
+        k = self._heads_split(self.k_proj(x), self._kv_heads)
+        v = self._heads_split(self.v_proj(x), self._kv_heads)
+        cos_t, sin_t = _rope_tables(t, self._head_dim, self._theta)
+        cos = mnp.array(cos_t)
+        sin = mnp.array(sin_t)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        rep = self._heads // self._kv_heads
+        if rep > 1:  # expand kv heads for the attention kernel
+            k = mnp.repeat(k, rep, axis=1)
+            v = mnp.repeat(v, rep, axis=1)
+        out = _ops.attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, self._units)
+        return self.o_proj(out)
+
+
+class LlamaFFN(HybridBlock):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, units, hidden_size, **kwargs):
+        super().__init__(**kwargs)
+        self.gate_proj = nn.Dense(hidden_size, flatten=False, use_bias=False)
+        self.up_proj = nn.Dense(hidden_size, flatten=False, use_bias=False)
+        self.down_proj = nn.Dense(units, flatten=False, use_bias=False)
+
+    def forward(self, x):
+        g = _ops.activation(self.gate_proj(x), "silu")
+        return self.down_proj(g * self.up_proj(x))
+
+
+class LlamaBlock(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, num_kv_heads,
+                 norm_eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.attn_norm = nn.RMSNorm(epsilon=norm_eps)
+        self.attention = LlamaAttention(units, num_heads, num_kv_heads)
+        self.ffn_norm = nn.RMSNorm(epsilon=norm_eps)
+        self.ffn = LlamaFFN(units, hidden_size)
+
+    def forward(self, x):
+        x = x + self.attention(self.attn_norm(x))
+        x = x + self.ffn(self.ffn_norm(x))
+        return x
+
+
+class LlamaModel(HybridBlock):
+    """Decoder-only LM; forward returns logits (B, T, vocab)."""
+
+    def __init__(self, vocab_size=32000, units=4096, hidden_size=11008,
+                 num_layers=32, num_heads=32, num_kv_heads=None,
+                 norm_eps=1e-5, tie_embeddings=False, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._tie = tie_embeddings
+        self.embed = nn.Embedding(vocab_size, units)
+        self._blocks = []
+        for i in range(num_layers):
+            blk = LlamaBlock(units, hidden_size, num_heads, num_kv_heads,
+                             norm_eps)
+            self._blocks.append(blk)
+            self.register_child(blk, f"layer{i}")
+        self.norm = nn.RMSNorm(epsilon=norm_eps)
+        if not tie_embeddings:
+            self.lm_head = nn.Dense(vocab_size, flatten=False,
+                                    use_bias=False)
+
+    def forward(self, input_ids):
+        x = self.embed(input_ids)
+        for blk in self._blocks:
+            x = blk(x)
+        x = self.norm(x)
+        if self._tie:
+            w = self.embed.weight.data()
+            return _ops.fully_connected(x, w, None, num_hidden=w.shape[0],
+                                        no_bias=True, flatten=False)
+        return self.lm_head(x)
+
+
+# canonical configs (vocab 32000 for llama-2 sizes, 128256 for llama-3-8b)
+_LLAMA_CONFIGS = {
+    "llama_tiny_test": dict(units=64, hidden_size=128, num_layers=2,
+                            num_heads=4, num_kv_heads=2, vocab_size=256),
+    "llama2_7b": dict(units=4096, hidden_size=11008, num_layers=32,
+                      num_heads=32, num_kv_heads=32, vocab_size=32000),
+    "llama3_8b": dict(units=4096, hidden_size=14336, num_layers=32,
+                      num_heads=32, num_kv_heads=8, vocab_size=128256),
+}
+
+
+def get_llama(config="llama3_8b", **overrides):
+    if config not in _LLAMA_CONFIGS:
+        raise MXNetError(f"unknown llama config {config!r}; options "
+                         f"{sorted(_LLAMA_CONFIGS)}")
+    cfg = dict(_LLAMA_CONFIGS[config])
+    cfg.update(overrides)
+    return LlamaModel(**cfg)
+
+
+def llama_sharding_rules():
+    """Megatron tp layout for the Llama param tree."""
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)\.weight", P("tp", None)),
+        (r"(o_proj|down_proj)\.weight", P(None, "tp")),
+        (r"(embed|lm_head)\.weight", P("tp", None)),
+        (r".*(gamma|beta)$", P()),
+    ]

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from ..ops.nn import *  # noqa: F401,F403
 from ..ops import nn as _nn
+from ..ops.control_flow import cond, foreach, while_loop  # noqa: F401
 from ..util import is_np_array, is_np_shape, set_np, reset_np  # noqa: F401
 
 

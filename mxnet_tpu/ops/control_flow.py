@@ -1,0 +1,181 @@
+"""Control-flow operators (reference: ``src/operator/control_flow.cc`` —
+``_foreach``/``_while_loop``/``_cond`` with hand-written backward graphs,
+``control_flow.cc:1096-1262``).
+
+TPU design: these lower directly onto ``lax.scan`` / ``lax.while_loop`` /
+``lax.cond`` — XLA compiles one loop body and differentiates scan/cond
+automatically (while_loop is forward-only, same as the reference's
+restriction that ``_while_loop`` backward requires bounded unrolling).
+Python callables receive/return NDArrays, so user code composes with the
+rest of the framework and records on the autograd tape via the dispatch
+layer.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .registry import apply as _apply
+
+
+def _split_state(out):
+    if isinstance(out, (list, tuple)) and len(out) == 2:
+        return out[0], out[1]
+    raise MXNetError("body must return (outputs, states)")
+
+
+def foreach(body, data, init_states):
+    """Run ``body(slice, states) -> (out, states)`` over axis-0 slices of
+    ``data`` (``npx.foreach`` / reference ``_foreach``): one compiled
+    ``lax.scan``; differentiable.
+    """
+    import jax
+
+    from .. import autograd
+    from ..ndarray.ndarray import NDArray
+
+    multi_data = isinstance(data, (list, tuple))
+    datas = list(data) if multi_data else [data]
+    multi_state = isinstance(init_states, (list, tuple))
+    states = list(init_states) if multi_state else [init_states]
+    n_data = len(datas)
+    out_struct = {}
+
+    if autograd.is_recording():
+        # eager tape recording: unroll in Python so gradients flow to BOTH
+        # the declared inputs and any closure-captured parameters (the
+        # reference's foreach backward covers free variables the same way,
+        # control_flow.cc:1096). The lax.scan path below serves inference
+        # and hybridized traces, where jax differentiates the whole graph.
+        from .. import numpy as mnp
+
+        length = datas[0].shape[0]
+        cur = states if multi_state else states[0]
+        outs_acc = None
+        for t in range(length):
+            sl = [d[t] for d in datas]
+            out, cur = _split_state(body(sl if multi_data else sl[0], cur))
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            if outs_acc is None:
+                outs_acc = [[] for _ in outs]
+                multi_out = isinstance(out, (list, tuple))
+            for acc, o in zip(outs_acc, outs):
+                acc.append(o)
+        stacked = [mnp.stack(acc) for acc in outs_acc]
+        out_val = stacked if multi_out else stacked[0]
+        return out_val, cur
+
+    def f(*arrs):
+        d_arrs = arrs[:n_data]
+        s_arrs = arrs[n_data:]
+
+        def step(carry, xs):
+            s_nd = [NDArray(c) for c in carry]
+            x_nd = [NDArray(x) for x in xs]
+            out, new_s = _split_state(body(
+                x_nd if multi_data else x_nd[0],
+                s_nd if multi_state else s_nd[0]))
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            new_states = (new_s if isinstance(new_s, (list, tuple))
+                          else [new_s])
+            out_struct["n_out"] = len(outs)
+            out_struct["multi_out"] = isinstance(out, (list, tuple))
+            return (tuple(o._data for o in new_states),
+                    tuple(o._data for o in outs))
+
+        carry, ys = jax.lax.scan(step, tuple(s_arrs), tuple(d_arrs))
+        return tuple(ys) + tuple(carry)
+
+    res = _apply(f, tuple(datas + states), name="foreach")
+    n_out = out_struct["n_out"]
+    outs = list(res[:n_out])
+    final_states = list(res[n_out:])
+    out_val = outs if out_struct["multi_out"] else outs[0]
+    state_val = final_states if multi_state else final_states[0]
+    return out_val, state_val
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """``npx.while_loop`` (reference ``_while_loop``): runs
+    ``func(*loop_vars)`` while ``cond(*loop_vars)`` holds.
+
+    Lowered to ``lax.while_loop`` (forward-only, like the reference's op
+    without ``max_iterations`` unrolling). Outputs stacked per-step are not
+    supported — the reference requires ``max_iterations`` for that; here
+    ``func`` returns only the new loop vars.
+    """
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    multi = isinstance(loop_vars, (list, tuple))
+    lvars = list(loop_vars) if multi else [loop_vars]
+
+    def f(*arrs):
+        def c(carry):
+            vals, it = carry
+            nd = [NDArray(v) for v in vals]
+            keep = cond(*nd)
+            k = keep._data if isinstance(keep, NDArray) else keep
+            if max_iterations is not None:
+                import jax.numpy as jnp
+
+                return jnp.logical_and(k.astype(bool),
+                                       it < max_iterations)
+            return k.astype(bool) if hasattr(k, "astype") else k
+
+        def b(carry):
+            vals, it = carry
+            nd = [NDArray(v) for v in vals]
+            new = func(*nd)
+            new = new if isinstance(new, (list, tuple)) else [new]
+            return (tuple(v._data if isinstance(v, NDArray) else v
+                          for v in new), it + 1)
+
+        out, _ = jax.lax.while_loop(c, b, (tuple(arrs), 0))
+        return tuple(out)
+
+    res = _apply(f, tuple(lvars), name="while_loop", record=False)
+    res = list(res) if isinstance(res, (list, tuple)) else [res]
+    return res if multi else res[0]
+
+
+def cond(pred, then_func, else_func, inputs):
+    """``npx.cond`` (reference ``_cond``): branch on a scalar predicate;
+    both branches trace into one ``lax.cond`` (differentiable)."""
+    import jax
+
+    from .. import autograd
+    from ..ndarray.ndarray import NDArray
+
+    multi = isinstance(inputs, (list, tuple))
+    ins = list(inputs) if multi else [inputs]
+
+    if autograd.is_recording():
+        # eager tape recording: the predicate is known, run that branch so
+        # gradients flow to closure-captured parameters too
+        import numpy as onp
+
+        take_then = bool(onp.asarray(
+            pred.asnumpy() if isinstance(pred, NDArray) else pred).item())
+        fn = then_func if take_then else else_func
+        return fn(*ins)
+
+    p = pred._data if isinstance(pred, NDArray) else pred
+
+    def f(pd, *arrs):
+        def run(fn):
+            def inner(xs):
+                nd = [NDArray(x) for x in xs]
+                out = fn(*nd)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                return tuple(o._data if isinstance(o, NDArray) else o
+                             for o in outs)
+
+            return inner
+
+        return jax.lax.cond(pd.astype(bool).reshape(()),
+                            run(then_func), run(else_func), tuple(arrs))
+
+    res = _apply(f, tuple([NDArray(p) if not isinstance(p, NDArray) else p
+                           for p in [pred]] + ins), name="cond")
+    res = list(res) if isinstance(res, (list, tuple)) else [res]
+    return res if len(res) > 1 else res[0]

@@ -147,15 +147,44 @@ class MXIndexedRecordIO(MXRecordIO):
         super().open()
         self.idx = {}
         self.keys = []
-        if self.flag == "r" and os.path.isfile(self.idx_path):
-            with open(self.idx_path) as fin:
-                for line in fin:
-                    parts = line.strip().split("\t")
-                    if len(parts) != 2:
-                        continue
-                    key = self.key_type(parts[0])
-                    self.idx[key] = int(parts[1])
+        if self.flag == "r":
+            if os.path.isfile(self.idx_path):
+                with open(self.idx_path) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        if len(parts) != 2:
+                            continue
+                        key = self.key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+            else:
+                # no .idx: rebuild by scanning the file — native C++ scanner
+                # when available (the reference's C++ path), python otherwise
+                for key, pos in enumerate(self._scan_offsets()):
+                    key = self.key_type(key)
+                    self.idx[key] = pos
                     self.keys.append(key)
+
+    def _scan_offsets(self):
+        try:
+            from .lib import recordio_native
+
+            if recordio_native.available():
+                offsets, _ = recordio_native.build_index(self.uri)
+                return [int(o) for o in offsets]
+        except MXNetError:
+            pass
+        # pure-python scan
+        offsets = []
+        saved = self.record.tell()
+        self.record.seek(0)
+        while True:
+            pos = self.record.tell()
+            if self.read() is None:
+                break
+            offsets.append(pos)
+        self.record.seek(saved)
+        return offsets
 
     def close(self):
         if self.is_open and self.writable:

@@ -1,0 +1,149 @@
+"""Llama model family + control-flow op tests."""
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, npx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.models import get_llama, llama_sharding_rules
+
+
+def _ids(b=2, t=16, vocab=256):
+    return mnp.array(np.random.randint(0, vocab, (b, t)))
+
+
+def test_llama_forward_backward():
+    net = get_llama("llama_tiny_test")
+    net.initialize()
+    ids = _ids()
+    with autograd.record():
+        logits = net(ids)
+        loss = logits.sum()
+    loss.backward()
+    assert logits.shape == (2, 16, 256)
+    g = net.collect_params()["embed.weight"].grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_llama_is_causal():
+    net = get_llama("llama_tiny_test")
+    net.initialize()
+    ids = _ids()
+    with autograd.predict_mode():
+        l1 = net(ids).asnumpy()
+        arr = ids.asnumpy().copy()
+        arr[0, 10] = (arr[0, 10] + 1) % 256
+        l2 = net(mnp.array(arr)).asnumpy()
+    np.testing.assert_allclose(l1[0, :10], l2[0, :10], rtol=2e-4, atol=1e-5)
+    assert np.abs(l1[0, 10:] - l2[0, 10:]).max() > 1e-6
+
+
+def test_llama_gqa_and_tied_variants():
+    net = get_llama("llama_tiny_test", num_kv_heads=1, tie_embeddings=True)
+    net.initialize()
+    out = net(_ids())
+    assert out.shape == (2, 16, 256)
+    # no separate lm_head param when tied
+    assert not any("lm_head" in n for n in net.collect_params())
+
+
+def test_llama_rope_rotation_properties():
+    from mxnet_tpu.models.llama import _rope_tables, apply_rope
+
+    # norm-preserving and position-dependent
+    x = mnp.array(np.random.randn(1, 2, 8, 16).astype("float32"))
+    cos_t, sin_t = _rope_tables(8, 16)
+    out = apply_rope(x, mnp.array(cos_t), mnp.array(sin_t))
+    np.testing.assert_allclose(
+        np.linalg.norm(out.asnumpy(), axis=-1),
+        np.linalg.norm(x.asnumpy(), axis=-1), rtol=1e-5)
+    # position 0 is identity
+    np.testing.assert_allclose(out.asnumpy()[:, :, 0], x.asnumpy()[:, :, 0],
+                               rtol=1e-6)
+    assert np.abs(out.asnumpy()[:, :, 1] - x.asnumpy()[:, :, 1]).max() > 1e-4
+
+
+def test_llama_sharded_train_step():
+    from mxnet_tpu.parallel import ShardedTrainer, ShardingRules, make_mesh
+
+    net = get_llama("llama_tiny_test")
+    net.initialize()
+    with autograd.predict_mode():
+        net(_ids(1, 16))  # materialize deferred shapes before sharding
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    tr = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "adamw",
+                        {"learning_rate": 1e-3}, mesh=mesh,
+                        rules=ShardingRules(llama_sharding_rules(),
+                                            default_axis=None))
+    X = np.random.randint(0, 256, (8, 16))
+    Y = np.random.randint(0, 256, (8, 16))
+    loss = float(tr.step(X, Y).asnumpy())
+    assert np.isfinite(loss)
+    p = tr.params["layer0.attention.q_proj.weight"]
+    assert p.sharding.spec == P("tp", None)
+    assert tr.params["layer0.attention.o_proj.weight"].sharding.spec \
+        == P(None, "tp")
+
+
+def test_llama_config_registry():
+    with pytest.raises(mx.MXNetError):
+        get_llama("llama_99t")
+
+
+# -- control flow ---------------------------------------------------------
+
+def test_foreach_scan_and_grad():
+    data = mnp.array(np.arange(12, dtype="float32").reshape(4, 3))
+    init = mnp.array(np.zeros(3, "float32"))
+    outs, final = npx.foreach(lambda x, s: (x + s, x + s), data, init)
+    np.testing.assert_allclose(final.asnumpy(), data.asnumpy().sum(0))
+    np.testing.assert_allclose(outs.asnumpy(),
+                               np.cumsum(data.asnumpy(), 0))
+    w = mnp.array([2.0])
+    w.attach_grad()
+    with autograd.record():
+        _, f = npx.foreach(lambda x, s: (x * w, s + x * w), data, init)
+        f.sum().backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), [data.asnumpy().sum()])
+
+
+def test_while_loop():
+    out = npx.while_loop(lambda x: x < 100, lambda x: x * 2,
+                         mnp.array(1.0))
+    assert float(out.asnumpy()) == 128.0
+    out = npx.while_loop(lambda x: x < 100, lambda x: x * 2,
+                         mnp.array(1.0), max_iterations=3)
+    assert float(out.asnumpy()) == 8.0
+
+
+def test_cond_branches_and_grad():
+    x = mnp.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = npx.cond(mnp.array(True), lambda v: v * 2, lambda v: v * 10, x)
+        y.sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+    z = npx.cond(mnp.array(False), lambda v: v * 2, lambda v: v * 10, x)
+    np.testing.assert_allclose(z.asnumpy(), [30.0])
+
+
+def test_foreach_inside_hybridize():
+    class ScanNet(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = gluon.nn.Dense(3, flatten=False, in_units=3)
+
+        def forward(self, seq):
+            _, fin = npx.foreach(
+                lambda x, s: (self.dense(x) + s, s + x), seq,
+                mnp.zeros((2, 3)))
+            return fin
+
+    net = ScanNet()
+    net.initialize()
+    seq = mnp.array(np.random.randn(5, 2, 3).astype("float32"))
+    eager = net(seq).asnumpy()
+    net.hybridize()
+    hybrid = net(seq).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
