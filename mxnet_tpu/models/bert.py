@@ -12,7 +12,7 @@ from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..ops import nn as _ops
 from .transformer import (MultiHeadAttention, PositionalEmbedding,
-                          TransformerEncoderCell, valid_length_mask)
+                          TransformerEncoderCell)
 
 
 class BERTEncoder(HybridBlock):
@@ -28,9 +28,9 @@ class BERTEncoder(HybridBlock):
             self._layers.append(cell)
             self.register_child(cell, f"layer{i}")
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, valid_length=None):
         for layer in self._layers:
-            x = layer(x, mask=mask)
+            x = layer(x, mask=mask, valid_length=valid_length)
         return x
 
 
@@ -61,11 +61,9 @@ class BERTModel(HybridBlock):
         x = x + self.token_type_embed(token_types)
         x = self.pos_embed(x)
         x = self.embed_dropout(self.embed_layer_norm(x))
-        mask = None
-        if valid_length is not None:
-            t = inputs.shape[1]
-            mask = valid_length_mask(valid_length, t, t)
-        seq = self.encoder(x, mask=mask)
+        # (B,) lengths go straight to the attention op: the flash kernel
+        # masks in-kernel instead of materializing a (T, T) mask
+        seq = self.encoder(x, valid_length=valid_length)
         pooled = self.pooler(seq[:, 0])
         return seq, pooled
 

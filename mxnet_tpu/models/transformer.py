@@ -46,13 +46,15 @@ class MultiHeadAttention(HybridBlock):
         x = x.reshape(b, t, self._num_heads, -1)
         return x.transpose(0, 2, 1, 3)  # (B, H, T, D)
 
-    def forward(self, query, key=None, value=None, mask=None):
+    def forward(self, query, key=None, value=None, mask=None,
+                valid_length=None):
         key = query if key is None else key
         value = key if value is None else value
         q = self._split(self.query_proj(query))
         k = self._split(self.key_proj(key))
         v = self._split(self.value_proj(value))
-        out = _ops.attention(q, k, v, mask=mask, causal=self._causal)
+        out = _ops.attention(q, k, v, mask=mask, causal=self._causal,
+                             valid_length=valid_length)
         b, h, t, d = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, t, h * d)
         out = self.out_proj(out)
@@ -94,15 +96,16 @@ class TransformerEncoderCell(HybridBlock):
         self.layer_norm_att = nn.LayerNorm(epsilon=layer_norm_eps)
         self.layer_norm_ffn = nn.LayerNorm(epsilon=layer_norm_eps)
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, valid_length=None):
         # sublayer dropout lives inside MultiHeadAttention / PositionwiseFFN
         # (after their output projections) — exactly once per sublayer
         if self._pre_norm:
-            h = self.attention(self.layer_norm_att(x), mask=mask)
+            h = self.attention(self.layer_norm_att(x), mask=mask,
+                               valid_length=valid_length)
             x = x + h
             x = x + self.ffn(self.layer_norm_ffn(x))
             return x
-        h = self.attention(x, mask=mask)
+        h = self.attention(x, mask=mask, valid_length=valid_length)
         x = self.layer_norm_att(x + h)
         x = self.layer_norm_ffn(x + self.ffn(x))
         return x
@@ -124,10 +127,11 @@ class TransformerDecoderCell(HybridBlock):
         self.layer_norm_cross = nn.LayerNorm(epsilon=layer_norm_eps)
         self.layer_norm_ffn = nn.LayerNorm(epsilon=layer_norm_eps)
 
-    def forward(self, x, mem, mem_mask=None):
+    def forward(self, x, mem, mem_mask=None, mem_valid_length=None):
         x = self.layer_norm_self(x + self.self_attention(x))
         x = self.layer_norm_cross(
-            x + self.cross_attention(x, mem, mem, mask=mem_mask))
+            x + self.cross_attention(x, mem, mem, mask=mem_mask,
+                                     valid_length=mem_valid_length))
         x = self.layer_norm_ffn(x + self.ffn(x))
         return x
 
@@ -168,15 +172,6 @@ class PositionalEmbedding(HybridBlock):
         return x + mnp.array(_sinusoid_table(t, self._units))
 
 
-def valid_length_mask(valid_length, tq, tk):
-    """(B,) valid lengths -> (B, 1, Tq, Tk) boolean attention mask."""
-    from .. import numpy as mnp
-
-    ar = mnp.arange(tk).reshape(1, 1, 1, tk)
-    vl = valid_length.reshape(-1, 1, 1, 1)
-    return (ar < vl).broadcast_to((valid_length.shape[0], 1, tq, tk))
-
-
 class Transformer(HybridBlock):
     """Encoder-decoder MT transformer (base config by default —
     the "Transformer-base MT" target in BASELINE.json)."""
@@ -207,25 +202,19 @@ class Transformer(HybridBlock):
         self._scale = math.sqrt(units)
 
     def encode(self, src, src_valid_length=None):
+        # valid_length flows to the attention op as (B,) lengths: the flash
+        # kernel masks in-kernel, never materializing a (T, T) mask
         x = self.src_embed(src) * self._scale
         x = self.pos_embed(x)
-        mask = None
-        if src_valid_length is not None:
-            t = src.shape[1]
-            mask = valid_length_mask(src_valid_length, t, t)
         for layer in self.enc_layers:
-            x = layer(x, mask=mask)
+            x = layer(x, valid_length=src_valid_length)
         return x
 
     def decode(self, tgt, mem, src_valid_length=None):
         y = self.tgt_embed(tgt) * self._scale
         y = self.pos_embed(y)
-        mem_mask = None
-        if src_valid_length is not None:
-            mem_mask = valid_length_mask(src_valid_length, tgt.shape[1],
-                                         mem.shape[1])
         for cell in self._dec_layers:
-            y = cell(y, mem, mem_mask=mem_mask)
+            y = cell(y, mem, mem_valid_length=src_valid_length)
         return self.proj(y)
 
     def forward(self, src, tgt, src_valid_length=None):

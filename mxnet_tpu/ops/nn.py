@@ -755,22 +755,33 @@ def smooth_l1(data, scalar=1.0):
 
 
 def attention(query, key, value, mask=None, causal=False, scale=None,
-              use_flash=True):
+              use_flash=True, valid_length=None):
     """Scaled dot-product attention over (B, H, T, D) tensors.
 
     Replaces the reference's fused matmul helpers
     (``src/operator/contrib/transformer.cc`` interleaved_matmul_selfatt_*)
     with a real attention op: Pallas flash-attention kernel on TPU,
-    XLA-fused reference path elsewhere. See
+    XLA-fused reference path elsewhere. ``valid_length`` (B,) key lengths
+    are masked inside the flash kernel (no dense mask materialized); a
+    dense ``mask`` forces the XLA path. See
     ``mxnet_tpu/ops/pallas/flash_attention.py``.
     """
     from .pallas import flash_attention as fa
 
-    def f(q, k, v, *m):
-        return fa.attention(q, k, v, m[0] if m else None, causal=causal,
-                            scale=scale, use_flash=use_flash)
+    n_extra = (mask is not None, valid_length is not None)
 
-    args = (query, key, value) if mask is None else (query, key, value, mask)
+    def f(q, k, v, *extra):
+        it = iter(extra)
+        m = next(it) if n_extra[0] else None
+        vl = next(it) if n_extra[1] else None
+        return fa.attention(q, k, v, m, causal=causal, scale=scale,
+                            use_flash=use_flash, valid_length=vl)
+
+    args = (query, key, value)
+    if mask is not None:
+        args = args + (mask,)
+    if valid_length is not None:
+        args = args + (valid_length,)
     return _apply(f, args, name="attention")
 
 
