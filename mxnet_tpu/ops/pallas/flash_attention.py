@@ -434,10 +434,20 @@ def _flash_bwd_dkv(q, k, v, g, lse, delta, kvalid, causal, causal_off, scale, bq
     return dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
 
 
-def _supports_pallas(q, k):
+def _platform_of(x):
+    """Platform the op will execute on: a concrete array's own device (an
+    eager CPU array next to an idle TPU chip must NOT pick the TPU kernel);
+    tracers have no devices — they lower for the default backend."""
     import jax
 
-    if not (_INTERPRET or jax.default_backend() in ("tpu", "axon")):
+    try:
+        return next(iter(x.devices())).platform
+    except Exception:
+        return jax.default_backend()
+
+
+def _supports_pallas(q, k):
+    if not (_INTERPRET or _platform_of(q) in ("tpu", "axon")):
         return False
     if q.ndim != 4 or q.shape[-1] > 256:
         return False

@@ -174,7 +174,7 @@ class ShardedTrainer:
 
     def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
                  mesh=None, rules: Optional[ShardingRules] = None,
-                 batch_spec=None):
+                 batch_spec=None, dtype=None):
         import jax
         from jax.sharding import NamedSharding
 
@@ -192,6 +192,10 @@ class ShardedTrainer:
         if self.mesh is None:
             raise MXNetError("ShardedTrainer needs a device mesh")
         self.rules = rules or ShardingRules()
+        # AMP policy (amp.py bf16-first): compute casts float params+inputs
+        # to `dtype` inside the step; master weights, grads and the update
+        # stay fp32 — the multi-precision layout of optimizer_op-inl.h
+        self._dtype = dtype
         P = _P()
         if batch_spec is None:
             batch_spec = P("dp") if "dp" in self.mesh.axis_names else P()
@@ -212,6 +216,9 @@ class ShardedTrainer:
         self.params = self.rules.shard(params, self.mesh)
         self._opt_states = self._init_opt_states()
         self._step_jit = None
+        self._compiled = {}   # batch-signature -> AOT executable
+        self._last_compiled = None
+        self._step_flops = None
         self._step_count = 0
         self._key = jax.random.PRNGKey(0)
 
@@ -249,38 +256,44 @@ class ShardedTrainer:
         state_names = self._state_names
         has_state = bool(state_names)
 
+        amp_dtype = self._dtype
+
+        def cast_amp(x):
+            if amp_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(amp_dtype)
+            return x
+
         def loss_of(train_params, state_params, batch, labels, key):
             params = dict(train_params)
             params.update(state_params)
-            r = apply_fn(params, batch, rng_key=key)
+            if amp_dtype is not None:
+                # cast-for-compute: autodiff through the cast hands back
+                # fp32 grads against the fp32 master params
+                params = {n: cast_amp(a) for n, a in params.items()}
+                batch = jax.tree_util.tree_map(cast_amp, batch)
+            batch = batch if isinstance(batch, tuple) else (batch,)
+            r = apply_fn(params, *batch, rng_key=key)
             if has_state:
                 out, new_state = r
             else:
                 out, new_state = r, {}
             from ..ndarray.ndarray import NDArray
 
-            out_nd = NDArray(out) if not isinstance(out, NDArray) else out
-            lbl_nd = NDArray(labels)
+            # outputs may be a pytree (e.g. BERT's (mlm_scores, nsp_scores));
+            # hand the loss_fn NDArray leaves with the structure intact
+            out_nd = jax.tree_util.tree_map(
+                lambda x: x if isinstance(x, NDArray) else NDArray(x), out,
+                is_leaf=lambda x: isinstance(x, NDArray))
+            lbl_nd = jax.tree_util.tree_map(NDArray, labels)
             loss = loss_fn(out_nd, lbl_nd)
             ldata = loss._data if isinstance(loss, NDArray) else loss
-            return jnp.mean(ldata), new_state
-
-        def step(train_params, state_params, opt_states, batch, labels, key,
-                 lrs, wds, t):
-            (loss, new_state), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_params, state_params, batch,
-                                       labels, key)
-            new_train = {}
-            new_opt = {}
-            for i, n in enumerate(train_names):
-                g = opt._prep_grad(grads[n].astype(train_params[n].dtype))
-                p_new, s_new = opt._update_raw(train_params[n], g,
-                                               opt_states[n], lrs[i], wds[i],
-                                               t)
-                new_train[n] = p_new
-                new_opt[n] = tuple(s_new) if isinstance(s_new, (list, tuple)) \
-                    else (s_new,)
-            return new_train, new_state, new_opt, loss
+            if amp_dtype is not None:
+                # mutable state (BN running stats) flows back at the master
+                # dtype so the AOT-compiled step signature stays stable
+                new_state = {
+                    n: v.astype(state_params[n].dtype)
+                    for n, v in new_state.items()}
+            return jnp.mean(ldata.astype(jnp.float32)), new_state
 
         from jax.sharding import NamedSharding
 
@@ -293,12 +306,39 @@ class ShardedTrainer:
         }
         train_shard = {n: p_shard[n] for n in train_names}
         state_shard = {n: p_shard[n] for n in state_names}
+
+        def step(train_params, state_params, opt_states, batch, labels, key,
+                 lrs, wds, t):
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_params, state_params, batch,
+                                       labels, key)
+            new_train = {}
+            new_opt = {}
+            for i, n in enumerate(train_names):
+                g = grads[n].astype(train_params[n].dtype)
+                # ZeRO discipline: pin the grad to the PARAM's sharding
+                # before the update. For fsdp-sharded params this makes the
+                # SPMD partitioner emit a reduce-scatter (each device gets
+                # only its shard's summed grad) and run the optimizer on
+                # 1/N of the state — gather-for-compute (XLA all-gathers
+                # the weight at its use sites) / scatter-for-update.
+                g = jax.lax.with_sharding_constraint(g, train_shard[n])
+                g = opt._prep_grad(g)
+                p_new, s_new = opt._update_raw(train_params[n], g,
+                                               opt_states[n], lrs[i], wds[i],
+                                               t)
+                new_train[n] = p_new
+                new_opt[n] = tuple(s_new) if isinstance(s_new, (list, tuple)) \
+                    else (s_new,)
+            return new_train, new_state, new_opt, loss
         opt_shard = {
             n: tuple(
                 NamedSharding(mesh, s.sharding.spec)
                 for s in self._opt_states[n])
             for n in train_names
         }
+        # a single NamedSharding acts as a pytree prefix: it applies to every
+        # leaf of the batch/labels trees (tuple inputs shard dim 0 over dp)
         batch_shard = NamedSharding(mesh, self.batch_spec)
         repl = NamedSharding(mesh, _P()())
         self._step_jit = jax.jit(
@@ -309,17 +349,48 @@ class ShardedTrainer:
             donate_argnums=(0, 1, 2),
         )
 
+    @property
+    def step_flops(self):
+        """XLA cost-analysis FLOPs of one compiled step (None before the
+        first step). The MFU numerator bench.py divides by chip peak."""
+        return self._step_flops
+
+    @property
+    def step_hlo(self):
+        """Compiled HLO text of the step (None before the first step);
+        tests assert collective choice (all-gather/reduce-scatter) on it."""
+        return self._last_compiled.as_text() \
+            if self._last_compiled is not None else None
+
+    def device_memory_bytes(self):
+        """Per-device bytes held by params + optimizer state (shard 0):
+        the ZeRO memory claim tests assert this drops ~N× under fsdp."""
+        total = 0
+        for arr in list(self.params.values()) + [
+                s for st in self._opt_states.values() for s in st]:
+            total += arr.addressable_shards[0].data.nbytes
+        return total
+
     def step(self, data, labels):
         """Run one SPMD training step; returns the scalar loss as an
-        NDArray (async — reading/printing it syncs, dispatch does not)."""
+        NDArray (async — reading/printing it syncs, dispatch does not).
+
+        ``data`` may be a single array or a tuple of arrays (multi-input
+        models, e.g. (tokens, segments) for BERT)."""
         import jax
 
         from ..ndarray.ndarray import NDArray
 
         if self._step_jit is None:
             self._build_step()
-        d = data._data if isinstance(data, NDArray) else data
-        l = labels._data if isinstance(labels, NDArray) else labels
+
+        def raw(x):
+            return x._data if isinstance(x, NDArray) else x
+
+        d = tuple(raw(x) for x in data) if isinstance(data, (list, tuple)) \
+            else raw(data)
+        l = jax.tree_util.tree_map(raw, labels,
+                                   is_leaf=lambda x: isinstance(x, NDArray))
         self._step_count += 1
         t = self._step_count
         n_train = len(self._train_names)
@@ -330,8 +401,24 @@ class ShardedTrainer:
         self._key, sub = jax.random.split(self._key)
         train = {n: self.params[n] for n in self._train_names}
         state = {n: self.params[n] for n in self._state_names}
-        new_train, new_state, new_opt, loss = self._step_jit(
-            train, state, self._opt_states, d, l, sub, lrs, wds, t)
+        args = (train, state, self._opt_states, d, l, sub, lrs, wds, t)
+        # AOT-compile once per batch signature (a partial final batch gets
+        # its own executable): the compiled callable skips per-call
+        # signature matching (cheaper dispatch) and exposes XLA's cost
+        # analysis, the exact-FLOPs source for MFU reporting
+        sig = tuple(
+            (x.shape, str(x.dtype))
+            for x in jax.tree_util.tree_leaves((d, l)))
+        compiled = self._compiled.get(sig)
+        if compiled is None:
+            compiled = self._step_jit.lower(*args).compile()
+            self._compiled[sig] = compiled
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            self._step_flops = (ca or {}).get("flops")
+        self._last_compiled = compiled
+        new_train, new_state, new_opt, loss = compiled(*args)
         self.params.update(new_train)
         self.params.update(new_state)
         self._opt_states = new_opt

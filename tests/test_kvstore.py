@@ -95,3 +95,41 @@ def test_trainer_with_dist_tpu_sync():
     w0 = net.weight.data().asnumpy().copy()
     tr.step(8)
     assert onp.abs(net.weight.data().asnumpy() - w0).sum() > 0
+
+
+def test_dist_tpu_sync_compiled_collective():
+    """Per-device lists covering the mesh take the COMPILED collective path:
+    one jitted XLA all-reduce with replicated out-sharding (the role of
+    `kvstore_dist.h:578` PushPullDefault), not an eager gather."""
+    import jax
+
+    kv = mx.kv.create("dist_tpu_sync")
+    devs = list(kv._mesh.devices.flatten())
+    n = len(devs)
+    assert n == 8  # virtual CPU mesh from conftest
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    vals = [NDArray(jax.device_put(onp.full((4, 3), i + 1.0, "float32"), d))
+            for i, d in enumerate(devs)]
+    outs = [np.zeros((4, 3)) for _ in range(n)]
+    kv.pushpull("g", vals, out=outs)
+    expect = sum(range(1, n + 1))
+    for o in outs:
+        onp.testing.assert_allclose(o.asnumpy(), expect)
+    assert kv.last_path == "collective"
+    assert "all-reduce" in kv.last_hlo
+    # results stay on their source devices (no gather-to-one-device)
+    for v, o in zip(vals, outs):
+        assert v._data.devices() == o._data.devices()
+
+
+def test_dist_tpu_sync_eager_fallback_same_device():
+    """Same-device lists (no per-device layout) fall back to the eager path
+    with identical numerics."""
+    kv = mx.kv.create("dist_tpu_sync")
+    vals = [np.ones((8,)) * (i + 1) for i in range(4)]
+    outs = [np.zeros((8,)) for _ in range(4)]
+    kv.pushpull("g", vals, out=outs)
+    for o in outs:
+        onp.testing.assert_allclose(o.asnumpy(), 10.0)
+    assert kv.last_path == "eager"

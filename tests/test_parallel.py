@@ -113,3 +113,57 @@ def test_ring_attention_rejects_bad_axis():
     x = jnp.zeros((1, 1, 8, 4))
     with pytest.raises(mx.MXNetError):
         ring_attention(x, x, x, mesh=mesh, axis="sp")
+
+
+def test_fsdp_zero_shards_memory_and_matches_dp():
+    """ZeRO/fsdp (SURVEY §2.3 'design fresh'): params + optimizer state
+    sharded over the data axis, XLA all-gathers weights at their use sites
+    and reduce-scatters grads into the sharded update. Asserts (a) the
+    collectives are really in the compiled step, (b) per-device param+state
+    memory drops ~N×, (c) the loss trajectory matches pure dp."""
+    def make_net():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(256, activation="relu", use_bias=False),
+                gluon.nn.Dense(256, activation="relu", use_bias=False),
+                gluon.nn.Dense(8, use_bias=False))
+        net.initialize()
+        with autograd.predict_mode():
+            net(mx.np.array(np.zeros((2, 64), dtype="float32")))
+        return net
+
+    np.random.seed(2)
+    net_dp = make_net()
+    net_fs = make_net()
+    pd, pf = net_dp.collect_params(), net_fs.collect_params()
+    for n in pd:
+        pf[n].set_data(pd[n].data())
+    X = np.random.randn(16, 64).astype("float32")
+    Y = np.random.randint(0, 8, (16,))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": 8})
+
+    tr_dp = ShardedTrainer(net_dp, loss_fn, "adam", {"learning_rate": 1e-2},
+                           mesh=mesh, rules=ShardingRules(default_axis=None))
+    # fsdp = the default rule sharding every param's largest dim over dp
+    tr_fs = ShardedTrainer(net_fs, loss_fn, "adam", {"learning_rate": 1e-2},
+                           mesh=mesh, rules=ShardingRules(default_axis="dp"))
+
+    losses_dp = [float(tr_dp.step(X, Y).asnumpy()) for _ in range(5)]
+    losses_fs = [float(tr_fs.step(X, Y).asnumpy()) for _ in range(5)]
+    np.testing.assert_allclose(losses_dp, losses_fs, rtol=1e-4, atol=1e-5)
+
+    # (a) gather-for-compute / scatter-for-update in the compiled program.
+    # The CPU backend lowers reduce-scatter as all-reduce + dynamic-slice
+    # (same sharded-grad semantics); TPU emits the fused reduce-scatter.
+    hlo = tr_fs.step_hlo
+    assert "all-gather" in hlo
+    assert "reduce-scatter" in hlo or (
+        "all-reduce" in hlo and "dynamic-slice" in hlo)
+    # (b) params + adam (m, v) state per device: dp holds full copies,
+    # fsdp holds 1/8 shards (all dims here divide 8)
+    mem_dp = tr_dp.device_memory_bytes()
+    mem_fs = tr_fs.device_memory_bytes()
+    assert mem_fs < mem_dp / 6
+    # (c) a param really is sharded
+    w = tr_fs.params["0.weight"]
+    assert w.addressable_shards[0].data.shape[0] * 8 == w.shape[0]
