@@ -259,6 +259,57 @@ def bench_bert_train():
     })
 
 
+def bench_lenet_eager():
+    """Imperative (non-hybridized) LeNet training — the reference's eager
+    LeNet/MNIST config. Exercises per-op dispatch + the eager jit cache
+    (SURVEY §7 hard part 2); reports the cached rate and the uncached rate."""
+    import numpy as onp
+
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.ops import registry
+
+    BATCH = 64
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(6, 5, activation="relu"), gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(16, 5, activation="relu"), gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(), gluon.nn.Dense(120, activation="relu"),
+            gluon.nn.Dense(84, activation="relu"), gluon.nn.Dense(10))
+    net.initialize()
+    x = mnp.array(onp.random.randn(BATCH, 1, 28, 28).astype("float32"))
+    y = mnp.array(onp.random.randint(0, 10, (BATCH,)))
+    with autograd.predict_mode():
+        net(x)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+
+    def step():
+        with autograd.record():
+            l = loss_fn(net(x), y).mean()
+        l.backward()
+        tr.step(1)
+        return l
+
+    rates = {}
+    prev_enabled = registry._eager_jit_enabled
+    try:
+        for flag in (False, True):
+            registry.set_eager_jit(flag)
+            registry._EAGER_JIT_CACHE.clear()
+            float(step().asnumpy())  # drain
+            dt = _timed_diff(step, lambda l: float(l.asnumpy()), 2, 8)
+            rates[flag] = BATCH / dt
+    finally:
+        registry.set_eager_jit(prev_enabled)
+    return _emit({
+        "metric": "lenet_eager_train_bs64",
+        "value": round(rates[True], 2),
+        "unit": "img/s",
+        "vs_baseline": None,
+        "uncached_img_s": round(rates[False], 2),
+    })
+
+
 def bench_bandwidth():
     """KVStore push/pull bandwidth (tools/bandwidth parity, perf.md:263)."""
     from mxnet_tpu.kvstore.dist_tpu import measure_pushpull_bandwidth
@@ -277,6 +328,7 @@ def main():
     failures = {}
     for name, fn in [("infer", bench_resnet_infer),
                      ("bandwidth", bench_bandwidth),
+                     ("lenet_eager", bench_lenet_eager),
                      ("bert", bench_bert_train),
                      ("resnet_train_bf16",
                       lambda: bench_resnet_train("bfloat16"))]:

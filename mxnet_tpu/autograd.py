@@ -132,17 +132,28 @@ class TapeNode:
 
     ``in_slots[i]`` is either a :class:`Leaf`, a ``(TapeNode, out_idx)``
     pair, or ``None`` (constant / untracked input).
+
+    ``fwd_fn``/``in_arrays`` (optional) let ``create_graph=True`` rebuild
+    the vjp *differentiably*: the backward walk re-linearizes ``fwd_fn`` at
+    the saved inputs as a recorded op, so grad-of-grad sees the full input
+    dependence (the reference builds the grad graph symbolically for the
+    same reason, ``src/nnvm/gradient.cc``).
     """
 
-    __slots__ = ("vjp_fn", "in_slots", "out_avals", "seq", "name", "__weakref__")
+    __slots__ = ("vjp_fn", "in_slots", "out_avals", "seq", "name",
+                 "fwd_fn", "in_arrays", "out_container", "__weakref__")
 
-    def __init__(self, vjp_fn, in_slots, out_avals, name=""):
+    def __init__(self, vjp_fn, in_slots, out_avals, name="",
+                 fwd_fn=None, in_arrays=None):
         self.vjp_fn = vjp_fn
         self.in_slots = in_slots
         self.out_avals = out_avals  # list of (shape, dtype) per output leaf
         _state.seq += 1
         self.seq = _state.seq
         self.name = name
+        self.fwd_fn = fwd_fn
+        self.in_arrays = in_arrays
+        self.out_container = False  # fwd returns a tuple even when len==1
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -228,11 +239,53 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # py
             ga._set_data_internal(jnp.asarray(ct, ga.dtype) if ct.dtype != ga.dtype else ct)
 
 
-def _run_backward(heads, head_grads, retain_graph):
-    """Shared tape walk. Returns the list of leaves touched (with _accum)."""
+def _node_vjp_recorded(node, cts):
+    """create_graph=True step: re-linearize ``node.fwd_fn`` at the saved
+    inputs *as a recorded op*, so the produced input-cotangents carry tape
+    links to both the cotangents and the original inputs — grad-of-grad
+    sees d(residual)/dx, which the stored first-order vjp closure cannot
+    provide (its residuals are baked constants)."""
+    from .ndarray.ndarray import NDArray
+    from .ops import registry
+
+    if node.fwd_fn is None or node.in_arrays is None:
+        raise MXNetError(
+            f"create_graph=True is not supported through node "
+            f"{node.name!r} (hybridized CachedOp or custom Function); "
+            f"compute the inner function imperatively for higher-order "
+            f"gradients")
+    n_out = len(node.out_avals)
+    as_tuple = n_out > 1 or node.out_container
+
+    def hfn(*args):
+        import jax
+
+        cs, xs = args[:n_out], args[n_out:]
+        _, vjp = jax.vjp(node.fwd_fn, *xs)
+        r = vjp(tuple(cs) if as_tuple else cs[0])
+        return tuple(r)
+
+    all_args = tuple(cts) + tuple(node.in_arrays)
+    out = registry.apply(hfn, all_args, name=(node.name or "op") + "_grad",
+                         sync_outputs=False, cacheable=False)
+    return out if isinstance(out, (list, tuple)) else (out,)
+
+
+def _run_backward(heads, head_grads, retain_graph, create_graph=False):
+    """Shared tape walk. Returns the list of leaves touched (with _accum).
+
+    ``create_graph=True`` runs the walk with NDArray cotangents and records
+    every vjp application back onto the tape (the reference's re-recorded
+    grad graph, ``python/mxnet/autograd.py:309``).
+    """
     import jax.numpy as jnp
 
-    node_cts = {}  # (id(node), out_idx) -> cotangent jax array
+    from .ndarray.ndarray import NDArray
+
+    def lift(x):
+        return NDArray(x) if create_graph and not isinstance(x, NDArray) else x
+
+    node_cts = {}  # (id(node), out_idx) -> cotangent (jax array / NDArray)
     touched_leaves = []
 
     def touch(leaf, ct):
@@ -248,7 +301,9 @@ def _run_backward(heads, head_grads, retain_graph):
         leaf = getattr(arr, "_leaf", None)
         if hg is None:
             # MXNet semantics: default head gradient is ones_like(head)
-            ct = jnp.ones(arr.shape, arr.dtype)
+            ct = lift(jnp.ones(arr.shape, arr.dtype))
+        elif create_graph:
+            ct = hg if isinstance(hg, NDArray) else NDArray(jnp.asarray(hg))
         else:
             ct = hg._data if hasattr(hg, "_data") else jnp.asarray(hg)
         if tape is not None:
@@ -270,13 +325,16 @@ def _run_backward(heads, head_grads, retain_graph):
         for i, aval in enumerate(node.out_avals):
             ct = node_cts.pop((id(node), i), None)
             if ct is None:
-                ct = _zeros_like_aval(aval)
+                ct = lift(_zeros_like_aval(aval))
             else:
                 has_any = True
             cts.append(ct)
         if not has_any:
             continue
-        in_cts = node.vjp_fn(tuple(cts) if len(cts) > 1 else cts[0])
+        if create_graph:
+            in_cts = _node_vjp_recorded(node, cts)
+        else:
+            in_cts = node.vjp_fn(tuple(cts) if len(cts) > 1 else cts[0])
         if not isinstance(in_cts, (tuple, list)):
             in_cts = (in_cts,)
         for slot, ict in zip(node.in_slots, in_cts):
@@ -286,8 +344,13 @@ def _run_backward(heads, head_grads, retain_graph):
                 touch(slot, ict)
             else:
                 _add_ct(node_cts, (id(slot[0]), slot[1]), ict)
-        if not retain_graph:
-            node.vjp_fn = None  # free residuals eagerly
+        if not retain_graph and not create_graph:
+            # free residuals AND the saved forward inputs eagerly — the
+            # higher-order bookkeeping must not raise ordinary training's
+            # peak activation memory
+            node.vjp_fn = None
+            node.fwd_fn = None
+            node.in_arrays = None
     return touched_leaves
 
 
@@ -295,18 +358,14 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
          train_mode=True):  # pylint: disable=unused-argument
     """Return gradients of heads w.r.t. variables (``autograd.py:309``).
 
-    ``create_graph=True`` (higher-order grad) is not supported in the tape
-    path yet; use ``mx.npx.grad_and_loss``/jax transforms for higher-order
-    needs. The reference implements it via re-recording the grad graph.
+    ``create_graph=True`` re-records every vjp application onto the tape
+    (via the saved forward functions), so the returned gradients are
+    themselves differentiable — ``grad(grad(f))`` works, matching the
+    reference's re-recorded grad graph and its
+    ``test_higher_order_grad.py`` contract.
     """
     from .ndarray.ndarray import NDArray
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order autograd) is not yet supported "
-            "on the TPU tape; wrap your function with mx.npx.value_and_grad "
-            "style transforms instead"
-        )
     if isinstance(heads, NDArray):
         heads = [heads]
     if isinstance(variables, NDArray):
@@ -324,8 +383,13 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         if getattr(v, "_leaf", None) is None:
             v._leaf = Leaf(None, "write")
             tmp_leaves.append(v)
+    prev_rec = None
+    if create_graph:
+        # the walk's vjp applications must themselves be recorded
+        prev_rec = set_recording(True)
     try:
-        _run_backward(heads, head_grads, retain_graph)
+        _run_backward(heads, head_grads, retain_graph,
+                      create_graph=create_graph)
         out = []
         for v in variables:
             ct = v._leaf._accum
@@ -334,9 +398,11 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
                 import jax.numpy as jnp
 
                 ct = jnp.zeros(v.shape, v.dtype)
-            out.append(NDArray(ct))
+            out.append(ct if isinstance(ct, NDArray) else NDArray(ct))
         return out
     finally:
+        if prev_rec is not None:
+            set_recording(prev_rec)
         for v in tmp_leaves:
             v._leaf = None
 

@@ -197,9 +197,13 @@ def _wrap(jfn, name, record=True):
             (args, kwargs), is_leaf=lambda x: isinstance(x, NDArray)
         )
         arr_pos = [i for i, l in enumerate(leaves) if isinstance(l, NDArray)]
+        # NDArray leaves must NOT be captured in the closure: the eager jit
+        # cache keys closures by cell contents, and array values are the
+        # jit-traced arguments, not static config
+        base = [None if isinstance(l, NDArray) else l for l in leaves]
 
         def closed(*xs):
-            nl = list(leaves)
+            nl = list(base)
             for p, x in zip(arr_pos, xs):
                 nl[p] = x
             a, k = jax.tree_util.tree_unflatten(treedef, nl)
@@ -208,7 +212,15 @@ def _wrap(jfn, name, record=True):
         arrays = tuple(leaves[i] for i in arr_pos)
         if out is not None:
             return _registry.apply_out(closed, arrays, name=name, out=out)
-        return _registry.apply(closed, arrays, name=name, record=record)
+        # cheap static key: `name` pins jfn; treedef + const leaves pin the
+        # call config. Hashing this is ~10x cheaper than walking closures.
+        try:
+            skey = ("npwrap", name, treedef,
+                    tuple(_registry._static_key(b) for b in base))
+        except TypeError:
+            skey = None
+        return _registry.apply(closed, arrays, name=name, record=record,
+                               static_key=skey)
 
     f.__name__ = name
     f.__qualname__ = name

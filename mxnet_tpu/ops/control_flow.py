@@ -84,7 +84,9 @@ def foreach(body, data, init_states):
         carry, ys = jax.lax.scan(step, tuple(s_arrs), tuple(d_arrs))
         return tuple(ys) + tuple(carry)
 
-    res = _apply(f, tuple(datas + states), name="foreach")
+    # cacheable=False: f populates out_struct at TRACE time; a jit-cache
+    # hit would skip tracing and leave it empty
+    res = _apply(f, tuple(datas + states), name="foreach", cacheable=False)
     n_out = out_struct["n_out"]
     outs = list(res[:n_out])
     final_states = list(res[n_out:])
@@ -133,7 +135,8 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         out, _ = jax.lax.while_loop(c, b, (tuple(arrs), 0))
         return tuple(out)
 
-    res = _apply(f, tuple(lvars), name="while_loop", record=False)
+    res = _apply(f, tuple(lvars), name="while_loop", record=False,
+                 cacheable=False)
     res = list(res) if isinstance(res, (list, tuple)) else [res]
     return res if multi else res[0]
 
@@ -176,6 +179,6 @@ def cond(pred, then_func, else_func, inputs):
                             run(then_func), run(else_func), tuple(arrs))
 
     res = _apply(f, tuple([NDArray(p) if not isinstance(p, NDArray) else p
-                           for p in [pred]] + ins), name="cond")
+                           for p in [pred]] + ins), name="cond", cacheable=False)
     res = list(res) if isinstance(res, (list, tuple)) else [res]
     return res if len(res) > 1 else res[0]

@@ -16,6 +16,7 @@ the reference synthesizes its Python op modules from the C registry
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, Optional
 
 from .. import autograd, engine
@@ -23,6 +24,86 @@ from ..base import MXNetError
 
 # global op table: name -> Op
 _OPS: Dict[str, "Op"] = {}
+
+# ---------------------------------------------------------------------------
+# Eager per-op jit cache (SURVEY.md §7 hard part 2)
+#
+# The reference keeps eager dispatch cheap by caching shape/dtype inference
+# per op signature (`SetShapeType`, `src/imperative/imperative.cc:117`). The
+# TPU analog: cache a `jax.jit` of the op callable keyed on everything
+# static — the function's code + closure values, non-array args, kwargs —
+# and let jit's own signature cache handle shapes/dtypes. One compiled
+# executable per (op, static config) replaces a fresh trace through op
+# Python + per-primitive dispatch on every imperative call.
+# ---------------------------------------------------------------------------
+
+_EAGER_JIT_CACHE: Dict[tuple, Callable] = {}
+_EAGER_JIT_SKIP = set()  # keys whose trace consumed RNG: never cache
+_KEPT_CALLABLES: Dict[int, Callable] = {}  # id-keyed pins (see _static_key)
+_EAGER_JIT_MAX = 4096  # runaway guard: clear rather than evict
+_eager_jit_enabled = os.environ.get("MXNET_EAGER_JIT_CACHE", "1") != "0"
+
+
+def set_eager_jit(flag: bool) -> None:
+    """Enable/disable the eager per-op jit cache (MXNET_EAGER_JIT_CACHE)."""
+    global _eager_jit_enabled
+    _eager_jit_enabled = bool(flag)
+
+
+def eager_jit_cache_size() -> int:
+    return len(_EAGER_JIT_CACHE)
+
+
+def _static_key(v, depth=0):
+    """Hashable identity of a static value; TypeError means 'don't cache'.
+
+    Functions key on (code object, closure values) so the per-call inner
+    closures in ops/nn.py (same code, different stride/pad cells) cache
+    correctly instead of colliding or leaking.
+    """
+    if depth > 6:
+        raise TypeError("static key too deep")
+    if v is None or isinstance(v, (str, bytes, type)):
+        return v
+    if isinstance(v, (bool, int, float, complex)):
+        # type-tagged: True==1==1.0 and 0.0==-0.0 hash-collide, but pick
+        # different weak-type/sign behavior under jax — must not share a key
+        return (type(v).__name__, repr(v))
+    if isinstance(v, (tuple, list)):
+        return (type(v).__name__,) + tuple(
+            _static_key(x, depth + 1) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted(
+            (k, _static_key(x, depth + 1)) for k, x in v.items()))
+    import types
+
+    if isinstance(v, types.ModuleType):
+        return ("module", v.__name__)
+    if callable(v) and hasattr(v, "__code__"):
+        return (v.__code__,) + tuple(
+            _static_key(c.cell_contents, depth + 1)
+            for c in (v.__closure__ or ()))
+    if callable(v):
+        # opaque long-lived callables (jnp ufunc / PjitFunction objects):
+        # key by identity, pinning a reference so the id is never reused
+        _KEPT_CALLABLES.setdefault(id(v), v)
+        return ("callable", type(v).__name__, id(v))
+    import numpy as _onp
+
+    if isinstance(v, _onp.dtype) or (isinstance(v, type(_onp.float32))):
+        return str(v)
+    if isinstance(v, _onp.ndarray) or hasattr(v, "__jax_array__") or \
+            hasattr(v, "_data"):
+        raise TypeError(f"array-valued static arg {type(v).__name__}")
+    try:
+        hash(v)
+    except TypeError:
+        raise TypeError(
+            f"unhashable static arg {type(v).__name__}") from None
+    # value-hashable objects (PyTreeDef, dtypes, enums) key directly; the
+    # cache tuple keeps `v` alive, so id-hashed objects can't be recycled
+    # into false hits
+    return v
 
 
 class Op:
@@ -83,13 +164,19 @@ def _ndarray_cls():
     return NDArray
 
 
-def apply(fn, args, kwargs=None, name="", record=True, sync_outputs=True):
+def apply(fn, args, kwargs=None, name="", record=True, sync_outputs=True,
+          static_key=None, cacheable=True):
     """Invoke ``fn`` on a mix of NDArray / scalar / array args.
 
     NDArray positions become differentiable primal inputs; everything else is
     closed over as a constant. When autograd is recording and any NDArray
     input is tracked, forward runs under ``jax.vjp`` and a tape node is
     created (``Imperative::RecordOp`` analog).
+
+    ``static_key`` — optional precomputed hashable identity of everything
+    static about this call (op + config). When given, the eager jit cache
+    uses it directly instead of walking ``fn``'s closure, which keeps the
+    per-call overhead down on hot namespace ops.
     """
     import jax
 
@@ -108,6 +195,41 @@ def apply(fn, args, kwargs=None, name="", record=True, sync_outputs=True):
             for pos, x in zip(arr_pos, xs):
                 template[pos] = x
             return fn(*template, **kwargs)
+
+    cache_key = None
+    cache_candidate = None
+    rng_mark = 0
+    if _eager_jit_enabled and cacheable:
+        try:
+            if static_key is not None:
+                key = static_key
+            else:
+                pos_set = set(arr_pos)
+                key = (
+                    _static_key(fn),
+                    tuple(arr_pos),
+                    len(args),
+                    tuple(_static_key(a) for i, a in enumerate(args)
+                          if i not in pos_set),
+                    _static_key(kwargs),
+                )
+            if key not in _EAGER_JIT_SKIP:
+                jitted = _EAGER_JIT_CACHE.get(key)
+                if jitted is not None:
+                    closed = jitted
+                else:
+                    from .. import random as _rng
+
+                    # jit now, publish to the cache only after the call
+                    # traced without drawing an RNG key (a cached trace
+                    # would replay the same baked key forever)
+                    rng_mark = _rng.consume_count()
+                    cache_key = key
+                    _uncached_closed = closed
+                    cache_candidate = jax.jit(closed)
+                    closed = cache_candidate
+        except TypeError:
+            pass  # unhashable static config (e.g. array-valued kwargs)
 
     from ..ndarray.ndarray import _tracked, _slot_of
 
@@ -132,22 +254,60 @@ def apply(fn, args, kwargs=None, name="", record=True, sync_outputs=True):
             return tuple(r)
         return r
 
-    if recording:
-        outs, vjp_fn = jax.vjp(normalized, *datas)
-    else:
-        outs = normalized(*datas)
+    try:
+        if recording:
+            outs, vjp_fn = jax.vjp(normalized, *datas)
+        else:
+            outs = normalized(*datas)
+    except Exception:
+        if cache_candidate is None:
+            raise
+        # maybe jit-specific (value-dependent Python: dynamic output
+        # shapes, host reads) — retry eagerly; only a SUCCESSFUL retry
+        # proves jit-incompatibility and justifies skipping the cache
+        # forever (a plain user error must not poison the key)
+        closed = _uncached_closed
+        cache_candidate = None
+        if recording:
+            outs, vjp_fn = jax.vjp(normalized, *datas)
+        else:
+            outs = normalized(*datas)
+        _EAGER_JIT_SKIP.add(cache_key)
+
+    if cache_candidate is not None:
+        from .. import random as _rng
+
+        if _rng.consume_count() == rng_mark:
+            if len(_EAGER_JIT_CACHE) >= _EAGER_JIT_MAX:
+                _EAGER_JIT_CACHE.clear()
+            _EAGER_JIT_CACHE[cache_key] = cache_candidate
+        else:
+            _EAGER_JIT_SKIP.add(cache_key)
 
     single = not isinstance(outs, (tuple, list))
     flat = [outs] if single else list(outs)
     wrapped = [NDArray(o) for o in flat]
 
     if recording:
+        if not single and len(flat) == 1:
+            # the tape walk hands a bare leaf when there's one output, but
+            # jax.vjp of a 1-tuple-returning fn wants a 1-tuple cotangent
+            raw_vjp = vjp_fn
+            vjp_fn = (lambda ct, _raw=raw_vjp:
+                      _raw(ct if isinstance(ct, tuple) else (ct,)))
         node = autograd.TapeNode(
             vjp_fn,
             [_slot_of(a) for a in arrays],
             [(o.shape, o.dtype) for o in flat],
             name=name or getattr(fn, "__name__", "op"),
+            # saved for create_graph=True: the backward walk re-linearizes
+            # this op as a recorded op (higher-order autograd)
+            fwd_fn=normalized,
+            in_arrays=list(arrays),
         )
+        # create_graph's replay must hand jax.vjp a cotangent matching the
+        # forward's output structure: bare leaf vs 1-tuple
+        node.out_container = not single
         for i, w in enumerate(wrapped):
             w._tape = (node, i)
 

@@ -77,8 +77,20 @@ def seed(seed_state, ctx="all"):  # pylint: disable=unused-argument
     _global_state.seed(seed_state)
 
 
+# monotone count of key draws; the eager per-op jit cache (ops/registry.py)
+# refuses to cache any trace that consumed a key — a cached trace would
+# replay the SAME baked-in key on every call, freezing the randomness
+_consume_count = 0
+
+
+def consume_count() -> int:
+    return _consume_count
+
+
 def next_key():
     """Fresh PRNG key from the active generator (trace-aware)."""
+    global _consume_count
+    _consume_count += 1
     if _trace_stack.stack:
         return _trace_stack.stack[-1].next_key()
     return _global_state.next_key()

@@ -1,0 +1,73 @@
+"""Eager per-op jit cache (SURVEY §7 hard part 2: the `SetShapeType`
+signature-cache role, done the XLA way — one compiled executable per
+(op, static config), reused across imperative calls)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu import np
+from mxnet_tpu.ops import registry
+
+
+def _cache_delta(fn, *calls):
+    before = registry.eager_jit_cache_size()
+    outs = [fn(*c) for c in calls]
+    return registry.eager_jit_cache_size() - before, outs
+
+
+def test_repeat_op_hits_cache():
+    a = np.array(onp.random.randn(8, 8).astype("float32"))
+    registry._EAGER_JIT_CACHE.clear()
+    np.tanh(a)
+    n1 = registry.eager_jit_cache_size()
+    assert n1 >= 1
+    for _ in range(5):
+        np.tanh(a)
+    assert registry.eager_jit_cache_size() == n1  # no growth: cache hits
+    out = np.tanh(a).asnumpy()
+    onp.testing.assert_allclose(out, onp.tanh(a.asnumpy()), rtol=1e-6)
+
+
+def test_distinct_static_config_distinct_entries():
+    a = np.array(onp.random.randn(4, 6).astype("float32"))
+    registry._EAGER_JIT_CACHE.clear()
+    s0 = np.sum(a, axis=0)
+    n1 = registry.eager_jit_cache_size()
+    s1 = np.sum(a, axis=1)
+    n2 = registry.eager_jit_cache_size()
+    assert n2 > n1  # axis is static config -> its own executable
+    onp.testing.assert_allclose(s0.asnumpy(), a.asnumpy().sum(0), rtol=1e-6)
+    onp.testing.assert_allclose(s1.asnumpy(), a.asnumpy().sum(1), rtol=1e-6)
+
+
+def test_rng_ops_never_cached_and_stay_random():
+    """Dropout draws a key per call; a cached trace would freeze the mask."""
+    from mxnet_tpu.ops import nn as _nn
+
+    a = np.ones((64, 64))
+    with autograd.train_mode():
+        d1 = _nn.dropout(a, p=0.5).asnumpy()
+        d2 = _nn.dropout(a, p=0.5).asnumpy()
+    assert (d1 != d2).any(), "dropout mask froze: RNG op was jit-cached"
+
+
+def test_grad_through_cached_op():
+    a = np.array(onp.random.randn(5, 5).astype("float32"))
+    a.attach_grad()
+    np.exp(a)  # populate cache
+    with autograd.record():
+        y = np.exp(a)
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(),
+                                onp.exp(a.asnumpy()), rtol=1e-5)
+
+
+def test_disable_flag():
+    registry.set_eager_jit(False)
+    try:
+        registry._EAGER_JIT_CACHE.clear()
+        a = np.array(onp.ones((3, 3), "float32"))
+        np.tanh(a)
+        assert registry.eager_jit_cache_size() == 0
+    finally:
+        registry.set_eager_jit(True)

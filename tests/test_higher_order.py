@@ -1,0 +1,96 @@
+"""Higher-order autograd (`create_graph=True`), matching the reference's
+tests/python/unittest/test_higher_order_grad.py cases: the first-order
+gradient is itself recorded, so differentiating it again gives true second
+derivatives (including the input-dependence of vjp residuals)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np
+
+
+def _second_derivative(fn, d2_expect, x_np):
+    x = np.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = fn(x)
+        (dy,) = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        z = dy.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), d2_expect(x_np),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_sin_second_order():
+    _second_derivative(lambda x: np.sin(x), lambda v: -onp.sin(v),
+                       onp.random.uniform(-2, 2, (3, 4)).astype("float32"))
+
+
+def test_cube_second_order():
+    _second_derivative(lambda x: x * x * x, lambda v: 6 * v,
+                       onp.random.uniform(-2, 2, (5,)).astype("float32"))
+
+
+def test_log_second_order():
+    _second_derivative(lambda x: np.log(x), lambda v: -1.0 / v ** 2,
+                       onp.random.uniform(0.5, 3, (4,)).astype("float32"))
+
+
+def test_sigmoid_second_order():
+    def sig(v):
+        return 1 / (1 + onp.exp(-v))
+
+    _second_derivative(
+        lambda x: 1 / (1 + np.exp(-x)),
+        lambda v: sig(v) * (1 - sig(v)) * (1 - 2 * sig(v)),
+        onp.random.uniform(-2, 2, (6,)).astype("float32"))
+
+
+def test_grad_of_grad_composed():
+    """d²/dx² of x·sin(x) = 2cos(x) − x·sin(x), through a multi-op graph."""
+    _second_derivative(
+        lambda x: x * np.sin(x),
+        lambda v: 2 * onp.cos(v) - v * onp.sin(v),
+        onp.random.uniform(-1, 1, (8,)).astype("float32"))
+
+
+def test_third_order():
+    """d³(x⁴)/dx³ = 24x: two create_graph walks stacked."""
+    v = onp.random.uniform(-2, 2, (4,)).astype("float32")
+    x = np.array(v)
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x) * (x * x)
+        (d1,) = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        (d2,) = autograd.grad(d1.sum(), x, create_graph=True,
+                              retain_graph=True)
+        z = d2.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 24 * v, rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_first_order_values_unchanged():
+    """create_graph=True must return the same first-order values."""
+    v = onp.random.uniform(-2, 2, (7,)).astype("float32")
+    x = np.array(v)
+    x.attach_grad()
+    with autograd.record():
+        y = np.tanh(x)
+        (dy,) = autograd.grad(y, x, create_graph=True, retain_graph=True)
+    onp.testing.assert_allclose(dy.asnumpy(), 1 - onp.tanh(v) ** 2,
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_hybridized_node_raises_clear_error():
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(3, in_units=4)
+    net.initialize()
+    net.hybridize()
+    x = np.array(onp.random.randn(2, 4).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = net(x).sum()
+        with pytest.raises(mx.MXNetError, match="create_graph"):
+            autograd.grad(y, x, create_graph=True, retain_graph=True)
