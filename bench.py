@@ -128,6 +128,39 @@ def bench_resnet_infer():
     })
 
 
+def bench_resnet_infer_int8():
+    """ResNet-50 INT8 inference, batch 32 (contrib.quantization int8 path;
+    v5e MXU int8 peak is 2x bf16). vs_baseline: the V100 fp16 row
+    (perf.md:208, 2085.51 img/s) — the reference's reduced-precision
+    inference analog."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    BATCH, SIZE = 32, 224
+    net = gluon.model_zoo.vision.resnet50_v1()
+    net.initialize()
+    x = mnp.array(
+        onp.random.uniform(-1, 1, (BATCH, 3, SIZE, SIZE)).astype("float32"))
+    with autograd.predict_mode():
+        net(mnp.array(onp.zeros((1, 3, 64, 64), dtype="float32")))
+    quantize_net(net, calib_data=x, calib_mode="naive")
+    net.hybridize(static_alloc=True)
+    with autograd.predict_mode():
+        net(x).asnumpy()  # compile + drain
+        dt = _timed_diff(lambda: net(x), lambda out: out.asnumpy(), 3, 18)
+    img_s = BATCH / dt
+    return _emit({
+        "metric": "resnet50_v1_infer_bs32_int8",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / 2085.51, 3),
+    })
+
+
 def _train_bench(net, loss_fn, optimizer, opt_params, data, labels,
                  rules=None, dtype=None, k1=3, k2=15):
     """Shared training-step timer: ShardedTrainer (SPMD step over the device
@@ -327,6 +360,7 @@ def main():
     rows = {}
     failures = {}
     for name, fn in [("infer", bench_resnet_infer),
+                     ("infer_int8", bench_resnet_infer_int8),
                      ("bandwidth", bench_bandwidth),
                      ("lenet_eager", bench_lenet_eager),
                      ("bert", bench_bert_train),

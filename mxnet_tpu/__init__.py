@@ -84,6 +84,7 @@ _LAZY_SUBMODULES = (
     "symbol",
     "sym",
     "metric",
+    "contrib",
 )
 
 _LAZY_ALIASES = {"kv": "kvstore", "sym": "symbol", "init": "initializer"}

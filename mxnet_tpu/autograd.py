@@ -388,8 +388,8 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         # the walk's vjp applications must themselves be recorded
         prev_rec = set_recording(True)
     try:
-        _run_backward(heads, head_grads, retain_graph,
-                      create_graph=create_graph)
+        touched = _run_backward(heads, head_grads, retain_graph,
+                                create_graph=create_graph)
         out = []
         for v in variables:
             ct = v._leaf._accum
@@ -399,6 +399,11 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
 
                 ct = jnp.zeros(v.shape, v.dtype)
             out.append(ct if isinstance(ct, NDArray) else NDArray(ct))
+        # leaves the walk touched but the caller didn't ask about (e.g.
+        # network params during a grad-penalty grad-wrt-input) must not
+        # keep stale accumulators — they'd poison the next backward()
+        for leaf in touched:
+            leaf._accum = None
         return out
     finally:
         if prev_rec is not None:

@@ -1,0 +1,394 @@
+"""INT8 quantization (reference ``src/operator/quantization/`` 6,744 LoC +
+``python/mxnet/contrib/quantization.py`` ``quantize_net``).
+
+TPU-first design: int8 matmul/conv run on the MXU at 2x the bf16 rate
+(v5e: 394 TOPS int8 vs 197 TFLOPS bf16), so quantized inference is a dot
+with ``preferred_element_type=int32`` plus a float rescale that XLA fuses
+into the surrounding elementwise work. No graph pass is needed — layers
+are swapped wholesale (`quantize_net`), the analog of the reference's
+``QuantizeGraph`` pass reached via ``MXQuantizeSymbol``
+(``src/c_api/c_api_symbolic.cc:926``).
+
+Calibration matches the reference's two modes (``calibrate.cc``):
+* ``naive`` — per-layer input absmax.
+* ``entropy`` — KL-divergence-optimal threshold over an activation
+  histogram (the TensorRT-style search in ``GetOptimalThreshold``).
+Weights always use per-output-channel symmetric scales.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray.ndarray import NDArray
+from ..ops import registry as _registry
+
+INT8_MAX = 127.0
+
+
+# ---------------------------------------------------------------------------
+# ops: quantize / dequantize / requantize (npx surface parity)
+# ---------------------------------------------------------------------------
+
+
+def quantize(data, min_range=None, max_range=None, out_type="int8"):
+    """Symmetric linear quantization to int8 (reference `_contrib_quantize`).
+
+    Returns ``(qdata, min_range, max_range)`` like the reference op.
+    """
+    if out_type != "int8":
+        raise MXNetError("TPU quantization supports int8 (MXU native); "
+                         f"got {out_type!r}")
+    import jax.numpy as jnp
+
+    if min_range is None or max_range is None:
+        d = data._data if isinstance(data, NDArray) else data
+        amax = float(jnp.max(jnp.abs(d)))
+        min_range, max_range = -amax, amax
+    thresh = max(abs(float(min_range)), abs(float(max_range))) or 1.0
+    scale = INT8_MAX / thresh
+
+    def f(x):
+        return jnp.clip(jnp.round(x * scale), -INT8_MAX,
+                        INT8_MAX).astype(jnp.int8)
+
+    q = _registry.apply(f, (data,), name="quantize", record=False)
+    from .. import numpy as mnp
+
+    return q, mnp.array([min_range]), mnp.array([max_range])
+
+
+def dequantize(qdata, min_range, max_range, out_type="float32"):
+    """int8 -> float (reference `_contrib_dequantize`)."""
+    import jax.numpy as jnp
+
+    lo = float(min_range.asnumpy()[0]) if isinstance(min_range, NDArray) \
+        else float(min_range)
+    hi = float(max_range.asnumpy()[0]) if isinstance(max_range, NDArray) \
+        else float(max_range)
+    thresh = max(abs(lo), abs(hi)) or 1.0
+    scale = thresh / INT8_MAX
+
+    def f(x):
+        return (x.astype(out_type)) * scale
+
+    return _registry.apply(f, (qdata,), name="dequantize", record=False)
+
+
+def requantize(qdata32, in_scale, out_scale):
+    """int32 accumulator -> int8 at a new scale (`_contrib_requantize`)."""
+    import jax.numpy as jnp
+
+    ratio = in_scale / out_scale
+
+    def f(x):
+        return jnp.clip(jnp.round(x.astype(jnp.float32) * ratio),
+                        -INT8_MAX, INT8_MAX).astype(jnp.int8)
+
+    return _registry.apply(f, (qdata32,), name="requantize", record=False)
+
+
+# ---------------------------------------------------------------------------
+# calibration (reference calibrate.cc)
+# ---------------------------------------------------------------------------
+
+
+def _smooth(d, eps=1e-4):
+    """Move a little mass onto zero entries (calibrate.cc
+    SmoothDistribution): KL needs full support on both distributions."""
+    is_z = d == 0
+    nz = ~is_z
+    n_nz = int(nz.sum())
+    if n_nz == 0:
+        return None
+    eps1 = eps * float(is_z.sum()) / n_nz
+    if eps1 >= 1.0:
+        return None
+    out = d.copy()
+    out[is_z] += eps
+    out[nz] -= eps1
+    # tiny nonzero entries could have gone negative: clamp to keep KL finite
+    return _onp.maximum(out, 1e-12)
+
+
+def _kl_optimal_threshold(hist, edges, num_quantized_bins=255, stride=8):
+    """KL-optimal clip threshold over a SIGNED activation histogram —
+    the reference's entropy calibration (calibrate.cc CalibrateComputeCPU).
+
+    The histogram is centered on zero; candidate thresholds are symmetric
+    windows around the center bin, so a ReLU zero-spike sits identically
+    in the reference and quantized distributions and never skews the
+    divergence (an |x| histogram would put it at the edge and would).
+    """
+    hist = hist.astype(_onp.float64)
+    n = hist.size
+    zero = n // 2
+    nhalf = num_quantized_bins // 2
+    if zero <= nhalf:
+        return float(edges[-1])
+    best_kl, best_th = _onp.inf, float(edges[-1])
+    for i in range(nhalf, zero + 1, stride):
+        start, stop = zero - i, zero + i + 1
+        th = float(edges[min(stop, len(edges) - 1)])
+        sliced = hist[start:stop].copy()
+        p = sliced.copy()
+        p[0] += hist[:start].sum()   # clip left outliers into the edge
+        p[-1] += hist[stop:].sum()   # clip right outliers
+        psum = p.sum()
+        if psum == 0:
+            continue
+        m = sliced.size // num_quantized_bins
+        if m == 0:
+            continue
+        q = _onp.zeros_like(sliced)
+        for j in range(num_quantized_bins):
+            s0 = j * m
+            s1 = (j + 1) * m if j < num_quantized_bins - 1 else sliced.size
+            seg = sliced[s0:s1]
+            nzm = seg != 0
+            cnt = int(nzm.sum())
+            if cnt:
+                q[s0:s1][nzm] = seg.sum() / cnt
+        qsum = q.sum()
+        if qsum == 0:
+            continue
+        ps = _smooth(p / psum)
+        qs = _smooth(q / qsum)
+        if ps is None or qs is None:
+            continue
+        kl = float(_onp.sum(ps * _onp.log(ps / qs)))
+        if kl < best_kl:
+            best_kl, best_th = kl, th
+    return best_th
+
+
+class _Collector:
+    """Forward-hook state: per-layer input stats during calibration."""
+
+    __slots__ = ("mode", "absmax", "hist", "edges", "num_bins")
+
+    def __init__(self, mode, num_bins=2049):  # odd: zero-centered bin
+        self.mode = mode
+        self.absmax = 0.0
+        self.hist = None
+        self.edges = None
+        self.num_bins = num_bins
+
+    def update(self, x: NDArray):
+        v = x.asnumpy().ravel()
+        amax = float(_onp.abs(v).max()) if v.size else 0.0
+        self.absmax = max(self.absmax, amax)
+        if self.mode == "entropy":
+            if self.hist is None:
+                r = max(amax, 1e-8)
+                self.edges = _onp.linspace(-r, r, self.num_bins + 1)
+                self.hist = _onp.histogram(v, bins=self.edges)[0]
+            else:
+                if amax > self.edges[-1]:
+                    # re-bin into a wider symmetric range, preserving mass
+                    new_edges = _onp.linspace(-amax, amax,
+                                              self.num_bins + 1)
+                    centers = (self.edges[:-1] + self.edges[1:]) / 2
+                    self.hist = _onp.histogram(
+                        centers, bins=new_edges, weights=self.hist)[0]
+                    self.edges = new_edges
+                self.hist += _onp.histogram(v, bins=self.edges)[0]
+
+    def threshold(self):
+        if self.mode == "entropy" and self.hist is not None:
+            return _kl_optimal_threshold(self.hist, self.edges)
+        return self.absmax or 1.0
+
+
+# ---------------------------------------------------------------------------
+# quantized layers
+# ---------------------------------------------------------------------------
+
+
+def _per_channel_scales(w, axis0_channels):
+    """Symmetric per-output-channel weight scales (oneDNN-style)."""
+    flat = w.reshape(axis0_channels, -1)
+    amax = _onp.abs(flat).max(axis=1)
+    amax[amax == 0] = 1.0
+    return amax / INT8_MAX
+
+
+class QuantizedDense(HybridBlock):
+    """int8 Dense: x->int8 (calibrated), int8x int8 dot -> int32 -> rescale.
+
+    Reference kernel: quantized_fully_connected.cc; here one
+    ``lax.dot_general(..., preferred_element_type=int32)`` on the MXU.
+    """
+
+    def __init__(self, dense: nn.Dense, in_threshold: float, **kwargs):
+        super().__init__(**kwargs)
+        w = dense.weight.data().asnumpy()
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self._act_type = dense._act_type
+        self._w_scale = _per_channel_scales(w, w.shape[0])  # (units,)
+        self._qw = _onp.clip(
+            _onp.round(w / self._w_scale[:, None]), -INT8_MAX,
+            INT8_MAX).astype(_onp.int8)
+        self._x_scale = float(in_threshold) / INT8_MAX
+        self._bias = (dense.bias.data().asnumpy()
+                      if dense.bias is not None else None)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from jax import lax
+
+        qw = self._qw
+        xs = self._x_scale
+        ws = self._w_scale
+        bias = self._bias
+        act = self._act_type
+        flatten = self._flatten
+
+        def f(xd):
+            if flatten and xd.ndim > 2:
+                xd = xd.reshape(xd.shape[0], -1)
+            qx = jnp.clip(jnp.round(xd / xs), -INT8_MAX,
+                          INT8_MAX).astype(jnp.int8)
+            acc = lax.dot_general(qx, jnp.asarray(qw),
+                                  (((qx.ndim - 1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (jnp.asarray(ws) * xs)
+            if bias is not None:
+                out = out + jnp.asarray(bias)
+            return out
+
+        out = _registry.apply(f, (x,), name="quantized_dense", record=False)
+        if act:
+            from ..ops import nn as _ops
+
+            out = _ops.activation(out, act)
+        return out
+
+
+class QuantizedConv(HybridBlock):
+    """int8 Conv2D (reference quantized_conv.cc) as one int8 MXU conv."""
+
+    def __init__(self, conv, in_threshold: float, **kwargs):
+        super().__init__(**kwargs)
+        w = conv.weight.data().asnumpy()
+        self._channels = conv._channels
+        self._kernel = tuple(conv._kernel)
+        self._strides = tuple(conv._strides)
+        self._padding = tuple(conv._padding)
+        self._dilation = tuple(conv._dilation)
+        self._groups = conv._groups
+        self._act_type = conv._act_type
+        self._w_scale = _per_channel_scales(w, w.shape[0])
+        self._qw = _onp.clip(
+            _onp.round(w / self._w_scale[:, None, None, None]),
+            -INT8_MAX, INT8_MAX).astype(_onp.int8)
+        self._x_scale = float(in_threshold) / INT8_MAX
+        self._bias = (conv.bias.data().asnumpy()
+                      if conv.bias is not None else None)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from jax import lax
+
+        qw, xs, ws = self._qw, self._x_scale, self._w_scale
+        bias, act = self._bias, self._act_type
+        strides, padding, dilation = self._strides, self._padding, \
+            self._dilation
+        groups = self._groups
+
+        def f(xd):
+            qx = jnp.clip(jnp.round(xd / xs), -INT8_MAX,
+                          INT8_MAX).astype(jnp.int8)
+            dn = lax.conv_dimension_numbers(qx.shape, qw.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+            pad = [(p, p) for p in padding]
+            acc = lax.conv_general_dilated(
+                qx, jnp.asarray(qw), strides, pad, rhs_dilation=dilation,
+                dimension_numbers=dn, feature_group_count=groups,
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (
+                jnp.asarray(ws) * xs)[None, :, None, None]
+            if bias is not None:
+                out = out + jnp.asarray(bias)[None, :, None, None]
+            return out
+
+        out = _registry.apply(f, (x,), name="quantized_conv", record=False)
+        if act:
+            from ..ops import nn as _ops
+
+            out = _ops.activation(out, act)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# quantize_net (reference contrib/quantization.py quantize_net)
+# ---------------------------------------------------------------------------
+
+
+_QUANTIZABLE = (nn.Dense, nn.Conv2D)
+
+
+def quantize_net(net, calib_data=None, calib_mode="entropy",
+                 quantized_dtype="int8", exclude_layers=None,
+                 num_calib_batches=None, logger=None):  # pylint: disable=unused-argument
+    """Swap Dense/Conv2D children for int8 versions, calibrated on
+    ``calib_data`` (an iterable of input batches, or a single batch).
+
+    Mirrors the reference's ``quantize_net`` flow: collect layer stats with
+    forward hooks → compute thresholds (naive absmax or entropy/KL) →
+    rewrite the graph (here: child swap instead of a symbol pass).
+    """
+    from .. import autograd
+
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 is supported on the MXU")
+    if calib_mode not in ("naive", "entropy"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+    exclude = set(exclude_layers or ())
+
+    # 1. walk the tree, attach collectors
+    targets = []  # (parent, child_name, layer, collector)
+
+    def walk(block, prefix=""):
+        for name, child in list(block._children.items()):
+            path = f"{prefix}{name}"
+            if isinstance(child, _QUANTIZABLE) and path not in exclude:
+                targets.append((block, name, child, _Collector(calib_mode)))
+            else:
+                walk(child, path + ".")
+
+    walk(net)
+    if not targets:
+        return net
+
+    handles = []
+    for _, _, layer, coll in targets:
+        handles.append(layer.register_forward_pre_hook(
+            lambda blk, inputs, _c=coll: _c.update(inputs[0])))
+
+    # 2. run calibration forwards
+    if calib_data is None:
+        raise MXNetError("quantize_net needs calib_data batches")
+    batches = calib_data if isinstance(calib_data, (list, tuple)) \
+        else [calib_data]
+    with autograd.predict_mode():
+        for batch in batches:
+            net(batch)
+    for h in handles:
+        h.detach()
+
+    # 3. swap in quantized layers
+    for parent, name, layer, coll in targets:
+        thresh = coll.threshold()
+        q = (QuantizedDense(layer, thresh)
+             if isinstance(layer, nn.Dense) else QuantizedConv(layer, thresh))
+        parent.register_child(q, name)
+        # attribute-held children (self.conv1 = Conv2D(...)) need the attr
+        # rebound too; Sequential children only live in _children
+        for attr, val in list(vars(parent).items()):
+            if val is layer:
+                object.__setattr__(parent, attr, q)
+    return net

@@ -70,7 +70,12 @@ class Context:
         """Resolve this context to a concrete ``jax.Device``."""
         jax = _jax()
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu") if jax.default_backend() != "cpu" else jax.devices()
+            # local_devices: under multi-process SPMD, jax.devices() is the
+            # GLOBAL list and entry 0 may belong to another process — a
+            # device_put there would need a collective every process joins
+            devs = (jax.local_devices(backend="cpu")
+                    if jax.default_backend() != "cpu"
+                    else jax.local_devices())
             if self.device_type == "cpu":
                 return devs[min(self.device_id, len(devs) - 1)]
             return devs[0]
@@ -121,7 +126,7 @@ def _accelerator_devices():
     jax = _jax()
     if jax.default_backend() == "cpu":
         return []
-    return jax.devices()
+    return jax.local_devices()
 
 
 def cpu(device_id: int = 0) -> Context:

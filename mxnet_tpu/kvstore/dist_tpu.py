@@ -177,14 +177,39 @@ class KVStoreDistTPUSync(KVStoreLocal):
             out.append(NDArray(jax.device_put(summed, dev)))
         return out
 
+    def _cross_process_sum(self, nd):
+        """Sum one (already locally-reduced) array across processes —
+        the multi-host half of pushpull (reference: ps-lite ZPushPull to
+        servers shared by all workers; here a gather+sum over the
+        jax.distributed runtime's collectives)."""
+        import jax
+        from jax.experimental import multihost_utils
+
+        if _jax().process_count() <= 1:
+            return nd
+        gathered = multihost_utils.process_allgather(nd._data)
+        dev = list(nd._data.devices())[0]
+        return NDArray(jax.device_put(gathered.sum(axis=0), dev))
+
     def pushpull(self, key, value, out=None, priority=0):  # pylint: disable=unused-argument
         keys, values = _normalize_grouped(key, value)
         _, outs = _normalize_grouped(key, out)
+        multi_proc = _jax().process_count() > 1
         for k, vals, dsts in zip(keys, values, outs):
             if vals is not None and len(vals) > 1:
                 reduced = self.allreduce(vals)
             else:
                 reduced = vals
+            if multi_proc and reduced is not None:
+                import jax
+
+                summed = self._cross_process_sum(reduced[0])
+                # keep each destination's device placement (the single-
+                # process path preserves it too)
+                reduced = [
+                    NDArray(jax.device_put(
+                        summed._data, list(r._data.devices())[0]))
+                    for r in reduced]
             if dsts is None:
                 self._store[k] = reduced[0]
                 continue

@@ -397,6 +397,10 @@ def apply_along_axis(func1d, axis, arr, *args, **kwargs):
 from . import linalg  # noqa: E402
 from . import random  # noqa: E402
 from . import fft  # noqa: E402
+from .extras import _install_extras as _ie  # noqa: E402
+
+_ie(globals(), _wrap)
+del _ie
 
 _sys.modules[__name__ + ".linalg"] = linalg
 _sys.modules[__name__ + ".random"] = random

@@ -204,3 +204,23 @@ def weibull(a, size=None, ctx=None, device=None):
 def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None, device=None):
     data = _jnp().exp(_jr().normal(_rng.next_key(), _size(size)) * sigma + mean)
     return _place(data, ctx or device or current_context())
+
+
+def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None,  # pylint: disable=unused-argument
+                        ctx=None, device=None):
+    """Draw from a multivariate normal (reference numpy/random.py:420)."""
+    mean_ = mean._data if isinstance(mean, NDArray) else _jnp().asarray(mean)
+    cov_ = cov._data if isinstance(cov, NDArray) else _jnp().asarray(cov)
+    data = _jr().multivariate_normal(_rng.next_key(), mean_, cov_,
+                                     _size(size))
+    return _place(data, ctx or device or current_context())
+
+
+def f(dfnum, dfden, size=None, ctx=None, device=None):
+    """Draw from an F distribution: ratio of scaled chi-squares."""
+    import jax.random as jr
+
+    k1, k2 = jr.split(_rng.next_key())
+    num = jr.gamma(k1, dfnum / 2.0, _size(size)) / (dfnum / 2.0)
+    den = jr.gamma(k2, dfden / 2.0, _size(size)) / (dfden / 2.0)
+    return _place(num / den, ctx or device or current_context())

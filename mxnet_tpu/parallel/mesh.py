@@ -81,8 +81,30 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     Maps ``DMLC_*``-style launch to ``jax.distributed.initialize``: no
     scheduler/server roles — every process is a worker (SPMD
     multi-controller, SURVEY.md §7 translation table).
+
+    Arguments left ``None`` are read from the environment the
+    ``tools/launch.py`` launcher sets (``MXNET_TPU_COORDINATOR``,
+    ``MXNET_TPU_NUM_PROCS``, ``MXNET_TPU_PROC_ID``) with the reference's
+    ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``/``DMLC_NUM_WORKER``/
+    ``DMLC_WORKER_ID`` accepted as aliases (`tools/launch.py:67-72`,
+    `distributed_training.md:262`).
     """
+    import os
+
     import jax
+
+    env = os.environ
+    if coordinator_address is None:
+        coordinator_address = env.get("MXNET_TPU_COORDINATOR")
+        if coordinator_address is None and "DMLC_PS_ROOT_URI" in env:
+            coordinator_address = (env["DMLC_PS_ROOT_URI"] + ":" +
+                                   env.get("DMLC_PS_ROOT_PORT", "9091"))
+    if num_processes is None:
+        v = env.get("MXNET_TPU_NUM_PROCS", env.get("DMLC_NUM_WORKER"))
+        num_processes = int(v) if v is not None else None
+    if process_id is None:
+        v = env.get("MXNET_TPU_PROC_ID", env.get("DMLC_WORKER_ID"))
+        process_id = int(v) if v is not None else None
 
     kwargs = {}
     if coordinator_address is not None:

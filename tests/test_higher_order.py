@@ -94,3 +94,19 @@ def test_hybridized_node_raises_clear_error():
         y = net(x).sum()
         with pytest.raises(mx.MXNetError, match="create_graph"):
             autograd.grad(y, x, create_graph=True, retain_graph=True)
+
+
+def test_grad_does_not_leak_accumulators_to_other_leaves():
+    """Gradient-penalty pattern: grad() w.r.t. the input must not leave a
+    stale accumulator on the params leaf that poisons the next backward."""
+    w = np.array(onp.ones((3,), "float32"))
+    x = np.array(onp.ones((3,), "float32") * 2)
+    w.attach_grad()
+    x.attach_grad()
+    with autograd.record():
+        loss = (w * x).sum()
+        autograd.grad(loss, x, retain_graph=True)
+    with autograd.record():
+        loss2 = (w * x).sum()
+    loss2.backward()
+    onp.testing.assert_allclose(w.grad.asnumpy(), [2, 2, 2])

@@ -1,0 +1,128 @@
+"""Oracle tests for the long-tail numpy surface (reference style:
+tests/python/unittest/test_numpy_op.py — every op checked against real
+NumPy on the same inputs)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+
+
+def _arr(*shape, seed=0, pos=False):
+    rng = onp.random.RandomState(seed)
+    a = rng.uniform(0.5 if pos else -2, 2, shape).astype("float32")
+    return a
+
+
+@pytest.mark.parametrize("name,args", [
+    ("corrcoef", (_arr(4, 16),)),
+    ("cov", (_arr(4, 16),)),
+    ("correlate", (_arr(8), _arr(5, seed=1))),
+    ("vander", (_arr(5),)),
+    ("unwrap", (_arr(12) * 4,)),
+    ("nanmax", (_arr(4, 4),)),
+    ("nanmin", (_arr(4, 4),)),
+    ("polyval", (_arr(4), _arr(6, seed=2))),
+    ("polyadd", (_arr(4), _arr(3, seed=2))),
+    ("polymul", (_arr(4), _arr(3, seed=2))),
+    ("polysub", (_arr(4), _arr(3, seed=2))),
+    ("trapz", (_arr(9),)),
+    ("argwhere", (_arr(6) > 0,)),
+    ("union1d", (onp.array([1, 2, 3]), onp.array([2, 4]))),
+    ("intersect1d", (onp.array([1, 2, 3, 9]), onp.array([2, 9, 4]))),
+    ("setdiff1d", (onp.array([1, 2, 3, 9]), onp.array([2, 9]))),
+    ("setxor1d", (onp.array([1, 2, 3]), onp.array([2, 4]))),
+    ("isin", (onp.array([1, 2, 3, 4]), onp.array([2, 4]))),
+    ("trim_zeros", (onp.array([0.0, 0, 1, 2, 0]),)),
+    ("msort", (_arr(6, 3),)),
+    ("spacing", (_arr(5, pos=True),)),
+])
+def test_against_numpy_oracle(name, args):
+    got = getattr(np, name)(*[np.array(a) for a in args])
+    want = getattr(onp, name)(*args) if hasattr(onp, name) else None
+    if name == "msort":
+        want = onp.sort(args[0], axis=0)
+    if name == "trapz":
+        want = onp.trapezoid(args[0])
+    got_np = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    onp.testing.assert_allclose(got_np, want, rtol=2e-5, atol=1e-5)
+
+
+def test_select_partition_choose():
+    a = _arr(10)
+    got = np.select([np.array(a) > 0, np.array(a) <= 0],
+                    [np.array(a), np.array(-a)])
+    onp.testing.assert_allclose(got.asnumpy(),
+                                onp.select([a > 0, a <= 0], [a, -a]),
+                                rtol=1e-6)
+    got = np.partition(np.array(a), 4)
+    assert got.asnumpy()[:5].max() <= got.asnumpy()[4:].min() + 1e-6
+    idx = onp.array([[0, 1], [1, 0]])
+    ch = onp.stack([onp.zeros((2, 2)), onp.ones((2, 2))]).astype("float32")
+    got = np.choose(np.array(idx), np.array(ch))
+    onp.testing.assert_allclose(got.asnumpy(), onp.choose(idx, ch))
+
+
+def test_indices_from_family():
+    a = _arr(5, 5)
+    for name in ("tril_indices_from", "triu_indices_from",
+                 "diag_indices_from"):
+        got = getattr(np, name)(np.array(a))
+        want = getattr(onp, name)(a)
+        for g, w in zip(got, want):
+            g_np = g.asnumpy() if hasattr(g, "asnumpy") else onp.asarray(g)
+            onp.testing.assert_array_equal(g_np, w)
+
+
+def test_fill_diagonal_mutates():
+    a = np.array(onp.zeros((4, 4), "float32"))
+    np.fill_diagonal(a, 7.0)
+    onp.testing.assert_allclose(a.asnumpy(), onp.eye(4) * 7)
+
+
+def test_financial():
+    onp.testing.assert_allclose(np.pv(0.05, 10, 100), -772.17, atol=0.01)
+    onp.testing.assert_allclose(np.npv(0.281, [-100, 39, 59, 55, 20]),
+                                -0.0066, atol=1e-2)
+    # numpy-financial documented example
+    onp.testing.assert_allclose(
+        np.mirr([-4500, -800, 800, 800, 600, 600, 800, 800, 700, 3000],
+                0.08, 0.055), 0.0666, atol=1e-3)
+    onp.testing.assert_allclose(np.rate(10, 0, -3500, 10000), 0.1107,
+                                atol=1e-3)
+    # principal + interest portions sum to the payment
+    total = np.pmt(0.07 / 12, 60, 25000)
+    pp = float(np.ppmt(0.07 / 12, 12, 60, 25000).asnumpy())
+    ip = float(np.ipmt(0.07 / 12, 12, 60, 25000).asnumpy())
+    onp.testing.assert_allclose(pp + ip, total, rtol=1e-6)
+
+
+def test_memory_predicates_and_constants():
+    a = np.ones((3,))
+    b = np.ones((3,))
+    assert np.shares_memory(a, a)
+    assert not np.may_share_memory(a, b)
+    assert onp.isnan(np.NAN) and np.PINF == onp.inf
+    assert np.finfo("float32").eps == onp.finfo("float32").eps
+
+
+def test_grad_flows_through_new_diff_ops():
+    from mxnet_tpu import autograd
+
+    x = np.array(_arr(8))
+    x.attach_grad()
+    with autograd.record():
+        y = np.cov(np.stack([x, x * 2])).sum()
+    y.backward()
+    assert onp.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_histogram_family():
+    a = _arr(100)
+    got = np.histogram_bin_edges(np.array(a), bins=10)
+    onp.testing.assert_allclose(got.asnumpy(),
+                                onp.histogram_bin_edges(a, bins=10),
+                                rtol=1e-5)
+    h, edges = np.histogramdd(np.array(_arr(50, 2)), bins=4)
+    wh, wedges = onp.histogramdd(_arr(50, 2), bins=4)
+    onp.testing.assert_allclose(h.asnumpy(), wh)

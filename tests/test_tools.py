@@ -1,0 +1,107 @@
+"""Tooling parity tests: tools/launch.py (reference tools/launch.py),
+tools/im2rec.py (reference tools/im2rec.py), benchmark/opperf.py
+(reference benchmark/opperf/). The launcher test is the reference's
+multi-process-on-one-host distributed smoke
+(tests/nightly/test_distributed_training*.sh done the JAX way)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as onp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = dict(os.environ)
+    # the conftest pins a virtual CPU mesh via XLA_FLAGS; subprocesses set up
+    # their own platform, and the distributed smoke needs 1 device/proc
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    for k in list(env):
+        if k.startswith(("MXNET_TPU_", "DMLC_")):
+            del env[k]
+    return env
+
+
+def test_launch_local_two_process_pushpull(tmp_path):
+    """2 processes: initialize_distributed from launcher env, then a
+    dist_tpu_sync pushpull must sum contributions ACROSS processes."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+        import mxnet_tpu as mx
+        from mxnet_tpu import np
+        from mxnet_tpu.parallel import initialize_distributed
+
+        initialize_distributed()  # reads MXNET_TPU_* from the launcher
+        rank = jax.process_index()
+        assert jax.process_count() == 2
+        kv = mx.kv.create('dist_tpu_sync')
+        assert kv.num_workers == 2
+        val = np.ones((4,)) * (rank + 1)
+        out = np.zeros((4,))
+        kv.pushpull('g', [val], out=[out])
+        got = out.asnumpy()
+        assert (got == 3.0).all(), got   # 1 + 2 across ranks
+        kv.barrier()
+        print(f'RANK{rank}_OK', flush=True)
+    """))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=_clean_env(),
+        cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RANK0_OK" in r.stdout and "RANK1_OK" in r.stdout
+
+
+def test_launch_requires_command():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"), "-n", "2"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode != 0
+
+
+def test_im2rec_list_and_pack_roundtrip(tmp_path):
+    from PIL import Image
+
+    root = tmp_path / "imgs"
+    for cls, color in (("cat", (255, 0, 0)), ("dog", (0, 255, 0))):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            Image.new("RGB", (32, 24), color).save(
+                root / cls / f"{i}.png")
+    prefix = str(tmp_path / "data")
+    import tools.im2rec as im2rec
+
+    assert im2rec.main([prefix, str(root), "--list", "--no-shuffle"]) == 0
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    assert im2rec.main([prefix, str(root), "--resize", "16"]) == 0
+
+    from mxnet_tpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    labels = set()
+    for idx in rec.keys:
+        header, img = recordio.unpack_img(rec.read_idx(idx))
+        labels.add(float(header.label))
+        assert img.shape[2] == 3 and min(img.shape[:2]) == 16
+    assert labels == {0.0, 1.0}
+
+
+def test_opperf_runs_and_reports(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark", "opperf.py"),
+         "--ops", "add,tanh", "--shape", "64,64", "--json"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    rows = [json.loads(l) for l in r.stdout.splitlines() if l.startswith("{")]
+    assert {row["op"] for row in rows} == {"add", "tanh"}
+    for row in rows:
+        assert row.get("fwd_us", 0) > 0

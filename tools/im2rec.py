@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Image -> RecordIO packer (reference ``tools/im2rec.py``).
+
+Two modes, matching the reference CLI:
+* ``--list``: walk an image directory and write a ``.lst`` file
+  (``index\\tlabel\\trelative-path`` lines).
+* pack (default): read a ``.lst`` file and write ``.rec`` + ``.idx``
+  (``MXIndexedRecordIO``), each record an ``IRHeader`` + encoded image
+  bytes, loadable by ``ImageRecordDataset`` / ``ImageRecordIter``.
+
+PIL replaces the reference's OpenCV for decode/resize/re-encode;
+``--pass-through`` stores the original file bytes untouched.
+"""
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def make_list(args):
+    """Write .lst: one `index<TAB>label<TAB>relpath` line per image, one
+    label per subdirectory (reference make_list behavior)."""
+    root = args.root
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    entries = []
+    if classes:
+        for c in classes:
+            for dirpath, _, files in os.walk(os.path.join(root, c)):
+                for f in sorted(files):
+                    if os.path.splitext(f)[1].lower() in _EXTS:
+                        rel = os.path.relpath(os.path.join(dirpath, f), root)
+                        entries.append((label_of[c], rel))
+    else:  # flat directory: label 0
+        for f in sorted(os.listdir(root)):
+            if os.path.splitext(f)[1].lower() in _EXTS:
+                entries.append((0, f))
+    if args.shuffle:
+        random.Random(args.seed).shuffle(entries)
+    lst = args.prefix + ".lst"
+    with open(lst, "w") as fh:
+        for i, (label, rel) in enumerate(entries):
+            fh.write(f"{i}\t{label}\t{rel}\n")
+    print(f"wrote {len(entries)} entries to {lst}")
+    return 0
+
+
+def read_list(path):
+    with open(path) as fh:
+        for line in fh:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(args):
+    from mxnet_tpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                     args.prefix + ".rec", "w")
+    n = 0
+    for idx, labels, rel in read_list(args.prefix + ".lst"):
+        path = os.path.join(args.root, rel)
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        if not args.pass_through:
+            from PIL import Image
+
+            img = Image.open(io.BytesIO(raw)).convert("RGB")
+            if args.resize:
+                w, h = img.size
+                s = args.resize / min(w, h)
+                img = img.resize((max(1, round(w * s)),
+                                  max(1, round(h * s))))
+            buf = io.BytesIO()
+            img.save(buf, format="JPEG", quality=args.quality)
+            raw = buf.getvalue()
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, raw))
+        n += 1
+    rec.close()
+    print(f"packed {n} records into {args.prefix}.rec")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="im2rec: image folder -> .lst / RecordIO "
+                    "(reference tools/im2rec.py parity)")
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst file instead of packing")
+    ap.add_argument("--shuffle", action="store_true", default=True)
+    ap.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side to this many pixels")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--pass-through", action="store_true",
+                    help="store original bytes without re-encoding")
+    args = ap.parse_args(argv)
+    if args.list:
+        return make_list(args)
+    return pack(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Multi-process / multi-host launcher (reference ``tools/launch.py``).
+
+The reference launcher starts a dmlc-core tracker plus N server and N
+worker processes with ``DMLC_ROLE``/``DMLC_PS_ROOT_URI`` env
+(`tools/launch.py:67-72`, `docs .../distributed_training.md:262`). The TPU
+build has no scheduler or server roles — every process is an SPMD worker —
+so launching means: start N processes that each call
+``mxnet_tpu.parallel.initialize_distributed()`` (→
+``jax.distributed.initialize``) with a shared coordinator address.
+
+Usage::
+
+    # N local processes (CPU collectives via Gloo; or one process per TPU
+    # host when run under a TPU pod's per-host scheduler):
+    python tools/launch.py -n 4 python train.py --my-args
+
+    # multi-host over ssh, one process per host in the hostfile:
+    python tools/launch.py -n 8 -H hosts.txt --launcher ssh \
+        python train.py
+
+Each process gets MXNET_TPU_COORDINATOR / MXNET_TPU_NUM_PROCS /
+MXNET_TPU_PROC_ID (plus the DMLC_* aliases for scripts written against
+the reference), which ``initialize_distributed()`` reads automatically.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env_for(rank, args, coordinator):
+    host, _, port = coordinator.partition(":")
+    port = port or str(args.port)
+    coordinator = f"{host}:{port}"
+    env = dict(os.environ)
+    env.update({
+        "MXNET_TPU_COORDINATOR": coordinator,
+        "MXNET_TPU_NUM_PROCS": str(args.num_workers),
+        "MXNET_TPU_PROC_ID": str(rank),
+        # reference-compat aliases (DMLC tracker naming)
+        "DMLC_PS_ROOT_URI": host,
+        "DMLC_PS_ROOT_PORT": port,
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_WORKER_ID": str(rank),
+        "DMLC_ROLE": "worker",
+    })
+    return env
+
+
+def launch_local(args, command):
+    coordinator = args.coordinator or f"127.0.0.1:{_free_port()}"
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            procs.append(subprocess.Popen(
+                command, env=_env_for(rank, args, coordinator)))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+def launch_ssh(args, command):
+    """One process per host line (reference ssh launcher parity)."""
+    hosts = [h.strip() for h in open(args.hostfile)
+             if h.strip() and not h.startswith("#")]
+    if len(hosts) < args.num_workers:
+        raise SystemExit(f"hostfile has {len(hosts)} hosts, need "
+                         f"{args.num_workers}")
+    coordinator = args.coordinator or f"{hosts[0]}:{args.port}"
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            env = _env_for(rank, args, coordinator)
+            exports = " ".join(
+                f"{k}={v!r}" for k, v in env.items()
+                if k.startswith(("MXNET_TPU_", "DMLC_")))
+            remote = f"cd {os.getcwd()!r} && env {exports} " + \
+                " ".join(command)
+            procs.append(subprocess.Popen(["ssh", hosts[rank], remote]))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="launch N SPMD worker processes "
+                    "(reference tools/launch.py parity)")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("--launcher", choices=("local", "ssh"), default="local")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (default: auto)")
+    ap.add_argument("--port", type=int, default=9091)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    if args.launcher == "ssh" or args.hostfile:
+        return launch_ssh(args, args.command)
+    return launch_local(args, args.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
