@@ -48,11 +48,9 @@ def bench_op(name, shape):
     fn = getattr(mnp, name, None) or getattr(npx, name, None)
     if fn is None:
         return None
-    import inspect
-
     try:
         sig_args = (a, b) if name in (
-            "add", "multiply", "divide", "dot", "matmul", "where_absent",
+            "add", "multiply", "divide", "dot", "matmul",
         ) else (a,)
         if name == "concatenate":
             sig_args = ([a, b],)

@@ -85,6 +85,7 @@ _LAZY_SUBMODULES = (
     "sym",
     "metric",
     "contrib",
+    "config",
 )
 
 _LAZY_ALIASES = {"kv": "kvstore", "sym": "symbol", "init": "initializer"}

@@ -288,3 +288,24 @@ class CachedOp:
         import jax
 
         return jax.tree_util.tree_unflatten(tree, wrapped)
+
+
+class CachedOpThreadSafe(CachedOp):
+    """Lock-protected CachedOp for multi-threaded inference.
+
+    Reference: ``src/imperative/cached_op_threadsafe.h:82`` — the C-predict
+    path serializes graph creation and state write-back behind a mutex so
+    concurrent threads can share one executor. Here the jit executables are
+    themselves thread-safe; the lock guards the signature-cache dict and
+    the mutable-state (BatchNorm stats) write-back.
+    """
+
+    def __init__(self, block, static_alloc=False, static_shape=False,
+                 flags=()):
+        super().__init__(block, static_alloc=static_alloc,
+                         static_shape=static_shape, flags=flags)
+        self._lock = threading.RLock()
+
+    def __call__(self, *args):
+        with self._lock:
+            return super().__call__(*args)

@@ -1,0 +1,107 @@
+"""Environment-flag registry with introspection.
+
+The reference configures itself through ~100 ``MXNET_*`` env vars read via
+``dmlc::GetEnv`` at use sites, documented centrally in
+``docs/.../env_var.md``, plus self-describing ``dmlc::Parameter`` structs.
+This module is the TPU build's equivalent: every flag the framework reads
+is registered here with its type, default, and doc, and
+``mx.config.describe()`` prints the live table (value, source) the way
+``__getdoc__`` exposes Parameter fields.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, NamedTuple
+
+
+class Flag(NamedTuple):
+    name: str
+    default: Any
+    doc: str
+    parse: Callable[[str], Any]
+
+
+_FLAGS: Dict[str, Flag] = {}
+
+
+def _bool(s: str) -> bool:
+    return s not in ("0", "false", "False", "")
+
+
+def register_flag(name, default, doc, parse=str):
+    _FLAGS[name] = Flag(name, default, doc, parse)
+    return _FLAGS[name]
+
+
+def get(name):
+    """Typed value of a registered flag (env wins over default)."""
+    flag = _FLAGS[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return flag.default
+    return flag.parse(raw)
+
+
+def is_set(name) -> bool:
+    return name in os.environ
+
+
+def list_flags():
+    """All registered flag names (env_var.md table analog)."""
+    return sorted(_FLAGS)
+
+
+def describe(file=None):
+    """Print name / current value / default / doc for every flag."""
+    import sys
+
+    out = file or sys.stdout
+    for name in list_flags():
+        f = _FLAGS[name]
+        cur = get(name)
+        src = "env" if is_set(name) else "default"
+        print(f"{name} = {cur!r} ({src}; default {f.default!r})\n"
+              f"    {f.doc}", file=out)
+
+
+# ---------------------------------------------------------------------------
+# The flags this framework reads (each registered next to its semantics;
+# reference: docs/static_site/src/pages/api/faq/env_var.md)
+# ---------------------------------------------------------------------------
+
+register_flag(
+    "MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice",
+    "Execution engine. 'NaiveEngine' blocks after every op (serialized "
+    "debugging, reference src/engine/naive_engine.cc); the default maps to "
+    "XLA async dispatch.")
+register_flag(
+    "MXNET_EAGER_JIT_CACHE", True,
+    "Cache one jax.jit executable per (op, static config) for imperative "
+    "dispatch (SURVEY §7 hard part 2). 0 disables.", _bool)
+register_flag(
+    "MXNET_WAITALL_FULL", False,
+    "mx.npx.waitall() sweeps every live array (exhaustive, slow) instead "
+    "of the recently-dispatched set.", _bool)
+register_flag(
+    "MXNET_TPU_PEAK_FLOPS", None,
+    "Override the chip peak FLOP/s used as the MFU denominator in "
+    "bench.py (default: by device_kind).",
+    float)
+register_flag(
+    "MXNET_TPU_NO_NATIVE", False,
+    "Disable the ctypes native library (native/recordio.cc prefetcher); "
+    "pure-Python fallbacks are used.", _bool)
+register_flag(
+    "MXNET_TPU_COORDINATOR", None,
+    "host:port of process 0 for jax.distributed.initialize; set by "
+    "tools/launch.py (reference DMLC_PS_ROOT_URI/PORT).")
+register_flag(
+    "MXNET_TPU_NUM_PROCS", None,
+    "World size for multi-process SPMD (reference DMLC_NUM_WORKER).", int)
+register_flag(
+    "MXNET_TPU_PROC_ID", None,
+    "This process's rank (reference DMLC_WORKER_ID).", int)
+register_flag(
+    "MXNET_MODULE_SEED", None,
+    "Base RNG seed for the test suite's per-test seeding (reference "
+    "tests conftest.py reproduction flow).", int)

@@ -1,2 +1,3 @@
 """Contrib namespace (reference ``python/mxnet/contrib/``)."""
+from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401

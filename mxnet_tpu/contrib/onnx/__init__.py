@@ -1,0 +1,13 @@
+"""ONNX interchange (reference ``python/mxnet/contrib/onnx/``).
+
+Self-contained: a protobuf wire codec + ONNX schema subset (serde),
+a jaxpr-walking exporter (mx2onnx analog) and a jnp-interpreting importer
+(onnx2mx analog) — no external onnx package needed, and the files are
+standard ONNX-17 ModelProtos.
+"""
+from .export import export_model
+from .import_ import ONNXBlock, import_model
+from .serde import Graph, Model, Node, Tensor
+
+__all__ = ["export_model", "import_model", "ONNXBlock", "Model", "Graph",
+           "Node", "Tensor"]

@@ -1,0 +1,419 @@
+"""Gluon/HybridBlock -> ONNX exporter over the traced jaxpr.
+
+Reference: ``python/mxnet/contrib/onnx/mx2onnx`` walks the nnvm symbol
+graph; the TPU-native analog walks the *jaxpr* of the functionalized
+forward (trace once -> export once), so anything the tracer can see —
+including plain-Python ``forward`` methods — exports, not just layer
+stacks. Parameters become ONNX initializers; each jax primitive maps to
+standard ONNX-17 ops.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as _onp
+
+from ...base import MXNetError
+from .serde import Graph, Model, Node, Tensor, np_to_onnx_dtype
+
+
+def _literal_cls():
+    try:
+        from jax.extend.core import Literal
+    except ImportError:  # older jax
+        from jax.core import Literal
+    return Literal
+
+
+def _dce(jaxpr):
+    """Keep only equations whose outputs feed the jaxpr outputs — drops
+    the traced-but-unused RNG key plumbing (random_wrap/fold_in chains)
+    that inference graphs carry along."""
+    Literal = _literal_cls()
+
+    live = {id(v) for v in jaxpr.outvars if not isinstance(v, Literal)}
+    keep = []
+    for eqn in reversed(jaxpr.eqns):
+        if any(id(v) in live for v in eqn.outvars):
+            keep.append(eqn)
+            for v in eqn.invars:
+                if not isinstance(v, Literal):
+                    live.add(id(v))
+    keep.reverse()
+    return keep
+
+
+class _Exporter:
+    def __init__(self):
+        self.nodes: List[Node] = []
+        self.names: Dict[int, str] = {}   # id(jax Var) -> onnx name
+        self.initializers: List[Tensor] = []
+        self._n = 0
+        self._const_cache: Dict[bytes, str] = {}
+
+    # -- naming -----------------------------------------------------------
+    def fresh(self, hint="t"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def name_of(self, var):
+        Literal = _literal_cls()
+
+        if isinstance(var, Literal):
+            return self.const(_onp.asarray(var.val))
+        return self.names[id(var)]
+
+    def bind(self, var, name):
+        self.names[id(var)] = name
+
+    def const(self, arr: _onp.ndarray, hint="const"):
+        arr = _onp.asarray(arr)
+        key = (str(arr.dtype) + str(arr.shape)).encode() + arr.tobytes()
+        hit = self._const_cache.get(key)
+        if hit is not None:
+            return hit
+        name = self.fresh(hint)
+        self.initializers.append(Tensor(name, arr))
+        self._const_cache[key] = name
+        return name
+
+    def emit(self, op_type, inputs, n_out=1, **attrs):
+        outs = [self.fresh(op_type.lower()) for _ in range(n_out)]
+        self.nodes.append(Node(op_type, list(inputs), outs, attrs=attrs))
+        return outs[0] if n_out == 1 else outs
+
+    # -- eqn dispatch ------------------------------------------------------
+    def run_jaxpr(self, jaxpr, in_names):
+        for var, name in zip(jaxpr.invars, in_names):
+            self.bind(var, name)
+        for var in jaxpr.constvars:
+            raise MXNetError("unbound constvar in inner jaxpr")
+        for eqn in jaxpr.eqns:
+            self.eqn(eqn)
+        return [self.name_of(v) for v in jaxpr.outvars]
+
+    def eqn(self, eqn):
+        prim = eqn.primitive.name
+        handler = getattr(self, "_p_" + prim.replace("-", "_"), None)
+        if handler is None:
+            handler = _SIMPLE.get(prim)
+            if handler is None:
+                raise MXNetError(
+                    f"ONNX export: unsupported primitive {prim!r}")
+            ins = [self.name_of(v) for v in eqn.invars]
+            out = self.emit(handler, ins)
+            self.bind(eqn.outvars[0], out)
+            return
+        handler(eqn)
+
+    # -- structural primitives --------------------------------------------
+    def _inline(self, eqn, closed):
+        ins = [self.name_of(v) for v in eqn.invars]
+        inner = closed.jaxpr
+        consts = closed.consts
+        for var, cval in zip(inner.constvars, consts):
+            self.bind(var, self.const(_onp.asarray(cval)))
+        for var, name in zip(inner.invars, ins):
+            self.bind(var, name)
+        for inner_eqn in inner.eqns:
+            self.eqn(inner_eqn)
+        for outer, inner_v in zip(eqn.outvars, inner.outvars):
+            self.bind(outer, self.name_of(inner_v))
+
+    def _p_pjit(self, eqn):
+        self._inline(eqn, eqn.params["jaxpr"])
+
+    _p_jit = _p_pjit  # jax >= 0.8 names the closed-call primitive 'jit'
+
+    def _p_closed_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"])
+
+    def _p_custom_jvp_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"])
+
+    def _p_custom_vjp_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"])
+
+    def _p_custom_jvp_call_jaxpr(self, eqn):
+        self._inline(eqn, eqn.params["fun_jaxpr"])
+
+    def _p_stop_gradient(self, eqn):
+        self.bind(eqn.outvars[0], self.name_of(eqn.invars[0]))
+
+    def _p_copy(self, eqn):
+        self.bind(eqn.outvars[0], self.name_of(eqn.invars[0]))
+
+    # -- shape / layout ----------------------------------------------------
+    def _p_reshape(self, eqn):
+        shape = eqn.params["new_sizes"]
+        shp = self.const(_onp.asarray(shape, _onp.int64), "shape")
+        out = self.emit("Reshape", [self.name_of(eqn.invars[0]), shp])
+        self.bind(eqn.outvars[0], out)
+
+    def _p_squeeze(self, eqn):
+        aval = eqn.outvars[0].aval
+        shp = self.const(_onp.asarray(aval.shape, _onp.int64), "shape")
+        out = self.emit("Reshape", [self.name_of(eqn.invars[0]), shp])
+        self.bind(eqn.outvars[0], out)
+
+    def _p_expand_dims(self, eqn):
+        self._p_squeeze(eqn)
+
+    def _p_transpose(self, eqn):
+        out = self.emit("Transpose", [self.name_of(eqn.invars[0])],
+                        perm=list(eqn.params["permutation"]))
+        self.bind(eqn.outvars[0], out)
+
+    def _p_broadcast_in_dim(self, eqn):
+        x = self.name_of(eqn.invars[0])
+        in_aval = eqn.invars[0].aval
+        shape = eqn.params["shape"]
+        bdims = eqn.params["broadcast_dimensions"]
+        # step 1: reshape so rank matches (1s in non-broadcast positions)
+        mid = [1] * len(shape)
+        for src, dst in enumerate(bdims):
+            mid[dst] = in_aval.shape[src]
+        if tuple(mid) != tuple(in_aval.shape):
+            shp = self.const(_onp.asarray(mid, _onp.int64), "shape")
+            x = self.emit("Reshape", [x, shp])
+        # step 2: numpy-style expand
+        if tuple(mid) != tuple(shape):
+            tgt = self.const(_onp.asarray(shape, _onp.int64), "shape")
+            x = self.emit("Expand", [x, tgt])
+        self.bind(eqn.outvars[0], x)
+
+    def _p_concatenate(self, eqn):
+        ins = [self.name_of(v) for v in eqn.invars]
+        out = self.emit("Concat", ins, axis=int(eqn.params["dimension"]))
+        self.bind(eqn.outvars[0], out)
+
+    def _p_slice(self, eqn):
+        p = eqn.params
+        starts = self.const(_onp.asarray(p["start_indices"], _onp.int64))
+        ends = self.const(_onp.asarray(p["limit_indices"], _onp.int64))
+        axes = self.const(
+            _onp.arange(len(p["start_indices"]), dtype=_onp.int64))
+        ins = [self.name_of(eqn.invars[0]), starts, ends, axes]
+        if p.get("strides"):
+            ins.append(self.const(_onp.asarray(p["strides"], _onp.int64)))
+        out = self.emit("Slice", ins)
+        self.bind(eqn.outvars[0], out)
+
+    def _p_convert_element_type(self, eqn):
+        dt = np_to_onnx_dtype(eqn.params["new_dtype"])
+        out = self.emit("Cast", [self.name_of(eqn.invars[0])], to=dt)
+        self.bind(eqn.outvars[0], out)
+
+    def _p_select_n(self, eqn):
+        # select_n(pred, x0, x1): x1 where pred else x0
+        c, x0, x1 = (self.name_of(v) for v in eqn.invars)
+        out = self.emit("Where", [c, x1, x0])
+        self.bind(eqn.outvars[0], out)
+
+    def _p_integer_pow(self, eqn):
+        y = eqn.params["y"]
+        x = self.name_of(eqn.invars[0])
+        if y == 2:
+            out = self.emit("Mul", [x, x])
+        else:
+            p = self.const(_onp.asarray(float(y), _onp.float32))
+            out = self.emit("Pow", [x, p])
+        self.bind(eqn.outvars[0], out)
+
+    def _p_rsqrt(self, eqn):
+        s = self.emit("Sqrt", [self.name_of(eqn.invars[0])])
+        out = self.emit("Reciprocal", [s])
+        self.bind(eqn.outvars[0], out)
+
+    def _p_iota(self, eqn):
+        n = eqn.params["shape"][int(eqn.params["dimension"])]
+        arr = _onp.arange(n)
+        out_aval = eqn.outvars[0].aval
+        arr = _onp.broadcast_to(
+            arr.reshape([-1 if i == eqn.params["dimension"] else 1
+                         for i in range(len(out_aval.shape))]),
+            out_aval.shape).astype(out_aval.dtype)
+        self.bind(eqn.outvars[0], self.const(arr, "iota"))
+
+    # -- reductions --------------------------------------------------------
+    def _reduce(self, eqn, op):
+        axes = self.const(_onp.asarray(eqn.params["axes"], _onp.int64))
+        out = self.emit(op, [self.name_of(eqn.invars[0]), axes],
+                        keepdims=0)
+        self.bind(eqn.outvars[0], out)
+
+    def _p_reduce_sum(self, eqn):
+        self._reduce(eqn, "ReduceSum")
+
+    def _p_reduce_max(self, eqn):
+        self._reduce(eqn, "ReduceMax")
+
+    def _p_reduce_min(self, eqn):
+        self._reduce(eqn, "ReduceMin")
+
+    def _p_argmax(self, eqn):
+        out = self.emit("ArgMax", [self.name_of(eqn.invars[0])],
+                        axis=int(eqn.params["axes"][0]), keepdims=0)
+        self.bind(eqn.outvars[0], out)
+
+    # -- matmul / conv / pool ---------------------------------------------
+    def _p_dot_general(self, eqn):
+        ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+        a, b = eqn.invars
+        an, bn = self.name_of(a), self.name_of(b)
+        ar, br = len(a.aval.shape), len(b.aval.shape)
+        if not lb and not rb and len(lc) == 1 and len(rc) == 1:
+            # plain 2D-style contraction; transpose so it's (..., k) x (k, n)
+            if lc[0] != ar - 1:
+                perm = [i for i in range(ar) if i != lc[0]] + [lc[0]]
+                an = self.emit("Transpose", [an], perm=perm)
+            if rc[0] != 0:
+                perm = [rc[0]] + [i for i in range(br) if i != rc[0]]
+                bn = self.emit("Transpose", [bn], perm=perm)
+            out = self.emit("MatMul", [an, bn])
+            self.bind(eqn.outvars[0], out)
+            return
+        if lb == (0,) and rb == (0,) and len(lc) == 1 and len(rc) == 1:
+            # single batch dim: BMM; move contracting dims to canonical spots
+            if lc[0] != ar - 1:
+                perm = [i for i in range(ar) if i != lc[0]] + [lc[0]]
+                an = self.emit("Transpose", [an], perm=perm)
+            if rc[0] != 1:
+                perm = [0, rc[0]] + [i for i in range(1, br) if i != rc[0]]
+                bn = self.emit("Transpose", [bn], perm=perm)
+            out = self.emit("MatMul", [an, bn])
+            self.bind(eqn.outvars[0], out)
+            return
+        raise MXNetError("ONNX export: unsupported dot_general layout "
+                         f"{eqn.params['dimension_numbers']}")
+
+    def _p_conv_general_dilated(self, eqn):
+        p = eqn.params
+        dn = p["dimension_numbers"]
+        if dn.lhs_spec[:2] != (0, 1) or dn.rhs_spec[:2] != (0, 1):
+            raise MXNetError("ONNX export: conv layout must be NCHW/OIHW")
+        pads = p["padding"]
+        onnx_pads = [lo for lo, _ in pads] + [hi for _, hi in pads]
+        out = self.emit(
+            "Conv",
+            [self.name_of(eqn.invars[0]), self.name_of(eqn.invars[1])],
+            strides=list(p["window_strides"]),
+            pads=onnx_pads,
+            dilations=list(p["rhs_dilation"]),
+            group=int(p["feature_group_count"]))
+        self.bind(eqn.outvars[0], out)
+
+    def _p_reduce_window_max(self, eqn):
+        p = eqn.params
+        dims = p["window_dimensions"]
+        if dims[0] != 1 or dims[1] != 1:
+            raise MXNetError("ONNX export: pooling must be spatial (NCHW)")
+        pads = p["padding"]
+        onnx_pads = [lo for lo, _ in pads[2:]] + [hi for _, hi in pads[2:]]
+        out = self.emit("MaxPool", [self.name_of(eqn.invars[0])],
+                        kernel_shape=list(dims[2:]),
+                        strides=list(p["window_strides"][2:]),
+                        pads=onnx_pads)
+        self.bind(eqn.outvars[0], out)
+
+    def _p_reduce_window_sum(self, eqn):
+        # jax avg-pool = reduce_window_sum / window_size; emit the sum as
+        # AveragePool * window_size so the later div folds exactly
+        p = eqn.params
+        dims = p["window_dimensions"]
+        if dims[0] != 1 or dims[1] != 1:
+            raise MXNetError("ONNX export: pooling must be spatial (NCHW)")
+        pads = p["padding"]
+        onnx_pads = [lo for lo, _ in pads[2:]] + [hi for _, hi in pads[2:]]
+        ap = self.emit("AveragePool", [self.name_of(eqn.invars[0])],
+                       kernel_shape=list(dims[2:]),
+                       strides=list(p["window_strides"][2:]),
+                       pads=onnx_pads, count_include_pad=1)
+        wsize = float(_onp.prod(dims))
+        scale = self.const(_onp.asarray(wsize, _onp.float32))
+        out = self.emit("Mul", [ap, scale])
+        self.bind(eqn.outvars[0], out)
+
+    def _p_erf(self, eqn):
+        out = self.emit("Erf", [self.name_of(eqn.invars[0])])
+        self.bind(eqn.outvars[0], out)
+
+    def _p_log1p(self, eqn):
+        one = self.const(_onp.asarray(1.0, _onp.float32))
+        s = self.emit("Add", [self.name_of(eqn.invars[0]), one])
+        self.bind(eqn.outvars[0], self.emit("Log", [s]))
+
+    def _p_expm1(self, eqn):
+        one = self.const(_onp.asarray(1.0, _onp.float32))
+        e = self.emit("Exp", [self.name_of(eqn.invars[0])])
+        self.bind(eqn.outvars[0], self.emit("Sub", [e, one]))
+
+
+# primitives that are 1:1 elementwise/binary renames
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow", "neg": "Neg",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign", "floor": "Floor",
+    "ceil": "Ceil", "round": "Round", "is_finite": "IsInf",
+    "eq": "Equal", "lt": "Less", "gt": "Greater",
+    "le": "LessOrEqual", "ge": "GreaterOrEqual",
+    "sin": "Sin", "cos": "Cos", "atan": "Atan", "asin": "Asin",
+    "acos": "Acos", "sinh": "Sinh", "cosh": "Cosh",
+}
+
+
+def export_model(block, example_inputs, path=None, producer="mxnet_tpu"):
+    """Export a HybridBlock (or pure fn) to ONNX bytes (and optionally a
+    file). ``example_inputs``: tuple of NDArrays/arrays fixing shapes.
+
+    Returns the serialized ``ModelProto`` bytes.
+    """
+    import jax
+
+    from ...ndarray.ndarray import NDArray
+    from ...parallel.functional import functionalize
+
+    if not isinstance(example_inputs, (tuple, list)):
+        example_inputs = (example_inputs,)
+    datas = [x._data if isinstance(x, NDArray) else _onp.asarray(x)
+             for x in example_inputs]
+
+    if callable(block) and not hasattr(block, "collect_params"):
+        fn = block
+    else:
+        apply_fn, params = functionalize(block, train_mode=False)
+
+        def fn(*xs):
+            return apply_fn(params, *xs)
+
+    closed = jax.make_jaxpr(fn)(*datas)
+    live_eqns = _dce(closed.jaxpr)
+    ex = _Exporter()
+    in_names = []
+    graph_inputs = []
+    for i, (var, d) in enumerate(zip(closed.jaxpr.invars, datas)):
+        nm = f"input_{i}"
+        in_names.append(nm)
+        ex.bind(var, nm)
+        graph_inputs.append(
+            (nm, np_to_onnx_dtype(_onp.asarray(d).dtype),
+             list(_onp.asarray(d).shape)))
+    for var, cval in zip(closed.jaxpr.constvars, closed.consts):
+        ex.bind(var, ex.const(_onp.asarray(cval), "param"))
+    for eqn in live_eqns:
+        ex.eqn(eqn)
+    graph_outputs = []
+    out_names = []
+    for i, var in enumerate(closed.jaxpr.outvars):
+        nm = ex.name_of(var)
+        out_names.append(nm)
+        graph_outputs.append(
+            (nm, np_to_onnx_dtype(var.aval.dtype), list(var.aval.shape)))
+    graph = Graph("mxnet_tpu_graph", ex.nodes, graph_inputs, graph_outputs,
+                  ex.initializers)
+    blob = Model(graph, producer=producer).encode()
+    if path:
+        with open(path, "wb") as fh:
+            fh.write(blob)
+    return blob

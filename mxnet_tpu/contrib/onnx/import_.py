@@ -1,0 +1,291 @@
+"""ONNX -> executable importer.
+
+Reference: ``python/mxnet/contrib/onnx/onnx2mx`` rebuilds an nnvm symbol;
+here the graph interprets straight onto jnp ops and returns an
+``ONNXBlock`` (a HybridBlock), so imported models hybridize into one XLA
+program like any native net. Covers the ONNX-17 op subset produced by the
+exporter plus the common inference ops (Gemm, Clip/Relu, Softmax,
+BatchNormalization, Gather, GlobalAveragePool...).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as _onp
+
+from ...base import MXNetError
+from ...gluon.block import HybridBlock
+from ...ndarray.ndarray import NDArray
+from .serde import _ONNX2NP, Model
+
+
+def _conv(env, node, jnp, lax):
+    x, w = env[node.inputs[0]], env[node.inputs[1]]
+    b = env[node.inputs[2]] if len(node.inputs) > 2 else None
+    nd = x.ndim - 2
+    strides = tuple(node.attrs.get("strides", [1] * nd))
+    dil = tuple(node.attrs.get("dilations", [1] * nd))
+    group = int(node.attrs.get("group", 1))
+    pads = node.attrs.get("pads", [0] * (2 * nd))
+    pad = tuple((int(pads[i]), int(pads[i + nd])) for i in range(nd))
+    dnums = lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCW", "OIW", "NCW"))
+    out = lax.conv_general_dilated(x, w, strides, pad, rhs_dilation=dil,
+                                   dimension_numbers=dnums,
+                                   feature_group_count=group)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _pool(env, node, jnp, lax, kind):
+    x = env[node.inputs[0]]
+    nd = x.ndim - 2
+    k = tuple(node.attrs["kernel_shape"])
+    strides = tuple(node.attrs.get("strides", [1] * nd))
+    pads = node.attrs.get("pads", [0] * (2 * nd))
+    pad = (((0, 0), (0, 0)) +
+           tuple((int(pads[i]), int(pads[i + nd])) for i in range(nd)))
+    dims = (1, 1) + k
+    str_full = (1, 1) + strides
+    if kind == "max":
+        init = -_onp.inf
+        out = lax.reduce_window(x, init, lax.max, dims, str_full, pad)
+        return out
+    out = lax.reduce_window(x, 0.0, lax.add, dims, str_full, pad)
+    if int(node.attrs.get("count_include_pad", 0)):
+        return out / float(_onp.prod(k))
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, str_full, pad)
+    return out / counts
+
+
+def _gemm(env, node, jnp):
+    a, b = env[node.inputs[0]], env[node.inputs[1]]
+    if int(node.attrs.get("transA", 0)):
+        a = a.T
+    if int(node.attrs.get("transB", 0)):
+        b = b.T
+    out = float(node.attrs.get("alpha", 1.0)) * (a @ b)
+    if len(node.inputs) > 2:
+        out = out + float(node.attrs.get("beta", 1.0)) * env[node.inputs[2]]
+    return out
+
+
+def _batchnorm(env, node, jnp):
+    x, scale, bias, mean, var = (env[n] for n in node.inputs[:5])
+    eps = float(node.attrs.get("epsilon", 1e-5))
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return ((x - mean.reshape(shape)) /
+            jnp.sqrt(var.reshape(shape) + eps) * scale.reshape(shape)
+            + bias.reshape(shape))
+
+
+def _slice(env, node, jnp):
+    x = env[node.inputs[0]]
+    starts = _onp.asarray(env[node.inputs[1]]).tolist()
+    ends = _onp.asarray(env[node.inputs[2]]).tolist()
+    axes = (_onp.asarray(env[node.inputs[3]]).tolist()
+            if len(node.inputs) > 3 else list(range(len(starts))))
+    steps = (_onp.asarray(env[node.inputs[4]]).tolist()
+             if len(node.inputs) > 4 else [1] * len(starts))
+    idx = [slice(None)] * x.ndim
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        e = min(e, x.shape[a])
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def _reduce(env, node, jnp, fn):
+    x = env[node.inputs[0]]
+    if len(node.inputs) > 1:
+        axes = tuple(_onp.asarray(env[node.inputs[1]]).tolist())
+    else:
+        axes = tuple(node.attrs.get("axes", range(x.ndim)))
+    keep = bool(node.attrs.get("keepdims", 1))
+    return fn(x, axis=axes, keepdims=keep)
+
+
+def _run_node(node, env):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    op = node.op_type
+    A = node.attrs
+    ins = node.inputs
+
+    def i(k=0):
+        return env[ins[k]]
+
+    if op == "Conv":
+        return _conv(env, node, jnp, lax)
+    if op == "MaxPool":
+        return _pool(env, node, jnp, lax, "max")
+    if op == "AveragePool":
+        return _pool(env, node, jnp, lax, "avg")
+    if op == "GlobalAveragePool":
+        return i().mean(axis=tuple(range(2, i().ndim)), keepdims=True)
+    if op == "MatMul":
+        return i(0) @ i(1)
+    if op == "Gemm":
+        return _gemm(env, node, jnp)
+    if op == "BatchNormalization":
+        return _batchnorm(env, node, jnp)
+    if op == "Reshape":
+        return i(0).reshape(
+            tuple(int(x) for x in _onp.asarray(i(1)).tolist()))
+    if op == "Transpose":
+        return jnp.transpose(i(), A.get("perm"))
+    if op == "Expand":
+        target = [int(x) for x in _onp.asarray(i(1)).tolist()]
+        x = i(0)
+        shape = list(x.shape)
+        if len(shape) < len(target):
+            shape = [1] * (len(target) - len(shape)) + shape
+            x = x.reshape(shape)
+        out_shape = [max(s, t) for s, t in zip(shape, target)]
+        return jnp.broadcast_to(x, out_shape)
+    if op == "Concat":
+        return jnp.concatenate([env[n] for n in ins], axis=int(A["axis"]))
+    if op == "Slice":
+        return _slice(env, node, jnp)
+    if op == "Cast":
+        return i().astype(_ONNX2NP[int(A["to"])])
+    if op == "Where":
+        return jnp.where(i(0), i(1), i(2))
+    if op == "Clip":
+        lo = env[ins[1]] if len(ins) > 1 and ins[1] else None
+        hi = env[ins[2]] if len(ins) > 2 and ins[2] else None
+        return jnp.clip(i(0), lo, hi)
+    if op == "Relu":
+        return jax.nn.relu(i())
+    if op == "LeakyRelu":
+        return jax.nn.leaky_relu(i(), A.get("alpha", 0.01))
+    if op == "Elu":
+        return jax.nn.elu(i(), A.get("alpha", 1.0))
+    if op == "Softmax":
+        return jax.nn.softmax(i(), axis=int(A.get("axis", -1)))
+    if op == "LogSoftmax":
+        return jax.nn.log_softmax(i(), axis=int(A.get("axis", -1)))
+    if op == "Flatten":
+        ax = int(A.get("axis", 1))
+        x = i()
+        return x.reshape((int(_onp.prod(x.shape[:ax])), -1))
+    if op == "Identity":
+        return i()
+    if op == "Gather":
+        return jnp.take(i(0), i(1), axis=int(A.get("axis", 0)))
+    if op == "Unsqueeze":
+        axes = (_onp.asarray(i(1)).tolist() if len(ins) > 1
+                else A.get("axes"))
+        x = i(0)
+        for a in sorted(axes):
+            x = jnp.expand_dims(x, int(a))
+        return x
+    if op == "Squeeze":
+        axes = (_onp.asarray(i(1)).tolist() if len(ins) > 1
+                else A.get("axes", None))
+        return jnp.squeeze(i(0), tuple(int(a) for a in axes)
+                           if axes else None)
+    if op == "Shape":
+        return jnp.asarray(i().shape, jnp.int64)
+    if op == "Constant":
+        return jnp.asarray(A["value"].array)
+    if op == "ReduceSum":
+        return _reduce(env, node, jnp, jnp.sum)
+    if op == "ReduceMean":
+        return _reduce(env, node, jnp, jnp.mean)
+    if op == "ReduceMax":
+        return _reduce(env, node, jnp, jnp.max)
+    if op == "ReduceMin":
+        return _reduce(env, node, jnp, jnp.min)
+    if op == "ArgMax":
+        return jnp.argmax(i(), axis=int(A.get("axis", 0)))
+    if op == "Erf":
+        import jax.scipy.special as jss
+
+        return jss.erf(i())
+    if op in ("Add", "Sub", "Mul", "Div", "Pow", "Max", "Min",
+              "Equal", "Less", "Greater", "LessOrEqual", "GreaterOrEqual"):
+        fn = {"Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+              "Div": jnp.divide, "Pow": jnp.power, "Max": jnp.maximum,
+              "Min": jnp.minimum, "Equal": jnp.equal, "Less": jnp.less,
+              "Greater": jnp.greater, "LessOrEqual": jnp.less_equal,
+              "GreaterOrEqual": jnp.greater_equal}[op]
+        return fn(i(0), i(1))
+    if op in ("Exp", "Log", "Tanh", "Sigmoid", "Sqrt", "Abs", "Neg",
+              "Sign", "Floor", "Ceil", "Round", "Reciprocal",
+              "Sin", "Cos", "Atan", "Asin", "Acos", "Sinh", "Cosh"):
+        fn = {"Exp": jnp.exp, "Log": jnp.log, "Tanh": jnp.tanh,
+              "Sigmoid": jax.nn.sigmoid, "Sqrt": jnp.sqrt, "Abs": jnp.abs,
+              "Neg": jnp.negative, "Sign": jnp.sign, "Floor": jnp.floor,
+              "Ceil": jnp.ceil, "Round": jnp.round,
+              "Reciprocal": jnp.reciprocal, "Sin": jnp.sin, "Cos": jnp.cos,
+              "Atan": jnp.arctan, "Asin": jnp.arcsin, "Acos": jnp.arccos,
+              "Sinh": jnp.sinh, "Cosh": jnp.cosh}[op]
+        return fn(i())
+    raise MXNetError(f"ONNX import: unsupported op {op!r}")
+
+
+class ONNXBlock(HybridBlock):
+    """Imported ONNX graph as a HybridBlock (SymbolBlock.imports analog):
+    forward interprets the node list on jnp; hybridize() compiles it."""
+
+    def __init__(self, model: Model, **kwargs):
+        super().__init__(**kwargs)
+        self.model = model
+        g = model.graph
+        self._init_arrays: Dict[str, _onp.ndarray] = {
+            t.name: t.array for t in g.initializers}
+        init_names = set(self._init_arrays)
+        self._input_names = [nm for nm, _, _ in g.inputs
+                             if nm not in init_names]
+        self._output_names = [nm for nm, _, _ in g.outputs]
+
+    def forward(self, *args):
+        from ...ops import registry as _registry
+
+        datas = tuple(a._data if isinstance(a, NDArray) else a
+                      for a in args)
+
+        def run(*xs):
+            import jax.numpy as jnp
+
+            env = {nm: jnp.asarray(arr)
+                   for nm, arr in self._init_arrays.items()}
+            env[""] = None
+            for nm, x in zip(self._input_names, xs):
+                env[nm] = x
+            for node in self.model.graph.nodes:
+                outs = _run_node(node, env)
+                if len(node.outputs) == 1:
+                    env[node.outputs[0]] = outs
+                else:
+                    for o, v in zip(node.outputs, outs):
+                        env[o] = v
+            outs = [env[nm] for nm in self._output_names]
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+        out = _registry.apply(run, [NDArray(d) for d in datas],
+                              name="onnx_graph", cacheable=False)
+        return out
+
+
+def import_model(path_or_bytes):
+    """Load an ONNX model file/bytes -> (ONNXBlock, params dict).
+
+    Reference API: ``mx.contrib.onnx.import_model(model_file)``.
+    """
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        blob = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as fh:
+            blob = fh.read()
+    model = Model.decode(blob)
+    if model.graph is None:
+        raise MXNetError("not an ONNX ModelProto (no graph)")
+    block = ONNXBlock(model)
+    params = {t.name: NDArray(t.array) for t in model.graph.initializers}
+    return block, params

@@ -1,0 +1,437 @@
+"""Minimal protobuf wire-format codec + the ONNX message subset.
+
+The environment ships no ``onnx`` package, so this module implements the
+protobuf encoding itself (varints, length-delimited fields — the public
+wire format) and the ONNX schema subset needed for model interchange:
+ModelProto / GraphProto / NodeProto / AttributeProto / TensorProto /
+ValueInfoProto, with the standard ONNX field numbers. Files produced here
+load in stock ``onnx``/onnxruntime, and stock ONNX files parse back.
+
+Reference role: ``python/mxnet/contrib/onnx/`` (mx2onnx serialization
+bottom layer).
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as _onp
+
+# -- wire primitives ---------------------------------------------------------
+
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+def _w_varint(out: bytearray, v: int):
+    if v < 0:
+        v += 1 << 64
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _w_tag(out: bytearray, fieldno: int, wtype: int):
+    _w_varint(out, (fieldno << 3) | wtype)
+
+
+def _w_len(out: bytearray, fieldno: int, payload: bytes):
+    _w_tag(out, fieldno, _LEN)
+    _w_varint(out, len(payload))
+    out += payload
+
+
+def _w_int(out: bytearray, fieldno: int, v: int):
+    _w_tag(out, fieldno, _VARINT)
+    _w_varint(out, int(v))
+
+
+def _w_str(out: bytearray, fieldno: int, s: str):
+    _w_len(out, fieldno, s.encode("utf-8"))
+
+
+def _w_float(out: bytearray, fieldno: int, v: float):
+    _w_tag(out, fieldno, _I32)
+    out += struct.pack("<f", v)
+
+
+def _r_varint(buf: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            if result >= 1 << 63:
+                result -= 1 << 64
+            return result, pos
+        shift += 7
+
+
+def parse_fields(buf: bytes):
+    """Yield (fieldno, wiretype, value) triples from one message body."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _r_varint(buf, pos)
+        fieldno, wtype = key >> 3, key & 7
+        if wtype == _VARINT:
+            v, pos = _r_varint(buf, pos)
+        elif wtype == _I64:
+            v = buf[pos:pos + 8]
+            pos += 8
+        elif wtype == _LEN:
+            ln, pos = _r_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wtype == _I32:
+            v = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wtype}")
+        yield fieldno, wtype, v
+
+
+# -- ONNX dtype table --------------------------------------------------------
+
+_NP2ONNX = {
+    _onp.dtype("float32"): 1, _onp.dtype("uint8"): 2,
+    _onp.dtype("int8"): 3, _onp.dtype("int16"): 5,
+    _onp.dtype("int32"): 6, _onp.dtype("int64"): 7,
+    _onp.dtype("bool"): 9, _onp.dtype("float16"): 10,
+    _onp.dtype("float64"): 11, _onp.dtype("uint32"): 12,
+    _onp.dtype("uint64"): 13,
+}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+# bfloat16 (ONNX 16) has no numpy dtype; exported as raw uint16 payload
+ONNX_BFLOAT16 = 16
+
+
+def np_to_onnx_dtype(dt) -> int:
+    return _NP2ONNX[_onp.dtype(dt)]
+
+
+# -- schema messages ---------------------------------------------------------
+
+
+@dataclass
+class Tensor:
+    """TensorProto: dims=1, data_type=2, raw_data=9, name=8."""
+
+    name: str
+    array: _onp.ndarray
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        for d in self.array.shape:
+            _w_int(out, 1, d)
+        _w_int(out, 2, np_to_onnx_dtype(self.array.dtype))
+        _w_str(out, 8, self.name)
+        _w_len(out, 9, _onp.ascontiguousarray(self.array).tobytes())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Tensor":
+        dims: List[int] = []
+        dtype = 1
+        name = ""
+        raw = b""
+        floats: List[float] = []
+        ints: List[int] = []
+        for f, w, v in parse_fields(buf):
+            if f == 1:
+                if w == _VARINT:
+                    dims.append(v)
+                else:  # packed
+                    pos = 0
+                    while pos < len(v):
+                        x, pos = _r_varint(v, pos)
+                        dims.append(x)
+            elif f == 2:
+                dtype = v
+            elif f == 8:
+                name = v.decode("utf-8")
+            elif f == 9:
+                raw = v
+            elif f == 4:  # float_data (non-raw encoding)
+                if w == _LEN:
+                    floats.extend(struct.unpack(f"<{len(v)//4}f", v))
+                else:
+                    floats.append(struct.unpack("<f", v)[0])
+            elif f == 7:  # int64_data
+                if w == _VARINT:
+                    ints.append(v)
+                else:
+                    pos = 0
+                    while pos < len(v):
+                        x, pos = _r_varint(v, pos)
+                        ints.append(x)
+        np_dt = _ONNX2NP.get(dtype, _onp.dtype("float32"))
+        if raw:
+            arr = _onp.frombuffer(raw, dtype=np_dt).reshape(dims)
+        elif floats:
+            arr = _onp.asarray(floats, np_dt).reshape(dims)
+        elif ints:
+            arr = _onp.asarray(ints, np_dt).reshape(dims)
+        else:
+            arr = _onp.zeros(dims, np_dt)
+        return cls(name, arr)
+
+
+@dataclass
+class Attribute:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    type=20. Types: FLOAT=1 INT=2 STRING=3 TENSOR=4 FLOATS=6 INTS=7."""
+
+    name: str
+    value: object
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        _w_str(out, 1, self.name)
+        v = self.value
+        if isinstance(v, bool):
+            v = int(v)
+        if isinstance(v, int):
+            _w_int(out, 3, v)
+            _w_int(out, 20, 2)
+        elif isinstance(v, float):
+            _w_float(out, 2, v)
+            _w_int(out, 20, 1)
+        elif isinstance(v, str):
+            _w_str(out, 4, v)
+            _w_int(out, 20, 3)
+        elif isinstance(v, Tensor):
+            _w_len(out, 5, v.encode())
+            _w_int(out, 20, 4)
+        elif isinstance(v, (list, tuple)) and all(
+                isinstance(x, int) for x in v):
+            for x in v:
+                _w_int(out, 8, x)
+            _w_int(out, 20, 7)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                _w_float(out, 7, float(x))
+            _w_int(out, 20, 6)
+        else:
+            raise ValueError(f"unsupported attribute {self.name}={v!r}")
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Attribute":
+        name = ""
+        ints: List[int] = []
+        floats: List[float] = []
+        sval: Optional[bytes] = None
+        fval: Optional[float] = None
+        ival: Optional[int] = None
+        tval: Optional[Tensor] = None
+        atype = 0
+        for f, w, v in parse_fields(buf):
+            if f == 1:
+                name = v.decode("utf-8")
+            elif f == 2:
+                fval = struct.unpack("<f", v)[0]
+            elif f == 3:
+                ival = v
+            elif f == 4:
+                sval = v
+            elif f == 5:
+                tval = Tensor.decode(v)
+            elif f == 7:
+                if w == _LEN:
+                    floats.extend(struct.unpack(f"<{len(v)//4}f", v))
+                else:
+                    floats.append(struct.unpack("<f", v)[0])
+            elif f == 8:
+                if w == _VARINT:
+                    ints.append(v)
+                else:
+                    pos = 0
+                    while pos < len(v):
+                        x, pos = _r_varint(v, pos)
+                        ints.append(x)
+            elif f == 20:
+                atype = v
+        if atype == 7 or (not atype and ints):
+            return cls(name, list(ints))
+        if atype == 6 or (not atype and floats):
+            return cls(name, list(floats))
+        if atype == 4 or tval is not None:
+            return cls(name, tval)
+        if atype == 3 or sval is not None:
+            return cls(name, sval.decode("utf-8") if sval else "")
+        if atype == 1 or fval is not None:
+            return cls(name, fval)
+        return cls(name, ival if ival is not None else 0)
+
+
+@dataclass
+class Node:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+
+    op_type: str
+    inputs: List[str]
+    outputs: List[str]
+    name: str = ""
+    attrs: Dict[str, object] = field(default_factory=dict)
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        for s in self.inputs:
+            _w_str(out, 1, s)
+        for s in self.outputs:
+            _w_str(out, 2, s)
+        if self.name:
+            _w_str(out, 3, self.name)
+        _w_str(out, 4, self.op_type)
+        for k in sorted(self.attrs):
+            _w_len(out, 5, Attribute(k, self.attrs[k]).encode())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Node":
+        inputs, outputs, attrs = [], [], {}
+        name = op_type = ""
+        for f, _w, v in parse_fields(buf):
+            if f == 1:
+                inputs.append(v.decode("utf-8"))
+            elif f == 2:
+                outputs.append(v.decode("utf-8"))
+            elif f == 3:
+                name = v.decode("utf-8")
+            elif f == 4:
+                op_type = v.decode("utf-8")
+            elif f == 5:
+                a = Attribute.decode(v)
+                attrs[a.name] = a.value
+        return cls(op_type, inputs, outputs, name, attrs)
+
+
+def _encode_value_info(name: str, dtype: int, shape) -> bytes:
+    # ValueInfoProto{name=1, type=2}; TypeProto{tensor_type=1};
+    # TypeProto.Tensor{elem_type=1, shape=2}; TensorShapeProto{dim=1};
+    # Dimension{dim_value=1}
+    shape_pb = bytearray()
+    for d in shape:
+        dim = bytearray()
+        _w_int(dim, 1, int(d))
+        _w_len(shape_pb, 1, bytes(dim))
+    tensor = bytearray()
+    _w_int(tensor, 1, dtype)
+    _w_len(tensor, 2, bytes(shape_pb))
+    tp = bytearray()
+    _w_len(tp, 1, bytes(tensor))
+    vi = bytearray()
+    _w_str(vi, 1, name)
+    _w_len(vi, 2, bytes(tp))
+    return bytes(vi)
+
+
+def _decode_value_info(buf: bytes):
+    name = ""
+    dtype = 1
+    shape: List[int] = []
+    for f, _w, v in parse_fields(buf):
+        if f == 1:
+            name = v.decode("utf-8")
+        elif f == 2:
+            for f2, _w2, v2 in parse_fields(v):
+                if f2 == 1:  # tensor_type
+                    for f3, _w3, v3 in parse_fields(v2):
+                        if f3 == 1:
+                            dtype = v3
+                        elif f3 == 2:
+                            for f4, _w4, v4 in parse_fields(v3):
+                                if f4 == 1:
+                                    for f5, _w5, v5 in parse_fields(v4):
+                                        if f5 == 1:
+                                            shape.append(v5)
+    return name, dtype, shape
+
+
+@dataclass
+class Graph:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+
+    name: str
+    nodes: List[Node]
+    inputs: List[tuple]        # (name, onnx_dtype, shape)
+    outputs: List[tuple]
+    initializers: List[Tensor]
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        for n in self.nodes:
+            _w_len(out, 1, n.encode())
+        _w_str(out, 2, self.name)
+        for t in self.initializers:
+            _w_len(out, 5, t.encode())
+        for nm, dt, shp in self.inputs:
+            _w_len(out, 11, _encode_value_info(nm, dt, shp))
+        for nm, dt, shp in self.outputs:
+            _w_len(out, 12, _encode_value_info(nm, dt, shp))
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Graph":
+        name = ""
+        nodes, inits, inputs, outputs = [], [], [], []
+        for f, _w, v in parse_fields(buf):
+            if f == 1:
+                nodes.append(Node.decode(v))
+            elif f == 2:
+                name = v.decode("utf-8")
+            elif f == 5:
+                inits.append(Tensor.decode(v))
+            elif f == 11:
+                inputs.append(_decode_value_info(v))
+            elif f == 12:
+                outputs.append(_decode_value_info(v))
+        return cls(name, nodes, inputs, outputs, inits)
+
+
+@dataclass
+class Model:
+    """ModelProto: ir_version=1, producer=2, graph=7, opset_import=8."""
+
+    graph: Graph
+    ir_version: int = 8
+    opset: int = 17
+    producer: str = "mxnet_tpu"
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        _w_int(out, 1, self.ir_version)
+        _w_str(out, 2, self.producer)
+        _w_len(out, 7, self.graph.encode())
+        opset = bytearray()
+        _w_str(opset, 1, "")          # default domain
+        _w_int(opset, 2, self.opset)
+        _w_len(out, 8, bytes(opset))
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Model":
+        graph = None
+        ir = 8
+        opset = 17
+        producer = ""
+        for f, _w, v in parse_fields(buf):
+            if f == 1:
+                ir = v
+            elif f == 2:
+                producer = v.decode("utf-8")
+            elif f == 7:
+                graph = Graph.decode(v)
+            elif f == 8:
+                for f2, _w2, v2 in parse_fields(v):
+                    if f2 == 2:
+                        opset = v2
+        return cls(graph, ir, opset, producer)

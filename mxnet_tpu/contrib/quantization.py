@@ -1,13 +1,15 @@
 """INT8 quantization (reference ``src/operator/quantization/`` 6,744 LoC +
 ``python/mxnet/contrib/quantization.py`` ``quantize_net``).
 
-TPU-first design: int8 matmul/conv run on the MXU at 2x the bf16 rate
+TPU-first design: int8 matmuls run on the MXU at 2x the bf16 rate
 (v5e: 394 TOPS int8 vs 197 TFLOPS bf16), so quantized inference is a dot
 with ``preferred_element_type=int32`` plus a float rescale that XLA fuses
-into the surrounding elementwise work. No graph pass is needed — layers
-are swapped wholesale (`quantize_net`), the analog of the reference's
-``QuantizeGraph`` pass reached via ``MXQuantizeSymbol``
-(``src/c_api/c_api_symbolic.cc:926``).
+into the surrounding elementwise work. XLA currently lowers int8 *convs*
+poorly on TPU (measured ~1000x off peak), so QuantizedConv rewrites the
+conv as im2col slices + one int8 matmul — MXU-native by construction. No
+graph pass is needed — layers are swapped wholesale (`quantize_net`), the
+analog of the reference's ``QuantizeGraph`` pass reached via
+``MXQuantizeSymbol`` (``src/c_api/c_api_symbolic.cc:926``).
 
 Calibration matches the reference's two modes (``calibrate.cc``):
 * ``naive`` — per-layer input absmax.
@@ -302,13 +304,45 @@ class QuantizedConv(HybridBlock):
         def f(xd):
             qx = jnp.clip(jnp.round(xd / xs), -INT8_MAX,
                           INT8_MAX).astype(jnp.int8)
-            dn = lax.conv_dimension_numbers(qx.shape, qw.shape,
-                                            ("NCHW", "OIHW", "NCHW"))
-            pad = [(p, p) for p in padding]
-            acc = lax.conv_general_dilated(
-                qx, jnp.asarray(qw), strides, pad, rhs_dilation=dilation,
-                dimension_numbers=dn, feature_group_count=groups,
-                preferred_element_type=jnp.int32)
+            if groups == 1:
+                # im2col + int8 MatMul: XLA lowers int8 *dot* onto the MXU
+                # int8 path (~2x bf16 rate) but int8 *conv* poorly — so the
+                # conv becomes shifted slices (VPU data movement) and one
+                # int32-accumulating matmul, the quantized_conv.cc role
+                # done in MXU-native form.
+                n, c, h, w = qx.shape
+                o, _, kh, kw = qw.shape
+                ph, pw = padding
+                qx_p = jnp.pad(qx, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+                oh = (h + 2 * ph - dilation[0] * (kh - 1) - 1) // strides[0] + 1
+                ow = (w + 2 * pw - dilation[1] * (kw - 1) - 1) // strides[1] + 1
+                cols = []
+                for i in range(kh):
+                    for j in range(kw):
+                        di, dj = i * dilation[0], j * dilation[1]
+                        cols.append(lax.slice(
+                            qx_p, (0, 0, di, dj),
+                            (n, c, di + (oh - 1) * strides[0] + 1,
+                             dj + (ow - 1) * strides[1] + 1),
+                            (1, 1, strides[0], strides[1])))
+                patches = jnp.concatenate(cols, axis=1)  # (N, C*kh*kw, OH, OW)
+                pk = patches.reshape(n, c * kh * kw, oh * ow)
+                wflat = jnp.asarray(
+                    qw.transpose(0, 2, 3, 1).reshape(o, kh * kw * c))
+                # patch channel order is (kh, kw, c) after the concat above
+                acc = lax.dot_general(
+                    wflat, pk, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32)  # (O, N, OH*OW)
+                acc = acc.transpose(1, 0, 2).reshape(n, o, oh, ow)
+            else:
+                dn = lax.conv_dimension_numbers(qx.shape, qw.shape,
+                                                ("NCHW", "OIHW", "NCHW"))
+                pad = [(p, p) for p in padding]
+                acc = lax.conv_general_dilated(
+                    qx, jnp.asarray(qw), strides, pad,
+                    rhs_dilation=dilation, dimension_numbers=dn,
+                    feature_group_count=groups,
+                    preferred_element_type=jnp.int32)
             out = acc.astype(jnp.float32) * (
                 jnp.asarray(ws) * xs)[None, :, None, None]
             if bias is not None:
@@ -348,6 +382,11 @@ def quantize_net(net, calib_data=None, calib_mode="entropy",
     if calib_mode not in ("naive", "entropy"):
         raise MXNetError(f"unknown calib_mode {calib_mode!r}")
     exclude = set(exclude_layers or ())
+
+    # calibration needs EAGER forwards: under a CachedOp trace the hooks
+    # would see tracers (asnumpy crashes) or, on a cache hit, not fire at
+    # all. De-hybridize; the caller re-hybridizes the quantized net.
+    net.hybridize(active=False)
 
     # 1. walk the tree, attach collectors
     targets = []  # (parent, child_name, layer, collector)

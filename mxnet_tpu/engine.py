@@ -26,12 +26,31 @@ result is blocked on immediately after dispatch.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
+import weakref
 
 from .base import env_str
 
 _state = threading.local()
+
+# recently dispatched arrays (weakrefs): wait_all() drains these instead of
+# blocking on every live array in the process (jax.live_arrays() is O(all
+# arrays ever alive) — pathological when waitall() runs once per epoch)
+_PENDING_MAX = 4096
+_pending = collections.deque(maxlen=_PENDING_MAX)
+_pending_lock = threading.Lock()
+
+
+def track_async(arrays):
+    """Record op outputs as outstanding async work for wait_all."""
+    with _pending_lock:
+        for a in arrays:
+            try:
+                _pending.append(weakref.ref(a))
+            except TypeError:
+                pass
 
 
 def engine_type() -> str:
@@ -52,7 +71,9 @@ def is_naive() -> bool:
 
 
 def maybe_sync(arrays):
-    """Called by the dispatch layer after each op when NaiveEngine is on."""
+    """Called by the dispatch layer after each op: tracks outputs for
+    wait_all, and blocks immediately when NaiveEngine is on."""
+    track_async(arrays)
     if is_naive():
         for a in arrays:
             try:
@@ -70,18 +91,35 @@ def wait_for_var(data):
 
 
 def wait_all():
-    """``MXNDArrayWaitAll`` analog: drain all outstanding async work."""
+    """``MXNDArrayWaitAll`` analog: drain outstanding async work.
+
+    Blocks on the recently-dispatched set (bounded deque of weakrefs) —
+    O(recent ops), not O(live arrays). ``MXNET_WAITALL_FULL=1`` restores
+    the exhaustive ``jax.live_arrays()`` sweep for debugging.
+    """
     import jax
 
     try:
         jax.effects_barrier()
     except Exception:
         pass
-    # block on every live sharded buffer the runtime still tracks
-    try:
-        jax.block_until_ready(jax.live_arrays())
-    except Exception:
-        pass
+    if env_str("MXNET_WAITALL_FULL", "0") == "1":
+        try:
+            jax.block_until_ready(jax.live_arrays())
+        except Exception:
+            pass
+        return
+    with _pending_lock:
+        refs = list(_pending)
+        _pending.clear()
+    for r in refs:
+        a = r()
+        if a is None:
+            continue
+        try:
+            a.block_until_ready()
+        except Exception:
+            pass
 
 
 @contextlib.contextmanager

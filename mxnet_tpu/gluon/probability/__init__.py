@@ -9,16 +9,35 @@ from .distributions import (
     Bernoulli,
     Beta,
     Categorical,
+    Cauchy,
+    Chi2,
     Dirichlet,
     Distribution,
     Exponential,
     Gamma,
+    Geometric,
+    Gumbel,
+    HalfNormal,
     Laplace,
     MultivariateNormal,
     Normal,
     Poisson,
+    StudentT,
     Uniform,
+    Weibull,
     kl_divergence,
     register_kl,
+)
+from . import transformation
+from .transformation import (
+    AbsTransform,
+    AffineTransform,
+    ComposeTransform,
+    ExpTransform,
+    PowerTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    Transformation,
+    TransformedDistribution,
 )
 from .stochastic_block import StochasticBlock, StochasticSequential

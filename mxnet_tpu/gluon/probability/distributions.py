@@ -572,3 +572,243 @@ def _kl_categorical_categorical(p, q):
         return jnp.sum(jnp.exp(plog) * (plog - qlog), -1)
 
     return _wrap(f, p.logit, q.logit, name="kl_categorical")
+
+
+class StudentT(Distribution):
+    """Student's t (reference studentT.py)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.df = mnp.array(df) if not hasattr(df, "_data") else df
+        self.loc = mnp.array(loc) if not hasattr(loc, "_data") else loc
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, df, loc, scale):
+            import jax.scipy.special as jss
+
+            z = (v - loc) / scale
+            return (jss.gammaln((df + 1) / 2) - jss.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(scale)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+
+        return _wrap(f, value, self.df, self.loc, self.scale,
+                     name="studentt_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.loc)
+
+        def f(df, loc, scale):
+            return loc + scale * jr.t(key, df, shape)
+
+        return _wrap(f, self.df, self.loc, self.scale, name="studentt_sample")
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        from ... import numpy as mnp
+
+        return self.scale ** 2 * self.df / (self.df - 2)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.loc = mnp.array(loc) if not hasattr(loc, "_data") else loc
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, loc, scale):
+            z = (v - loc) / scale
+            return -jnp.log(math.pi * scale * (1 + z ** 2))
+
+        return _wrap(f, value, self.loc, self.scale, name="cauchy_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.loc)
+
+        def f(loc, scale):
+            return loc + scale * jr.cauchy(key, shape)
+
+        return _wrap(f, self.loc, self.scale, name="cauchy_sample")
+
+
+class HalfNormal(Distribution):
+    def __init__(self, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, scale):
+            return (0.5 * math.log(2 / math.pi) - jnp.log(scale)
+                    - v ** 2 / (2 * scale ** 2)
+                    + jnp.where(v >= 0, 0.0, -jnp.inf))
+
+        return _wrap(f, value, self.scale, name="halfnormal_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.scale)
+
+        def f(scale):
+            return _jnp().abs(scale * jr.normal(key, shape))
+
+        return _wrap(f, self.scale, name="halfnormal_sample")
+
+    @property
+    def mean(self):
+        return self.scale * math.sqrt(2 / math.pi)
+
+
+class Chi2(Distribution):
+    def __init__(self, df, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.df = mnp.array(df) if not hasattr(df, "_data") else df
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, df):
+            import jax.scipy.special as jss
+
+            k = df / 2
+            return ((k - 1) * jnp.log(v) - v / 2 - jss.gammaln(k)
+                    - k * math.log(2.0))
+
+        return _wrap(f, value, self.df, name="chi2_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.df)
+
+        def f(df):
+            return 2.0 * jr.gamma(key, df / 2, shape)
+
+        return _wrap(f, self.df, name="chi2_sample")
+
+    @property
+    def mean(self):
+        return self.df
+
+    @property
+    def variance(self):
+        return 2 * self.df
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, prob, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.prob = mnp.array(prob) if not hasattr(prob, "_data") else prob
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, p):
+            return v * jnp.log1p(-p) + jnp.log(p)
+
+        return _wrap(f, value, self.prob, name="geometric_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.prob)
+
+        def f(p):
+            u = jr.uniform(key, shape, minval=1e-7, maxval=1.0)
+            return _jnp().floor(_jnp().log(u) / _jnp().log1p(-p))
+
+        return _wrap(f, self.prob, name="geometric_sample")
+
+    @property
+    def mean(self):
+        return (1 - self.prob) / self.prob
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.loc = mnp.array(loc) if not hasattr(loc, "_data") else loc
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, loc, scale):
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+
+        return _wrap(f, value, self.loc, self.scale, name="gumbel_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.loc)
+
+        def f(loc, scale):
+            return loc + scale * jr.gumbel(key, shape)
+
+        return _wrap(f, self.loc, self.scale, name="gumbel_sample")
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * 0.5772156649015329
+
+
+class Weibull(Distribution):
+    def __init__(self, concentration, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.concentration = mnp.array(concentration) \
+            if not hasattr(concentration, "_data") else concentration
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, k, scale):
+            z = v / scale
+            return (jnp.log(k / scale) + (k - 1) * jnp.log(z) - z ** k)
+
+        return _wrap(f, value, self.concentration, self.scale,
+                     name="weibull_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.concentration)
+
+        def f(k, scale):
+            u = jr.uniform(key, shape, minval=1e-7, maxval=1.0)
+            return scale * (-_jnp().log(u)) ** (1.0 / k)
+
+        return _wrap(f, self.concentration, self.scale, name="weibull_sample")

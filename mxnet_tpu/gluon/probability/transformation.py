@@ -1,0 +1,213 @@
+"""Bijective transformations + TransformedDistribution.
+
+Reference: ``python/mxnet/gluon/probability/transformation/transformation.py``
+(part of the 5,516-LoC probability package). Each transformation knows its
+forward map, inverse, and log|det J|, composing into reparameterized
+distributions — all jnp-traceable so transformed samples flow through jit
+and autograd.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from .distributions import Distribution, _data, _wrap
+
+
+def _as_nd(x):
+    return x if isinstance(x, NDArray) else NDArray(x)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class Transformation:
+    """Bijection y = f(x) with tractable inverse and log-det-Jacobian."""
+
+    bijective = True
+    sign = 1  # sign of the Jacobian determinant (for CDF transforms)
+
+    def __call__(self, x):
+        return _wrap(self._forward, _as_nd(x), name=type(self).__name__)
+
+    def inv(self, y):
+        return _wrap(self._inverse, _as_nd(y),
+                     name=type(self).__name__ + "_inv")
+
+    def log_det_jacobian(self, x, y):
+        return _wrap(self._log_det, _as_nd(x), _as_nd(y),
+                     name=type(self).__name__ + "_ldj")
+
+    # subclass hooks on raw jnp arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _log_det(self, x, y):
+        raise NotImplementedError
+
+
+class ExpTransform(Transformation):
+    """y = exp(x)."""
+
+    def _forward(self, x):
+        return _jnp().exp(x)
+
+    def _inverse(self, y):
+        return _jnp().log(y)
+
+    def _log_det(self, x, y):
+        return x
+
+
+class AffineTransform(Transformation):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc = _data(loc)
+        self.scale = _data(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _log_det(self, x, y):
+        jnp = _jnp()
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class PowerTransform(Transformation):
+    """y = x ** exponent (x > 0)."""
+
+    def __init__(self, exponent):
+        self.exponent = _data(exponent)
+
+    def _forward(self, x):
+        return x ** self.exponent
+
+    def _inverse(self, y):
+        return y ** (1.0 / self.exponent)
+
+    def _log_det(self, x, y):
+        jnp = _jnp()
+        return jnp.log(jnp.abs(self.exponent * y / x))
+
+
+class SigmoidTransform(Transformation):
+    """y = 1 / (1 + exp(-x))."""
+
+    def _forward(self, x):
+        import jax
+
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        jnp = _jnp()
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _log_det(self, x, y):
+        import jax
+
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class AbsTransform(Transformation):
+    """y = |x| — not bijective; inverse picks the positive branch."""
+
+    bijective = False
+
+    def _forward(self, x):
+        return _jnp().abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _log_det(self, x, y):
+        return _jnp().zeros_like(x)
+
+
+class SoftmaxTransform(Transformation):
+    """y = softmax(x) over the last axis (not bijective: simplex)."""
+
+    bijective = False
+
+    def _forward(self, x):
+        import jax
+
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return _jnp().log(y)
+
+    def _log_det(self, x, y):
+        raise MXNetError("SoftmaxTransform has no scalar log-det "
+                         "(dimension-reducing)")
+
+
+class ComposeTransform(Transformation):
+    """f = parts[-1] ∘ ... ∘ parts[0]."""
+
+    def __init__(self, parts):
+        self.parts = list(parts)
+        self.bijective = all(p.bijective for p in self.parts)
+
+    def _forward(self, x):
+        for p in self.parts:
+            x = p._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for p in reversed(self.parts):
+            y = p._inverse(y)
+        return y
+
+    def _log_det(self, x, y):
+        jnp = _jnp()
+        total = None
+        cur = x
+        for p in self.parts:
+            nxt = p._forward(cur)
+            ld = p._log_det(cur, nxt)
+            total = ld if total is None else total + ld
+            cur = nxt
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through a chain of transformations
+    (reference transformed_distribution.py): log_prob uses the
+    change-of-variables formula."""
+
+    has_grad = True
+
+    def __init__(self, base: Distribution, transforms):
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        self.base = base
+        self.transform = ComposeTransform(transforms)
+        super().__init__()
+
+    def sample(self, size=None):
+        x = self.base.sample(size)
+        return self.transform(x)
+
+    def sample_n(self, n):
+        return self.sample((n,))
+
+    def log_prob(self, value):
+        if not self.transform.bijective:
+            raise MXNetError("log_prob needs a bijective transform chain")
+
+        def f(v):
+            x = self.transform._inverse(v)
+            ld = self.transform._log_det(x, v)
+            base_lp = _data(self.base.log_prob(NDArray(x)))
+            return base_lp - ld
+
+        return _wrap(f, _as_nd(value), name="transformed_log_prob")

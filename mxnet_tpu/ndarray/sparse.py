@@ -2,11 +2,16 @@
 
 Reference: storage types on NDArray (``include/mxnet/ndarray.h:61-66``),
 ``python/mxnet/ndarray/sparse.py``, and the FComputeEx sparse kernels in
-``src/operator/tensor/``. SURVEY.md §7 calls for dense-first with sparse only
-where the API demands it: these classes carry (indices, values) structure and
-convert to/from dense; math falls back to dense (the reference's storage-
-fallback path, ``src/common/exec_utils.h:138-174``) except for the
-row-sparse update/pull fast paths used by embeddings and kvstore.
+``src/operator/tensor/``. SURVEY.md §7 calls for dense-first with sparse
+only where the API demands it.
+
+Storage really is sparse here: construction keeps only
+(indices, values) / (indptr, indices, data) buffers; the dense array is
+materialized LAZILY the first time a dense consumer touches ``_data``
+(the storage-fallback moment, ``src/common/exec_utils.h:138-174``).
+Embedding-scale row_sparse gradients therefore cost O(nnz) until some op
+actually needs the dense view — the memory contract ``PullRowSparse``
+exists for (``include/mxnet/kvstore.h``).
 """
 from __future__ import annotations
 
@@ -23,31 +28,88 @@ def _jnp():
 
 
 class BaseSparseNDArray(NDArray):
-    """Common base; ``self._data`` holds the *dense* fallback lazily."""
+    """Common base. ``_data`` is a property: dense materialization is
+    deferred until first access and cached afterwards."""
 
-    __slots__ = ()
+    __slots__ = ("_dense_cache", "_dense_shape")
+
+    def _init_sparse(self, shape, stype):
+        self._dense_cache = None
+        self._dense_shape = tuple(int(s) for s in shape)
+        self._tape = None
+        self._leaf = None
+        self._version = 0
+        self._stype = stype
+
+    def _densify(self):
+        raise NotImplementedError
+
+    @property
+    def _data(self):
+        d = self._dense_cache
+        if d is None:
+            d = self._densify()
+            self._dense_cache = d
+        return d
+
+    @_data.setter
+    def _data(self, v):
+        # a dense write-through (e.g. kvstore row_sparse_pull writing into
+        # a sparse destination) must keep the SPARSE buffers coherent, or
+        # retain()/values would serve pre-mutation rows
+        self._dense_cache = v
+        self._resparsify(v)
+
+    def _resparsify(self, dense):
+        raise NotImplementedError
+
+    def is_materialized(self):
+        """True once some dense consumer forced the fallback (tests use
+        this to assert sparse ops stayed O(nnz))."""
+        return self._dense_cache is not None
+
+    # shape/dtype must NOT force densification
+    @property
+    def shape(self):
+        return self._dense_shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self.values.dtype)
+
+    @property
+    def ndim(self):
+        return len(self._dense_shape)
+
+    @property
+    def size(self):
+        return int(_np.prod(self._dense_shape)) if self._dense_shape else 1
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Row-sparse: (indices[K], values[K, ...cols]) over rows of a 2D+ array.
+    """Row-sparse: (indices[K], values[K, ...cols]) over rows of a 2D+
+    array. Gradient arrays of embeddings are the main producer in the
+    reference; kvstore ``PullRowSparse`` consumes them."""
 
-    Gradient arrays of embeddings are the main producer in the reference;
-    kvstore ``PullRowSparse`` consumes them (``include/mxnet/kvstore.h``).
-    """
-
-    __slots__ = ("indices", "values", "_dense_shape")
+    __slots__ = ("indices", "values")
 
     def __init__(self, values, indices, shape):
-        self.indices = indices if isinstance(indices, NDArray) else NDArray(indices)
-        self.values = values if isinstance(values, NDArray) else NDArray(values)
-        self._dense_shape = tuple(shape)
-        dense = _jnp().zeros(shape, self.values.dtype)
-        dense = dense.at[self.indices._data].set(self.values._data)
-        super().__init__(dense, stype="row_sparse")
+        self.indices = indices if isinstance(indices, NDArray) \
+            else NDArray(indices)
+        self.values = values if isinstance(values, NDArray) \
+            else NDArray(values)
+        self._init_sparse(shape, "row_sparse")
 
-    @property
-    def data(self):
-        return self.values
+    def _densify(self):
+        dense = _jnp().zeros(self._dense_shape, self.values.dtype)
+        return dense.at[self.indices._data].set(self.values._data)
+
+    def _resparsify(self, dense):
+        jnp = _jnp()
+        flat = dense.reshape(dense.shape[0], -1)
+        rows = jnp.nonzero(jnp.any(flat != 0, axis=1))[0].astype(jnp.int64)
+        object.__setattr__(self, "indices", NDArray(rows))
+        object.__setattr__(self, "values", NDArray(dense[rows]))
 
     def tostype(self, stype):
         if stype == "row_sparse":
@@ -57,9 +119,16 @@ class RowSparseNDArray(BaseSparseNDArray):
         raise MXNetError(f"cannot convert row_sparse to {stype}")
 
     def retain(self, indices):
-        idx = indices._data if isinstance(indices, NDArray) else _jnp().asarray(indices)
-        vals = self._data[idx]
-        return RowSparseNDArray(NDArray(vals), NDArray(idx), self._dense_shape)
+        """Keep only the rows whose index appears in ``indices``
+        (reference ``_retain`` / PullRowSparse row selection) — computed
+        on the SPARSE buffers, never the dense view."""
+        jnp = _jnp()
+        idx = indices._data if isinstance(indices, NDArray) \
+            else jnp.asarray(indices)
+        mask = jnp.isin(self.indices._data, idx)
+        return RowSparseNDArray(NDArray(self.values._data[mask]),
+                                NDArray(self.indices._data[mask]),
+                                self._dense_shape)
 
 
 class CSRNDArray(BaseSparseNDArray):
@@ -68,21 +137,41 @@ class CSRNDArray(BaseSparseNDArray):
     __slots__ = ("indptr", "indices", "values")
 
     def __init__(self, data, indptr, indices, shape):
-        self.indptr = indptr if isinstance(indptr, NDArray) else NDArray(indptr)
-        self.indices = indices if isinstance(indices, NDArray) else NDArray(indices)
+        self.indptr = indptr if isinstance(indptr, NDArray) \
+            else NDArray(indptr)
+        self.indices = indices if isinstance(indices, NDArray) \
+            else NDArray(indices)
         self.values = data if isinstance(data, NDArray) else NDArray(data)
-        ip = _np.asarray(self.indptr.asnumpy(), dtype=_np.int64)
-        ci = _np.asarray(self.indices.asnumpy(), dtype=_np.int64)
-        vals = self.values.asnumpy()
-        dense = _np.zeros(shape, vals.dtype)
-        for r in range(shape[0]):
-            cols = ci[ip[r]:ip[r + 1]]
-            dense[r, cols] = vals[ip[r]:ip[r + 1]]
-        super().__init__(dense, stype="csr")
+        self._init_sparse(shape, "csr")
 
-    @property
-    def data(self):
-        return self.values
+    def _densify(self):
+        jnp = _jnp()
+        ip = self.indptr._data.astype(jnp.int64)
+        # row id per nonzero = repeat(arange(rows), row_lengths): one
+        # vectorized scatter, not a Python row loop
+        rows = jnp.repeat(
+            jnp.arange(self._dense_shape[0], dtype=jnp.int64),
+            jnp.diff(ip), total_repeat_length=self.values.shape[0])
+        dense = jnp.zeros(self._dense_shape, self.values.dtype)
+        return dense.at[rows, self.indices._data].set(self.values._data)
+
+    def _resparsify(self, dense):
+        jnp = _jnp()
+        host = _np.asarray(dense)
+        indptr = [0]
+        cols = []
+        vals = []
+        for r in range(host.shape[0]):
+            nz = _np.nonzero(host[r])[0]
+            cols.extend(nz.tolist())
+            vals.extend(host[r, nz].tolist())
+            indptr.append(len(cols))
+        object.__setattr__(self, "indptr",
+                           NDArray(_np.asarray(indptr, _np.int64)))
+        object.__setattr__(self, "indices",
+                           NDArray(_np.asarray(cols, _np.int64)))
+        object.__setattr__(self, "values",
+                           NDArray(_np.asarray(vals, host.dtype)))
 
     def tostype(self, stype):
         if stype == "csr":

@@ -79,6 +79,11 @@ def _static_key(v, depth=0):
 
     if isinstance(v, types.ModuleType):
         return ("module", v.__name__)
+    if isinstance(v, types.MethodType):
+        # bound method: the receiver is part of the identity — two
+        # instances sharing a class must not share a cache entry
+        return ("method", v.__func__.__code__,
+                _static_key(v.__self__, depth + 1))
     if callable(v) and hasattr(v, "__code__"):
         return (v.__code__,) + tuple(
             _static_key(c.cell_contents, depth + 1)

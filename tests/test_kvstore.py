@@ -62,11 +62,27 @@ def test_dist_aliases_and_async_rejection():
 def test_gradient_compression_error_feedback():
     gc = mx.kvstore.GradientCompression(threshold=1.0)
     g = np.array([0.6, -0.6, 0.2, 1.5])
-    c1 = gc.compress("k", g).asnumpy()
+    c1 = gc.decompress("k", gc.compress("k", g)).asnumpy()
     onp.testing.assert_allclose(c1, [0, 0, 0, 1.0])  # |0.6|<1 -> 0 + residual
-    c2 = gc.compress("k", g).asnumpy()
+    c2 = gc.decompress("k", gc.compress("k", g)).asnumpy()
     # residual 0.6 + new 0.6 = 1.2 -> quantizes to 1.0 now
     onp.testing.assert_allclose(c2, [1.0, -1.0, 0, 1.0])
+
+
+def test_gradient_compression_really_packs():
+    """The wire buffer must be 2 bits/value (16x smaller than fp32)."""
+    gc = mx.kvstore.GradientCompression(threshold=0.5)
+    g = np.array(onp.random.randn(1024).astype("float32"))
+    packed = gc.compress("w", g)
+    assert packed.dtype == onp.uint8
+    assert packed.asnumpy().nbytes == 1024 // 4  # 4 values per byte
+    dense = gc.decompress("w", packed).asnumpy()
+    assert dense.shape == (1024,)
+    assert set(onp.unique(dense)).issubset({-0.5, 0.0, 0.5})
+    # roundtrip matches the dense quantization exactly
+    gc2 = mx.kvstore.GradientCompression(threshold=0.5)
+    q = gc2.quantize("w", g).asnumpy()
+    onp.testing.assert_allclose(dense, q)
 
 
 def test_optimizer_states_save_load(tmp_path):

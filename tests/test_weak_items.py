@@ -1,0 +1,192 @@
+"""Tests for the hardened subsystems: lazy sparse storage, bounded
+wait_all, CachedOpThreadSafe, config flag registry, probability
+transformations + new distributions."""
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np
+
+
+def test_row_sparse_is_lazy():
+    """Construction must NOT allocate the dense buffer (the whole point of
+    row_sparse for embedding-scale grads, kvstore.h PullRowSparse)."""
+    vals = onp.ones((3, 4), "float32")
+    idx = onp.array([1, 5, 7], "int64")
+    rs = mx.nd.sparse.row_sparse_array((vals, idx), shape=(100000, 4))
+    assert not rs.is_materialized()
+    assert rs.shape == (100000, 4)      # metadata without densifying
+    assert rs.dtype == onp.float32
+    assert not rs.is_materialized()
+    kept = rs.retain(onp.array([5, 7]))  # sparse-path retain
+    assert not rs.is_materialized()
+    onp.testing.assert_array_equal(kept.indices.asnumpy(), [5, 7])
+    dense = rs.tostype("default")        # the storage-fallback moment
+    assert rs.is_materialized()
+    assert dense.asnumpy()[5].sum() == 4
+
+
+def test_csr_lazy_and_correct():
+    data = onp.array([1.0, 2, 3], "float32")
+    indptr = onp.array([0, 2, 3], "int64")
+    indices = onp.array([0, 2, 1], "int64")
+    csr = mx.nd.sparse.csr_matrix((data, indptr, indices), shape=(2, 3))
+    assert not csr.is_materialized()
+    want = onp.array([[1, 0, 2], [0, 3, 0]], "float32")
+    onp.testing.assert_array_equal(csr.tostype("default").asnumpy(), want)
+
+
+def test_waitall_bounded_and_correct():
+    from mxnet_tpu import engine
+
+    a = np.ones((16, 16))
+    for _ in range(5):
+        a = np.tanh(a)
+    mx.waitall()  # must drain without sweeping every live array
+    with engine._pending_lock:
+        assert len(engine._pending) == 0
+    onp.testing.assert_allclose(a.asnumpy(),
+                                onp.tanh(onp.tanh(onp.tanh(onp.tanh(
+                                    onp.tanh(onp.ones((16, 16))))))),
+                                rtol=1e-6)
+
+
+def test_cachedop_threadsafe_concurrent_inference():
+    from mxnet_tpu.cachedop import CachedOpThreadSafe
+
+    net = gluon.nn.Dense(8, in_units=16)
+    net.initialize()
+    op = CachedOpThreadSafe(net)
+    x = np.array(onp.random.randn(4, 16).astype("float32"))
+    with autograd.predict_mode():
+        want = op(x).asnumpy()
+    results = [None] * 8
+    errors = []
+
+    def worker(i):
+        try:
+            with autograd.predict_mode():
+                results[i] = op(x).asnumpy()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for r in results:
+        onp.testing.assert_allclose(r, want, rtol=1e-6)
+
+
+def test_config_registry():
+    import io
+
+    from mxnet_tpu import config
+
+    assert "MXNET_ENGINE_TYPE" in config.list_flags()
+    assert config.get("MXNET_ENGINE_TYPE") == "ThreadedEnginePerDevice"
+    assert config.get("MXNET_EAGER_JIT_CACHE") is True
+    buf = io.StringIO()
+    config.describe(file=buf)
+    text = buf.getvalue()
+    assert "MXNET_WAITALL_FULL" in text and "waitall" in text
+
+
+def test_transformed_distribution_lognormal():
+    from mxnet_tpu.gluon.probability import (ExpTransform, Normal,
+                                             TransformedDistribution)
+
+    mu, sigma = 0.3, 0.5
+    dist = TransformedDistribution(Normal(mu, sigma), ExpTransform())
+    mx.random.seed(7)
+    s = dist.sample((20000,)).asnumpy()
+    assert (s > 0).all()
+    # lognormal mean = exp(mu + sigma^2/2)
+    onp.testing.assert_allclose(s.mean(), onp.exp(mu + sigma ** 2 / 2),
+                                rtol=0.05)
+    v = onp.array([0.5, 1.0, 2.0], "float32")
+    got = dist.log_prob(np.array(v)).asnumpy()
+    want = (-onp.log(v) - onp.log(sigma) - 0.5 * onp.log(2 * onp.pi)
+            - (onp.log(v) - mu) ** 2 / (2 * sigma ** 2))
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_affine_sigmoid_compose_roundtrip():
+    from mxnet_tpu.gluon.probability import (AffineTransform,
+                                             ComposeTransform,
+                                             SigmoidTransform)
+
+    t = ComposeTransform([AffineTransform(1.0, 2.0), SigmoidTransform()])
+    x = np.array(onp.random.randn(10).astype("float32"))
+    y = t(x)
+    back = t.inv(y)
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(), rtol=1e-4,
+                                atol=1e-5)
+    ld = t.log_det_jacobian(x, y)
+    assert ld.shape == (10,)
+
+
+@pytest.mark.parametrize("dist_cls,kwargs,mean_fn", [
+    ("StudentT", {"df": 7.0}, lambda k: 0.0),
+    ("Cauchy", {"loc": 0.0, "scale": 1.0}, None),
+    ("HalfNormal", {"scale": 2.0}, lambda k: 2.0 * onp.sqrt(2 / onp.pi)),
+    ("Chi2", {"df": 5.0}, lambda k: 5.0),
+    ("Geometric", {"prob": 0.3}, lambda k: 0.7 / 0.3),
+    ("Gumbel", {"loc": 1.0, "scale": 2.0},
+     lambda k: 1.0 + 2.0 * 0.5772156649),
+    ("Weibull", {"concentration": 2.0, "scale": 1.0}, None),
+])
+def test_new_distributions_sample_and_logprob(dist_cls, kwargs, mean_fn):
+    from mxnet_tpu.gluon import probability as prob
+
+    dist = getattr(prob, dist_cls)(**kwargs)
+    mx.random.seed(11)
+    s = dist.sample((30000,)).asnumpy()
+    assert s.shape == (30000,)
+    assert onp.isfinite(s).all()
+    if mean_fn is not None:
+        onp.testing.assert_allclose(s.mean(), mean_fn(kwargs), rtol=0.08,
+                                    atol=0.05)
+    pts = onp.abs(s[:4]) + 0.1  # positive support safe for all of these
+    lp = dist.log_prob(np.array(pts.astype("float32"))).asnumpy()
+    assert onp.isfinite(lp).all()
+
+
+def test_sparse_dense_write_resparsifies():
+    """A dense write-through must keep the sparse buffers coherent
+    (kvstore row_sparse_pull writes into sparse destinations)."""
+    rs = mx.nd.sparse.row_sparse_array(
+        (onp.ones((2, 3), "float32"), onp.array([0, 2], "int64")),
+        shape=(4, 3))
+    new = onp.zeros((4, 3), "float32")
+    new[1] = 5.0
+    rs._set_data_internal(__import__("jax").numpy.asarray(new))
+    onp.testing.assert_array_equal(rs.indices.asnumpy(), [1])
+    onp.testing.assert_allclose(rs.values.asnumpy(), [[5, 5, 5]])
+    kept = rs.retain(onp.array([1]))
+    onp.testing.assert_allclose(kept.values.asnumpy(), [[5, 5, 5]])
+
+
+def test_quantize_net_dehybridizes_for_calibration():
+    from mxnet_tpu.contrib import quantization as q
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8), gluon.nn.Dense(4))
+    net.initialize()
+    x = np.array(onp.random.randn(2, 16).astype("float32"))
+    with autograd.predict_mode():
+        net(x)
+    net.hybridize()
+    with autograd.predict_mode():
+        net(x)  # cached trace exists
+    q.quantize_net(net, calib_data=x, calib_mode="naive")
+    from mxnet_tpu.contrib.quantization import QuantizedDense
+
+    assert isinstance(net[0], QuantizedDense)
+    # calibration really ran: the scale is not the bogus default 1/127
+    assert abs(net[0]._x_scale - 1.0 / 127) > 1e-9
