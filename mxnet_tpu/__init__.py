@@ -86,6 +86,7 @@ _LAZY_SUBMODULES = (
     "metric",
     "contrib",
     "config",
+    "subgraph",
 )
 
 _LAZY_ALIASES = {"kv": "kvstore", "sym": "symbol", "init": "initializer"}

@@ -93,6 +93,20 @@ class CachedOp:
         self._bwd_cache = {}
 
     # -- helpers ----------------------------------------------------------
+    def _lookup_or_build(self, key, grad_mode, args_tracked, static_args):
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(key, grad_mode, args_tracked, static_args)
+            self._cache[key] = entry
+        return entry
+
+    def _write_back_state(self, state_params, new_states):
+        """Write back mutated state (BatchNorm running stats etc.)."""
+        for p, ns in zip(state_params, new_states):
+            arr = p.data()
+            if arr._data is not ns:
+                arr._set_data_internal(ns)
+
     def _split_params(self):
         params = list(self.block.collect_params().values())
         train = [p for p in params if p.grad_req != "null"]
@@ -149,6 +163,12 @@ class CachedOp:
             out_tree_box["tree"] = tree
             out_datas = [o._data if isinstance(o, NDArray) else o for o in flat_outs]
             return out_datas, new_states
+
+        # subgraph-backend passes (optimize_for): fn->fn transforms over
+        # the replayed forward — remat, dtype autocast, custom rewrites
+        # (the SubgraphProperty partition hook done the trace-once way)
+        for graph_pass in getattr(block, "_graph_passes", ()) or ():
+            replay = graph_pass(replay)
 
         diff_arg_idx = [i for i, t in enumerate(args_tracked) if t]
 
@@ -236,10 +256,8 @@ class CachedOp:
         ) if grad_mode else tuple(False for _ in traced_args)
 
         key = self._key(arg_datas, grad_mode, args_tracked, static_args)
-        entry = self._cache.get(key)
-        if entry is None:
-            entry = self._build(key, grad_mode, args_tracked, static_args)
-            self._cache[key] = entry
+        entry = self._lookup_or_build(key, grad_mode, args_tracked,
+                                      static_args)
 
         train_params = entry["train_params"]
         state_params = entry["state_params"]
@@ -250,11 +268,7 @@ class CachedOp:
         out_datas, new_states, vjp = entry["fwd"](tp_datas, st_datas, rng_key,
                                                   *arg_datas)
 
-        # write back mutated state (BatchNorm running stats etc.)
-        for p, ns in zip(state_params, new_states):
-            arr = p.data()
-            if arr._data is not ns:
-                arr._set_data_internal(ns)
+        self._write_back_state(state_params, new_states)
 
         wrapped = [NDArray(d) for d in out_datas]
 
@@ -296,8 +310,8 @@ class CachedOpThreadSafe(CachedOp):
     Reference: ``src/imperative/cached_op_threadsafe.h:82`` — the C-predict
     path serializes graph creation and state write-back behind a mutex so
     concurrent threads can share one executor. Here the jit executables are
-    themselves thread-safe; the lock guards the signature-cache dict and
-    the mutable-state (BatchNorm stats) write-back.
+    themselves thread-safe, so only those two sections lock: cache-hit
+    calls execute concurrently.
     """
 
     def __init__(self, block, static_alloc=False, static_shape=False,
@@ -306,6 +320,19 @@ class CachedOpThreadSafe(CachedOp):
                          static_shape=static_shape, flags=flags)
         self._lock = threading.RLock()
 
-    def __call__(self, *args):
+    def _lookup_or_build(self, key, grad_mode, args_tracked, static_args):
+        entry = self._cache.get(key)
+        if entry is not None:
+            return entry
+        with self._lock:  # double-checked: one thread traces/compiles
+            entry = self._cache.get(key)
+            if entry is None:
+                entry = super()._lookup_or_build(
+                    key, grad_mode, args_tracked, static_args)
+            return entry
+
+    def _write_back_state(self, state_params, new_states):
+        if not state_params:
+            return
         with self._lock:
-            return super().__call__(*args)
+            super()._write_back_state(state_params, new_states)

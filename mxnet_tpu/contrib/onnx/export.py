@@ -235,21 +235,35 @@ class _Exporter:
             out_aval.shape).astype(out_aval.dtype)
         self.bind(eqn.outvars[0], self.const(arr, "iota"))
 
+    def _p_is_finite(self, eqn):
+        x = self.name_of(eqn.invars[0])
+        inf = self.emit("IsInf", [x])
+        nan = self.emit("IsNaN", [x])
+        bad = self.emit("Or", [inf, nan])
+        self.bind(eqn.outvars[0], self.emit("Not", [bad]))
+
     # -- reductions --------------------------------------------------------
-    def _reduce(self, eqn, op):
-        axes = self.const(_onp.asarray(eqn.params["axes"], _onp.int64))
-        out = self.emit(op, [self.name_of(eqn.invars[0]), axes],
-                        keepdims=0)
+    def _reduce(self, eqn, op, axes_as_input):
+        # opset 17: only ReduceSum takes axes as an INPUT; ReduceMax/Min
+        # still take the axes ATTRIBUTE (input form arrives in opset 18)
+        x = self.name_of(eqn.invars[0])
+        axes = [int(a) for a in eqn.params["axes"]]
+        if axes_as_input:
+            out = self.emit(
+                op, [x, self.const(_onp.asarray(axes, _onp.int64))],
+                keepdims=0)
+        else:
+            out = self.emit(op, [x], axes=axes, keepdims=0)
         self.bind(eqn.outvars[0], out)
 
     def _p_reduce_sum(self, eqn):
-        self._reduce(eqn, "ReduceSum")
+        self._reduce(eqn, "ReduceSum", axes_as_input=True)
 
     def _p_reduce_max(self, eqn):
-        self._reduce(eqn, "ReduceMax")
+        self._reduce(eqn, "ReduceMax", axes_as_input=False)
 
     def _p_reduce_min(self, eqn):
-        self._reduce(eqn, "ReduceMin")
+        self._reduce(eqn, "ReduceMin", axes_as_input=False)
 
     def _p_argmax(self, eqn):
         out = self.emit("ArgMax", [self.name_of(eqn.invars[0])],
@@ -292,6 +306,12 @@ class _Exporter:
         dn = p["dimension_numbers"]
         if dn.lhs_spec[:2] != (0, 1) or dn.rhs_spec[:2] != (0, 1):
             raise MXNetError("ONNX export: conv layout must be NCHW/OIHW")
+        if any(d != 1 for d in p.get("lhs_dilation", ()) or ()):
+            # transposed conv lowers with lhs_dilation=strides; emitting a
+            # plain Conv would be silently wrong
+            raise MXNetError(
+                "ONNX export: transposed convolution (lhs_dilation) is not "
+                "supported yet")
         pads = p["padding"]
         onnx_pads = [lo for lo, _ in pads] + [hi for _, hi in pads]
         out = self.emit(
@@ -355,7 +375,7 @@ _SIMPLE = {
     "max": "Max", "min": "Min", "pow": "Pow", "neg": "Neg",
     "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
     "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign", "floor": "Floor",
-    "ceil": "Ceil", "round": "Round", "is_finite": "IsInf",
+    "ceil": "Ceil", "round": "Round",
     "eq": "Equal", "lt": "Less", "gt": "Greater",
     "le": "LessOrEqual", "ge": "GreaterOrEqual",
     "sin": "Sin", "cos": "Cos", "atan": "Atan", "asin": "Asin",

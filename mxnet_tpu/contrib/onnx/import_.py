@@ -207,6 +207,16 @@ def _run_node(node, env):
         import jax.scipy.special as jss
 
         return jss.erf(i())
+    if op == "IsInf":
+        return jnp.isinf(i())
+    if op == "IsNaN":
+        return jnp.isnan(i())
+    if op == "Not":
+        return jnp.logical_not(i())
+    if op in ("Or", "And", "Xor"):
+        fn = {"Or": jnp.logical_or, "And": jnp.logical_and,
+              "Xor": jnp.logical_xor}[op]
+        return fn(i(0), i(1))
     if op in ("Add", "Sub", "Mul", "Div", "Pow", "Max", "Min",
               "Equal", "Less", "Greater", "LessOrEqual", "GreaterOrEqual"):
         fn = {"Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,

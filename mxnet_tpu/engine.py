@@ -31,32 +31,45 @@ import contextlib
 import threading
 import weakref
 
-from .base import env_str
-
 _state = threading.local()
 
 # recently dispatched arrays (weakrefs): wait_all() drains these instead of
 # blocking on every live array in the process (jax.live_arrays() is O(all
-# arrays ever alive) — pathological when waitall() runs once per epoch)
+# arrays ever alive) — pathological when waitall() runs once per epoch).
+# Tracking is per-thread (GIL-safe deque appends, no lock on the hot eager
+# dispatch path); the registry of thread deques is what wait_all sweeps.
 _PENDING_MAX = 4096
-_pending = collections.deque(maxlen=_PENDING_MAX)
-_pending_lock = threading.Lock()
+_pending_tls = threading.local()
+_pending_registry = {}          # thread ident -> deque
+_pending_lock = threading.Lock()  # guards the registry only
+
+
+def _my_pending():
+    dq = getattr(_pending_tls, "dq", None)
+    if dq is None:
+        dq = collections.deque(maxlen=_PENDING_MAX)
+        _pending_tls.dq = dq
+        with _pending_lock:
+            _pending_registry[threading.get_ident()] = dq
+    return dq
 
 
 def track_async(arrays):
     """Record op outputs as outstanding async work for wait_all."""
-    with _pending_lock:
-        for a in arrays:
-            try:
-                _pending.append(weakref.ref(a))
-            except TypeError:
-                pass
+    dq = _my_pending()
+    for a in arrays:
+        try:
+            dq.append(weakref.ref(a))
+        except TypeError:
+            pass
 
 
 def engine_type() -> str:
     t = getattr(_state, "engine_type", None)
     if t is None:
-        t = env_str("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+        from . import config
+
+        t = config.get("MXNET_ENGINE_TYPE")
         _state.engine_type = t
     return t
 
@@ -73,13 +86,15 @@ def is_naive() -> bool:
 def maybe_sync(arrays):
     """Called by the dispatch layer after each op: tracks outputs for
     wait_all, and blocks immediately when NaiveEngine is on."""
-    track_async(arrays)
     if is_naive():
+        # already synced — nothing outstanding to track
         for a in arrays:
             try:
                 a.block_until_ready()
             except AttributeError:
                 pass
+        return
+    track_async(arrays)
 
 
 def wait_for_var(data):
@@ -99,27 +114,33 @@ def wait_all():
     """
     import jax
 
+    from . import config
+
     try:
         jax.effects_barrier()
     except Exception:
         pass
-    if env_str("MXNET_WAITALL_FULL", "0") == "1":
+    if config.get("MXNET_WAITALL_FULL"):
         try:
             jax.block_until_ready(jax.live_arrays())
         except Exception:
             pass
         return
     with _pending_lock:
-        refs = list(_pending)
-        _pending.clear()
-    for r in refs:
-        a = r()
-        if a is None:
-            continue
-        try:
-            a.block_until_ready()
-        except Exception:
-            pass
+        deques = list(_pending_registry.values())
+    for dq in deques:
+        while True:
+            try:
+                r = dq.popleft()
+            except IndexError:
+                break
+            a = r()
+            if a is None:
+                continue
+            try:
+                a.block_until_ready()
+            except Exception:
+                pass
 
 
 @contextlib.contextmanager

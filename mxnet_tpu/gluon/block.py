@@ -252,13 +252,31 @@ class HybridBlock(Block):
 
     def optimize_for(self, x, *args, backend=None, clear=True, partition_if_dynamic=True,
                      static_alloc=False, static_shape=False, **kwargs):
-        """Reference ``optimize_for`` (subgraph backend partition + build).
+        """Reference ``optimize_for`` (subgraph backend partition + build,
+        ``subgraph_property.h:86-385`` / ``MXOptimizeForBackend``).
 
-        TPU: XLA is the (only) backend; this hybridizes, runs one warm-up
-        call to build the executable, and returns. Custom jaxpr-rewrite
-        passes can be registered via ``mxnet_tpu.parallel.passes`` (future).
+        TPU redesign: a backend is a named bundle of function-transform
+        passes from :mod:`mxnet_tpu.subgraph` (``remat``, ``bf16``, or
+        user-registered via ``subgraph.register_backend``). The passes wrap
+        the traced forward before jit; then one warm-up call builds the
+        executable.
         """
-        del backend, clear, partition_if_dynamic, kwargs
+        del partition_if_dynamic, kwargs
+        changed = False
+        if clear and getattr(self, "_graph_passes", None):
+            # reference semantics: clear=True drops prior backend state
+            # even when no new backend is given
+            self._graph_passes = []
+            changed = True
+        if backend is not None:
+            from ..subgraph import get_backend_passes
+
+            passes = get_backend_passes(backend)  # validate + fetch
+            self._graph_passes = list(
+                getattr(self, "_graph_passes", ()) or ()) + passes
+            changed = True
+        if changed and getattr(self, "_cached_op", None) is not None:
+            self._cached_op = None  # rebuild with the new pass set
         self.hybridize(True, static_alloc=static_alloc, static_shape=static_shape)
         self(x, *args)
 

@@ -10,3 +10,9 @@ from . import mesh
 from .mesh import get_mesh, initialize_distributed, make_mesh, mesh_scope, set_mesh
 from . import functional
 from .functional import ShardedTrainer, ShardingRules, functionalize
+from . import pipeline
+from .pipeline import pipeline_apply, stack_stage_params
+from . import moe
+from .moe import MoEBlock, moe_dispatch_combine, moe_sharding_rules
+from . import ring_attention
+from .ring_attention import ring_attention as ring_attention_fn  # noqa: F401

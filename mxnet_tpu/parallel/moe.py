@@ -1,0 +1,154 @@
+"""Mixture-of-Experts with expert parallelism over an ``ep`` mesh axis.
+
+SURVEY.md §2.3: expert parallelism is absent in the reference — another
+design-fresh TPU component. The layer is the Switch/Mesh-TensorFlow
+formulation: a learned router picks top-k experts per token, tokens are
+dispatched into fixed-capacity expert buffers with one einsum (static
+shapes — no dynamic gather, SURVEY §7 hard part 3), expert FFNs run
+sharded over ``ep`` (XLA inserts the all-to-all when token and expert
+shardings differ), and a second einsum combines weighted outputs.
+Everything is differentiable; router load-balancing uses the standard
+auxiliary loss (Shazeer et al., Switch Transformer).
+"""
+from __future__ import annotations
+
+import math
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from ..ndarray.ndarray import NDArray
+from ..ops import registry as _registry
+
+
+def _maybe_constrain(arr, mesh, axis):
+    """Pin the expert dim to the ``ep`` axis (this is what makes XLA place
+    the all-to-all) — skipped in eager execution where a single-device
+    array can't take a mesh-wide constraint."""
+    if mesh is None or axis not in mesh.axis_names:
+        return arr
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P(axis, *([None] * (arr.ndim - 1)))
+    try:
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec))
+    except Exception:
+        return arr
+
+
+def moe_dispatch_combine(x, router_logits, expert_fn, num_experts,
+                         capacity, mesh=None, axis="ep"):
+    """Functional MoE core on raw arrays (jit/shard-friendly).
+
+    x: (N, d) tokens; router_logits: (N, E); expert_fn(i_params?) — here
+    expert computation is a closure ``expert_fn(expert_inputs) ->
+    expert_outputs`` mapping (E, C, d) -> (E, C, d_out).
+    Returns (out (N, d_out), aux_loss scalar).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n, _ = x.shape
+    e, c = num_experts, capacity
+    probs = jax.nn.softmax(router_logits, axis=-1)          # (N, E)
+    expert_idx = jnp.argmax(probs, axis=-1)                 # top-1 (N,)
+    expert_1h = jax.nn.one_hot(expert_idx, e, dtype=x.dtype)
+    gate = jnp.sum(probs * expert_1h, axis=-1)              # (N,)
+
+    # position of each token inside its expert's buffer; tokens past the
+    # capacity are dropped (residual passes them through unchanged)
+    pos = jnp.cumsum(expert_1h, axis=0) * expert_1h - 1.0   # (N, E)
+    in_cap = (pos < c) & (expert_1h > 0)
+    pos_1h = jax.nn.one_hot(pos.astype(jnp.int32), c, dtype=x.dtype)
+    dispatch = expert_1h[:, :, None] * pos_1h * in_cap[:, :, None]
+    # (N, E, C) 0/1 dispatch tensor
+    expert_inputs = jnp.einsum("nec,nd->ecd", dispatch, x)
+    expert_inputs = _maybe_constrain(expert_inputs, mesh, axis)
+    expert_outputs = expert_fn(expert_inputs)               # (E, C, do)
+    expert_outputs = _maybe_constrain(expert_outputs, mesh, axis)
+    combine = dispatch * gate[:, None, None]                # (N, E, C)
+    out = jnp.einsum("nec,ecd->nd", combine, expert_outputs)
+
+    # Switch-style load-balance loss: E * sum_e fraction_e * prob_mass_e
+    frac = expert_1h.mean(axis=0)
+    mass = probs.mean(axis=0)
+    aux = e * jnp.sum(frac * mass)
+    return out, aux
+
+
+class MoEBlock(HybridBlock):
+    """Drop-in FFN replacement: router + E expert FFNs, expert-parallel.
+
+    Usage in a transformer: swap ``PositionwiseFFN`` for
+    ``MoEBlock(units, hidden_size, num_experts=8)``; shard expert params
+    with ``moe_sharding_rules()`` (P('ep', ...) on the leading expert dim).
+    The auxiliary load-balance loss accumulates on ``self.aux_loss`` (an
+    NDArray) each forward; trainers add it to the objective.
+    """
+
+    def __init__(self, units, hidden_size, num_experts=8,
+                 capacity_factor=1.25, activation="gelu", **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._hidden = hidden_size
+        self._e = num_experts
+        self._cap_factor = capacity_factor
+        self._act = activation
+        self.router = Parameter("router", shape=(units, num_experts))
+        # expert weights carry a leading E axis -> shardable over 'ep'
+        self.w1 = Parameter("w1", shape=(num_experts, units, hidden_size))
+        self.b1 = Parameter("b1", shape=(num_experts, hidden_size),
+                            init="zeros")
+        self.w2 = Parameter("w2", shape=(num_experts, hidden_size, units))
+        self.b2 = Parameter("b2", shape=(num_experts, units), init="zeros")
+        self.aux_loss = None
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        from . import mesh as mesh_mod
+
+        b, t, d = x.shape
+        cap = max(1, int(math.ceil(b * t / self._e * self._cap_factor)))
+        act_name = self._act
+        e = self._e
+        mesh = mesh_mod.get_mesh()
+
+        def f(xd, router, w1, b1, w2, b2):
+            tokens = xd.reshape(b * t, d)
+            logits = tokens @ router
+
+            def experts(inp):  # (E, C, d)
+                h = jnp.einsum("ecd,edh->ech", inp, w1) + b1[:, None, :]
+                if act_name == "gelu":
+                    h = jax.nn.gelu(h)
+                elif act_name == "relu":
+                    h = jax.nn.relu(h)
+                else:
+                    h = jnp.tanh(h)
+                return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+            out, aux = moe_dispatch_combine(
+                tokens, logits, experts, e, cap, mesh=mesh)
+            return out.reshape(b, t, d), aux
+
+        out, aux = _registry.apply(
+            f, (x, self.router.data(), self.w1.data(), self.b1.data(),
+                self.w2.data(), self.b2.data()),
+            name="moe", cacheable=False)
+        self.aux_loss = aux
+        return out
+
+
+def moe_sharding_rules(prefix=""):
+    """PartitionSpecs placing each expert's weights on its ``ep`` device."""
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (prefix + r".*\.(w1|w2)$", P("ep", None, None)),
+        (prefix + r".*\.(b1|b2)$", P("ep", None)),
+        (prefix + r".*\.router$", P()),
+    ]

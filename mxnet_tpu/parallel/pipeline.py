@@ -1,0 +1,125 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pp``
+mesh axis.
+
+SURVEY.md §2.3: the reference has NO pipeline parallelism (model
+parallelism exists only as a manual per-layer device-placement doc) — this
+is one of the design-fresh TPU components. The design is the canonical
+SPMD pipeline: each device along ``pp`` owns one stage's parameters
+(stacked and sharded on the leading axis), activations march through the
+ring with ``lax.ppermute`` inside ``shard_map``, and the fill/drain bubble
+costs (S-1)/(M+S-1) of the ticks for M microbatches over S stages. The
+whole schedule is one differentiable XLA program — reverse-mode flows
+back through the permutes, so training works with plain ``jax.grad``.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+from ..base import MXNetError
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """shard_map across jax versions (check_vma vs check_rep kwarg)."""
+    try:
+        from jax import shard_map
+
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except (ImportError, TypeError):
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp",
+                   num_microbatches=None):
+    """Run ``x`` through S pipelined stages.
+
+    ``stage_fn(params, mb) -> mb``: one stage on one microbatch; every
+    stage must preserve the microbatch shape (uniform blocks, e.g.
+    transformer layers).
+    ``stacked_params``: pytree whose leaves are stacked per-stage along a
+    leading S axis (sharded ``P(axis)`` on the mesh).
+    ``x``: (B, ...) batch, replicated; B must divide into microbatches.
+
+    Returns (B, ...) outputs (replicated), identical to applying the S
+    stages sequentially.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    if axis not in mesh.axis_names:
+        raise MXNetError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+    n_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    m = num_microbatches or n_stages
+    if batch % m:
+        raise MXNetError(f"batch {batch} not divisible into {m} microbatches")
+    mb = batch // m
+
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    for leaf in leaves:
+        if leaf.shape[0] != n_stages:
+            raise MXNetError(
+                f"stacked param leading dim {leaf.shape[0]} != pipeline "
+                f"stages {n_stages}")
+
+    x_mb = x.reshape((m, mb) + x.shape[1:])
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def per_device(params, xs):
+        # params: leaves (1, ...) — this device's stage; xs: full (m, mb,...)
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage_id = jax.lax.axis_index(axis)
+        ticks = m + n_stages - 1
+
+        def tick(t, carry):
+            recv, outs = carry
+            feed = x_mb_at(xs, t)
+            cur = jnp.where(stage_id == 0, feed, recv)
+            out = stage_fn(my_params, cur)
+            # collect from the last stage once the pipe is full
+            is_out = jnp.logical_and(stage_id == n_stages - 1,
+                                     t >= n_stages - 1)
+            idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            outs = outs.at[idx].set(
+                jnp.where(is_out, out, outs[idx]))
+            recv = jax.lax.ppermute(out, axis, perm)
+            return recv, outs
+
+        def x_mb_at(xs, t):
+            idx = jnp.clip(t, 0, m - 1)
+            return jax.lax.dynamic_index_in_dim(xs, idx, keepdims=False)
+
+        recv0 = jnp.zeros_like(xs[0])
+        outs0 = jnp.zeros_like(xs)
+        _, outs = jax.lax.fori_loop(0, ticks, tick, (recv0, outs0))
+        # only the last stage holds real outputs; broadcast via psum after
+        # zeroing every other stage's buffer
+        outs = jnp.where(stage_id == n_stages - 1, outs, 0.0)
+        return jax.lax.psum(outs, axis)
+
+    pspecs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    result = _shard_map(
+        per_device, mesh, (pspecs, P()), P())(stacked_params, x_mb)
+    return result.reshape((batch,) + x.shape[1:])
+
+
+def stack_stage_params(param_list, mesh=None, axis="pp"):
+    """Stack per-stage param pytrees along a leading axis and (optionally)
+    shard them ``P(axis)`` — the layout ``pipeline_apply`` consumes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *param_list)
+    if mesh is not None:
+        def place(leaf):
+            spec = P(axis, *([None] * (leaf.ndim - 1)))
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+        stacked = jax.tree_util.tree_map(place, stacked)
+    return stacked

@@ -1,0 +1,98 @@
+"""Subgraph backend / graph-pass registry.
+
+Reference: ``src/operator/subgraph/subgraph_property.h:86-385`` — backends
+register ``SubgraphProperty`` objects; ``Symbol.optimize_for(backend)``
+partitions the graph and hands subgraphs to the backend.
+
+TPU redesign: XLA owns partitioning/fusion, so a "backend" here is a
+named bundle of FUNCTION TRANSFORMS applied to the traced forward before
+jit — the idiomatic compiler hook on a trace-once runtime. A pass is
+``fn -> fn`` (e.g. ``jax.checkpoint`` for rematerialization, a dtype
+autocast wrapper, a jaxpr rewriter via ``jax.make_jaxpr``+eval). Backends
+compose passes in order.
+
+Built-ins:
+* ``remat``   — wrap the forward in ``jax.checkpoint`` (activation
+  rematerialization: the memory-planning role of ``PlanMemory``).
+* ``bf16``    — cast float inputs/params to bfloat16 for compute (the
+  low-precision graph pass, ``src/nnvm/low_precision_pass.cc``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from .base import MXNetError
+
+_BACKENDS: Dict[str, List[Callable]] = {}
+
+
+def register_backend(name: str, *passes: Callable):
+    """Register (or extend) a backend as an ordered list of fn->fn passes
+    (``SubgraphBackendRegistry`` analog)."""
+    _BACKENDS.setdefault(name, []).extend(passes)
+    return name
+
+
+def register_pass(backend: str):
+    """Decorator form: ``@register_pass('mybackend')``."""
+
+    def deco(fn):
+        register_backend(backend, fn)
+        return fn
+
+    return deco
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+def get_backend_passes(name: str):
+    try:
+        return list(_BACKENDS[name])
+    except KeyError:
+        raise MXNetError(
+            f"unknown subgraph backend {name!r}; registered: "
+            f"{list_backends()}") from None
+
+
+def apply_backend(name: str, fn: Callable) -> Callable:
+    """Compose the backend's passes over a traceable function."""
+    for p in get_backend_passes(name):
+        fn = p(fn)
+    return fn
+
+
+# -- built-in backends -------------------------------------------------------
+
+
+def _remat_pass(fn):
+    import jax
+
+    return jax.checkpoint(fn)
+
+
+def _bf16_pass(fn):
+    import jax
+    import jax.numpy as jnp
+
+    def cast(x):
+        try:
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(jnp.bfloat16)
+        except TypeError:  # exotic dtypes (PRNG keys)
+            pass
+        return x
+
+    def wrapped(*args):
+        out = fn(*jax.tree_util.tree_map(cast, args))
+        return jax.tree_util.tree_map(
+            lambda o: o.astype(jnp.float32)
+            if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating)
+            else o, out)
+
+    return wrapped
+
+
+register_backend("remat", _remat_pass)
+register_backend("bf16", _bf16_pass)

@@ -47,7 +47,8 @@ def test_waitall_bounded_and_correct():
         a = np.tanh(a)
     mx.waitall()  # must drain without sweeping every live array
     with engine._pending_lock:
-        assert len(engine._pending) == 0
+        assert all(len(dq) == 0
+                   for dq in engine._pending_registry.values())
     onp.testing.assert_allclose(a.asnumpy(),
                                 onp.tanh(onp.tanh(onp.tanh(onp.tanh(
                                     onp.tanh(onp.ones((16, 16))))))),
