@@ -40,8 +40,9 @@ _state = threading.local()
 # dispatch path); the registry of thread deques is what wait_all sweeps.
 _PENDING_MAX = 4096
 _pending_tls = threading.local()
-_pending_registry = {}          # thread ident -> deque
-_pending_lock = threading.Lock()  # guards the registry only
+_pending_registry = {}          # thread ident -> (thread weakref, deque)
+_pending_orphans = collections.deque(maxlen=_PENDING_MAX)
+_pending_lock = threading.Lock()  # guards registry + orphans
 
 
 def _my_pending():
@@ -49,8 +50,14 @@ def _my_pending():
     if dq is None:
         dq = collections.deque(maxlen=_PENDING_MAX)
         _pending_tls.dq = dq
+        ident = threading.get_ident()
         with _pending_lock:
-            _pending_registry[threading.get_ident()] = dq
+            old = _pending_registry.get(ident)
+            if old is not None:
+                # ident reuse after a thread died: keep its undrained refs
+                _pending_orphans.extend(old[1])
+            _pending_registry[ident] = (
+                weakref.ref(threading.current_thread()), dq)
     return dq
 
 
@@ -127,7 +134,14 @@ def wait_all():
             pass
         return
     with _pending_lock:
-        deques = list(_pending_registry.values())
+        deques = [dq for _, dq in _pending_registry.values()]
+        deques.append(_pending_orphans)
+        # prune registry entries for dead threads (their deques were just
+        # captured above and get drained below) — no per-thread leak
+        dead = [ident for ident, (tref, _dq) in _pending_registry.items()
+                if tref() is None or not tref().is_alive()]
+        for ident in dead:
+            del _pending_registry[ident]
     for dq in deques:
         while True:
             try:

@@ -151,19 +151,27 @@ class NDArrayIter(DataIter):
         return 0
 
 
+def _read_csv(path):
+    """Native threaded parser (textparse.cc) with numpy fallback — the
+    reference's C++ iter_csv tier vs its Python one."""
+    from ..lib import textparse_native
+
+    if textparse_native.available():
+        return textparse_native.load_csv(path)
+    return _onp.loadtxt(path, delimiter=",", dtype=_onp.float32, ndmin=2)
+
+
 class CSVIter(DataIter):
     """CSV reader (reference C++ ``src/io/iter_csv.cc:218``)."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
                  batch_size=1, round_batch=True, **kwargs):
         super().__init__(batch_size)
-        data = _onp.loadtxt(data_csv, delimiter=",", dtype=_onp.float32,
-                            ndmin=2)
+        data = _read_csv(data_csv)
         data = data.reshape((-1,) + tuple(data_shape))
         label = None
         if label_csv is not None:
-            label = _onp.loadtxt(label_csv, delimiter=",",
-                                 dtype=_onp.float32, ndmin=2)
+            label = _read_csv(label_csv)
             label = label.reshape((-1,) + tuple(label_shape))
         self._iter = NDArrayIter(
             data, label, batch_size=batch_size,
@@ -368,3 +376,67 @@ def _init_data(data, allow_empty, default_name):
             v = v.asnumpy()
         out.append((k, _onp.asarray(v)))
     return out
+
+
+def _read_libsvm(path, num_features):
+    """Native threaded LibSVM parser with a pure-Python fallback; returns
+    (dense (rows, num_features) data, (rows,) labels)."""
+    from ..lib import textparse_native
+
+    if textparse_native.available():
+        return textparse_native.load_libsvm(path, num_features)
+    rows_d = []
+    rows_l = []
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            if not parts:
+                continue
+            rows_l.append(float(parts[0]))
+            row = _onp.zeros(num_features, _onp.float32)
+            for tok in parts[1:]:
+                idx, val = tok.split(":")
+                row[int(idx)] = float(val)
+            rows_d.append(row)
+    data = _onp.stack(rows_d) if rows_d else \
+        _onp.zeros((0, num_features), _onp.float32)
+    return data, _onp.asarray(rows_l, _onp.float32)
+
+
+class LibSVMIter(DataIter):
+    """LibSVM reader (reference C++ ``src/io/iter_libsvm.cc:200``):
+    'label idx:val ...' lines parsed by the native threaded parser into a
+    dense (rows, num_features) batch stream; labels may come from a
+    separate LibSVM file (reference label_libsvm option)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        num_features = int(_onp.prod(data_shape))
+        data, label = _read_libsvm(data_libsvm, num_features)
+        data = data.reshape((-1,) + tuple(data_shape))
+        if label_libsvm is not None:
+            # the label file's FEATURE vectors are the labels (its leading
+            # label column is ignored), matching the reference's
+            # label_libsvm semantics for multi-dimensional labels
+            nlab = int(_onp.prod(label_shape)) if label_shape else 1
+            label, _ignored = _read_libsvm(label_libsvm, nlab)
+            label = (label.reshape((-1,) + tuple(label_shape))
+                     if label_shape else label.reshape(-1))
+        self._iter = NDArrayIter(
+            data, label, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard", **kwargs)
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label

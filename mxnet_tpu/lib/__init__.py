@@ -80,3 +80,22 @@ def recordio_lib():
         lib.rio_prefetch_close.argtypes = [ctypes.c_void_p]
         lib._sigs_set = True
     return lib
+
+
+def textparse_lib():
+    """Native CSV/LibSVM parser (see ``native/textparse.cc``)."""
+    lib = load("textparse", "textparse.cc")
+    if lib is not None and not getattr(lib, "_sigs_set2", False):
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.txt_count_rows.restype = ctypes.c_long
+        lib.txt_count_rows.argtypes = [ctypes.c_char_p]
+        lib.csv_ncols.restype = ctypes.c_long
+        lib.csv_ncols.argtypes = [ctypes.c_char_p]
+        lib.csv_parse.restype = ctypes.c_long
+        lib.csv_parse.argtypes = [ctypes.c_char_p, f32p, ctypes.c_long,
+                                  ctypes.c_long]
+        lib.libsvm_parse.restype = ctypes.c_long
+        lib.libsvm_parse.argtypes = [ctypes.c_char_p, f32p, f32p,
+                                     ctypes.c_long, ctypes.c_long]
+        lib._sigs_set2 = True
+    return lib

@@ -1,0 +1,59 @@
+"""Python surface over the native text parsers (``native/textparse.cc``).
+
+The reference parses CSV/LibSVM in threaded C++ iterators
+(``src/io/iter_csv.cc:218``, ``src/io/iter_libsvm.cc:200``); this module
+exposes that tier. Falls back to None when the toolchain is unavailable —
+callers then use numpy.loadtxt-style paths.
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as _onp
+
+from ..base import MXNetError
+from . import textparse_lib
+
+
+def available():
+    return textparse_lib() is not None
+
+
+def load_csv(path) -> _onp.ndarray:
+    """Parse a uniform-width float CSV into a (rows, cols) float32 array."""
+    lib = textparse_lib()
+    if lib is None:
+        raise MXNetError("native textparse unavailable (no g++?)")
+    path_b = str(path).encode()
+    rows = lib.txt_count_rows(path_b)
+    cols = lib.csv_ncols(path_b)
+    if rows < 0 or cols < 0:
+        raise MXNetError(f"cannot read {path}")
+    out = _onp.empty((rows, cols), dtype=_onp.float32)
+    n = lib.csv_parse(path_b,
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      out.size, cols)
+    if n < 0:
+        raise MXNetError(f"malformed CSV {path} (ragged rows or bad float)")
+    return out[:n // cols]
+
+
+def load_libsvm(path, num_features) -> tuple:
+    """Parse LibSVM into dense (rows, num_features) float32 + (rows,)
+    labels (the reference iterator's dense storage fallback)."""
+    lib = textparse_lib()
+    if lib is None:
+        raise MXNetError("native textparse unavailable (no g++?)")
+    path_b = str(path).encode()
+    rows = lib.txt_count_rows(path_b)
+    if rows < 0:
+        raise MXNetError(f"cannot read {path}")
+    data = _onp.zeros((rows, num_features), dtype=_onp.float32)
+    label = _onp.zeros((rows,), dtype=_onp.float32)
+    n = lib.libsvm_parse(
+        path_b, data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        rows, num_features)
+    if n < 0:
+        raise MXNetError(f"malformed LibSVM file {path}")
+    return data[:n], label[:n]

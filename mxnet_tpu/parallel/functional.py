@@ -95,6 +95,24 @@ def functionalize(block, train_mode=False):
     return apply_fn, params
 
 
+def _collect_aux_losses(block):
+    """Sum `aux_loss` values the forward just set on any sub-block (MoE
+    router load-balance terms). Values are tracers from THIS trace — read
+    immediately inside the loss closure, never cached."""
+    total = None
+    stack = [block]
+    while stack:
+        b = stack.pop()
+        aux = getattr(b, "aux_loss", None)
+        if aux is not None:
+            from ..ndarray.ndarray import NDArray
+
+            a = aux._data if isinstance(aux, NDArray) else aux
+            total = a if total is None else total + a
+        stack.extend(getattr(b, "_children", {}).values())
+    return total
+
+
 # ---------------------------------------------------------------------------
 # sharding rules
 # ---------------------------------------------------------------------------
@@ -174,7 +192,7 @@ class ShardedTrainer:
 
     def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
                  mesh=None, rules: Optional[ShardingRules] = None,
-                 batch_spec=None, dtype=None):
+                 batch_spec=None, dtype=None, aux_loss_weight=0.01):
         import jax
         from jax.sharding import NamedSharding
 
@@ -196,6 +214,9 @@ class ShardedTrainer:
         # to `dtype` inside the step; master weights, grads and the update
         # stay fp32 — the multi-precision layout of optimizer_op-inl.h
         self._dtype = dtype
+        # blocks exposing `aux_loss` (MoE router balance) contribute
+        # weight * sum(aux) to the objective inside the same trace
+        self._aux_weight = aux_loss_weight
         P = _P()
         if batch_spec is None:
             batch_spec = P("dp") if "dp" in self.mesh.axis_names else P()
@@ -287,6 +308,9 @@ class ShardedTrainer:
             lbl_nd = jax.tree_util.tree_map(NDArray, labels)
             loss = loss_fn(out_nd, lbl_nd)
             ldata = loss._data if isinstance(loss, NDArray) else loss
+            aux = _collect_aux_losses(self.block)
+            if aux is not None:
+                ldata = ldata + self._aux_weight * aux
             if amp_dtype is not None:
                 # mutable state (BN running stats) flows back at the master
                 # dtype so the AOT-compiled step signature stays stable

@@ -84,8 +84,13 @@ class MoEBlock(HybridBlock):
     Usage in a transformer: swap ``PositionwiseFFN`` for
     ``MoEBlock(units, hidden_size, num_experts=8)``; shard expert params
     with ``moe_sharding_rules()`` (P('ep', ...) on the leading expert dim).
-    The auxiliary load-balance loss accumulates on ``self.aux_loss`` (an
-    NDArray) each forward; trainers add it to the objective.
+
+    Load-balance auxiliary loss: each forward sets ``self.aux_loss``.
+    ``ShardedTrainer`` collects it automatically inside its compiled step
+    (``aux_loss_weight``). In eager training add it to the objective
+    yourself (``loss = ce + 0.01 * net.moe.aux_loss``); under plain
+    ``hybridize()`` the attribute holds a stale trace value — use
+    ShardedTrainer (or eager) when training MoE.
     """
 
     def __init__(self, units, hidden_size, num_experts=8,

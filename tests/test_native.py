@@ -2,6 +2,7 @@
 import os
 
 import numpy as np
+import numpy as onp
 import pytest
 
 import mxnet_tpu as mx
@@ -80,3 +81,128 @@ def test_native_rejects_corrupt_file(tmp_path):
         f.write(b"not a recordio file at all....")
     with pytest.raises(mx.MXNetError):
         recordio_native.build_index(bad)
+
+
+def test_native_csv_parse_matches_numpy(tmp_path):
+    from mxnet_tpu.lib import textparse_native
+
+    if not textparse_native.available():
+        pytest.skip("no native toolchain")
+    rng = onp.random.RandomState(0)
+    arr = rng.randn(500, 7).astype("float32")
+    p = tmp_path / "d.csv"
+    onp.savetxt(p, arr, delimiter=",", fmt="%.6g")
+    got = textparse_native.load_csv(str(p))
+    want = onp.loadtxt(p, delimiter=",", dtype=onp.float32, ndmin=2)
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_native_csv_rejects_ragged(tmp_path):
+    from mxnet_tpu.lib import textparse_native
+
+    if not textparse_native.available():
+        pytest.skip("no native toolchain")
+    p = tmp_path / "bad.csv"
+    p.write_text("1,2,3\n4,5\n")
+    with pytest.raises(mx.MXNetError, match="malformed"):
+        textparse_native.load_csv(str(p))
+
+
+def test_native_libsvm_parse(tmp_path):
+    from mxnet_tpu.lib import textparse_native
+
+    if not textparse_native.available():
+        pytest.skip("no native toolchain")
+    p = tmp_path / "d.svm"
+    p.write_text("1 0:1.5 3:-2.0\n0 2:7\n2 1:0.25 4:4\n")
+    data, label = textparse_native.load_libsvm(str(p), 5)
+    onp.testing.assert_allclose(label, [1, 0, 2])
+    want = onp.zeros((3, 5), "float32")
+    want[0, 0], want[0, 3] = 1.5, -2.0
+    want[1, 2] = 7
+    want[2, 1], want[2, 4] = 0.25, 4
+    onp.testing.assert_allclose(data, want)
+
+
+def test_csviter_native_and_libsvmiter(tmp_path):
+    import mxnet_tpu.io as mio
+
+    rng = onp.random.RandomState(1)
+    arr = rng.randn(20, 4).astype("float32")
+    p = tmp_path / "d.csv"
+    onp.savetxt(p, arr, delimiter=",", fmt="%.6g")
+    it = mio.CSVIter(str(p), data_shape=(4,), batch_size=5)
+    batches = list(it)
+    assert len(batches) == 4
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy(), arr[:5],
+                                rtol=1e-5)
+
+    svm = tmp_path / "d.svm"
+    svm.write_text("".join(
+        f"{i % 3} 0:{i}.5 2:{i}\n" for i in range(8)))
+    it = mio.LibSVMIter(str(svm), data_shape=(4,), batch_size=4)
+    b = next(iter(it))
+    onp.testing.assert_allclose(b.label[0].asnumpy(), [0, 1, 2, 0])
+    onp.testing.assert_allclose(b.data[0].asnumpy()[1],
+                                [1.5, 0, 1, 0])
+
+
+def test_native_csv_comments_blank_and_pagesize(tmp_path):
+    from mxnet_tpu.lib import textparse_native
+
+    if not textparse_native.available():
+        pytest.skip("no native toolchain")
+    # comments + blank lines behave like numpy.loadtxt
+    p = tmp_path / "c.csv"
+    p.write_text("# header comment\n\n1,2,3\n# mid comment\n4,5,6\n")
+    got = textparse_native.load_csv(str(p))
+    onp.testing.assert_allclose(got, [[1, 2, 3], [4, 5, 6]])
+    # exactly page-sized file without trailing newline must not crash
+    page = os.sysconf("SC_PAGE_SIZE")
+    row = "1.5,2.5\n"
+    body = row * (page // len(row))
+    pad = page - len(body)
+    body = body[:-1]  # strip final newline
+    body = ("9," * ((pad + 1) // 2)).join([""]) + body  # keep simple: rebuild
+    # construct a file of EXACTLY page bytes ending in a digit
+    content = row * (page // len(row))
+    content = content[:page - 4] 
+    content = content.rstrip("\n,")
+    filler = page - len(content) - 4
+    content = content + "\n" + "8" * 3
+    content = content + "1" * (page - len(content))
+    assert len(content) == page and content[-1].isdigit()
+    p2 = tmp_path / "exact.csv"
+    p2.write_bytes(content.encode())
+    try:
+        textparse_native.load_csv(str(p2))  # ragged -> error is fine
+    except mx.MXNetError:
+        pass  # must raise cleanly, not SIGBUS
+
+
+def test_native_libsvm_crlf(tmp_path):
+    from mxnet_tpu.lib import textparse_native
+
+    if not textparse_native.available():
+        pytest.skip("no native toolchain")
+    p = tmp_path / "w.svm"
+    p.write_bytes(b"1 0:1.5 2:3\r\n0 1:2\r\n")
+    data, label = textparse_native.load_libsvm(str(p), 3)
+    onp.testing.assert_allclose(label, [1, 0])
+    onp.testing.assert_allclose(data, [[1.5, 0, 3], [0, 2, 0]])
+
+
+def test_libsvmiter_label_file_without_native(tmp_path, monkeypatch):
+    """label_libsvm works through the shared fallback parser."""
+    import mxnet_tpu.io as mio
+    from mxnet_tpu.lib import textparse_native
+
+    svm = tmp_path / "d.svm"
+    svm.write_text("0 0:1\n0 1:2\n")
+    lab = tmp_path / "l.svm"
+    lab.write_text("0 0:5\n0 0:7\n")
+    monkeypatch.setattr(textparse_native, "available", lambda: False)
+    it = mio.LibSVMIter(str(svm), data_shape=(3,), label_libsvm=str(lab),
+                        batch_size=2)
+    b = next(iter(it))
+    onp.testing.assert_allclose(b.label[0].asnumpy(), [5, 7])

@@ -48,7 +48,8 @@ def test_waitall_bounded_and_correct():
     mx.waitall()  # must drain without sweeping every live array
     with engine._pending_lock:
         assert all(len(dq) == 0
-                   for dq in engine._pending_registry.values())
+                   for _tref, dq in engine._pending_registry.values())
+        assert len(engine._pending_orphans) == 0
     onp.testing.assert_allclose(a.asnumpy(),
                                 onp.tanh(onp.tanh(onp.tanh(onp.tanh(
                                     onp.tanh(onp.ones((16, 16))))))),
