@@ -175,10 +175,15 @@ def bench_resnet_infer_int8():
 
 
 def _train_bench(net, loss_fn, optimizer, opt_params, data, labels,
-                 rules=None, dtype=None, k1=3, k2=15):
+                 rules=None, dtype=None, k1=3, k2=15, fuse=None):
     """Shared training-step timer: ShardedTrainer (SPMD step over the device
-    mesh — the dist_tpu_sync execution model), XLA-counted FLOPs -> MFU."""
+    mesh — the dist_tpu_sync execution model), XLA-counted FLOPs -> MFU.
+
+    ``fuse=N``: time ``step_n`` windows of N steps in one dispatch (the
+    bulk-exec path); the returned dt is per WINDOW (divide by N for
+    per-step)."""
     import jax
+    import numpy as onp
 
     from mxnet_tpu.parallel import ShardedTrainer, ShardingRules, make_mesh
 
@@ -190,17 +195,28 @@ def _train_bench(net, loss_fn, optimizer, opt_params, data, labels,
     # not host->device transfers of the same bytes every iteration
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    shard = NamedSharding(mesh, P("dp"))
-    place = lambda a: jax.device_put(a, shard)  # noqa: E731
-    data = tuple(place(x) for x in data) if isinstance(data, (list, tuple)) \
-        else place(data)
-    labels = jax.tree_util.tree_map(place, labels)
+    def place_tree(tree, spec):
+        sh = NamedSharding(mesh, spec)
+        return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tree)
+
+    if fuse:
+        stack = lambda a: onp.broadcast_to(  # noqa: E731
+            a[None], (fuse,) + a.shape).copy()
+        data = jax.tree_util.tree_map(stack, data)
+        labels = jax.tree_util.tree_map(stack, labels)
+        data = place_tree(data, P(None, "dp"))
+        labels = place_tree(labels, P(None, "dp"))
+        step = lambda: trainer.step_n(data, labels)  # noqa: E731
+        fetch = lambda ls: float(ls.asnumpy().reshape(-1)[-1])  # noqa: E731
+    else:
+        data = place_tree(data, P("dp"))
+        labels = place_tree(labels, P("dp"))
+        step = lambda: trainer.step(data, labels)  # noqa: E731
+        fetch = lambda loss: float(loss.asnumpy().reshape(-1)[0])  # noqa: E731
     # compile AND drain: on the lazy tunnel runtime only a host fetch
     # guarantees compilation + execution happened before the timed loops
-    float(trainer.step(data, labels).asnumpy().reshape(-1)[0])
-    dt = _timed_diff(lambda: trainer.step(data, labels),
-                     lambda loss: float(loss.asnumpy().reshape(-1)[0]),
-                     k1, k2)
+    fetch(step())
+    dt = _timed_diff(step, fetch, k1, k2)
     peak = _peak_flops()
     mfu = (trainer.step_flops / dt / peak) if (peak and trainer.step_flops) \
         else None
@@ -244,6 +260,34 @@ def bench_resnet_train(dtype=None):
     tag = "bf16_amp" if dtype else "fp32"
     return _emit({
         "metric": f"resnet50_v1_train_bs256_{tag}",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASE_TRAIN_IMG_S, 3),
+        "mfu": round(mfu, 4) if mfu else None,
+    })
+
+
+def bench_resnet_train_fused(n_fuse=4):
+    """ResNet-50 bf16 training with N steps fused into one dispatch
+    (`ShardedTrainer.step_n` lax.scan window — the bulk-exec path):
+    removes per-step host dispatch from the measurement, showing the
+    framework's compute ceiling."""
+    import numpy as onp
+
+    from mxnet_tpu import gluon
+
+    BATCH = 256
+    net = _make_resnet()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = onp.random.uniform(-1, 1, (BATCH, 3, 224, 224)).astype("float32")
+    y = onp.random.randint(0, 1000, (BATCH,)).astype("int32")
+    dt, mfu = _train_bench(
+        net, loss_fn, "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}, x, y,
+        dtype="bfloat16", fuse=n_fuse, k1=2, k2=8)
+    img_s = n_fuse * BATCH / dt
+    return _emit({
+        "metric": f"resnet50_v1_train_bs256_bf16_fused{n_fuse}",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASE_TRAIN_IMG_S, 3),
@@ -382,14 +426,15 @@ def main():
                      ("lenet_eager", bench_lenet_eager),
                      ("bert", bench_bert_train),
                      ("resnet_train_bf16",
-                      lambda: bench_resnet_train("bfloat16"))]:
+                      lambda: bench_resnet_train("bfloat16")),
+                     ("resnet_train_fused", bench_resnet_train_fused)]:
         try:
             rows[name] = fn()
         except Exception as e:  # keep the suite alive; report what ran
             failures[name] = f"{type(e).__name__}: {e}"
             print(f"# bench {name} failed: {failures[name]}", file=sys.stderr)
-    head = rows.get("resnet_train_bf16") or rows.get("bert") \
-        or rows.get("infer")
+    head = rows.get("resnet_train_fused") or rows.get("resnet_train_bf16") \
+        or rows.get("bert") or rows.get("infer")
     if head is None:
         _emit({"metric": "bench_failed", "value": 0, "unit": "",
                "vs_baseline": 0, "errors": failures})

@@ -138,8 +138,11 @@ def wait_all():
         deques.append(_pending_orphans)
         # prune registry entries for dead threads (their deques were just
         # captured above and get drained below) — no per-thread leak
-        dead = [ident for ident, (tref, _dq) in _pending_registry.items()
-                if tref() is None or not tref().is_alive()]
+        dead = []
+        for ident, (tref, _dq) in _pending_registry.items():
+            t = tref()  # bind once: the second deref could race GC
+            if t is None or not t.is_alive():
+                dead.append(ident)
         for ident in dead:
             del _pending_registry[ident]
     for dq in deques:

@@ -171,6 +171,10 @@ size_t count_lines(const char* p, const char* end) {
       comment = false;
     } else if (comment) {
       continue;
+    } else if (*p == ',') {
+      // separator-only lines (",,") carry no values: not content, keeping
+      // the row count consistent with what csv_parse actually writes
+      continue;
     } else if (!isspace(static_cast<unsigned char>(*p))) {
       if (*p == '#' && !content) comment = true;
       else content = true;
@@ -254,11 +258,12 @@ long csv_parse(const char* path, float* out, long cap, long ncols) {
       const char* end = m.data + bounds[i + 1];
       float* dst = out + row_off[i] * ncols;
       long col = 0;
+      size_t written = 0;
       bool any = false;
       while (p < end) {
         if (*p == '\n') {
           if (any && col != ncols) { errs[i] = 1; return; }
-          if (any) col = 0;
+          if (any) { col = 0; ++written; }
           any = false;
           ++p;
           continue;
@@ -280,7 +285,13 @@ long csv_parse(const char* path, float* out, long cap, long ncols) {
         any = true;
         p = next;
       }
-      if (any && col != ncols) errs[i] = 1;
+      if (any) {
+        if (col != ncols) { errs[i] = 1; return; }
+        ++written;
+      }
+      // every counted row must have been written — anything else would
+      // leave uninitialized tail rows in the caller's buffer
+      if (written != rows[i]) errs[i] = 1;
     });
   }
   for (auto& t : threads) t.join();

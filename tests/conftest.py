@@ -17,8 +17,12 @@ if _FLAG not in os.environ.get("XLA_FLAGS", ""):
 
 import jax  # noqa: E402
 
-# the axon sitecustomize pins JAX_PLATFORMS=axon; override to CPU for tests
-jax.config.update("jax_platforms", "cpu")
+# the axon sitecustomize pins JAX_PLATFORMS=axon; override to CPU for tests.
+# MXNET_TEST_PLATFORM=tpu keeps the real chip visible so the tpu-marked
+# smoke tests (tests/test_tpu_smoke.py) exercise real hardware:
+#   MXNET_TEST_PLATFORM=tpu python -m pytest tests/test_tpu_smoke.py
+if os.environ.get("MXNET_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as _np  # noqa: E402
 import pytest  # noqa: E402

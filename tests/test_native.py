@@ -157,19 +157,12 @@ def test_native_csv_comments_blank_and_pagesize(tmp_path):
     p.write_text("# header comment\n\n1,2,3\n# mid comment\n4,5,6\n")
     got = textparse_native.load_csv(str(p))
     onp.testing.assert_allclose(got, [[1, 2, 3], [4, 5, 6]])
-    # exactly page-sized file without trailing newline must not crash
+    # exactly page-sized file without trailing newline must not crash:
+    # build EXACTLY page bytes ending in a digit
     page = os.sysconf("SC_PAGE_SIZE")
     row = "1.5,2.5\n"
-    body = row * (page // len(row))
-    pad = page - len(body)
-    body = body[:-1]  # strip final newline
-    body = ("9," * ((pad + 1) // 2)).join([""]) + body  # keep simple: rebuild
-    # construct a file of EXACTLY page bytes ending in a digit
     content = row * (page // len(row))
-    content = content[:page - 4] 
-    content = content.rstrip("\n,")
-    filler = page - len(content) - 4
-    content = content + "\n" + "8" * 3
+    content = content[:page - 4].rstrip("\n,") + "\n"
     content = content + "1" * (page - len(content))
     assert len(content) == page and content[-1].isdigit()
     p2 = tmp_path / "exact.csv"
@@ -206,3 +199,16 @@ def test_libsvmiter_label_file_without_native(tmp_path, monkeypatch):
                         batch_size=2)
     b = next(iter(it))
     onp.testing.assert_allclose(b.label[0].asnumpy(), [5, 7])
+
+
+def test_native_csv_separator_only_line_errors(tmp_path):
+    """A ',,' line must raise cleanly, never return uninitialized rows."""
+    from mxnet_tpu.lib import textparse_native
+
+    if not textparse_native.available():
+        pytest.skip("no native toolchain")
+    p = tmp_path / "sep.csv"
+    p.write_text("1,2\n,,\n3,4\n")
+    got = textparse_native.load_csv(str(p))
+    # separator-only line carries no values -> skipped like a blank line
+    onp.testing.assert_allclose(got, [[1, 2], [3, 4]])

@@ -167,3 +167,47 @@ def test_fsdp_zero_shards_memory_and_matches_dp():
     # (c) a param really is sharded
     w = tr_fs.params["0.weight"]
     assert w.addressable_shards[0].data.shape[0] * 8 == w.shape[0]
+
+
+def test_step_n_matches_sequential_steps():
+    """One fused scan window == the same steps dispatched one by one
+    (bulk-exec semantics, engine.h:311-317)."""
+    np.random.seed(4)
+    net_a = _mlp()
+    net_b = _mlp()
+    pa, pb = net_a.collect_params(), net_b.collect_params()
+    for n in pa:
+        pb[n].set_data(pa[n].data())
+    mesh = make_mesh({"dp": 8})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr_a = ShardedTrainer(net_a, loss_fn, "sgd",
+                          {"learning_rate": 0.05, "momentum": 0.9},
+                          mesh=mesh, rules=ShardingRules(default_axis=None))
+    tr_b = ShardedTrainer(net_b, loss_fn, "sgd",
+                          {"learning_rate": 0.05, "momentum": 0.9},
+                          mesh=mesh, rules=ShardingRules(default_axis=None))
+    X = np.random.randn(4, 16, 20).astype("float32")
+    Y = np.random.randint(0, 10, (4, 16))
+    losses_fused = tr_a.step_n(X, Y).asnumpy()
+    losses_seq = [float(tr_b.step(X[i], Y[i]).asnumpy()) for i in range(4)]
+    np.testing.assert_allclose(losses_fused, losses_seq, rtol=1e-5,
+                               atol=1e-6)
+    for n in tr_a.params:
+        np.testing.assert_allclose(
+            np.asarray(tr_a.params[n]), np.asarray(tr_b.params[n]),
+            rtol=2e-5, atol=2e-5)
+
+
+def test_step_n_then_step_interleave():
+    """step_n and step share optimizer bookkeeping (update counts)."""
+    net = _mlp()
+    mesh = make_mesh({"dp": 8})
+    tr = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+                        {"learning_rate": 1e-2}, mesh=mesh,
+                        rules=ShardingRules(default_axis=None))
+    X = np.random.randn(3, 8, 20).astype("float32")
+    Y = np.random.randint(0, 10, (3, 8))
+    tr.step_n(X, Y)
+    loss = tr.step(X[0], Y[0])
+    assert np.isfinite(float(loss.asnumpy()))
+    assert tr._step_count == 4

@@ -1,0 +1,89 @@
+"""Real-chip smoke tests (the reference's tests/python/gpu/ role).
+
+Run with ``MXNET_TEST_PLATFORM=tpu python -m pytest tests/test_tpu_smoke.py``
+— the conftest then leaves the TPU platform active instead of pinning the
+virtual CPU mesh. On the CPU mesh these all skip.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np
+
+import jax
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() not in ("tpu", "axon"),
+    reason="needs the real TPU chip (MXNET_TEST_PLATFORM=tpu)")
+
+
+def test_tpu_context_and_eager_op():
+    ctx = mx.tpu()
+    assert ctx.real_device_type() in ("tpu", "axon")
+    a = np.ones((128, 128), ctx=ctx)
+    out = (np.tanh(a) @ a).asnumpy()
+    assert out.shape == (128, 128)
+    onp.testing.assert_allclose(out[0, 0], onp.tanh(1.0) * 128, rtol=1e-3)
+
+
+def test_flash_attention_pallas_path_executes():
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+
+    q = np.array(onp.random.randn(2, 4, 256, 64).astype("float32"),
+                 ctx=mx.tpu())
+    vl = np.array(onp.array([256, 180], "int32"), ctx=mx.tpu())
+    out = fa.attention(q._data, q._data, q._data, valid_length=vl._data)
+    assert fa.last_path() == "pallas"
+    ref = fa._reference_attention(q._data, q._data, q._data,
+                                  valid_length=vl._data)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-2, atol=2e-2)
+
+
+def test_bf16_amp_training_step_on_chip():
+    from mxnet_tpu.parallel import ShardedTrainer, ShardingRules, make_mesh
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(128, activation="relu"), gluon.nn.Dense(10))
+    net.initialize()
+    with autograd.predict_mode():
+        net(np.array(onp.zeros((2, 64), "float32")))
+    tr = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+                        {"learning_rate": 1e-2},
+                        mesh=make_mesh({"dp": 1}),
+                        rules=ShardingRules(default_axis=None),
+                        dtype="bfloat16")
+    X = onp.random.randn(32, 64).astype("float32")
+    Y = onp.random.randint(0, 10, (32,))
+    losses = [float(tr.step(X, Y).asnumpy()) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    assert tr.step_flops and tr.step_flops > 0
+
+
+def test_hybridize_donation_and_polymorphic_batch():
+    net = gluon.nn.Dense(16, in_units=32)
+    net.initialize(ctx=mx.tpu())
+    net.hybridize(static_alloc=True)
+    with autograd.predict_mode():
+        a = net(np.array(onp.ones((4, 32), "float32"), ctx=mx.tpu()))
+        b = net(np.array(onp.ones((7, 32), "float32"), ctx=mx.tpu()))
+    assert a.shape == (4, 16) and b.shape == (7, 16)
+
+
+def test_int8_quantized_dense_on_chip():
+    from mxnet_tpu.contrib import quantization as q
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(8))
+    net.initialize()
+    x = np.array(onp.random.randn(16, 32).astype("float32"))
+    with autograd.predict_mode():
+        ref = net(x).asnumpy()
+    q.quantize_net(net, calib_data=x, calib_mode="naive")
+    net.reset_ctx(mx.tpu())
+    xt = np.array(x.asnumpy(), ctx=mx.tpu())
+    with autograd.predict_mode():
+        got = net(xt).asnumpy()
+    corr = onp.corrcoef(got.ravel(), ref.ravel())[0, 1]
+    assert corr > 0.98
