@@ -218,8 +218,9 @@ def _train_bench(net, loss_fn, optimizer, opt_params, data, labels,
     fetch(step())
     dt = _timed_diff(step, fetch, k1, k2)
     peak = _peak_flops()
-    mfu = (trainer.step_flops / dt / peak) if (peak and trainer.step_flops) \
-        else None
+    # step_flops is per-step; a fused window executes `fuse` steps per dt
+    flops = (trainer.step_flops or 0) * (fuse or 1)
+    mfu = (flops / dt / peak) if (peak and flops) else None
     return dt, mfu
 
 

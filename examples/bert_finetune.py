@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""BERT classifier fine-tuning — the GluonNLP sentence-classification
+flow on the TPU-native stack (flash attention + bf16 SPMD step).
+
+Synthetic "sentiment" task: sequences whose token-id distribution leaks
+the label, so convergence is verifiable without a dataset.
+
+    python examples/bert_finetune.py --steps 30
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+
+def synthetic_batch(rng, batch, seq, vocab, num_classes):
+    y = rng.randint(0, num_classes, batch)
+    # class-dependent token bias: class c draws more tokens near c*vocab/C
+    x = rng.randint(1, vocab, (batch, seq))
+    for i, c in enumerate(y):
+        center = 1 + int((c + 0.5) * (vocab - 1) / num_classes)
+        n_bias = seq // 2
+        x[i, :n_bias] = rng.randint(max(1, center - 50),
+                                    min(vocab, center + 50), n_bias)
+    lengths = rng.randint(seq // 2, seq + 1, batch)
+    for i, L in enumerate(lengths):
+        x[i, L:] = 0  # pad: valid_length masks these in-kernel
+    return x.astype("int32"), y.astype("int32")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--classes", type=int, default=2)
+    ap.add_argument("--layers", type=int, default=2,
+                    help="encoder layers (12 = full BERT-base)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.models.bert import BERTClassifier, get_bert_model
+    from mxnet_tpu.parallel import (ShardedTrainer, ShardingRules, make_mesh)
+
+    VOCAB = 1000
+
+    class Step(HybridBlock):
+        """valid_length derived from the pad mask inside the trace."""
+
+        def __init__(self, model):
+            super().__init__()
+            self.model = model
+
+        def forward(self, tokens):
+            vl = (tokens != 0).sum(axis=1)
+            return self.model(tokens, valid_length=vl)
+
+    bert = get_bert_model("bert_12_768_12", vocab_size=VOCAB,
+                          num_layers=args.layers, dropout=0.1)
+    net = Step(BERTClassifier(bert, num_classes=args.classes))
+    net.initialize()
+    rng = onp.random.RandomState(0)
+    with autograd.predict_mode():
+        net(mnp.array(onp.ones((1, 8), "int32")))
+
+    mesh = make_mesh({"dp": len(jax.devices())})
+    trainer = ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 3e-4}, mesh=mesh,
+        rules=ShardingRules(default_axis=None), dtype="bfloat16")
+
+    x, y = synthetic_batch(rng, args.batch_size, args.seq_len, VOCAB,
+                           args.classes)
+    first = last = None
+    for step in range(args.steps):
+        loss = float(trainer.step(x, y).asnumpy())
+        if first is None:
+            first = loss
+        last = loss
+        if step % max(1, args.steps // 5) == 0:
+            print(f"step {step}: loss={loss:.4f}")
+    print(f"loss {first:.3f} -> {last:.3f}")
+    return 0 if last < first else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

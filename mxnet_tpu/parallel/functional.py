@@ -425,6 +425,59 @@ class ShardedTrainer:
             total += arr.addressable_shards[0].data.nbytes
         return total
 
+    # -- shared host-side step machinery ----------------------------------
+    def _unwrap_batch(self, data, labels):
+        import jax
+
+        from ..ndarray.ndarray import NDArray
+
+        def raw(x):
+            return x._data if isinstance(x, NDArray) else x
+
+        d = tuple(raw(x) for x in data) if isinstance(data, (list, tuple)) \
+            else raw(data)
+        l = jax.tree_util.tree_map(raw, labels,
+                                   is_leaf=lambda x: isinstance(x, NDArray))
+        return d, l
+
+    def _advance_optimizer(self, n):
+        """Advance step/update counts by n; return (lrs, wds, t_first)."""
+        t_first = self._step_count + 1
+        self._step_count += n
+        n_train = len(self._train_names)
+        for i in range(n_train):
+            self.optimizer._index_update_count[i] = self._step_count
+        lrs = tuple(self.optimizer._get_lr(i) for i in range(n_train))
+        wds = tuple(self.optimizer._get_wd(i) for i in range(n_train))
+        return lrs, wds, t_first
+
+    def _run_compiled(self, sig, jit_fn, args):
+        """AOT-compile once per signature (a partial final batch gets its
+        own executable): the compiled callable skips per-call signature
+        matching and exposes XLA's cost analysis — the exact per-step
+        FLOPs source for MFU reporting. Returns the executable's outputs;
+        updates params/opt state from the first three."""
+        hit = self._compiled.get(sig)
+        if hit is None:
+            compiled = jit_fn.lower(*args).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            flops = (ca or {}).get("flops")
+            self._compiled[sig] = (compiled, flops)
+        else:
+            compiled, flops = hit
+        # refresh per call so the property tracks the LAST executed
+        # program (scan bodies are counted once by XLA, so this stays a
+        # per-step figure even for step_n windows)
+        self._step_flops = flops
+        self._last_compiled = compiled
+        new_train, new_state, new_opt, out = compiled(*args)
+        self.params.update(new_train)
+        self.params.update(new_state)
+        self._opt_states = new_opt
+        return out
+
     def step(self, data, labels):
         """Run one SPMD training step; returns the scalar loss as an
         NDArray (async — reading/printing it syncs, dispatch does not).
@@ -437,45 +490,16 @@ class ShardedTrainer:
 
         if self._step_jit is None:
             self._build_step()
-
-        def raw(x):
-            return x._data if isinstance(x, NDArray) else x
-
-        d = tuple(raw(x) for x in data) if isinstance(data, (list, tuple)) \
-            else raw(data)
-        l = jax.tree_util.tree_map(raw, labels,
-                                   is_leaf=lambda x: isinstance(x, NDArray))
-        self._step_count += 1
-        t = self._step_count
-        n_train = len(self._train_names)
-        for i in range(n_train):
-            self.optimizer._index_update_count[i] = t
-        lrs = tuple(self.optimizer._get_lr(i) for i in range(n_train))
-        wds = tuple(self.optimizer._get_wd(i) for i in range(n_train))
+        d, l = self._unwrap_batch(data, labels)
+        lrs, wds, t = self._advance_optimizer(1)
         self._key, sub = jax.random.split(self._key)
         train = {n: self.params[n] for n in self._train_names}
         state = {n: self.params[n] for n in self._state_names}
         args = (train, state, self._opt_states, d, l, sub, lrs, wds, t)
-        # AOT-compile once per batch signature (a partial final batch gets
-        # its own executable): the compiled callable skips per-call
-        # signature matching (cheaper dispatch) and exposes XLA's cost
-        # analysis, the exact-FLOPs source for MFU reporting
         sig = tuple(
             (x.shape, str(x.dtype))
             for x in jax.tree_util.tree_leaves((d, l)))
-        compiled = self._compiled.get(sig)
-        if compiled is None:
-            compiled = self._step_jit.lower(*args).compile()
-            self._compiled[sig] = compiled
-            ca = compiled.cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0] if ca else {}
-            self._step_flops = (ca or {}).get("flops")
-        self._last_compiled = compiled
-        new_train, new_state, new_opt, loss = compiled(*args)
-        self.params.update(new_train)
-        self.params.update(new_state)
-        self._opt_states = new_opt
+        loss = self._run_compiled(sig, self._step_jit, args)
         return NDArray(loss)
 
     def step_n(self, data, labels, num_steps=None):
@@ -494,27 +518,19 @@ class ShardedTrainer:
 
         if self._step_jit is None:
             self._build_step()
-
-        def raw(x):
-            return x._data if isinstance(x, NDArray) else x
-
-        d = tuple(raw(x) for x in data) if isinstance(data, (list, tuple)) \
-            else raw(data)
-        l = jax.tree_util.tree_map(raw, labels,
-                                   is_leaf=lambda x: isinstance(x, NDArray))
-        n = num_steps or jax.tree_util.tree_leaves(d)[0].shape[0]
-        if jax.tree_util.tree_leaves(d)[0].shape[0] != n:
+        d, l = self._unwrap_batch(data, labels)
+        avail = jax.tree_util.tree_leaves(d)[0].shape[0]
+        n = avail if num_steps is None else int(num_steps)
+        if n < 1 or n > avail:
+            raise MXNetError(
+                f"step_n: num_steps={num_steps} but the stacked leading "
+                f"axis holds {avail} step batches")
+        if avail != n:
             # scan runs the whole leading axis: slice so bookkeeping
             # (update counts, lr schedule, FLOPs) matches execution
             d = jax.tree_util.tree_map(lambda x: x[:n], d)
             l = jax.tree_util.tree_map(lambda x: x[:n], l)
-        t0 = self._step_count + 1
-        self._step_count += n
-        n_train = len(self._train_names)
-        for i in range(n_train):
-            self.optimizer._index_update_count[i] = self._step_count
-        lrs = tuple(self.optimizer._get_lr(i) for i in range(n_train))
-        wds = tuple(self.optimizer._get_wd(i) for i in range(n_train))
+        lrs, wds, t0 = self._advance_optimizer(n)
         self._key, sub = jax.random.split(self._key)
         train = {k: self.params[k] for k in self._train_names}
         state = {k: self.params[k] for k in self._state_names}
@@ -522,22 +538,7 @@ class ShardedTrainer:
         sig = ("step_n", n, tuple(
             (x.shape, str(x.dtype))
             for x in jax.tree_util.tree_leaves((d, l))))
-        compiled = self._compiled.get(sig)
-        if compiled is None:
-            compiled = self._stepn_jit.lower(*args).compile()
-            self._compiled[sig] = compiled
-            ca = compiled.cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0] if ca else {}
-            flops = (ca or {}).get("flops")
-            # XLA cost analysis counts a while/scan BODY once, not per
-            # trip: scale by the window length for a whole-window figure
-            self._step_flops = flops * n if flops else flops
-        self._last_compiled = compiled
-        new_train, new_state, new_opt, losses = compiled(*args)
-        self.params.update(new_train)
-        self.params.update(new_state)
-        self._opt_states = new_opt
+        losses = self._run_compiled(sig, self._stepn_jit, args)
         return NDArray(losses)
 
     def sync_to_block(self):

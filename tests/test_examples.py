@@ -1,0 +1,37 @@
+"""Example scripts smoke tests (reference: example/ runnability is CI'd).
+Each runs tiny configs end-to-end on the virtual CPU mesh."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    return r.stdout
+
+
+def test_train_mnist_synthetic():
+    out = _run("train_mnist.py", "--synthetic", "--epochs", "2",
+               "--samples", "512", "--cpu")
+    assert "accuracy=" in out
+
+
+def test_train_imagenet_spmd_tiny():
+    out = _run("train_imagenet_spmd.py", "--model", "resnet18_v1",
+               "--batch-size", "16", "--steps", "4", "--image-size", "64")
+    assert "trained 4 steps" in out
+
+
+def test_bert_finetune_tiny():
+    out = _run("bert_finetune.py", "--steps", "8", "--batch-size", "8",
+               "--seq-len", "32", "--layers", "1")
+    assert "loss" in out

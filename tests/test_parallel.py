@@ -211,3 +211,23 @@ def test_step_n_then_step_interleave():
     loss = tr.step(X[0], Y[0])
     assert np.isfinite(float(loss.asnumpy()))
     assert tr._step_count == 4
+
+
+def test_step_n_validates_num_steps_and_keeps_flops_per_step():
+    net = _mlp()
+    mesh = make_mesh({"dp": 8})
+    tr = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                        {"learning_rate": 0.05}, mesh=mesh,
+                        rules=ShardingRules(default_axis=None))
+    X = np.random.randn(3, 8, 20).astype("float32")
+    Y = np.random.randint(0, 10, (3, 8))
+    with pytest.raises(mx.MXNetError, match="num_steps"):
+        tr.step_n(X, Y, num_steps=5)  # only 3 stacked batches
+    with pytest.raises(mx.MXNetError, match="num_steps"):
+        tr.step_n(X, Y, num_steps=0)
+    tr.step_n(X, Y, num_steps=2)
+    assert tr._step_count == 2
+    flops_window = tr.step_flops
+    tr.step(X[0], Y[0])
+    # the property stays per-step across both paths
+    assert abs(tr.step_flops - flops_window) / tr.step_flops < 0.2
