@@ -87,9 +87,12 @@ _LAZY_SUBMODULES = (
     "contrib",
     "config",
     "subgraph",
+    "visualization",
+    "viz",
 )
 
-_LAZY_ALIASES = {"kv": "kvstore", "sym": "symbol", "init": "initializer"}
+_LAZY_ALIASES = {"kv": "kvstore", "sym": "symbol", "init": "initializer",
+                 "viz": "visualization"}
 
 
 def __getattr__(name):

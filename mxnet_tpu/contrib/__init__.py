@@ -1,3 +1,5 @@
 """Contrib namespace (reference ``python/mxnet/contrib/``)."""
 from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import text  # noqa: F401

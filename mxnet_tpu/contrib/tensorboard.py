@@ -1,0 +1,154 @@
+"""TensorBoard logging (reference: ``python/mxnet/contrib/tensorboard.py``).
+
+The reference delegates to the external ``mxboard`` package. This build is
+self-contained: ``SummaryWriter`` serializes TensorBoard event files
+directly (TFRecord framing + hand-rolled protobuf for the tiny
+``Event``/``Summary`` messages), so ``tensorboard --logdir`` works with no
+extra dependency. Scalar summaries only — that is all
+``LogMetricsCallback`` (the reference's public surface) ever emits.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+# -- crc32c (Castagnoli, reflected poly 0x82F63B78) --------------------------
+# TFRecord framing requires masked crc32c checksums; pure Python is fine at
+# logging rates (a few records per step).
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- minimal protobuf wire encoding ------------------------------------------
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        # protobuf int64: negatives use the 10-byte two's-complement form
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _summary_value(tag: str, value: float) -> bytes:
+    # Summary.Value: tag = field 1 (string), simple_value = field 2 (float)
+    return (_len_delim(1, tag.encode("utf-8"))
+            + _tag(2, 5) + struct.pack("<f", float(value)))
+
+
+def _event(wall_time: float, step: int = 0, *, file_version: str = None,
+           scalars=None) -> bytes:
+    # Event: wall_time = field 1 (double), step = field 2 (int64),
+    #        file_version = field 3 (string), summary = field 5 (Summary)
+    msg = _tag(1, 1) + struct.pack("<d", wall_time)
+    if step:
+        msg += _tag(2, 0) + _varint(step)
+    if file_version is not None:
+        msg += _len_delim(3, file_version.encode("utf-8"))
+    if scalars:
+        summary = b"".join(_len_delim(1, _summary_value(t, v))
+                           for t, v in scalars)
+        msg += _len_delim(5, summary)
+    return msg
+
+
+class SummaryWriter:
+    """Writes TensorBoard scalar event files (``events.out.tfevents.*``)."""
+
+    _seq = 0
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        # pid + per-process counter keep concurrent writers on the same
+        # logdir from truncating each other's files
+        SummaryWriter._seq += 1
+        fname = "events.out.tfevents.%d.%s.%d.%d" % (
+            int(time.time()), os.uname().nodename, os.getpid(),
+            SummaryWriter._seq)
+        self._path = os.path.join(logdir, fname)
+        self._f = open(self._path, "wb")
+        self._write_record(_event(time.time(),
+                                  file_version="brain.Event:2"))
+
+    def _write_record(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+        self._f.flush()
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._write_record(_event(time.time(), int(global_step),
+                                  scalars=[(tag, value)]))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class LogMetricsCallback:
+    """Periodically log metric values as TensorBoard scalars (reference
+    ``contrib/tensorboard.py:24`` — same callback signature: called with a
+    param object carrying ``eval_metric``)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = SummaryWriter(logging_dir)
+        self.step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

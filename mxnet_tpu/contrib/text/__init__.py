@@ -1,0 +1,3 @@
+"""Text utilities (reference: ``python/mxnet/contrib/text/``)."""
+from . import embedding, utils, vocab
+from .vocab import Vocabulary

@@ -1,0 +1,268 @@
+"""Token embeddings (reference:
+``python/mxnet/contrib/text/embedding.py`` — registry + ``create``,
+``_TokenEmbedding`` loading ``token<delim>vec...`` text files,
+``GloVe``/``FastText`` named sources, ``CustomEmbedding``,
+``CompositeEmbedding``).
+
+TPU-build differences: vectors land in an NDArray (host-resident until
+used), and pretrained archives are never downloaded (zero-egress
+environment) — ``GloVe``/``FastText`` resolve ``pretrained_file_name``
+inside ``embedding_root`` and raise with guidance when the file is not
+already on disk.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as onp
+
+from ...base import MXNetError
+from . import vocab as _vocab
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Register a ``_TokenEmbedding`` subclass under its lowercase name
+    (reference ``embedding.py:40``)."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding, e.g.
+    ``create('glove', pretrained_file_name=...)`` (reference
+    ``embedding.py:63``)."""
+    cls = _REGISTRY.get(embedding_name.lower())
+    if cls is None:
+        raise MXNetError(
+            "unknown embedding %r; registered: %s"
+            % (embedding_name, sorted(_REGISTRY)))
+    return cls(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained source file names per embedding (reference
+    ``embedding.py:90``)."""
+    if embedding_name is not None:
+        cls = _REGISTRY.get(embedding_name.lower())
+        if cls is None:
+            raise MXNetError("unknown embedding %r" % embedding_name)
+        return list(cls.pretrained_file_name_sha1)
+    return {name: list(cls.pretrained_file_name_sha1)
+            for name, cls in _REGISTRY.items()}
+
+
+class _TokenEmbedding(_vocab.Vocabulary):
+    """Base embedding: a Vocabulary whose indices also map to vectors."""
+
+    pretrained_file_name_sha1 = {}
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf8",
+                        restrict_vocab=False):
+        """Parse a ``token<delim>v1...`` file. Open mode (default): every
+        new file token is appended to the index. Vocabulary mode
+        (``restrict_vocab=True``): the index is fixed to the pre-seeded
+        vocabulary and the file only fills in vectors for those tokens."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise MXNetError(
+                "`pretrained_file_path` must point to an existing "
+                "embedding text file; got %r" % pretrained_file_path)
+        indexed = set(self._idx_to_token)
+        file_vecs = {}
+        with open(pretrained_file_path, "rb") as f:
+            for line_num, raw in enumerate(f, 1):
+                try:
+                    line = raw.decode(encoding)
+                except UnicodeDecodeError:
+                    logging.warning(
+                        "line %d in %s: skipped undecodable bytes",
+                        line_num, pretrained_file_path)
+                    continue
+                elems = line.rstrip().split(elem_delim)
+                if len(elems) < 2:
+                    continue
+                if line_num == 1 and len(elems) == 2 \
+                        and all(e.isdigit() for e in elems):
+                    # fastText-style header line "num_tokens dim"
+                    continue
+                token, vec = elems[0], elems[1:]
+                if not token or token in file_vecs:
+                    continue
+                if restrict_vocab and token not in indexed:
+                    continue
+                try:
+                    vec = [float(x) for x in vec]
+                except ValueError:
+                    logging.warning(
+                        "line %d in %s: skipped non-numeric vector",
+                        line_num, pretrained_file_path)
+                    continue
+                if self._vec_len == 0:
+                    self._vec_len = len(vec)
+                elif len(vec) != self._vec_len:
+                    logging.warning(
+                        "line %d in %s: dim %d != %d, skipped",
+                        line_num, pretrained_file_path, len(vec),
+                        self._vec_len)
+                    continue
+                file_vecs[token] = vec
+                if not restrict_vocab and token not in indexed:
+                    indexed.add(token)
+                    self._token_to_idx[token] = len(self._idx_to_token)
+                    self._idx_to_token.append(token)
+        mat = onp.zeros((len(self._idx_to_token), self._vec_len), "float32")
+        for i, token in enumerate(self._idx_to_token):
+            if token in file_vecs:
+                mat[i] = file_vecs[token]
+            elif i:
+                mat[i] = init_unknown_vec((self._vec_len,))
+        mat[0] = init_unknown_vec((self._vec_len,))
+        from ... import numpy as mnp
+
+        self._idx_to_vec = mnp.array(mat)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for ``tokens``; unknown tokens get index-0's vector
+        (reference ``embedding.py:370``)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            idx = [self._token_to_idx.get(
+                t, self._token_to_idx.get(t.lower(), 0)) for t in toks]
+        else:
+            idx = [self._token_to_idx.get(t, 0) for t in toks]
+        from ... import numpy as mnp
+
+        vecs = self._idx_to_vec[mnp.array(onp.asarray(idx, "int32"))]
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors for known ``tokens`` (reference
+        ``embedding.py:415``)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        for t in toks:
+            if t not in self._token_to_idx:
+                raise MXNetError("token %r is unknown to this embedding" % t)
+        arr = onp.array(self._idx_to_vec.asnumpy())
+        vals = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else onp.asarray(new_vectors, "float32")
+        vals = vals.reshape(len(toks), self._vec_len)
+        for t, v in zip(toks, vals):
+            arr[self._token_to_idx[t]] = v
+        from ... import numpy as mnp
+
+        self._idx_to_vec = mnp.array(arr)
+
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        if pretrained_file_name not in cls.pretrained_file_name_sha1:
+            raise MXNetError(
+                "cannot find pretrained file %r for %s; expected one of %s"
+                % (pretrained_file_name, cls.__name__,
+                   sorted(cls.pretrained_file_name_sha1)))
+
+    @classmethod
+    def _resolve_pretrained(cls, embedding_root, pretrained_file_name):
+        cls._check_pretrained_file_names(pretrained_file_name)
+        path = os.path.join(os.path.expanduser(embedding_root),
+                            cls.__name__.lower(), pretrained_file_name)
+        if not os.path.isfile(path):
+            raise MXNetError(
+                "pretrained file %s not found. This build runs without "
+                "network egress: download it elsewhere and place it at "
+                "that path." % path)
+        return path
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe vectors from a local copy of the published .txt files."""
+
+    pretrained_file_name_sha1 = {
+        name: None for name in [
+            "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+            "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+            "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+            "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt",
+        ]}
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=onp.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        path = self._resolve_pretrained(embedding_root, pretrained_file_name)
+        if vocabulary is not None:
+            self._index_tokens_from_vocabulary(vocabulary)
+        self._load_embedding(path, " ", init_unknown_vec,
+                             restrict_vocab=vocabulary is not None)
+
+    def _index_tokens_from_vocabulary(self, vocabulary):
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText vectors from a local copy of the published .vec files."""
+
+    pretrained_file_name_sha1 = {
+        name: None for name in [
+            "wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec", "wiki.fr.vec",
+            "wiki.de.vec", "wiki.es.vec", "wiki.ru.vec", "wiki.ja.vec",
+        ]}
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=onp.zeros, **kwargs):
+        super().__init__(**kwargs)
+        path = self._resolve_pretrained(embedding_root, pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from a user file of ``token<delim>v1<delim>v2...`` lines
+    (reference ``embedding.py:635``)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=onp.zeros, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    ``embedding.py:677``)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        super().__init__()
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = []
+        for emb in token_embeddings:
+            parts.append(emb.get_vecs_by_tokens(self._idx_to_token))
+        from ... import numpy as mnp
+
+        self._idx_to_vec = mnp.concatenate(parts, axis=-1)
+        self._vec_len = self._idx_to_vec.shape[-1]

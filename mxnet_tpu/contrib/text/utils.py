@@ -1,0 +1,19 @@
+"""Text helpers (reference: ``python/mxnet/contrib/text/utils.py:26``)."""
+from __future__ import annotations
+
+import collections
+import re
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in ``source_str`` split on the ``token_delim`` /
+    ``seq_delim`` regular expressions; returns (or updates) a Counter."""
+    tokens = filter(None, re.split(token_delim + "|" + seq_delim,
+                                   source_str))
+    if to_lower:
+        tokens = [t.lower() for t in tokens]
+    if counter_to_update is None:
+        return collections.Counter(tokens)
+    counter_to_update.update(tokens)
+    return counter_to_update

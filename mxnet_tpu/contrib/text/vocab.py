@@ -1,0 +1,91 @@
+"""Text vocabulary indexing (reference:
+``python/mxnet/contrib/text/vocab.py:28`` — same public surface:
+``Vocabulary(counter, most_freq_count, min_freq, unknown_token,
+reserved_tokens)``, ``to_indices``, ``to_tokens``, ``token_to_idx``,
+``idx_to_token``)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+
+
+class Vocabulary:
+    """Maps tokens <-> integer indices.
+
+    Index 0 is the unknown token; reserved tokens follow, then counter keys
+    sorted by descending frequency (ties broken alphabetically), capped at
+    ``most_freq_count`` and filtered by ``min_freq``.
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("`min_freq` must be set to a positive value")
+        if reserved_tokens is not None:
+            reserved = set(reserved_tokens)
+            if unknown_token in reserved:
+                raise MXNetError(
+                    "`reserved_tokens` cannot contain `unknown_token`")
+            if len(reserved) != len(reserved_tokens):
+                raise MXNetError(
+                    "`reserved_tokens` cannot contain duplicate tokens")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens else None)
+        self._idx_to_token = [unknown_token] + (self._reserved_tokens or [])
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        existing = set(self._idx_to_token)
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        budget = (most_freq_count if most_freq_count is not None
+                  else len(pairs))
+        for token, freq in pairs:
+            if freq < min_freq or budget <= 0:
+                break
+            if token in existing:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            budget -= 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token (or list of tokens) -> index (or list of indices);
+        unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        """Index (or list of indices) -> token (or list of tokens)."""
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        toks = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(
+                    "token index %d out of range [0, %d)"
+                    % (i, len(self._idx_to_token)))
+            toks.append(self._idx_to_token[i])
+        return toks[0] if single else toks

@@ -8,23 +8,34 @@ from . import distributions
 from .distributions import (
     Bernoulli,
     Beta,
+    Binomial,
     Categorical,
     Cauchy,
     Chi2,
     Dirichlet,
     Distribution,
     Exponential,
+    FisherSnedecor,
     Gamma,
     Geometric,
     Gumbel,
+    HalfCauchy,
     HalfNormal,
+    Independent,
     Laplace,
+    Multinomial,
     MultivariateNormal,
+    NegativeBinomial,
     Normal,
+    OneHotCategorical,
+    Pareto,
     Poisson,
+    RelaxedBernoulli,
+    RelaxedOneHotCategorical,
     StudentT,
     Uniform,
     Weibull,
+    empirical_kl,
     kl_divergence,
     register_kl,
 )

@@ -71,8 +71,13 @@ class Distribution:
     def entropy(self):
         raise NotImplementedError
 
-    def _shape(self, size, param):
+    def _shape(self, size, param, *more_params):
+        import numpy as onp
+
         base = tuple(param.shape)
+        if more_params:
+            base = onp.broadcast_shapes(
+                base, *[tuple(p.shape) for p in more_params])
         if size is None:
             return base
         if isinstance(size, int):
@@ -101,7 +106,7 @@ class Normal(Distribution):
     def sample(self, size=None):
         jr = _jr()
         key = _rng.next_key()
-        shape = self._shape(size, self.loc)
+        shape = self._shape(size, self.loc, self.scale)
 
         def f(loc, scale):
             return loc + scale * jr.normal(key, shape)
@@ -144,7 +149,7 @@ class Laplace(Distribution):
     def sample(self, size=None):
         jr = _jr()
         key = _rng.next_key()
-        shape = self._shape(size, self.loc)
+        shape = self._shape(size, self.loc, self.scale)
 
         def f(loc, scale):
             return loc + scale * jr.laplace(key, shape)
@@ -160,11 +165,13 @@ class Laplace(Distribution):
         return 2.0 * self.scale ** 2
 
 
-class Bernoulli(Distribution):
-    def __init__(self, prob=None, logit=None, **kwargs):
+class _ProbLogitMixin:
+    """Shared prob=/logit= dual parameterization (sigmoid link) used by
+    Bernoulli, Binomial and NegativeBinomial."""
+
+    def _init_prob_logit(self, prob, logit):
         from ... import numpy as mnp
 
-        super().__init__(**kwargs)
         if (prob is None) == (logit is None):
             raise MXNetError("give exactly one of prob=/logit=")
         self._prob = (mnp.array(prob) if prob is not None
@@ -187,6 +194,12 @@ class Bernoulli(Distribution):
         jnp = _jnp()
         return _wrap(lambda p: jnp.log(p) - jnp.log1p(-p), self._prob,
                      name="logit")
+
+
+class Bernoulli(_ProbLogitMixin, Distribution):
+    def __init__(self, prob=None, logit=None, **kwargs):
+        super().__init__(**kwargs)
+        self._init_prob_logit(prob, logit)
 
     def log_prob(self, value):
         jnp = _jnp()
@@ -293,7 +306,7 @@ class Uniform(Distribution):
     def sample(self, size=None):
         jr = _jr()
         key = _rng.next_key()
-        shape = self._shape(size, self.low)
+        shape = self._shape(size, self.low, self.high)
 
         def f(lo, hi):
             return lo + (hi - lo) * jr.uniform(key, shape)
@@ -358,7 +371,7 @@ class Gamma(Distribution):
     def sample(self, size=None):
         jr = _jr()
         key = _rng.next_key()
-        shape = self._shape(size, self.shape_param)
+        shape = self._shape(size, self.shape_param, self.scale)
 
         def f(a, s):
             return s * jr.gamma(key, a, shape)
@@ -392,7 +405,7 @@ class Beta(Distribution):
     def sample(self, size=None):
         jr = _jr()
         key = _rng.next_key()
-        shape = self._shape(size, self.alpha)
+        shape = self._shape(size, self.alpha, self.beta)
 
         def f(a, b):
             return jr.beta(key, a, b, shape)
@@ -602,7 +615,7 @@ class StudentT(Distribution):
     def sample(self, size=None):
         jr = _jr()
         key = _rng.next_key()
-        shape = self._shape(size, self.loc)
+        shape = self._shape(size, self.loc, self.df, self.scale)
 
         def f(df, loc, scale):
             return loc + scale * jr.t(key, df, shape)
@@ -640,7 +653,7 @@ class Cauchy(Distribution):
     def sample(self, size=None):
         jr = _jr()
         key = _rng.next_key()
-        shape = self._shape(size, self.loc)
+        shape = self._shape(size, self.loc, self.scale)
 
         def f(loc, scale):
             return loc + scale * jr.cauchy(key, shape)
@@ -771,7 +784,7 @@ class Gumbel(Distribution):
     def sample(self, size=None):
         jr = _jr()
         key = _rng.next_key()
-        shape = self._shape(size, self.loc)
+        shape = self._shape(size, self.loc, self.scale)
 
         def f(loc, scale):
             return loc + scale * jr.gumbel(key, shape)
@@ -781,6 +794,478 @@ class Gumbel(Distribution):
     @property
     def mean(self):
         return self.loc + self.scale * 0.5772156649015329
+
+
+class Binomial(_ProbLogitMixin, Distribution):
+    """Binomial(n, p) (reference ``distributions/binomial.py``)."""
+
+    def __init__(self, n=1, prob=None, logit=None, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.n = mnp.array(n) if not hasattr(n, "_data") else n
+        self._init_prob_logit(prob, logit)
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, n, l):
+            import jax.scipy.special as jss
+
+            binom = (jss.gammaln(n + 1) - jss.gammaln(v + 1)
+                     - jss.gammaln(n - v + 1))
+            # v*l - n*softplus(l) is the stable logit form
+            return binom + v * l - n * jnp.logaddexp(0.0, l)
+
+        return _wrap(f, value, self.n, self.logit, name="binomial_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        p = self.prob
+        shape = self._shape(size, p, self.n)
+
+        def f(n, pp):
+            return jr.binomial(key, n, pp, shape=shape).astype("float32")
+
+        return _wrap(f, self.n, p, name="binomial_sample")
+
+    @property
+    def mean(self):
+        return self.n * self.prob
+
+    @property
+    def variance(self):
+        p = self.prob
+        return self.n * p * (1 - p)
+
+
+class NegativeBinomial(_ProbLogitMixin, Distribution):
+    """Failures-before-n-successes form: P(X=k) = C(k+n-1,k)(1-p)^n p^k
+    (reference ``distributions/negative_binomial.py``)."""
+
+    def __init__(self, n=1, prob=None, logit=None, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.n = mnp.array(n) if not hasattr(n, "_data") else n
+        self._init_prob_logit(prob, logit)
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, n, p):
+            import jax.scipy.special as jss
+
+            coef = (jss.gammaln(v + n) - jss.gammaln(n)
+                    - jss.gammaln(v + 1))
+            return coef + n * jnp.log1p(-p) + v * jnp.log(p)
+
+        return _wrap(f, value, self.n, self.prob, name="negbinomial_logp")
+
+    def sample(self, size=None):
+        # Gamma-Poisson mixture: lam ~ Gamma(n, p/(1-p)), X ~ Poisson(lam)
+        jr = _jr()
+        import jax
+
+        k1, k2 = jax.random.split(_rng.next_key())
+        p = self.prob
+        shape = self._shape(size, p, self.n)
+
+        def f(n, pp):
+            lam = jr.gamma(k1, n, shape) * (pp / (1 - pp))
+            return jr.poisson(k2, lam).astype("float32")
+
+        return _wrap(f, self.n, p, name="negbinomial_sample")
+
+    @property
+    def mean(self):
+        p = self.prob
+        return self.n * p / (1 - p)
+
+    @property
+    def variance(self):
+        p = self.prob
+        return self.n * p / (1 - p) ** 2
+
+
+class Multinomial(Distribution):
+    """Counts over ``num_events`` categories from ``total_count`` draws
+    (reference ``distributions/multinomial.py``)."""
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 total_count=1, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(event_dim=1, **kwargs)
+        if (prob is None) == (logit is None):
+            raise MXNetError("give exactly one of prob=/logit=")
+        self._prob = (mnp.array(prob) if prob is not None
+                      and not hasattr(prob, "_data") else prob)
+        self._logit = (mnp.array(logit) if logit is not None
+                       and not hasattr(logit, "_data") else logit)
+        self.total_count = int(total_count)
+        self.num_events = num_events
+
+    @property
+    def prob(self):
+        if self._prob is not None:
+            return self._prob
+        import jax
+
+        return _wrap(lambda l: jax.nn.softmax(l, axis=-1), self._logit,
+                     name="softmax")
+
+    @property
+    def logit(self):
+        if self._logit is not None:
+            return self._logit
+        jnp = _jnp()
+        return _wrap(lambda p: jnp.log(p), self._prob, name="log")
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, p):
+            import jax.scipy.special as jss
+
+            n = jnp.sum(v, -1)
+            coef = jss.gammaln(n + 1) - jnp.sum(jss.gammaln(v + 1), -1)
+            # xlogy: 0 * log(0) contributes 0 for empty categories
+            return coef + jnp.sum(jss.xlogy(v, p), -1)
+
+        return _wrap(f, value, self.prob, name="multinomial_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        jnp = _jnp()
+        key = _rng.next_key()
+        p = self.prob
+        count = self.total_count
+        pre = (tuple(size) if isinstance(size, (tuple, list))
+               else ((size,) if size else ()))
+
+        def f(pp):
+            # jr.multinomial produces the counts directly — O(batch*k)
+            # memory regardless of total_count
+            n = jnp.full(pre + tuple(pp.shape[:-1]), float(count))
+            probs = jnp.broadcast_to(pp, pre + tuple(pp.shape))
+            return jr.multinomial(key, n, probs).astype("float32")
+
+        return _wrap(f, p, name="multinomial_sample")
+
+    @property
+    def mean(self):
+        return self.total_count * self.prob
+
+
+class FisherSnedecor(Distribution):
+    """F-distribution (reference ``distributions/fishersnedecor.py``)."""
+
+    def __init__(self, df1, df2, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.df1 = mnp.array(df1) if not hasattr(df1, "_data") else df1
+        self.df2 = mnp.array(df2) if not hasattr(df2, "_data") else df2
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, d1, d2):
+            import jax.scipy.special as jss
+
+            lbeta = (jss.gammaln(d1 / 2) + jss.gammaln(d2 / 2)
+                     - jss.gammaln((d1 + d2) / 2))
+            safe_v = jnp.where(v > 0, v, 1.0)
+            lp = (d1 / 2 * jnp.log(d1) + d2 / 2 * jnp.log(d2)
+                  + (d1 / 2 - 1) * jnp.log(safe_v)
+                  - (d1 + d2) / 2 * jnp.log(d2 + d1 * safe_v) - lbeta)
+            return jnp.where(v > 0, lp, -jnp.inf)
+
+        return _wrap(f, value, self.df1, self.df2, name="fishersnedecor_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.df1, self.df2)
+
+        def f(d1, d2):
+            return jr.f(key, d1, d2, shape)
+
+        return _wrap(f, self.df1, self.df2, name="fishersnedecor_sample")
+
+    @property
+    def mean(self):
+        return self.df2 / (self.df2 - 2)
+
+
+class HalfCauchy(Distribution):
+    """|Cauchy(0, scale)| (reference ``distributions/half_cauchy.py``)."""
+
+    def __init__(self, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, scale):
+            z = v / scale
+            return (math.log(2 / math.pi) - jnp.log(scale)
+                    - jnp.log1p(z ** 2)
+                    + jnp.where(v >= 0, 0.0, -jnp.inf))
+
+        return _wrap(f, value, self.scale, name="halfcauchy_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.scale)
+
+        def f(scale):
+            return _jnp().abs(scale * jr.cauchy(key, shape))
+
+        return _wrap(f, self.scale, name="halfcauchy_sample")
+
+
+class Pareto(Distribution):
+    """Pareto Type I (reference ``distributions/pareto.py``)."""
+
+    def __init__(self, alpha, scale=1.0, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self.alpha = mnp.array(alpha) if not hasattr(alpha, "_data") else alpha
+        self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, a, m):
+            inside = v >= m
+            return jnp.where(
+                inside,
+                jnp.log(a) + a * jnp.log(m) - (a + 1) * jnp.log(v),
+                -jnp.inf)
+
+        return _wrap(f, value, self.alpha, self.scale, name="pareto_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        key = _rng.next_key()
+        shape = self._shape(size, self.alpha, self.scale)
+
+        def f(a, m):
+            u = jr.uniform(key, shape, minval=1e-7, maxval=1.0)
+            return m * u ** (-1.0 / a)
+
+        return _wrap(f, self.alpha, self.scale, name="pareto_sample")
+
+    @property
+    def mean(self):
+        from ... import numpy as mnp
+
+        return mnp.where(self.alpha > 1,
+                         self.alpha * self.scale / (self.alpha - 1),
+                         mnp.array(float("inf")))
+
+
+class OneHotCategorical(Distribution):
+    """One-hot coded categorical (reference
+    ``distributions/one_hot_categorical.py``)."""
+
+    def __init__(self, num_events=None, prob=None, logit=None, **kwargs):
+        super().__init__(**kwargs)
+        self._base = Categorical(num_events=num_events, prob=prob,
+                                 logit=logit)
+        self.event_dim = 1
+        self.num_events = num_events
+
+    @property
+    def prob(self):
+        return self._base.prob
+
+    @property
+    def logit(self):
+        return self._base.logit
+
+    def log_prob(self, value):
+        import jax
+        jnp = _jnp()
+
+        def f(v, l):
+            return jnp.sum(v * jax.nn.log_softmax(l, -1), -1)
+
+        return _wrap(f, value, self.logit, name="onehot_categorical_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        jnp = _jnp()
+        key = _rng.next_key()
+        logit = self.logit
+        pre = (tuple(size) if isinstance(size, (tuple, list))
+               else ((size,) if size else ()))
+
+        def f(l):
+            k = l.shape[-1]
+            draws = jr.categorical(key, l, shape=pre + tuple(l.shape[:-1]))
+            return (draws[..., None] == jnp.arange(k)).astype("float32")
+
+        return _wrap(f, logit, name="onehot_categorical_sample")
+
+    @property
+    def mean(self):
+        return self.prob
+
+
+class RelaxedBernoulli(Distribution):
+    """Concrete / Gumbel-sigmoid relaxation (reference
+    ``distributions/relaxed_bernoulli.py``)."""
+
+    def __init__(self, T=1.0, prob=None, logit=None, **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(**kwargs)
+        self._base = Bernoulli(prob=prob, logit=logit)
+        self.T = mnp.array(T) if not hasattr(T, "_data") else T
+
+    @property
+    def prob(self):
+        return self._base.prob
+
+    @property
+    def logit(self):
+        return self._base.logit
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, t, l):
+            # BinConcrete density (Maddison et al. 2017, eq. 24) in log space
+            z = jnp.log(v) - jnp.log1p(-v)
+            u = l - t * z
+            return (jnp.log(t) + u - 2 * jnp.logaddexp(0.0, u)
+                    - jnp.log(v) - jnp.log1p(-v))
+
+        return _wrap(f, value, self.T, self.logit,
+                     name="relaxed_bernoulli_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        jnp = _jnp()
+        key = _rng.next_key()
+        logit = self.logit
+        shape = self._shape(size, logit)
+
+        def f(t, l):
+            u = jr.uniform(key, shape, minval=1e-7, maxval=1 - 1e-7)
+            noise = jnp.log(u) - jnp.log1p(-u)
+            return 1 / (1 + jnp.exp(-(l + noise) / t))
+
+        return _wrap(f, self.T, logit, name="relaxed_bernoulli_sample")
+
+
+class RelaxedOneHotCategorical(Distribution):
+    """Gumbel-softmax relaxation (reference
+    ``distributions/relaxed_one_hot_categorical.py``)."""
+
+    def __init__(self, T=1.0, num_events=None, prob=None, logit=None,
+                 **kwargs):
+        from ... import numpy as mnp
+
+        super().__init__(event_dim=1, **kwargs)
+        self._base = Categorical(num_events=num_events, prob=prob,
+                                 logit=logit)
+        self.T = mnp.array(T) if not hasattr(T, "_data") else T
+        self.num_events = num_events
+
+    @property
+    def prob(self):
+        return self._base.prob
+
+    @property
+    def logit(self):
+        return self._base.logit
+
+    def log_prob(self, value):
+        jnp = _jnp()
+
+        def f(v, t, l):
+            import jax.scipy.special as jss
+
+            k = l.shape[-1]
+            score = l - t * jnp.log(v)
+            score = score - jss.logsumexp(score, -1, keepdims=True)
+            return (jss.gammaln(jnp.asarray(float(k)))
+                    + (k - 1) * jnp.log(t)
+                    + jnp.sum(score - jnp.log(v), -1))
+
+        return _wrap(f, value, self.T, self.logit,
+                     name="relaxed_onehot_logp")
+
+    def sample(self, size=None):
+        jr = _jr()
+        import jax
+        key = _rng.next_key()
+        logit = self.logit
+        pre = (tuple(size) if isinstance(size, (tuple, list))
+               else ((size,) if size else ()))
+
+        def f(t, l):
+            g = jr.gumbel(key, pre + tuple(l.shape))
+            return jax.nn.softmax((l + g) / t, axis=-1)
+
+        return _wrap(f, self.T, logit, name="relaxed_onehot_sample")
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims (reference
+    ``distributions/independent.py``): log_prob sums over them."""
+
+    def __init__(self, base_distribution, reinterpreted_batch_ndims,
+                 **kwargs):
+        super().__init__(
+            event_dim=base_distribution.event_dim
+            + reinterpreted_batch_ndims, **kwargs)
+        self.base_dist = base_distribution
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+
+    def log_prob(self, value):
+        jnp = _jnp()
+        base_lp = self.base_dist.log_prob(value)
+        n = self.reinterpreted_batch_ndims
+
+        def f(lp):
+            return jnp.sum(lp, axis=tuple(range(-n, 0)))
+
+        return _wrap(f, base_lp, name="independent_logp")
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    def sample_n(self, n):
+        return self.base_dist.sample_n(n)
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+    def entropy(self):
+        jnp = _jnp()
+        base_ent = self.base_dist.entropy()
+        n = self.reinterpreted_batch_ndims
+
+        def f(e):
+            return jnp.sum(e, axis=tuple(range(-n, 0)))
+
+        return _wrap(f, base_ent, name="independent_entropy")
 
 
 class Weibull(Distribution):
@@ -805,10 +1290,311 @@ class Weibull(Distribution):
     def sample(self, size=None):
         jr = _jr()
         key = _rng.next_key()
-        shape = self._shape(size, self.concentration)
+        shape = self._shape(size, self.concentration, self.scale)
 
         def f(k, scale):
             u = jr.uniform(key, shape, minval=1e-7, maxval=1.0)
             return scale * (-_jnp().log(u)) ** (1.0 / k)
 
         return _wrap(f, self.concentration, self.scale, name="weibull_sample")
+
+
+# -- KL registry, part 2: the full reference registration set ----------------
+# (reference ``distributions/divergence.py`` registers same-family KLs for
+# every closed-form pair plus Uniform->Normal/Gumbel and
+# Exponential->Gumbel/Normal/Gamma cross terms. All formulas below are the
+# standard closed forms, written against jnp directly.)
+
+def empirical_kl(p, q, n_samples=1):
+    """Monte-Carlo estimate of KL(p||q): mean of log p(x) - log q(x) over
+    ``n_samples`` draws from p (reference ``divergence.py:empirical_kl``)."""
+    samples = p.sample_n(n_samples)
+    jnp = _jnp()
+
+    def f(lp, lq):
+        return jnp.mean(lp - lq, axis=0)
+
+    return _wrap(f, p.log_prob(samples), q.log_prob(samples),
+                 name="empirical_kl")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    jnp = _jnp()
+
+    def f(sp, sq):
+        return jnp.log(sq / sp) + sp / sq - 1.0
+
+    return _wrap(f, p.scale, q.scale, name="kl_exponential")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    jnp = _jnp()
+
+    def f(pl, ph, ql, qh):
+        contained = (ql <= pl) & (qh >= ph)
+        return jnp.where(contained, jnp.log((qh - ql) / (ph - pl)), jnp.inf)
+
+    return _wrap(f, p.low, p.high, q.low, q.high, name="kl_uniform")
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    jnp = _jnp()
+
+    def f(l1, s1, l2, s2):
+        return (jnp.log((s1 + s2) ** 2 + (l1 - l2) ** 2)
+                - jnp.log(4 * s1 * s2))
+
+    return _wrap(f, p.loc, p.scale, q.loc, q.scale, name="kl_cauchy")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    jnp = _jnp()
+
+    def f(l1, s1, l2, s2):
+        d = jnp.abs(l1 - l2)
+        return (jnp.log(s2 / s1) + d / s2
+                + s1 / s2 * jnp.exp(-d / s1) - 1.0)
+
+    return _wrap(f, p.loc, p.scale, q.loc, q.scale, name="kl_laplace")
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    jnp = _jnp()
+
+    def f(rp, rq):
+        return rp * (jnp.log(rp) - jnp.log(rq)) + rq - rp
+
+    return _wrap(f, p.rate, q.rate, name="kl_poisson")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    jnp = _jnp()
+
+    def f(p1, p2):
+        return (jnp.log(p1 / p2)
+                + (1 - p1) / p1 * (jnp.log1p(-p1) - jnp.log1p(-p2)))
+
+    return _wrap(f, p.prob, q.prob, name="kl_geometric")
+
+
+@register_kl(Pareto, Pareto)
+def _kl_pareto_pareto(p, q):
+    jnp = _jnp()
+
+    def f(a1, m1, a2, m2):
+        kl = (jnp.log(a1 / a2) + a2 * jnp.log(m1 / m2)
+              + (a2 - a1) / a1)
+        return jnp.where(m1 >= m2, kl, jnp.inf)
+
+    return _wrap(f, p.alpha, p.scale, q.alpha, q.scale, name="kl_pareto")
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    jnp = _jnp()
+
+    def f(l1, s1, l2, s2):
+        import jax.lax as lax
+
+        euler = 0.5772156649015329
+        return (jnp.log(s2 / s1) + (l1 - l2 + s1 * euler) / s2
+                - euler - 1.0
+                + jnp.exp((l2 - l1) / s2) * jnp.exp(lax.lgamma(1 + s1 / s2)))
+
+    return _wrap(f, p.loc, p.scale, q.loc, q.scale, name="kl_gumbel")
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    jnp = _jnp()
+
+    def f(a1, s1, a2, s2):
+        import jax.scipy.special as jss
+
+        return ((a1 - a2) * jss.digamma(a1) - jss.gammaln(a1)
+                + jss.gammaln(a2) + a2 * jnp.log(s2 / s1)
+                + a1 * (s1 / s2 - 1.0))
+
+    return _wrap(f, p.shape_param, p.scale, q.shape_param, q.scale,
+                 name="kl_gamma")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    jnp = _jnp()
+
+    def f(a1, b1, a2, b2):
+        import jax.scipy.special as jss
+
+        def lbeta(a, b):
+            return jss.gammaln(a) + jss.gammaln(b) - jss.gammaln(a + b)
+
+        return (lbeta(a2, b2) - lbeta(a1, b1)
+                + (a1 - a2) * jss.digamma(a1)
+                + (b1 - b2) * jss.digamma(b1)
+                + (a2 - a1 + b2 - b1) * jss.digamma(a1 + b1))
+
+    return _wrap(f, p.alpha, p.beta, q.alpha, q.beta, name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    jnp = _jnp()
+
+    def f(a1, a2):
+        import jax.scipy.special as jss
+
+        s1 = jnp.sum(a1, -1)
+        return (jss.gammaln(s1) - jnp.sum(jss.gammaln(a1), -1)
+                - jss.gammaln(jnp.sum(a2, -1))
+                + jnp.sum(jss.gammaln(a2), -1)
+                + jnp.sum((a1 - a2)
+                          * (jss.digamma(a1)
+                             - jss.digamma(s1)[..., None]), -1))
+
+    return _wrap(f, p.alpha, q.alpha, name="kl_dirichlet")
+
+
+@register_kl(HalfNormal, HalfNormal)
+def _kl_halfnormal_halfnormal(p, q):
+    jnp = _jnp()
+
+    def f(s1, s2):
+        return jnp.log(s2 / s1) + s1 ** 2 / (2 * s2 ** 2) - 0.5
+
+    return _wrap(f, p.scale, q.scale, name="kl_halfnormal")
+
+
+@register_kl(HalfCauchy, HalfCauchy)
+def _kl_halfcauchy_halfcauchy(p, q):
+    # identical to the full-Cauchy KL (both densities are doubled)
+    jnp = _jnp()
+
+    def f(s1, s2):
+        return jnp.log((s1 + s2) ** 2) - jnp.log(4 * s1 * s2)
+
+    return _wrap(f, p.scale, q.scale, name="kl_halfcauchy")
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial_binomial(p, q):
+    jnp = _jnp()
+    import numpy as onp
+
+    # closed form only exists for equal counts; p.n > q.n has disjoint
+    # support (KL = inf); p.n < q.n has no closed form (same contract as
+    # torch's _kl_binomial_binomial)
+    if bool(onp.any(p.n.asnumpy() < q.n.asnumpy())):
+        raise MXNetError(
+            "KL(Binomial(n1) || Binomial(n2)) with n1 < n2 has no closed "
+            "form; use empirical_kl")
+
+    def f(n1, n2, p1, p2):
+        kl = n1 * (p1 * (jnp.log(p1) - jnp.log(p2))
+                   + (1 - p1) * (jnp.log1p(-p1) - jnp.log1p(-p2)))
+        return jnp.where(n1 == n2, kl, jnp.inf)
+
+    return _wrap(f, p.n, q.n, p.prob, q.prob, name="kl_binomial")
+
+
+@register_kl(OneHotCategorical, OneHotCategorical)
+def _kl_onehot_onehot(p, q):
+    return _kl_categorical_categorical(p._base, q._base)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    jnp = _jnp()
+
+    def f(mu1, L1, mu2, L2):
+        d = mu1.shape[-1]
+        # tr(S2^-1 S1) = ||L2^-1 L1||_F^2 via triangular solve
+        M = jnp.linalg.solve(L2, L1)
+        tr = jnp.sum(M ** 2, axis=(-2, -1))
+        diff = jnp.linalg.solve(L2, (mu2 - mu1)[..., None])[..., 0]
+        maha = jnp.sum(diff ** 2, -1)
+        logdet = 2 * (jnp.sum(jnp.log(jnp.diagonal(L2, axis1=-2, axis2=-1)),
+                              -1)
+                      - jnp.sum(jnp.log(jnp.diagonal(L1, axis1=-2,
+                                                     axis2=-1)), -1))
+        return 0.5 * (tr + maha - d + logdet)
+
+    return _wrap(f, p.loc, p.scale_tril, q.loc, q.scale_tril, name="kl_mvn")
+
+
+@register_kl(Uniform, Normal)
+def _kl_uniform_normal(p, q):
+    jnp = _jnp()
+
+    def f(lo, hi, loc, scale):
+        w = hi - lo
+        t1 = jnp.log(math.sqrt(2 * math.pi) * scale / w)
+        t2 = w ** 2 / 12
+        t3 = ((hi + lo - 2 * loc) / 2) ** 2
+        return t1 + 0.5 * (t2 + t3) / scale ** 2
+
+    return _wrap(f, p.low, p.high, q.loc, q.scale, name="kl_uniform_normal")
+
+
+@register_kl(Uniform, Gumbel)
+def _kl_uniform_gumbel(p, q):
+    jnp = _jnp()
+
+    def f(lo, hi, loc, scale):
+        common = scale / (hi - lo)
+        zh = (hi - loc) / scale
+        zl = (lo - loc) / scale
+        t1 = jnp.log(common) + 0.5 * (zh + zl)
+        t2 = common * (jnp.exp(-zh) - jnp.exp(-zl))
+        return t1 - t2
+
+    return _wrap(f, p.low, p.high, q.loc, q.scale, name="kl_uniform_gumbel")
+
+
+@register_kl(Exponential, Normal)
+def _kl_exponential_normal(p, q):
+    jnp = _jnp()
+
+    def f(s, loc, scale):
+        # E[x] = s, E[x^2] = 2 s^2 under Exponential(scale=s)
+        var = scale ** 2
+        t1 = 0.5 * jnp.log(2 * math.pi * var / s ** 2)
+        return t1 - 1 + (2 * s ** 2 - 2 * loc * s + loc ** 2) / (2 * var)
+
+    return _wrap(f, p.scale, q.loc, q.scale, name="kl_exponential_normal")
+
+
+@register_kl(Exponential, Gumbel)
+def _kl_exponential_gumbel(p, q):
+    jnp = _jnp()
+
+    def f(s, loc, scale):
+        ratio = scale / s
+        lsr = loc / scale
+        t1 = jnp.log(ratio) - 1
+        t2 = jnp.exp(lsr) * ratio / (ratio + 1)
+        return t1 - lsr + t2 + 1 / ratio
+
+    return _wrap(f, p.scale, q.loc, q.scale, name="kl_exponential_gumbel")
+
+
+@register_kl(Exponential, Gamma)
+def _kl_exponential_gamma(p, q):
+    jnp = _jnp()
+
+    def f(sp, a, sq):
+        import jax.scipy.special as jss
+
+        euler = 0.5772156649015329
+        ratio = sp / sq
+        return (-a * jnp.log(ratio) + ratio + jss.gammaln(a)
+                + a * euler - (1 + euler))
+
+    return _wrap(f, p.scale, q.shape_param, q.scale,
+                 name="kl_exponential_gamma")

@@ -232,7 +232,7 @@ def _wrap(jfn, name, record=True):
 _DIFF_OPS = """
 add subtract multiply divide true_divide floor_divide mod remainder power
 float_power fmod negative positive reciprocal abs absolute fabs sign
-rint fix trunc
+rint trunc
 exp expm1 exp2 log log2 log10 log1p sqrt cbrt square
 sin cos tan arcsin arccos arctan arctan2 sinh cosh tanh arcsinh arccosh
 arctanh hypot deg2rad rad2deg degrees radians
@@ -257,6 +257,10 @@ ediff1d gradient diff interp
 average median nanmedian percentile nanpercentile quantile nanquantile
 ptp round around floor ceil
 matvec vecdot vecmat
+geomspace block nanstd nanvar nextafter permute_dims
+matrix_transpose trapezoid concat pow
+acos acosh asin asinh atan atanh atan2
+angle sort_complex
 """
 
 # Non-differentiable / index-valued / predicate ops.
@@ -274,6 +278,9 @@ unique bincount digitize histogram histogram2d
 may_share_memory shares_memory
 result_type can_cast promote_types
 isscalar ndim size shape iscomplexobj isrealobj
+iscomplex isreal isdtype
+bitwise_invert bitwise_left_shift bitwise_right_shift bitwise_count
+unique_all unique_counts unique_inverse unique_values
 topk_absent
 """
 
@@ -292,6 +299,15 @@ def _install(namespace, names, record):
 
 _install(globals(), _DIFF_OPS, record=True)
 _install(globals(), _NONDIFF_OPS, record=False)
+
+# jnp.fix is deprecated (alias of trunc); keep the numpy-parity name alive
+fix = _wrap(lambda x: _jnp().trunc(x), "fix", record=True)
+
+# functional form: JAX arrays are immutable, so this RETURNS the result
+put_along_axis = _wrap(
+    lambda arr, indices, values, axis: _jnp().put_along_axis(
+        arr, indices, values, axis, inplace=False),
+    "put_along_axis", record=True)
 
 
 # a few names needing special handling -------------------------------------

@@ -105,10 +105,11 @@ class Symbol:
                 [tuple(o.shape) for o in outs], [])
 
     # -- evaluation -------------------------------------------------------
-    def _eval_with(self, bindings, raw=False):
+    def _eval_with(self, bindings, raw=False, memo=None):
         from .ndarray.ndarray import NDArray
 
-        memo = {}
+        if memo is None:
+            memo = {}
 
         def ev(s):
             if id(s) in memo:

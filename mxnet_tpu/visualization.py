@@ -1,0 +1,165 @@
+"""Network visualization (reference: ``python/mxnet/visualization.py`` —
+``print_summary`` layer table and ``plot_network`` graphviz rendering).
+
+Works on this build's lazy :class:`~mxnet_tpu.symbol.Symbol` DAG. For
+Gluon models prefer ``Block.summary`` (already implemented); these helpers
+cover the symbolic-API parity surface. ``plot_network`` emits DOT source
+directly — the ``graphviz`` Python package is optional and only needed to
+render to an image.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+
+def _walk(symbol):
+    """Topological (inputs-first) node order over the Symbol DAG."""
+    from .symbol import Symbol
+
+    order, seen = [], set()
+
+    def rec(s):
+        if id(s) in seen:
+            return
+        seen.add(id(s))
+        for a in s._args:
+            if isinstance(a, Symbol):
+                rec(a)
+        order.append(s)
+
+    rec(symbol)
+    return order
+
+
+def _node_label(s):
+    return s.name or (s._op or "var")
+
+
+def print_summary(symbol, shape=None, line_length=98, positions=None):
+    """Print a layer-by-layer table: name(op), output shape, params,
+    previous layers (reference ``visualization.py:print_summary``).
+
+    ``shape``: dict mapping argument names to input shapes (same contract
+    as the reference; needed to report per-layer output shapes).
+    """
+    from .symbol import Symbol
+
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    order = _walk(symbol)
+    shapes = {}
+    if shape is not None:
+        import numpy as onp
+
+        # ONE evaluation of the DAG on zeros with a shared memo: every
+        # node's output shape falls out of the single pass (O(n), not a
+        # per-node re-evaluation)
+        from . import numpy as mnp
+
+        bindings = {k: mnp.array(onp.zeros(v, "float32"))
+                    for k, v in shape.items()}
+        for node in order:
+            if node._op is None and node.name not in bindings:
+                raise MXNetError(
+                    "shape= must cover every free variable; missing %r"
+                    % node.name)
+        memo = {}
+        symbol._eval_with(bindings, memo=memo)
+        for node in order:
+            out = memo.get(id(node))
+            shapes[id(node)] = tuple(out.shape) if out is not None else None
+
+    cols = [int(line_length * p) for p in positions]
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def row(fields):
+        line = ""
+        for text, stop in zip(fields, cols):
+            line = (line + str(text))[:stop - 1].ljust(stop)
+        print(line)
+
+    print("=" * line_length)
+    row(header)
+    print("=" * line_length)
+    total = 0
+    for node in order:
+        kind = node._op or "Variable"
+        out_shape = shapes.get(id(node), "")
+        prev = ", ".join(_node_label(a) for a in node._args
+                         if isinstance(a, Symbol))
+        # parameter count is only known for variables with given shapes
+        params = 0
+        if node._op is None and shape is not None \
+                and node.name in (shape or {}):
+            n = 1
+            for d in shape[node.name]:
+                n *= d
+            params = n
+        total += params
+        row(["%s (%s)" % (_node_label(node), kind), out_shape or "",
+             params, prev])
+        print("_" * line_length)
+    print("Total params: %d" % total)
+    print("=" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz digraph of the Symbol DAG (reference
+    ``visualization.py:plot_network``). Returns a ``graphviz.Digraph``
+    when the package is available, else an object exposing ``.source``
+    (DOT text) and ``.save(path)``."""
+    from .symbol import Symbol
+
+    node_attrs = node_attrs or {}
+    order = _walk(symbol)
+    lines = ["digraph \"%s\" {" % title, "  rankdir=BT;"]
+    style = ("shape=box, style=filled, fixedsize=false, "
+             "fillcolor=\"#8dd3c7\"")
+    ids = {}
+    for i, node in enumerate(order):
+        ids[id(node)] = "node%d" % i
+        if node._op is None:
+            if hide_weights and node.name not in ("data", "x", "input"):
+                continue
+            attr = ("shape=oval, style=filled, fillcolor=\"#fb8072\"")
+        else:
+            attr = style
+        extra = "".join(", %s=%s" % kv for kv in node_attrs.items())
+        label = _node_label(node)
+        if node._op is not None and node._op not in label:
+            label = "%s\\n%s" % (label, node._op)
+        lines.append("  %s [label=\"%s\", %s%s];"
+                     % (ids[id(node)], label, attr, extra))
+    for node in order:
+        for a in node._args:
+            if not isinstance(a, Symbol):
+                continue
+            if a._op is None and hide_weights \
+                    and a.name not in ("data", "x", "input"):
+                continue
+            lines.append("  %s -> %s;" % (ids[id(a)], ids[id(node)]))
+    lines.append("}")
+    source = "\n".join(lines)
+    try:
+        import graphviz  # noqa: F401 — optional renderer
+
+        dot = graphviz.Digraph(name=title, format=save_format)
+        dot.body = lines[1:-1]
+        return dot
+    except ImportError:
+        class _Dot:
+            def __init__(self, src):
+                self.source = src
+
+            def save(self, path):
+                with open(path, "w") as f:
+                    f.write(self.source)
+                return path
+
+            def render(self, *a, **k):
+                raise MXNetError(
+                    "install the `graphviz` package to render; use "
+                    ".source / .save() for the DOT text")
+
+        return _Dot(source)

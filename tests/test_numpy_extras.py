@@ -126,3 +126,77 @@ def test_histogram_family():
     h, edges = np.histogramdd(np.array(_arr(50, 2)), bins=4)
     wh, wedges = onp.histogramdd(_arr(50, 2), bins=4)
     onp.testing.assert_allclose(h.asnumpy(), wh)
+
+
+# -- round-2 tail: array-api aliases, geomspace/block/trapezoid family ------
+
+@pytest.mark.parametrize("name,args", [
+    ("nanstd", (_arr(4, 4),)),
+    ("nanvar", (_arr(4, 4),)),
+    ("nextafter", (_arr(6), _arr(6, seed=3))),
+    ("trapezoid", (_arr(9),)),
+    ("angle", (_arr(6),)),
+    ("sort_complex", (_arr(6),)),
+    ("acos", (_arr(6) / 4,)),
+    ("acosh", (_arr(6, pos=True) + 1,)),
+    ("asin", (_arr(6) / 4,)),
+    ("asinh", (_arr(6),)),
+    ("atan", (_arr(6),)),
+    ("atanh", (_arr(6) / 4,)),
+    ("atan2", (_arr(6), _arr(6, seed=1))),
+    ("permute_dims", (_arr(2, 3, 4), (2, 0, 1))),
+    ("matrix_transpose", (_arr(3, 4),)),
+    ("concat", ([_arr(3), _arr(4, seed=1)],)),
+    ("pow", (_arr(6, pos=True), 2.5)),
+    ("fix", (_arr(8) * 3,)),
+    ("iscomplex", (_arr(5),)),
+    ("isreal", (_arr(5),)),
+])
+def test_round2_tail_vs_numpy(name, args):
+    def conv(x):
+        if isinstance(x, onp.ndarray):
+            return np.array(x)
+        if isinstance(x, list):
+            return [conv(v) for v in x]
+        return x
+
+    got = getattr(np, name)(*[conv(a) for a in args])
+    want = getattr(onp, name)(*args)
+    onp.testing.assert_allclose(onp.asarray(got.asnumpy()), want,
+                                rtol=2e-5, atol=1e-6)
+
+
+def test_geomspace_block_put_along_axis():
+    onp.testing.assert_allclose(
+        np.geomspace(1, 256, 9).asnumpy(), onp.geomspace(1, 256, 9),
+        rtol=1e-5)
+    got = np.block([[np.array(_arr(2, 2)), np.array(_arr(2, 2, seed=1))]])
+    want = onp.block([[_arr(2, 2), _arr(2, 2, seed=1)]])
+    onp.testing.assert_allclose(got.asnumpy(), want, rtol=1e-6)
+    a = _arr(4, 4)
+    idx = onp.argmax(a, axis=1, keepdims=True)
+    got = np.put_along_axis(np.array(a), np.array(idx), 0.0, axis=1)
+    want = a.copy()
+    onp.put_along_axis(want, idx, 0.0, axis=1)
+    # jnp.put_along_axis is functional (returns the updated array)
+    onp.testing.assert_allclose(got.asnumpy(), want, rtol=1e-6)
+
+
+def test_unique_variants_and_bitwise_aliases():
+    a = onp.array([3, 1, 2, 3, 1], "int32")
+    vals = np.unique_values(np.array(a))
+    onp.testing.assert_array_equal(onp.sort(vals.asnumpy()),
+                                   onp.unique(a))
+    uv, cnt = np.unique_counts(np.array(a))
+    order = onp.argsort(uv.asnumpy())
+    onp.testing.assert_array_equal(uv.asnumpy()[order], [1, 2, 3])
+    onp.testing.assert_array_equal(cnt.asnumpy()[order], [2, 1, 2])
+    x = onp.array([0b1011], "int32")
+    onp.testing.assert_array_equal(
+        np.bitwise_count(np.array(x)).asnumpy(), [3])
+    onp.testing.assert_array_equal(
+        np.bitwise_invert(np.array(x)).asnumpy(), ~x)
+    onp.testing.assert_array_equal(
+        np.bitwise_left_shift(np.array(x), 2).asnumpy(), x << 2)
+    onp.testing.assert_array_equal(
+        np.bitwise_right_shift(np.array(x), 1).asnumpy(), x >> 1)

@@ -1,0 +1,245 @@
+"""New-distribution coverage (reference
+``python/mxnet/gluon/probability/distributions/`` — binomial, multinomial,
+negative_binomial, fishersnedecor, half_cauchy, pareto, one_hot_categorical,
+relaxed_bernoulli, relaxed_one_hot_categorical, independent — and the
+full ``divergence.py`` KL registration set)."""
+import numpy as np
+import pytest
+from scipy import stats
+
+import mxnet_tpu as mx
+from mxnet_tpu import numpy as mnp
+from mxnet_tpu.gluon import probability as prob
+
+
+def test_binomial_logp_and_moments():
+    d = prob.Binomial(n=10, prob=0.3)
+    np.testing.assert_allclose(
+        float(d.log_prob(mnp.array(4.0)).asnumpy()),
+        stats.binom.logpmf(4, 10, 0.3), rtol=1e-5)
+    mx.random.seed(3)
+    s = d.sample((4000,)).asnumpy()
+    assert abs(s.mean() - 3.0) < 0.15
+    assert abs(float(d.mean.asnumpy()) - 3.0) < 1e-6
+    assert abs(float(d.variance.asnumpy()) - 2.1) < 1e-5
+    # logit parameterization agrees
+    dl = prob.Binomial(n=10, logit=float(np.log(0.3 / 0.7)))
+    np.testing.assert_allclose(
+        float(dl.log_prob(mnp.array(4.0)).asnumpy()),
+        stats.binom.logpmf(4, 10, 0.3), rtol=1e-5)
+
+
+def test_negative_binomial_logp_and_moments():
+    d = prob.NegativeBinomial(n=5, prob=0.4)
+    # scipy nbinom counts failures with success prob; our p is the
+    # per-trial "failure" weight: P(X=k) = C(k+n-1,k)(1-p)^n p^k
+    np.testing.assert_allclose(
+        float(d.log_prob(mnp.array(3.0)).asnumpy()),
+        stats.nbinom.logpmf(3, 5, 0.6), rtol=1e-5)
+    mx.random.seed(4)
+    s = d.sample((6000,)).asnumpy()
+    expect = 5 * 0.4 / 0.6
+    assert abs(s.mean() - expect) < 0.2
+
+
+def test_multinomial_logp_and_sampling():
+    p = np.array([0.2, 0.3, 0.5])
+    d = prob.Multinomial(num_events=3, prob=p.tolist(), total_count=8)
+    v = np.array([2.0, 2.0, 4.0])
+    np.testing.assert_allclose(
+        float(d.log_prob(mnp.array(v)).asnumpy()),
+        stats.multinomial.logpmf(v, 8, p), rtol=1e-5)
+    mx.random.seed(5)
+    s = d.sample((2000,)).asnumpy()
+    assert s.shape == (2000, 3)
+    np.testing.assert_array_equal(s.sum(-1), np.full(2000, 8.0))
+    np.testing.assert_allclose(s.mean(0), 8 * p, atol=0.2)
+
+
+def test_fishersnedecor_logp():
+    d = prob.FisherSnedecor(df1=4.0, df2=7.0)
+    np.testing.assert_allclose(
+        float(d.log_prob(mnp.array(1.5)).asnumpy()),
+        stats.f.logpdf(1.5, 4, 7), rtol=1e-5)
+    mx.random.seed(6)
+    s = d.sample((8000,)).asnumpy()
+    assert abs(s.mean() - 7.0 / 5.0) < 0.2
+
+
+def test_half_cauchy_and_pareto():
+    hc = prob.HalfCauchy(scale=2.0)
+    np.testing.assert_allclose(
+        float(hc.log_prob(mnp.array(1.0)).asnumpy()),
+        stats.halfcauchy.logpdf(1.0, scale=2.0), rtol=1e-5)
+    assert float(hc.log_prob(mnp.array(-1.0)).asnumpy()) == -np.inf
+    pa = prob.Pareto(alpha=3.0, scale=2.0)
+    np.testing.assert_allclose(
+        float(pa.log_prob(mnp.array(4.0)).asnumpy()),
+        stats.pareto.logpdf(4.0, 3.0, scale=2.0), rtol=1e-5)
+    mx.random.seed(7)
+    s = pa.sample((6000,)).asnumpy()
+    assert abs(s.mean() - 3.0) < 0.1
+    np.testing.assert_allclose(float(pa.mean.asnumpy()), 3.0, rtol=1e-6)
+
+
+def test_one_hot_categorical():
+    p = np.array([0.1, 0.6, 0.3])
+    d = prob.OneHotCategorical(num_events=3, prob=p.tolist())
+    np.testing.assert_allclose(
+        float(d.log_prob(mnp.array([0.0, 1.0, 0.0])).asnumpy()),
+        np.log(0.6), rtol=1e-5)
+    mx.random.seed(8)
+    s = d.sample((3000,)).asnumpy()
+    assert s.shape == (3000, 3)
+    np.testing.assert_array_equal(s.sum(-1), np.ones(3000))
+    np.testing.assert_allclose(s.mean(0), p, atol=0.05)
+
+
+def test_relaxed_distributions_sample_in_simplex():
+    mx.random.seed(9)
+    rb = prob.RelaxedBernoulli(T=0.5, logit=0.3)
+    s = rb.sample((500,)).asnumpy()
+    assert ((s > 0) & (s < 1)).all()
+    lp = rb.log_prob(mnp.array(0.7)).asnumpy()
+    assert np.isfinite(lp)
+    roc = prob.RelaxedOneHotCategorical(
+        T=0.7, num_events=3, logit=[0.1, 0.2, -0.1])
+    s = roc.sample((400,)).asnumpy()
+    assert s.shape == (400, 3)
+    np.testing.assert_allclose(s.sum(-1), np.ones(400), rtol=1e-5)
+    # density integrates: spot-check finiteness + temperature dependence
+    v = mnp.array([0.2, 0.5, 0.3])
+    assert np.isfinite(float(roc.log_prob(v).asnumpy()))
+
+
+def test_independent_sums_event_dims():
+    base = prob.Normal(loc=mnp.array(np.zeros((4, 3), "float32")),
+                       scale=mnp.array(np.ones((4, 3), "float32")))
+    d = prob.Independent(base, 1)
+    v = mnp.array(np.ones((4, 3), "float32"))
+    lp = d.log_prob(v).asnumpy()
+    assert lp.shape == (4,)
+    np.testing.assert_allclose(
+        lp, base.log_prob(v).asnumpy().sum(-1), rtol=1e-6)
+    ent = d.entropy().asnumpy()
+    assert ent.shape == (4,)
+
+
+KL_CASES = [
+    (prob.Exponential(2.0), prob.Exponential(3.0)),
+    (prob.Uniform(0.0, 1.0), prob.Uniform(-0.5, 2.0)),
+    (prob.Cauchy(0.0, 1.0), prob.Cauchy(1.0, 2.0)),
+    (prob.Laplace(0.0, 1.0), prob.Laplace(0.5, 2.0)),
+    (prob.Poisson(2.0), prob.Poisson(3.5)),
+    (prob.Geometric(0.3), prob.Geometric(0.5)),
+    (prob.Pareto(3.0, 2.0), prob.Pareto(2.0, 1.0)),
+    (prob.Gumbel(0.0, 1.0), prob.Gumbel(0.5, 1.5)),
+    (prob.Gamma(2.0, 1.5), prob.Gamma(3.0, 1.0)),
+    (prob.Beta(2.0, 3.0), prob.Beta(1.0, 1.0)),
+    (prob.HalfNormal(1.0), prob.HalfNormal(2.0)),
+    (prob.HalfCauchy(1.0), prob.HalfCauchy(2.0)),
+    (prob.Binomial(8, prob=0.3), prob.Binomial(8, prob=0.5)),
+    (prob.Uniform(0.0, 1.0), prob.Normal(0.0, 1.0)),
+    (prob.Uniform(0.0, 1.0), prob.Gumbel(0.0, 1.0)),
+    (prob.Exponential(1.5), prob.Normal(0.0, 2.0)),
+    (prob.Exponential(1.5), prob.Gumbel(0.5, 2.0)),
+    (prob.Exponential(1.5), prob.Gamma(2.0, 1.0)),
+]
+
+
+@pytest.mark.parametrize("p,q", KL_CASES,
+                         ids=[f"{type(p).__name__}-{type(q).__name__}-{i}"
+                              for i, (p, q) in enumerate(KL_CASES)])
+def test_kl_closed_form_vs_monte_carlo(p, q):
+    mx.random.seed(11)
+    closed = float(np.asarray(prob.kl_divergence(p, q).asnumpy()))
+    assert np.isfinite(closed) and closed >= -1e-6
+    est = float(np.asarray(prob.empirical_kl(p, q, 20000).asnumpy()))
+    # MC error scales with the distribution's variance; generous tolerance
+    assert abs(closed - est) < max(0.1, 0.15 * abs(closed))
+
+
+def test_kl_dirichlet_and_mvn_and_onehot():
+    mx.random.seed(12)
+    p = prob.Dirichlet(mnp.array([1.0, 2.0, 3.0]))
+    q = prob.Dirichlet(mnp.array([2.0, 2.0, 2.0]))
+    closed = float(prob.kl_divergence(p, q).asnumpy())
+    est = float(np.asarray(prob.empirical_kl(p, q, 20000).asnumpy()))
+    assert abs(closed - est) < 0.05
+    mp = prob.MultivariateNormal(
+        loc=mnp.array([0.0, 0.0]), cov=mnp.array([[1.0, 0.2], [0.2, 1.0]]))
+    mq = prob.MultivariateNormal(
+        loc=mnp.array([1.0, -1.0]), cov=mnp.array([[2.0, 0.0], [0.0, 2.0]]))
+    closed = float(prob.kl_divergence(mp, mq).asnumpy())
+    est = float(np.asarray(prob.empirical_kl(mp, mq, 20000).asnumpy()))
+    assert abs(closed - est) < 0.1
+    op = prob.OneHotCategorical(prob=[0.2, 0.8])
+    oq = prob.OneHotCategorical(prob=[0.5, 0.5])
+    expect = 0.2 * np.log(0.2 / 0.5) + 0.8 * np.log(0.8 / 0.5)
+    np.testing.assert_allclose(
+        float(prob.kl_divergence(op, oq).asnumpy()), expect, rtol=1e-5)
+
+
+def test_uniform_uniform_kl_outside_support_is_inf():
+    kl = prob.kl_divergence(prob.Uniform(0.0, 2.0), prob.Uniform(0.5, 1.0))
+    assert float(kl.asnumpy()) == np.inf
+
+
+def test_multinomial_zero_prob_category_logp():
+    # 0 * log(0) must contribute 0, not NaN (xlogy semantics)
+    d = prob.Multinomial(num_events=3, prob=[0.5, 0.5, 0.0], total_count=4)
+    got = float(d.log_prob(mnp.array([2.0, 2.0, 0.0])).asnumpy())
+    np.testing.assert_allclose(
+        got, stats.multinomial.logpmf([2, 2, 0], 4, [0.5, 0.5, 0.0]),
+        rtol=1e-5)
+
+
+def test_binomial_kl_count_mismatch():
+    # disjoint support -> inf; n1 < n2 has no closed form -> error
+    kl = prob.kl_divergence(prob.Binomial(10, prob=0.3),
+                            prob.Binomial(5, prob=0.3))
+    assert float(kl.asnumpy()) == np.inf
+    with pytest.raises(mx.MXNetError, match="no closed"):
+        prob.kl_divergence(prob.Binomial(5, prob=0.3),
+                           prob.Binomial(10, prob=0.3))
+
+
+def test_glove_vocabulary_mode(tmp_path):
+    import collections
+
+    from mxnet_tpu.contrib import text
+
+    root = tmp_path / "emb"
+    (root / "glove").mkdir(parents=True)
+    (root / "glove" / "glove.6B.50d.txt").write_text(
+        "hello 0.1 0.2\nworld 0.3 0.4\nextra 0.5 0.6\n")
+    voc = text.Vocabulary(collections.Counter(["hello", "world"]))
+    emb = text.embedding.create(
+        "glove", pretrained_file_name="glove.6B.50d.txt",
+        embedding_root=str(root), vocabulary=voc)
+    # vocabulary tokens got their file vectors
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [0.1, 0.2], rtol=1e-6)
+    # out-of-vocabulary file tokens were NOT indexed
+    assert "extra" not in emb.token_to_idx
+    assert len(emb) == len(voc)
+
+
+def test_sample_shape_broadcasts_across_params():
+    # array n with scalar prob, array scale with scalar loc, etc.
+    mx.random.seed(13)
+    s = prob.Binomial(n=mnp.array([5.0, 10.0]), prob=0.5).sample()
+    assert s.shape == (2,)
+    s = prob.Normal(0.0, mnp.array([1.0, 2.0, 3.0])).sample((4,))
+    assert s.shape == (4, 3)
+    s = prob.FisherSnedecor(df1=mnp.array([4.0, 6.0]), df2=8.0).sample()
+    assert s.shape == (2,)
+    s = prob.NegativeBinomial(n=mnp.array([2.0, 4.0]), prob=0.3).sample()
+    assert s.shape == (2,)
+    s = prob.Gamma(shape=2.0, scale=mnp.array([1.0, 2.0])).sample()
+    assert s.shape == (2,)
+
+
+def test_fishersnedecor_out_of_support():
+    d = prob.FisherSnedecor(df1=4.0, df2=7.0)
+    assert float(d.log_prob(mnp.array(-1.0)).asnumpy()) == -np.inf
