@@ -89,6 +89,11 @@ _LAZY_SUBMODULES = (
     "subgraph",
     "visualization",
     "viz",
+    "callback",
+    "model",
+    "name",
+    "attribute",
+    "error",
 )
 
 _LAZY_ALIASES = {"kv": "kvstore", "sym": "symbol", "init": "initializer",

@@ -1,0 +1,51 @@
+"""Symbol attribute scoping (reference: ``python/mxnet/attribute.py`` —
+``AttrScope`` context manager merging scope attributes into symbol
+attrs)."""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+_local = threading.local()
+
+
+class AttrScope:
+    """``with AttrScope(group='fc'):`` attaches attributes to every symbol
+    created inside the scope; inner scopes and per-symbol attrs win."""
+
+    def __init__(self, **kwargs):
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise MXNetError("Attributes need to be string")
+        self._attr = kwargs
+        self._old_scope = None
+
+    def get(self, attr):
+        """Merge scope attrs under user-supplied ``attr``."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        self._old_scope = current()
+        merged = dict(self._old_scope._attr)
+        merged.update(self._attr)
+        self._attr = merged
+        _local.scope = self
+        return self
+
+    def __exit__(self, *exc):
+        _local.scope = self._old_scope
+
+
+def current():
+    """The active AttrScope (an empty default when none is entered)."""
+    scope = getattr(_local, "scope", None)
+    if scope is None:
+        scope = AttrScope()
+        _local.scope = scope
+    return scope

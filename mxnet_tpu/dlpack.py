@@ -1,25 +1,61 @@
 """DLPack zero-copy tensor interchange (reference: ``python/mxnet/dlpack.py``
-over the 3rdparty/dlpack submodule)."""
+over the 3rdparty/dlpack submodule).
+
+Modern DLPack interchange is the ``__dlpack__``/``__dlpack_device__``
+protocol (what torch/numpy/cupy/jax ``from_dlpack`` all consume), so
+``to_dlpack_for_read`` returns a small exporter object implementing it —
+it keeps the underlying buffer alive, unlike a raw consumed-once capsule.
+"""
 from __future__ import annotations
 
 
+class DLPackExporter:
+    """Holds a jax.Array and speaks the DLPack exchange protocol."""
+
+    def __init__(self, jax_array):
+        self._array = jax_array
+
+    def __dlpack__(self, **kwargs):
+        return self._array.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._array.__dlpack_device__()
+
+
+class _CapsuleWrapper:
+    """Adapts a legacy consumed-once PyCapsule to the modern protocol
+    (device reported as CPU — legacy capsules carry no device info)."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):  # pylint: disable=unused-argument
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
 def to_dlpack_for_read(array):
-    """NDArray -> DLPack capsule (shared, read-only semantics)."""
+    """NDArray -> DLPack exporter (shared, read-only semantics)."""
     array.wait_to_read()
-    return array._data.__dlpack__()
+    return DLPackExporter(array._data)
 
 
 def to_dlpack_for_write(array):
     """MXNet distinguishes read/write capsules for engine ordering; XLA
-    arrays are immutable so both hand out the same capsule."""
+    arrays are immutable so both hand out the same exporter."""
     return to_dlpack_for_read(array)
 
 
-def from_dlpack(capsule_or_array):
-    """DLPack capsule (or any __dlpack__ object: torch/numpy/cupy tensors)
-    -> NDArray, zero-copy where the backend allows."""
+def from_dlpack(obj):
+    """Any ``__dlpack__`` object (torch/numpy/cupy/jax tensors, our
+    exporter) or a legacy PyCapsule -> NDArray, zero-copy where the
+    backend allows."""
     import jax.numpy as jnp
 
     from .ndarray.ndarray import NDArray
 
-    return NDArray(jnp.from_dlpack(capsule_or_array))
+    if not hasattr(obj, "__dlpack__"):
+        obj = _CapsuleWrapper(obj)
+    return NDArray(jnp.from_dlpack(obj))

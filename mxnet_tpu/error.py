@@ -1,0 +1,58 @@
+"""Typed framework errors (reference: ``python/mxnet/error.py`` — a
+registry mapping error-type names to exception classes so errors keep
+their Python type across the (here: nonexistent) FFI boundary)."""
+from __future__ import annotations
+
+from .base import MXNetError, NotSupportedForTPUError
+
+__all__ = ["MXNetError", "InternalError", "register"]
+
+_ERROR_REGISTRY = {}
+
+
+def register(name_or_cls, cls=None):
+    """``register('ValueError', ValueError)`` or decorator form
+    ``@register`` on an MXNetError subclass (reference
+    ``base.py:register_error``)."""
+    if cls is not None:
+        _ERROR_REGISTRY[name_or_cls] = cls
+        return cls
+    if isinstance(name_or_cls, type):
+        _ERROR_REGISTRY[name_or_cls.__name__] = name_or_cls
+        return name_or_cls
+
+    def deco(c):
+        _ERROR_REGISTRY[name_or_cls] = c
+        return c
+
+    return deco
+
+
+register_error = register
+
+
+def error_class(name):
+    """Resolve a registered error-type name (MXNetError fallback)."""
+    return _ERROR_REGISTRY.get(name, MXNetError)
+
+
+@register
+class InternalError(MXNetError):
+    """Internal invariant violation inside the framework."""
+
+    def __init__(self, msg):
+        if "hint:" not in msg:
+            msg += ("\nhint: you hit an internal error; please report it "
+                    "with the full traceback")
+        super().__init__(msg)
+
+
+register("ValueError", ValueError)
+register("TypeError", TypeError)
+register("AttributeError", AttributeError)
+register("IndexError", IndexError)
+register("NotImplementedError", NotImplementedError)
+register("IOError", IOError)
+register("FloatingPointError", FloatingPointError)
+register("RuntimeError", RuntimeError)
+register("NotSupportedForTPUError", NotSupportedForTPUError)

@@ -113,3 +113,734 @@ class ImageIter:
 
         return ImageRecordIter(path_imgrec, data_shape,
                                batch_size=batch_size, **kwargs)
+
+
+def scale_down(src_size, size):
+    """Scale ``size`` down proportionally so it fits inside ``src_size``
+    (reference ``image.py:scale_down``)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, values=0):  # pylint: disable=redefined-builtin,unused-argument
+    """Pad an HWC image with a constant border (reference
+    ``image.py:copyMakeBorder`` over cv2.copyMakeBorder; constant mode)."""
+    from . import numpy as mnp
+
+    arr = _to_numpy(src)
+    out = _onp.pad(arr, ((top, bot), (left, right), (0, 0)),
+                   mode="constant", constant_values=values)
+    return mnp.array(out)
+
+
+def random_size_crop(src, size, area, ratio, interp=1, **kwargs):
+    """Random crop with size in ``area`` fraction and aspect in ``ratio``,
+    resized to ``size`` (reference ``image.py:random_size_crop``)."""
+    arr = _to_numpy(src)
+    h, w = arr.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _onp.random.uniform(*area) * src_area
+        log_ratio = (_onp.log(ratio[0]), _onp.log(ratio[1]))
+        aspect = _onp.exp(_onp.random.uniform(*log_ratio))
+        new_w = int(round(_onp.sqrt(target_area * aspect)))
+        new_h = int(round(_onp.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = _onp.random.randint(0, w - new_w + 1)
+            y0 = _onp.random.randint(0, h - new_h + 1)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    # fallback: center crop (reference behavior)
+    out, coords = center_crop(src, size, interp)
+    return out, coords
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate CHW image(s) / NCHW batches by degrees on-device via the
+    spatial-transformer ops (reference ``image.py:imrotate`` — same
+    float32-only, scalar-angle-for-single-image contract)."""
+    from . import numpy as mnp
+    from .base import MXNetError
+    from .ops.spatial import bilinear_sampler, grid_generator
+
+    if zoom_in and zoom_out:
+        raise MXNetError("`zoom_in` and `zoom_out` cannot be both True")
+    if str(src.dtype) != "float32":
+        raise MXNetError("only float32 images are supported")
+    expanded = False
+    if src.ndim == 3:
+        expanded = True
+        src = src.reshape((1,) + tuple(src.shape))
+        if hasattr(rotation_degrees, "ndim") and rotation_degrees.ndim:
+            raise MXNetError("single image requires a scalar angle")
+    elif src.ndim != 4:
+        raise MXNetError("only 3D (CHW) and 4D (NCHW) inputs are supported")
+    n = src.shape[0]
+    ang = _onp.asarray(
+        rotation_degrees.asnumpy()
+        if hasattr(rotation_degrees, "asnumpy") else rotation_degrees,
+        dtype="float32").reshape(-1)
+    if ang.size == 1:
+        ang = _onp.repeat(ang, n)
+    if ang.size != n:
+        raise MXNetError("number of angles must match the batch size")
+    rad = _onp.pi * ang / 180.0
+    c, s = _onp.cos(rad), _onp.sin(rad)
+    scale = _onp.ones_like(c)
+    if zoom_in:
+        scale = 1.0 / (_onp.abs(c) + _onp.abs(s))
+    elif zoom_out:
+        scale = _onp.abs(c) + _onp.abs(s)
+    # output->input mapping: rotate by -theta (positive angle =
+    # counterclockwise in image space), scaled
+    theta = _onp.stack([c * scale, -s * scale, _onp.zeros(n),
+                        s * scale, c * scale, _onp.zeros(n)],
+                       axis=1).astype("float32")
+    grid = grid_generator(mnp.array(theta), transform_type="affine",
+                          target_shape=tuple(src.shape[2:]))
+    out = bilinear_sampler(src, grid)
+    return out[0] if expanded else out
+
+
+def random_rotate(src, angle_limits, zoom_in=False, zoom_out=False):
+    """Rotate by a uniform random angle in ``angle_limits`` (reference
+    ``image.py:random_rotate``)."""
+    lo, hi = angle_limits
+    if src.ndim == 3:
+        ang = float(_onp.random.uniform(lo, hi))
+    else:
+        ang = _onp.random.uniform(lo, hi, size=(src.shape[0],)) \
+            .astype("float32")
+    return imrotate(src, ang, zoom_in=zoom_in, zoom_out=zoom_out)
+
+
+# -- legacy Augmenter family (reference image.py:761-1284) -------------------
+
+class Augmenter:
+    """Image augmenter base: callable, with JSON-able params."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        order = _onp.random.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        return random_flip_left_right(src, self.p)
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        from . import numpy as mnp
+
+        alpha = 1.0 + _onp.random.uniform(-self.brightness, self.brightness)
+        return mnp.array(_to_numpy(src).astype("float32") * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _COEF = _onp.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        from . import numpy as mnp
+
+        arr = _to_numpy(src).astype("float32")
+        alpha = 1.0 + _onp.random.uniform(-self.contrast, self.contrast)
+        gray = (arr * self._COEF).sum() * 3.0 / arr.size
+        return mnp.array(arr * alpha + gray * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _COEF = _onp.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        from . import numpy as mnp
+
+        arr = _to_numpy(src).astype("float32")
+        alpha = 1.0 + _onp.random.uniform(-self.saturation, self.saturation)
+        gray = (arr * self._COEF).sum(-1, keepdims=True)
+        return mnp.array(arr * alpha + gray * (1 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """Hue rotation in YIQ space (reference ``image.py:1015`` tyiq
+    matrices)."""
+
+    _TYIQ = _onp.array([[0.299, 0.587, 0.114],
+                        [0.596, -0.274, -0.321],
+                        [0.211, -0.523, 0.311]], "float32")
+    _ITYIQ = _onp.array([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], "float32")
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        from . import numpy as mnp
+
+        arr = _to_numpy(src).astype("float32")
+        alpha = _onp.random.uniform(-self.hue, self.hue)
+        u, w = _onp.cos(alpha * _onp.pi), _onp.sin(alpha * _onp.pi)
+        bt = _onp.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                        "float32")
+        t = self._ITYIQ @ bt @ self._TYIQ
+        return mnp.array(arr @ t.T)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (AlexNet style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _onp.asarray(eigval, "float32")
+        self.eigvec = _onp.asarray(eigvec, "float32")
+
+    def __call__(self, src):
+        from . import numpy as mnp
+
+        alpha = _onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return mnp.array(_to_numpy(src).astype("float32") + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _COEF = _onp.array([[0.299], [0.587], [0.114]], "float32")
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        from . import numpy as mnp
+
+        if _onp.random.rand() < self.p:
+            arr = _to_numpy(src).astype("float32")
+            gray = arr @ self._COEF
+            return mnp.array(_onp.repeat(gray, 3, axis=-1))
+        return src if hasattr(src, "_data") else mnp.array(src)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter pipeline (reference
+    ``image.py:1171`` — same knobs, same ordering)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _onp.array([55.46, 4.794, 1.148])
+        eigvec = _onp.array([[-0.5675, 0.7192, 0.4009],
+                             [-0.5808, -0.0045, -0.814],
+                             [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = _onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _onp.array([58.395, 57.12, 57.375])
+    if mean is not None and len(_onp.atleast_1d(mean)):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# -- detection augmenters (reference image/detection.py) ---------------------
+# Label convention (reference parity): each object is a row
+# [cls_id, xmin, ymin, xmax, ymax, ...], coordinates normalized to [0, 1].
+
+class DetAugmenter:
+    """Detection augmenter base: ``__call__(src, label) -> (src, label)``."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__, self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Apply an image-only Augmenter, passing labels through (reference
+    ``detection.py:66``)."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one augmenter from a list, or skip with
+    ``skip_prob`` (reference ``detection.py:91``)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or _onp.random.rand() < self.skip_prob:
+            return src, label
+        aug = self.aug_list[_onp.random.randint(len(self.aug_list))]
+        return aug(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and mirror box x-coordinates (reference
+    ``detection.py:127``)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        from . import numpy as mnp
+
+        if _onp.random.rand() < self.p:
+            src = mnp.array(_to_numpy(src)[:, ::-1].copy())
+            label = _onp.array(label, dtype="float32")
+            xmin = 1.0 - label[:, 3]
+            xmax = 1.0 - label[:, 1]
+            label[:, 1], label[:, 3] = xmin, xmax
+        return src, label
+
+
+def _box_area(b):
+    return max(0.0, b[2] - b[0]) * max(0.0, b[3] - b[1])
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping sufficient object coverage; objects whose
+    center falls outside are dropped, the rest are clipped and
+    renormalized (reference ``detection.py:153``)."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _crop_labels(self, label, crop):
+        """crop = (x0, y0, x1, y1) normalized; returns adjusted labels or
+        None when every object is ejected."""
+        x0, y0, x1, y1 = crop
+        w, h = x1 - x0, y1 - y0
+        out = []
+        for row in _onp.array(label, dtype="float32"):
+            bx = row[1:5]
+            cx, cy = (bx[0] + bx[2]) / 2, (bx[1] + bx[3]) / 2
+            if not (x0 <= cx <= x1 and y0 <= cy <= y1):
+                continue
+            inter = [max(bx[0], x0), max(bx[1], y0),
+                     min(bx[2], x1), min(bx[3], y1)]
+            area = _box_area(bx)
+            if area <= 0 or _box_area(inter) / area \
+                    < self.min_eject_coverage:
+                continue
+            new = row.copy()
+            new[1] = (inter[0] - x0) / w
+            new[2] = (inter[1] - y0) / h
+            new[3] = (inter[2] - x0) / w
+            new[4] = (inter[3] - y0) / h
+            out.append(new)
+        return _onp.stack(out) if out else None
+
+    def __call__(self, src, label):
+        from . import numpy as mnp
+
+        arr = _to_numpy(src)
+        h, w = arr.shape[:2]
+        label = _onp.array(label, dtype="float32")
+        for _ in range(self.max_attempts):
+            area_f = _onp.random.uniform(*self.area_range)
+            ratio = _onp.random.uniform(*self.aspect_ratio_range)
+            cw = _onp.sqrt(area_f * ratio)
+            ch = _onp.sqrt(area_f / ratio)
+            if cw > 1 or ch > 1:
+                continue
+            cx0 = _onp.random.uniform(0, 1 - cw)
+            cy0 = _onp.random.uniform(0, 1 - ch)
+            crop = (cx0, cy0, cx0 + cw, cy0 + ch)
+            # coverage check: every kept object's overlap fraction
+            new_label = self._crop_labels(label, crop)
+            if new_label is None:
+                continue
+            covered = [_box_area([max(b[1], crop[0]), max(b[2], crop[1]),
+                                  min(b[3], crop[2]), min(b[4], crop[3])])
+                       / max(_box_area(b[1:5]), 1e-12) for b in label]
+            if max(covered) < self.min_object_covered:
+                continue
+            px0, py0 = int(cx0 * w), int(cy0 * h)
+            pw, ph = max(1, int(cw * w)), max(1, int(ch * h))
+            return (mnp.array(arr[py0:py0 + ph, px0:px0 + pw].copy()),
+                    new_label)
+        return (src if hasattr(src, "_data") else mnp.array(arr)), label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand-pad; labels shrink into the padded canvas (reference
+    ``detection.py:324``)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        from . import numpy as mnp
+
+        arr = _to_numpy(src)
+        h, w = arr.shape[:2]
+        label = _onp.array(label, dtype="float32")
+        for _ in range(self.max_attempts):
+            area_f = _onp.random.uniform(*self.area_range)
+            ratio = _onp.random.uniform(*self.aspect_ratio_range)
+            nw = int(w * _onp.sqrt(area_f * ratio))
+            nh = int(h * _onp.sqrt(area_f / ratio))
+            if nw < w or nh < h:
+                continue
+            x0 = _onp.random.randint(0, nw - w + 1)
+            y0 = _onp.random.randint(0, nh - h + 1)
+            canvas = _onp.empty((nh, nw, arr.shape[2]), dtype=arr.dtype)
+            canvas[:] = _onp.asarray(self.pad_val, dtype=arr.dtype)
+            canvas[y0:y0 + h, x0:x0 + w] = arr
+            new = label.copy()
+            new[:, 1] = (label[:, 1] * w + x0) / nw
+            new[:, 2] = (label[:, 2] * h + y0) / nh
+            new[:, 3] = (label[:, 3] * w + x0) / nw
+            new[:, 4] = (label[:, 4] * h + y0) / nh
+            return mnp.array(canvas), new
+        return (src if hasattr(src, "_data") else mnp.array(arr)), label
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0.0):
+    """One DetRandomSelectAug over per-threshold crop augmenters
+    (reference ``detection.py:418`` — each scalar arg may be a list)."""
+    # normalize every arg to equal-length lists (reference zips them)
+    def aslist(v, like_pairs=False):
+        if like_pairs:
+            if isinstance(v, tuple):
+                return [v]
+            return list(v)
+        if isinstance(v, (list, tuple)):
+            return list(v)
+        return [v]
+
+    mocs = aslist(min_object_covered)
+    arrs = aslist(aspect_ratio_range, like_pairs=True)
+    ars = aslist(area_range, like_pairs=True)
+    mecs = aslist(min_eject_coverage)
+    mas = aslist(max_attempts)
+    n = max(map(len, (mocs, arrs, ars, mecs, mas)))
+
+    def pick(lst, i):
+        return lst[i] if i < len(lst) else lst[-1]
+
+    crops = [DetRandomCropAug(pick(mocs, i), pick(arrs, i), pick(ars, i),
+                              pick(mecs, i), pick(mas, i))
+             for i in range(n)]
+    return DetRandomSelectAug(crops, skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0,
+                       pca_noise=0, hue=0, inter_method=2,
+                       min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection pipeline (reference ``detection.py:483`` —
+    same knobs/order: resize, color, pad, crop, mirror, force-resize,
+    cast, normalize)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = _onp.array([55.46, 4.794, 1.148])
+        eigvec = _onp.array([[-0.5675, 0.7192, 0.4009],
+                             [-0.5808, -0.0045, -0.814],
+                             [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if rand_pad > 0:
+        auglist.append(DetRandomSelectAug(
+            [DetRandomPadAug(aspect_ratio_range,
+                             (1.0, max(area_range)), max_attempts,
+                             pad_val)], 1 - rand_pad))
+    if rand_crop > 0:
+        auglist.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (min(area_range), 1.0), min_eject_coverage, max_attempts,
+            skip_prob=1 - rand_crop))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if mean is True:
+        mean = _onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _onp.array([58.395, 57.12, 57.375])
+    if mean is not None and len(_onp.atleast_1d(mean)):
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter:
+    """Detection iterator over an image RecordIO file (reference
+    ``detection.py:625``): yields NCHW image batches plus fixed-width
+    object-label batches ``(batch, max_objects, label_width)`` padded
+    with -1 rows."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 shuffle=False, aug_list=None, label_width=5,
+                 max_objects=16, **kwargs):
+        if path_imgrec is None:
+            raise MXNetError("ImageDetIter requires path_imgrec")
+        from .gluon.data.vision.datasets import ImageRecordDataset
+
+        self._dataset = ImageRecordDataset(path_imgrec)
+        self.batch_size = batch_size
+        self._shape = tuple(data_shape)
+        self._shuffle = shuffle
+        self._label_width = label_width
+        self._max_objects = max_objects
+        self.auglist = (aug_list if aug_list is not None
+                        else CreateDetAugmenter(data_shape, **kwargs))
+        self.reset()
+
+    def reset(self):
+        n = len(self._dataset)
+        self._order = (_onp.random.permutation(n) if self._shuffle
+                       else _onp.arange(n))
+        self._pos = 0
+
+    def __iter__(self):
+        return self
+
+    def _parse_label(self, raw):
+        """Flat record label -> (num_obj, label_width) array (reference
+        header format: [header_w, obj_w, ...extras..., obj rows])."""
+        raw = _onp.asarray(raw, dtype="float32").ravel()
+        if raw.size == self._label_width:
+            return raw.reshape(1, self._label_width)
+        header_w = int(raw[0])
+        obj_w = int(raw[1])
+        body = raw[header_w:]
+        n = body.size // obj_w
+        return body[:n * obj_w].reshape(n, obj_w)[:, :self._label_width]
+
+    def __next__(self):
+        from . import numpy as mnp
+
+        if self._pos >= len(self._order):
+            raise StopIteration
+        idx = self._order[self._pos:self._pos + self.batch_size]
+        self._pos += self.batch_size
+        c, h, w = self._shape
+        data = _onp.zeros((len(idx), c, h, w), dtype="float32")
+        labels = -_onp.ones((len(idx), self._max_objects,
+                             self._label_width), dtype="float32")
+        for k, i in enumerate(idx):
+            img, label = self._dataset[int(i)]
+            label = self._parse_label(label)
+            for aug in self.auglist:
+                img, label = aug(img, label)
+            arr = _to_numpy(img).astype("float32")
+            data[k] = arr.transpose(2, 0, 1)
+            m = min(len(label), self._max_objects)
+            labels[k, :m] = label[:m]
+        return SimpleBatch(mnp.array(data), mnp.array(labels))
+
+    def next(self):
+        return self.__next__()
+
+
+class SimpleBatch:
+    """Minimal DataBatch: ``.data``/``.label`` lists (reference
+    ``io.DataBatch``)."""
+
+    def __init__(self, data, label):
+        self.data = [data]
+        self.label = [label]

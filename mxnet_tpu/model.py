@@ -1,0 +1,58 @@
+"""Model checkpoint helpers (reference: ``python/mxnet/model.py:189-260`` —
+``save_checkpoint``/``load_checkpoint``/``load_params``; the legacy
+FeedForward trainer itself is gone in 2.x, Gluon + Trainer replace it).
+
+Checkpoint layout matches the reference: ``prefix-symbol.json`` holds the
+graph, ``prefix-%04d.params`` holds a flat name->NDArray map where
+argument parameters are prefixed ``arg:`` and auxiliary states ``aux:``.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base import MXNetError
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):  # pylint: disable=unused-argument
+    """Save ``prefix-symbol.json`` + ``prefix-<epoch>.params``."""
+    from .ndarray.utils import save as nd_save
+
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in (arg_params or {}).items()}
+    save_dict.update({("aux:%s" % k): v
+                      for k, v in (aux_params or {}).items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd_save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_params(prefix, epoch):
+    """Load ``prefix-<epoch>.params`` -> (arg_params, aux_params)."""
+    from .ndarray.utils import load as nd_load
+
+    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        if ":" not in k:
+            raise MXNetError(
+                "params file entry %r is not in arg:/aux: checkpoint "
+                "format" % k)
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            raise MXNetError("unknown parameter kind %r in checkpoint" % tp)
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Load ``prefix-symbol.json`` + params -> (symbol, args, auxs)."""
+    from . import symbol as sym_mod
+
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

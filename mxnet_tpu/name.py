@@ -1,0 +1,52 @@
+"""Automatic symbol naming (reference: ``python/mxnet/name.py`` —
+``NameManager`` per-hint counters and the ``Prefix`` variant, usable as
+context managers)."""
+from __future__ import annotations
+
+import threading
+
+_local = threading.local()
+
+
+class NameManager:
+    """Generates ``hint0, hint1, ...`` names; user-given names win."""
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+    def __enter__(self):
+        self._old_manager = current()
+        _local.manager = self
+        return self
+
+    def __exit__(self, *exc):
+        _local.manager = self._old_manager
+
+
+class Prefix(NameManager):
+    """Prepends a fixed prefix to every auto-generated name (reference
+    ``name.py:71``)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current():
+    """The active NameManager (a default instance when none is entered)."""
+    mgr = getattr(_local, "manager", None)
+    if mgr is None:
+        mgr = NameManager()
+        _local.manager = mgr
+    return mgr

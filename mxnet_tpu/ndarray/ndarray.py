@@ -336,6 +336,42 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
+    def __array_function__(self, func, types, args, kwargs):
+        """NumPy dispatch protocol (reference
+        ``python/mxnet/numpy_dispatch_protocol.py``): ``numpy.<fn>(nd)``
+        routes to the ``mx.np`` implementation when one exists — staying
+        on-device and returning NDArray — else falls back to real numpy
+        on host copies."""
+        from .. import numpy as mnp
+
+        ours = getattr(mnp, func.__name__, None)
+        if ours is not None and callable(ours):
+            try:
+                return ours(*args, **kwargs)
+            except TypeError:
+                pass  # signature mismatch (e.g. out=/where=): host fallback
+        host = [a.asnumpy() if isinstance(a, NDArray) else a for a in args]
+        return func(*host, **kwargs)
+
+    def __array_ufunc__(self, ufunc, method, *args, **kwargs):
+        """NumPy ufunc protocol: same routing as __array_function__ for
+        the plain-call case; other methods (reduce/accumulate/at) fall
+        back to host numpy."""
+        if method != "__call__" or kwargs.get("out") is not None:
+            host = [a.asnumpy() if isinstance(a, NDArray) else a
+                    for a in args]
+            return getattr(ufunc, method)(*host, **kwargs)
+        from .. import numpy as mnp
+
+        ours = getattr(mnp, ufunc.__name__, None)
+        if ours is not None and callable(ours):
+            try:
+                return ours(*args, **kwargs)
+            except TypeError:
+                pass
+        host = [a.asnumpy() if isinstance(a, NDArray) else a for a in args]
+        return ufunc(*host, **kwargs)
+
     def __dlpack__(self, stream=None):  # pylint: disable=unused-argument
         return self._data.__dlpack__()
 

@@ -11,6 +11,11 @@ from __future__ import annotations
 from ..ops.nn import *  # noqa: F401,F403
 from ..ops import nn as _nn
 from ..ops.control_flow import cond, foreach, while_loop  # noqa: F401
+from ..ops.spatial import (  # noqa: F401
+    bilinear_sampler,
+    grid_generator,
+    spatial_transformer,
+)
 from ..util import is_np_array, is_np_shape, set_np, reset_np  # noqa: F401
 
 
@@ -26,6 +31,70 @@ def waitall():
     engine.wait_all()
 
 
+# framework extras the reference's npx also carries
+# (``python/mxnet/numpy_extension/__init__.py`` __all__): NDArray
+# persistence, dlpack interchange, numpy zero-copy, and the one-key
+# samplers ``bernoulli``/``normal_n``/``uniform_n``
+from ..dlpack import (  # noqa: F401,E402
+    from_dlpack,
+    to_dlpack_for_read,
+    to_dlpack_for_write,
+)
+from ..ndarray.utils import load, save  # noqa: F401,E402
+
+
+def from_numpy(ndarray, zero_copy=True):  # pylint: disable=unused-argument
+    """Wrap a host numpy array as an NDArray (XLA owns device buffers, so
+    a host->device transfer replaces the reference's zero-copy view)."""
+    from .. import numpy as mnp
+
+    return mnp.array(ndarray)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None,
+              device=None):  # pylint: disable=unused-argument
+    """Bernoulli sampling (reference ``_npx_bernoulli``)."""
+    from ..gluon.probability import Bernoulli
+
+    out = Bernoulli(prob=prob, logit=logit).sample(size)
+    return out.astype(dtype) if dtype else out
+
+
+def _n_shape(batch_shape, *params):
+    import numpy as onp
+
+    bcast = onp.broadcast_shapes(
+        *[tuple(getattr(p, "shape", ())) for p in params])
+    if batch_shape is None:
+        return bcast or None
+    if isinstance(batch_shape, int):
+        batch_shape = (batch_shape,)
+    return tuple(batch_shape) + bcast
+
+
+def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, ctx=None,
+             device=None):  # pylint: disable=unused-argument
+    """``np.random.normal`` with shape = batch_shape + broadcast(params)
+    (reference ``_npi_normal_n``)."""
+    from .. import numpy as mnp
+
+    return mnp.random.normal(loc, scale, size=_n_shape(batch_shape, loc,
+                                                       scale), dtype=dtype)
+
+
+def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, ctx=None,
+              device=None):  # pylint: disable=unused-argument
+    """``np.random.uniform`` with shape = batch_shape + broadcast(params)
+    (reference ``_npi_uniform_n``)."""
+    from .. import numpy as mnp
+
+    return mnp.random.uniform(low, high, size=_n_shape(batch_shape, low,
+                                                       high), dtype=dtype)
+
+
 __all__ = [n for n in dir(_nn) if not n.startswith("_")] + [
     "seed", "waitall", "set_np", "reset_np", "is_np_array", "is_np_shape",
+    "save", "load", "from_dlpack", "from_numpy", "to_dlpack_for_read",
+    "to_dlpack_for_write", "bernoulli", "normal_n", "uniform_n",
+    "grid_generator", "bilinear_sampler", "spatial_transformer",
 ]

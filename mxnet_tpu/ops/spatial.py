@@ -1,0 +1,127 @@
+"""Spatial-transformer op family (reference:
+``src/operator/grid_generator.cc``, ``src/operator/bilinear_sampler.cc``,
+``src/operator/spatial_transformer.cc``).
+
+TPU-first design: the sampler is pure gather + arithmetic (fully
+differentiable through jnp.take/where, so vjp gives the reference's
+backward kernels for free), grids use the reference's normalized [-1, 1]
+coordinate convention, and everything jits — these run inside
+``hybridize`` like any other op.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .registry import apply as _apply
+from .registry import register as _register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _affine_grid(theta, h, w):
+    """(N, 6) affine -> (N, 2, h, w) sampling grid, normalized [-1, 1]."""
+    jnp = _jnp()
+    n = theta.shape[0]
+    theta = theta.reshape(n, 2, 3)
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, h*w)
+    out = jnp.einsum("nij,jk->nik", theta, coords)              # (n, 2, h*w)
+    return out.reshape(n, 2, h, w)
+
+
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """Generate a sampling grid (reference ``GridGenerator``):
+    ``affine``: data (N, 6) row-major 2x3 matrices; ``warp``: data
+    (N, 2, H, W) pixel-offset flow added to the identity grid."""
+    jnp = _jnp()
+    if transform_type == "affine":
+        if target_shape is None:
+            raise MXNetError("grid_generator(affine) needs target_shape")
+        h, w = int(target_shape[0]), int(target_shape[1])
+
+        def f(t):
+            return _affine_grid(t, h, w)
+
+        return _apply(f, (data,), name="grid_generator:affine")
+    if transform_type == "warp":
+
+        def f(flow):
+            n, _, h, w = flow.shape
+            base = _affine_grid(
+                jnp.tile(jnp.asarray([1.0, 0.0, 0.0, 0.0, 1.0, 0.0]),
+                         (n, 1)), h, w)
+            # flow is in pixels; normalize to the [-1, 1] grid scale
+            fx = flow[:, 0] * (2.0 / max(w - 1, 1))
+            fy = flow[:, 1] * (2.0 / max(h - 1, 1))
+            return base + jnp.stack([fx, fy], axis=1)
+
+        return _apply(f, (data,), name="grid_generator:warp")
+    raise MXNetError(f"unknown transform_type {transform_type!r}")
+
+
+def _j_bilinear_sample(data, grid):
+    """data (N,C,H,W), grid (N,2,Ho,Wo) in [-1,1] -> (N,C,Ho,Wo);
+    out-of-range samples contribute 0 (reference zero padding)."""
+    jnp = _jnp()
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0   # (n, ho, wo)
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        # validity BEFORE clipping; invalid taps weighted 0
+        valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0)
+                 & (yi <= h - 1))[:, None]
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        flat = data.reshape(n, c, h * w)
+        idx = (yc * w + xc).reshape(n, 1, -1)
+        vals = jnp.take_along_axis(
+            flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])), axis=2)
+        vals = vals.reshape(n, c, *xi.shape[1:])
+        return jnp.where(valid, vals, 0.0)
+
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[:, None]
+           + gather(y0, x0 + 1) * (wx * (1 - wy))[:, None]
+           + gather(y0 + 1, x0) * ((1 - wx) * wy)[:, None]
+           + gather(y0 + 1, x0 + 1) * (wx * wy)[:, None])
+    return out
+
+
+def bilinear_sampler(data, grid, **kwargs):  # pylint: disable=unused-argument
+    """Bilinear sampling by a normalized grid (reference
+    ``BilinearSampler``)."""
+    return _apply(_j_bilinear_sample, (data, grid),
+                  name="bilinear_sampler")
+
+
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type="affine",
+                        sampler_type="bilinear", **kwargs):  # pylint: disable=unused-argument
+    """Affine spatial transformer network head (reference
+    ``SpatialTransformer``): loc (N, 6) -> grid -> bilinear sample."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError(
+            "spatial_transformer supports transform_type='affine' + "
+            "sampler_type='bilinear' (reference parity)")
+    if target_shape is None:
+        target_shape = data.shape[2:]
+    h, w = int(target_shape[0]), int(target_shape[1])
+
+    def f(d, t):
+        return _j_bilinear_sample(d, _affine_grid(t, h, w))
+
+    return _apply(f, (data, loc), name="spatial_transformer")
+
+
+for _name in ("grid_generator", "bilinear_sampler", "spatial_transformer"):
+    _register(_name, globals()[_name], wrapper=True)

@@ -56,11 +56,15 @@ def _resolve_op(name):
 class Symbol:
     """A lazy expression node."""
 
-    def __init__(self, op, args, kwargs, name=None):
+    def __init__(self, op, args, kwargs, name=None, attr=None):
+        from . import attribute, name as name_mod
+
         self._op = op          # None for variables
         self._args = args
         self._kwargs = kwargs or {}
-        self.name = name or (op if isinstance(op, str) else "sym")
+        hint = op if isinstance(op, str) else "var"
+        self.name = name_mod.current().get(name, hint)
+        self.attr = attribute.current().get(attr)
 
     # -- graph introspection ---------------------------------------------
     def list_arguments(self):
@@ -159,15 +163,21 @@ class Symbol:
             if id(s) in memo:
                 return memo[id(s)]
             entry = {"op": s._op or "null", "name": s.name,
-                     "attrs": {k: str(v) for k, v in s._kwargs.items()}}
-            entry["inputs"] = [walk(a) for a in s._args
-                               if isinstance(a, Symbol)]
+                     "attrs": {k: repr(v) for k, v in s._kwargs.items()}}
+            # full arg list (symbol refs AND literal constants) so load()
+            # can reconstruct the DAG; "inputs" kept for reference-style
+            # introspection of symbol edges only
+            entry["args"] = [
+                {"node": walk(a)} if isinstance(a, Symbol)
+                else {"const": repr(a)} for a in s._args]
+            entry["inputs"] = [a["node"] for a in entry["args"]
+                               if "node" in a]
             nodes.append(entry)
             memo[id(s)] = len(nodes) - 1
             return memo[id(s)]
 
         walk(self)
-        return json.dumps({"nodes": nodes, "mxnet_tpu_symbol": 1}, indent=2)
+        return json.dumps({"nodes": nodes, "mxnet_tpu_symbol": 2}, indent=2)
 
     def save(self, fname):
         with open(fname, "w") as f:
@@ -175,7 +185,7 @@ class Symbol:
 
     # -- composition ------------------------------------------------------
     def _binop(self, other, op):
-        return Symbol(op, (self, other), {}, name=op)
+        return Symbol(op, (self, other), {})
 
     def __add__(self, other):
         return self._binop(other, "add")
@@ -190,7 +200,7 @@ class Symbol:
         return self._binop(other, "divide")
 
     def __neg__(self):
-        return Symbol("negative", (self,), {}, name="neg")
+        return Symbol("negative", (self,), {})
 
     def __repr__(self):
         return f"<Symbol {self.name}>"
@@ -200,7 +210,8 @@ class Symbol:
             raise AttributeError(op_name)
 
         def method(*args, **kwargs):
-            return Symbol(op_name, (self,) + args, kwargs, name=op_name)
+            name = kwargs.pop("name", None)
+            return Symbol(op_name, (self,) + args, kwargs, name=name)
 
         return method
 
@@ -253,16 +264,44 @@ Variable = var
 
 
 def load(fname):
-    raise MXNetError(
-        "legacy symbol JSON cannot be re-executed in the TPU build (no nnvm "
-        "runtime); export models with HybridBlock.export (StableHLO) and "
-        "reload with SymbolBlock.imports")
+    """Reload a Symbol saved by :meth:`Symbol.save`. Legacy nnvm JSON is
+    rejected with guidance (no nnvm runtime in the TPU build; use
+    HybridBlock.export / SymbolBlock.imports for models)."""
+    import ast
+
+    with open(fname) as f:
+        data = json.load(f)
+    if "mxnet_tpu_symbol" not in data:
+        raise MXNetError(
+            "legacy symbol JSON cannot be re-executed in the TPU build (no "
+            "nnvm runtime); export models with HybridBlock.export "
+            "(StableHLO) and reload with SymbolBlock.imports")
+
+    def literal(r):
+        try:
+            return ast.literal_eval(r)
+        except (ValueError, SyntaxError):
+            return r
+
+    built = []
+    for node in data["nodes"]:
+        kwargs = {k: literal(v) for k, v in node.get("attrs", {}).items()}
+        if node["op"] == "null":
+            built.append(Symbol(None, (), {}, name=node["name"]))
+            continue
+        args = tuple(
+            built[a["node"]] if "node" in a else literal(a["const"])
+            for a in node.get("args",
+                              [{"node": i} for i in node["inputs"]]))
+        built.append(Symbol(node["op"], args, kwargs, name=node["name"]))
+    return built[-1]
 
 
 def _make_op(op_name):
     def op_fn(*args, **kwargs):
-        name = kwargs.pop("name", op_name)
-        return Symbol(op_name, args, kwargs, name=name)
+        name = kwargs.pop("name", None)  # None -> NameManager auto-naming
+        attr = kwargs.pop("attr", None)
+        return Symbol(op_name, args, kwargs, name=name, attr=attr)
 
     op_fn.__name__ = op_name
     return op_fn

@@ -1,0 +1,140 @@
+"""Legacy top-level module parity: callback, model checkpoints, name
+scopes, attribute scopes, typed errors, symbol JSON round-trip, and the
+NumPy dispatch protocol (reference: ``python/mxnet/{callback,model,name,
+attribute,error,numpy_dispatch_protocol}.py``)."""
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.base import MXNetError
+
+
+def test_name_manager_and_prefix():
+    before = mx.sym.var("x").tanh().name
+    nxt = mx.sym.var("x").tanh().name
+    # auto names are distinct and hint-based
+    assert before != nxt and before.startswith("tanh")
+    with mx.name.Prefix("stage1_"):
+        assert mx.sym.var("z").relu().name.startswith("stage1_relu")
+    # user-specified names always win
+    assert mx.sym.var("q").tanh(name="myact").name == "myact"
+
+
+def test_attr_scope_merging():
+    with mx.attribute.AttrScope(group="enc"):
+        s = mx.sym.var("w").tanh()
+        assert s.attr["group"] == "enc"
+        with mx.attribute.AttrScope(lr_mult="2"):
+            inner = mx.sym.var("v").tanh()
+            assert inner.attr == {"group": "enc", "lr_mult": "2"}
+    after = mx.sym.var("u").tanh()
+    assert "group" not in after.attr
+    with pytest.raises(MXNetError):
+        mx.attribute.AttrScope(bad=1)
+
+
+def test_symbol_json_round_trip_with_consts(tmp_path):
+    sym = ((mx.sym.var("a") + 2.0) * mx.sym.var("b")).sum(axis=1)
+    path = str(tmp_path / "s.json")
+    sym.save(path)
+    back = mx.sym.load(path)
+    a = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mnp.array([[2.0, 2.0], [0.5, 0.5]])
+    onp.testing.assert_allclose(back.eval(a=a, b=b)[0].asnumpy(),
+                                sym.eval(a=a, b=b)[0].asnumpy())
+    assert back.list_arguments() == sym.list_arguments()
+
+
+def test_symbol_load_rejects_legacy_json(tmp_path):
+    p = tmp_path / "legacy.json"
+    p.write_text('{"nodes": [], "arg_nodes": [], "heads": []}')
+    with pytest.raises(MXNetError, match="nnvm"):
+        mx.sym.load(str(p))
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "model")
+    sym = mx.sym.var("a").tanh()
+    arg = {"w": mnp.array([1.0, 2.0])}
+    aux = {"running_mean": mnp.array([0.5])}
+    mx.model.save_checkpoint(prefix, 3, sym, arg, aux)
+    s, a2, x2 = mx.model.load_checkpoint(prefix, 3)
+    onp.testing.assert_allclose(a2["w"].asnumpy(), [1.0, 2.0])
+    onp.testing.assert_allclose(x2["running_mean"].asnumpy(), [0.5])
+    assert s.list_arguments() == ["a"]
+    # params-only load
+    a3, x3 = mx.model.load_params(prefix, 3)
+    assert set(a3) == {"w"} and set(x3) == {"running_mean"}
+
+
+def test_do_checkpoint_period(tmp_path):
+    import os
+
+    prefix = str(tmp_path / "ck")
+    cb = mx.callback.do_checkpoint(prefix, period=2)
+    arg = {"w": mnp.array([1.0])}
+    cb(0, None, arg, {})   # epoch 1: not a multiple of 2
+    cb(1, None, arg, {})   # epoch 2: saved
+    assert not os.path.exists(prefix + "-0001.params")
+    assert os.path.exists(prefix + "-0002.params")
+
+
+def test_speedometer_and_log_callbacks(caplog):
+    class Param:
+        def __init__(self, nbatch, metric=None):
+            self.epoch = 0
+            self.nbatch = nbatch
+            self.eval_metric = metric
+
+    sp = mx.callback.Speedometer(batch_size=32, frequent=10)
+    with caplog.at_level(logging.INFO):
+        sp(Param(0))
+        sp(Param(10))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+    from mxnet_tpu.gluon import metric as metric_mod
+
+    m = metric_mod.Accuracy()
+    m.update(mnp.array([1.0, 0.0]), mnp.array([1.0, 1.0]))
+    caplog.clear()
+    with caplog.at_level(logging.INFO):
+        mx.callback.log_train_metric(5)(Param(5, m))
+        mx.callback.LogValidationMetricsCallback()(Param(5, m))
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("Train-accuracy" in s for s in msgs)
+    assert any("Validation-accuracy" in s for s in msgs)
+
+
+def test_error_registry():
+    assert mx.error.error_class("ValueError") is ValueError
+    assert mx.error.error_class("unknown-kind") is MXNetError
+    with pytest.raises(mx.error.InternalError, match="hint"):
+        raise mx.error.InternalError("boom")
+
+    @mx.error.register
+    class CustomError(MXNetError):
+        pass
+
+    assert mx.error.error_class("CustomError") is CustomError
+
+
+def test_numpy_dispatch_protocol():
+    a = mnp.array([1.0, 2.0, 3.0])
+    # numpy functions dispatch to mx.np and stay NDArray
+    r = onp.sum(a)
+    assert type(r).__name__ == "NDArray" and float(r.asnumpy()) == 6.0
+    r = onp.concatenate([a, a])
+    assert type(r).__name__ == "NDArray" and r.shape == (6,)
+    # ufuncs too
+    r = onp.exp(a)
+    assert type(r).__name__ == "NDArray"
+    onp.testing.assert_allclose(r.asnumpy(), onp.exp([1.0, 2.0, 3.0]),
+                                rtol=1e-6)
+    # mixed numpy-array + NDArray arithmetic returns NDArray
+    r = onp.ones(3, "float32") + a
+    assert type(r).__name__ == "NDArray"
+    # ufunc .reduce falls back to host numpy values
+    assert float(onp.add.reduce(a)) == 6.0

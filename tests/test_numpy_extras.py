@@ -200,3 +200,28 @@ def test_unique_variants_and_bitwise_aliases():
         np.bitwise_left_shift(np.array(x), 2).asnumpy(), x << 2)
     onp.testing.assert_array_equal(
         np.bitwise_right_shift(np.array(x), 1).asnumpy(), x >> 1)
+
+
+def test_npx_framework_extras(tmp_path):
+    # reference numpy_extension __all__ tail: save/load, dlpack, samplers
+    import torch
+
+    mx.random.seed(1)
+    s = mx.npx.bernoulli(prob=0.4, size=(50,))
+    assert s.shape == (50,)
+    s = mx.npx.normal_n(np.array([0.0, 5.0]), 1.0, batch_shape=(3,))
+    assert s.shape == (3, 2)
+    assert mx.npx.uniform_n(0.0, 1.0, batch_shape=4).shape == (4,)
+    a = np.array([1.0, 2.0])
+    onp.testing.assert_allclose(
+        mx.npx.from_dlpack(mx.npx.to_dlpack_for_read(a)).asnumpy(), [1, 2])
+    # cross-framework interchange both directions
+    onp.testing.assert_allclose(
+        mx.npx.from_dlpack(torch.arange(3, dtype=torch.float32)).asnumpy(),
+        [0, 1, 2])
+    onp.testing.assert_allclose(
+        torch.from_dlpack(mx.npx.to_dlpack_for_read(a)).numpy(), [1, 2])
+    assert mx.npx.from_numpy(onp.ones((2, 2), "float32")).shape == (2, 2)
+    mx.npx.save(str(tmp_path / "x.nd"), {"w": a})
+    onp.testing.assert_allclose(
+        mx.npx.load(str(tmp_path / "x.nd"))["w"].asnumpy(), [1, 2])
