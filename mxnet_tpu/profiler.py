@@ -71,6 +71,92 @@ def dumps(reset=False):
     return "\n".join(lines)
 
 
+def device_op_stats(trace_dir=None):
+    """Per-op DEVICE time table from a captured trace (the role of the
+    reference's ``src/profiler/aggregate_stats.cc`` tables).
+
+    Parses the chrome-trace the ``jax.profiler`` run wrote (device pid rows
+    carry ``device_duration_ps``/``model_flops``/``bytes_accessed`` per XLA
+    op) and aggregates by op name. Returns rows sorted by total device time:
+    ``{"name", "category", "calls", "total_us", "avg_us", "flops",
+    "bytes_accessed", "tflops_s", "gb_s"}``.
+
+    ``trace_dir`` defaults to the directory of the last ``set_state('run')``
+    capture. Empty list when the backend recorded no device events (pure-CPU
+    runs expose host events only).
+    """
+    import glob
+    import gzip
+    import json
+
+    d = trace_dir or _trace_dir
+    if d is None:
+        raise MXNetError("no trace captured: run "
+                         "set_state('run') ... set_state('stop') first")
+    paths = sorted(glob.glob(os.path.join(d, "**", "*.trace.json.gz"),
+                             recursive=True))
+    if not paths:
+        return []
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # device pids are announced by process_name metadata like '/device:TPU:0'
+    dev_pids = {e.get("pid") for e in events
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+                and "/device:" in str(e.get("args", {}).get("name", ""))}
+    agg = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        args = e.get("args", {})
+        if "device_duration_ps" not in args:
+            continue
+        name = e.get("name", "?")
+        row = agg.setdefault(name, {
+            "name": name,
+            "category": args.get("hlo_category", ""),
+            "calls": 0, "total_us": 0.0, "flops": 0, "bytes_accessed": 0})
+        row["calls"] += 1
+        row["total_us"] += float(args["device_duration_ps"]) / 1e6
+        row["flops"] += int(args.get("model_flops", 0) or 0)
+        row["bytes_accessed"] += int(args.get("bytes_accessed", 0) or 0)
+    rows = sorted(agg.values(), key=lambda r: -r["total_us"])
+    for r in rows:
+        r["avg_us"] = r["total_us"] / max(r["calls"], 1)
+        secs = r["total_us"] / 1e6
+        r["tflops_s"] = r["flops"] / secs / 1e12 if secs else 0.0
+        r["gb_s"] = r["bytes_accessed"] / secs / 1e9 if secs else 0.0
+    return rows
+
+
+def device_op_table(trace_dir=None, by_category=False, top=30):
+    """Formatted per-op (or per-category) device-time table; the printable
+    analog of ``MXAggregateProfileStatsPrint``."""
+    rows = device_op_stats(trace_dir)
+    if by_category:
+        cats = {}
+        for r in rows:
+            c = cats.setdefault(r["category"] or "other", {
+                "name": r["category"] or "other", "calls": 0,
+                "total_us": 0.0, "flops": 0, "bytes_accessed": 0})
+            c["calls"] += r["calls"]
+            c["total_us"] += r["total_us"]
+            c["flops"] += r["flops"]
+            c["bytes_accessed"] += r["bytes_accessed"]
+        rows = sorted(cats.values(), key=lambda r: -r["total_us"])
+        for r in rows:
+            secs = r["total_us"] / 1e6
+            r["tflops_s"] = r["flops"] / secs / 1e12 if secs else 0.0
+            r["gb_s"] = r["bytes_accessed"] / secs / 1e9 if secs else 0.0
+    lines = [f"{'Name':<32}{'Calls':>7}{'Total(us)':>12}"
+             f"{'TFLOP/s':>9}{'GB/s':>8}"]
+    for r in rows[:top]:
+        lines.append(f"{r['name'][:31]:<32}{r['calls']:>7}"
+                     f"{r['total_us']:>12.1f}{r['tflops_s']:>9.1f}"
+                     f"{r['gb_s']:>8.0f}")
+    return "\n".join(lines)
+
+
 def pause(profile_process="worker"):  # pylint: disable=unused-argument
     if _running:
         set_state("stop")

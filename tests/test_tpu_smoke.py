@@ -30,9 +30,11 @@ def test_tpu_context_and_eager_op():
 def test_flash_attention_pallas_path_executes():
     from mxnet_tpu.ops.pallas import flash_attention as fa
 
-    q = np.array(onp.random.randn(2, 4, 256, 64).astype("float32"),
+    # 2048 tokens: above the empirical flash-vs-XLA crossover (~1024) so
+    # the hardware pallas path is the one selected and exercised
+    q = np.array(onp.random.randn(1, 2, 2048, 64).astype("float32"),
                  ctx=mx.tpu())
-    vl = np.array(onp.array([256, 180], "int32"), ctx=mx.tpu())
+    vl = np.array(onp.array([1600], "int32"), ctx=mx.tpu())
     out = fa.attention(q._data, q._data, q._data, valid_length=vl._data)
     assert fa.last_path() == "pallas"
     ref = fa._reference_attention(q._data, q._data, q._data,
