@@ -111,6 +111,56 @@ def _timed_diff(step, fetch, k1, k2):
     return diffs[len(diffs) // 2]
 
 
+def _infer_rate_fused(net, x_host, n_fuse=16):
+    """Per-inference seconds with n_fuse forwards fused into ONE dispatch
+    (lax.scan on device). Single-dispatch inference at bs32 is tunnel-RTT
+    bound (~10 ms of dispatch against ~2-5 ms of device work), so the
+    un-fused rows under-report the chip; the scan chains each forward on a
+    negligible function of the previous logits so XLA cannot elide or
+    reorder the iterations."""
+    import functools
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from mxnet_tpu.parallel.functional import functionalize
+
+    apply_fn, params = functionalize(net, train_mode=False)
+
+    @functools.partial(jax.jit, static_argnums=2)
+    def run(params, x, m):
+        def body(carry, _):
+            out = apply_fn(params, x + carry)
+            logits = jax.tree_util.tree_leaves(out)[0]
+            # serialize iterations: next input nudged by the last logits
+            return jnp.mean(logits).astype(x.dtype) * 1e-12, None
+
+        c, _ = jax.lax.scan(body, jnp.zeros((), x.dtype), None, length=m)
+        return c
+
+    x = jnp.asarray(x_host)
+    onp.asarray(run(params, x, n_fuse))
+    onp.asarray(run(params, x, 4 * n_fuse))
+
+    def t(m):
+        t0 = time.perf_counter()
+        r = run(params, x, m)
+        onp.asarray(r)
+        return time.perf_counter() - t0
+
+    diffs = []
+    for _ in range(3):
+        d1, d2 = t(n_fuse), t(4 * n_fuse)
+        if d2 > d1:
+            diffs.append((d2 - d1) / (3 * n_fuse))
+    if not diffs:
+        raise RuntimeError("degenerate fused-inference timing")
+    diffs.sort()
+    return diffs[len(diffs) // 2]
+
+
 def bench_resnet_infer():
     """ResNet-50 v1 fp32 inference, batch 32 — benchmark_score.py protocol
     through the user-facing path: model_zoo net -> hybridize() -> XLA."""
@@ -144,12 +194,28 @@ def bench_resnet_infer():
         dt = _timed_diff(lambda: net(x),
                          lambda out: out.asnumpy(), 3, 18)
     img_s = BATCH / dt
-    return _emit({
+    row = _emit({
         "metric": "resnet50_v1_infer_bs32_fp32",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASE_INFER_IMG_S, 3),
     })
+    # fused probe AFTER the stable row is out: a fused-timing flake must
+    # not cost the protocol metric
+    with autograd.predict_mode():
+        dt_fused = _infer_rate_fused(net, x._data)
+    global _FP32_INFER_FUSED_S
+    _FP32_INFER_FUSED_S = dt_fused
+    _emit({
+        "metric": "resnet50_v1_infer_bs32_fp32_fused16",
+        "value": round(BATCH / dt_fused, 2),
+        "unit": "img/s",
+        "vs_baseline": round(BATCH / dt_fused / BASE_INFER_IMG_S, 3),
+    })
+    return row
+
+
+_FP32_INFER_FUSED_S = None
 
 
 def bench_resnet_infer_int8():
@@ -175,7 +241,10 @@ def bench_resnet_infer_int8():
     xc = mnp.array(
         onp.random.uniform(-1, 1, (8, 3, SIZE, SIZE)).astype("float32"),
         ctx=mx.cpu())
-    quantize_net(net, calib_data=xc, calib_mode="naive")
+    # bf16 inter-layer activations: the reference's reduced-precision
+    # protocol feeds fp16 inputs to its fp16 rows (perf.md:208); same here
+    quantize_net(net, calib_data=xc, calib_mode="naive",
+                 activation_dtype="bfloat16")
     try:
         ctx = mx.tpu()
         ctx.jax_device()
@@ -184,18 +253,37 @@ def bench_resnet_infer_int8():
         ctx = mx.cpu()
     x = mnp.array(
         onp.random.uniform(-1, 1, (BATCH, 3, SIZE, SIZE)).astype("float32"),
-        ctx=ctx)
+        ctx=ctx).astype("bfloat16")
     net.hybridize(static_alloc=True)
     with autograd.predict_mode():
         net(x).asnumpy()  # compile + drain
         dt = _timed_diff(lambda: net(x), lambda out: out.asnumpy(), 3, 18)
     img_s = BATCH / dt
-    return _emit({
+    _emit({
         "metric": "resnet50_v1_infer_bs32_int8",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / 2085.51, 3),
     })
+    with autograd.predict_mode():
+        dt_fused = _infer_rate_fused(net, x._data)
+    # the perf contract int8 exists for: >=1.5x the fp32 rate measured the
+    # same (fused, dispatch-amortized) way — a slower int8 path FAILS the
+    # bench rather than shipping a number that quietly lost to fp32
+    fp32_s = _FP32_INFER_FUSED_S
+    speedup = (fp32_s / dt_fused) if fp32_s else None
+    row = _emit({
+        "metric": "resnet50_v1_infer_bs32_int8_fused16",
+        "value": round(BATCH / dt_fused, 2),
+        "unit": "img/s",
+        "vs_baseline": round(BATCH / dt_fused / 2085.51, 3),
+        "speedup_vs_fp32": round(speedup, 3) if speedup else None,
+    })
+    if speedup is not None and speedup < 1.5:
+        raise RuntimeError(
+            f"int8 fused inference is only {speedup:.2f}x fp32 (>=1.5x "
+            f"required): the int8 path is not earning its existence")
+    return row
 
 
 def _train_bench(net, loss_fn, optimizer, opt_params, data, labels,

@@ -79,7 +79,10 @@ def test_quantize_net_conv_and_hybridize():
     x = np.array(rng.randn(4, 3, 16, 16).astype("float32"))
     with autograd.predict_mode():
         ref = net(x).asnumpy()
-    qnet = q.quantize_net(net, calib_data=[x], calib_mode="naive")
+    # exclude_first_conv=False: this net's only conv IS the stem; the test
+    # pins the conv path, so quantize it (the default leaves it float)
+    qnet = q.quantize_net(net, calib_data=[x], calib_mode="naive",
+                          exclude_first_conv=False)
     from mxnet_tpu.contrib.quantization import QuantizedConv, QuantizedDense
 
     kinds = [type(c) for c in qnet]
@@ -128,3 +131,46 @@ def test_exclude_layers_and_errors():
         q.quantize_net(net, calib_data=x, calib_mode="bogus")
     with pytest.raises(mx.MXNetError):
         q.quantize_net(net, calib_data=x, quantized_dtype="uint4")
+
+
+def test_quantize_net_exclude_options():
+    """exclude_first_conv default keeps the stem float; exclude_layers_match
+    regexes skip matching paths (reference quantize_net parameters)."""
+    rng = onp.random.RandomState(3)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1), gluon.nn.Conv2D(8, 3, padding=1),
+            gluon.nn.Flatten(), gluon.nn.Dense(5))
+    net.initialize()
+    x = np.array(rng.randn(2, 3, 8, 8).astype("float32"))
+    with autograd.predict_mode():
+        net(x)
+    from mxnet_tpu.contrib.quantization import QuantizedConv, QuantizedDense
+    from mxnet_tpu.gluon import nn as gnn
+
+    qnet = q.quantize_net(net, calib_data=[x], calib_mode="naive",
+                          exclude_layers_match=[r"\b3\b"])
+    kinds = [type(c) for c in qnet]
+    assert kinds[0] is gnn.Conv2D          # stem stays float (default)
+    assert kinds[1] is QuantizedConv       # second conv quantized
+    assert QuantizedDense not in kinds     # '3' (the Dense) matched exclude
+
+
+def test_quantize_net_bf16_activations_accuracy():
+    """activation_dtype='bfloat16' keeps predictions close to fp32: the
+    int8 path's TPU deployment mode (bf16 inter-layer traffic)."""
+    rng = onp.random.RandomState(4)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+            gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.Flatten(), gluon.nn.Dense(10))
+    net.initialize()
+    x = np.array(rng.randn(8, 3, 16, 16).astype("float32"))
+    with autograd.predict_mode():
+        ref = net(x).asnumpy()
+    q.quantize_net(net, calib_data=[x], calib_mode="naive",
+                   activation_dtype="bfloat16")
+    with autograd.predict_mode():
+        got = net(x.astype("bfloat16")).asnumpy().astype("float32")
+    corr = onp.corrcoef(got.ravel(), ref.ravel())[0, 1]
+    assert corr > 0.98
+    assert (got.argmax(1) == ref.argmax(1)).mean() > 0.8
