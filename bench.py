@@ -200,18 +200,21 @@ def bench_resnet_infer():
         "unit": "img/s",
         "vs_baseline": round(img_s / BASE_INFER_IMG_S, 3),
     })
-    # fused probe AFTER the stable row is out: a fused-timing flake must
-    # not cost the protocol metric
-    with autograd.predict_mode():
-        dt_fused = _infer_rate_fused(net, x._data)
+    # fused probe AFTER the stable row is out, and non-fatal: a
+    # fused-timing flake must not cost the protocol metric
     global _FP32_INFER_FUSED_S
-    _FP32_INFER_FUSED_S = dt_fused
-    _emit({
-        "metric": "resnet50_v1_infer_bs32_fp32_fused16",
-        "value": round(BATCH / dt_fused, 2),
-        "unit": "img/s",
-        "vs_baseline": round(BATCH / dt_fused / BASE_INFER_IMG_S, 3),
-    })
+    try:
+        with autograd.predict_mode():
+            dt_fused = _infer_rate_fused(net, x._data)
+        _FP32_INFER_FUSED_S = dt_fused
+        _emit({
+            "metric": "resnet50_v1_infer_bs32_fp32_fused16",
+            "value": round(BATCH / dt_fused, 2),
+            "unit": "img/s",
+            "vs_baseline": round(BATCH / dt_fused / BASE_INFER_IMG_S, 3),
+        })
+    except Exception as e:
+        print(f"# fp32 fused probe failed: {e}", file=sys.stderr)
     return row
 
 
@@ -269,8 +272,21 @@ def bench_resnet_infer_int8():
         dt_fused = _infer_rate_fused(net, x._data)
     # the perf contract int8 exists for: >=1.5x the fp32 rate measured the
     # same (fused, dispatch-amortized) way — a slower int8 path FAILS the
-    # bench rather than shipping a number that quietly lost to fp32
+    # bench rather than shipping a number that quietly lost to fp32. If
+    # the fp32 bench didn't leave its fused rate (row order / flake), the
+    # gate measures it here rather than silently waiving the contract.
     fp32_s = _FP32_INFER_FUSED_S
+    if fp32_s is None:
+        fnet = gluon.model_zoo.vision.resnet50_v1()
+        fnet.initialize(ctx=mx.cpu())
+        with autograd.predict_mode():
+            fnet(mnp.array(onp.zeros((1, 3, 64, 64), dtype="float32"),
+                           ctx=mx.cpu()))
+        if ctx.device_type != "cpu":
+            fnet.reset_ctx(ctx)
+        with autograd.predict_mode():
+            fp32_s = _infer_rate_fused(
+                fnet, x._data.astype("float32"))
     speedup = (fp32_s / dt_fused) if fp32_s else None
     row = _emit({
         "metric": "resnet50_v1_infer_bs32_int8_fused16",
@@ -535,22 +551,44 @@ def bench_bert_train_fused(n_fuse=8):
 def bench_lenet_eager():
     """Imperative (non-hybridized) LeNet training — the reference's eager
     LeNet/MNIST config. Exercises per-op dispatch + the eager jit cache
-    (SURVEY §7 hard part 2); reports the cached rate and the uncached rate."""
+    (SURVEY §7 hard part 2); reports the cached rate and the uncached rate.
+
+    Diagnosis of the r2 eager gap (the measurement this round's >=2x fix
+    came from): the r2 bench built its arrays on the DEFAULT context, i.e.
+    jax-CPU, where a single LeNet conv *backward* costs ~7 ms of genuine
+    single-host compute (the 129 ms step was device-bound, not
+    dispatch-bound — the jit cache rightly bought only 8%). On the TPU
+    context the per-op device time is negligible and the cost structure
+    inverts: the tunnel runtime drains ~0.7-4 ms per executed op, so the
+    step is dispatch-round-trip-bound, exactly SURVEY §7 hard part 2's
+    prediction. Two fixes: (1) this bench now runs on mx.tpu() like every
+    other row; (2) recorded ops now run their forward through the cached
+    per-op executable and their backward through a cached compiled vjp
+    (registry._make_cached_vjp) instead of per-step jax.vjp retracing +
+    Python transpose interpretation — 2.3x the r2 rate; the remaining time
+    is ~50 tunnel round-trips that only op-graph batching could remove."""
     import numpy as onp
 
+    import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon
     from mxnet_tpu import np as mnp
     from mxnet_tpu.ops import registry
 
     BATCH = 64
+    try:
+        ctx = mx.tpu()
+        ctx.jax_device()
+    except Exception:
+        ctx = mx.cpu()
     net = gluon.nn.HybridSequential()
     net.add(gluon.nn.Conv2D(6, 5, activation="relu"), gluon.nn.MaxPool2D(2),
             gluon.nn.Conv2D(16, 5, activation="relu"), gluon.nn.MaxPool2D(2),
             gluon.nn.Flatten(), gluon.nn.Dense(120, activation="relu"),
             gluon.nn.Dense(84, activation="relu"), gluon.nn.Dense(10))
-    net.initialize()
-    x = mnp.array(onp.random.randn(BATCH, 1, 28, 28).astype("float32"))
-    y = mnp.array(onp.random.randint(0, 10, (BATCH,)))
+    net.initialize(ctx=ctx)
+    x = mnp.array(onp.random.randn(BATCH, 1, 28, 28).astype("float32"),
+                  ctx=ctx)
+    y = mnp.array(onp.random.randint(0, 10, (BATCH,)), ctx=ctx)
     with autograd.predict_mode():
         net(x)
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
@@ -569,8 +607,10 @@ def bench_lenet_eager():
         for flag in (False, True):
             registry.set_eager_jit(flag)
             registry._EAGER_JIT_CACHE.clear()
-            float(step().asnumpy())  # drain
-            dt = _timed_diff(step, lambda l: float(l.asnumpy()), 2, 8)
+            registry._EAGER_BWD_CACHE.clear()
+            for _ in range(3):
+                float(step().asnumpy())  # drain + warm fwd AND bwd caches
+            dt = _timed_diff(step, lambda l: float(l.asnumpy()), 3, 18)
             rates[flag] = BATCH / dt
     finally:
         registry.set_eager_jit(prev_enabled)
