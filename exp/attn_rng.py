@@ -1,0 +1,89 @@
+"""Head-to-head: pallas vs XLA attention at BERT shapes; threefry vs rbg RNG."""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+sys.path.insert(0, "/root/repo")
+
+
+def timed(fn, fetch, k1=5, k2=55, reps=3):
+    fetch(fn())
+    diffs = []
+    for _ in range(reps):
+        def t(k):
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(k):
+                r = fn()
+            fetch(r)
+            return time.perf_counter() - t0
+        d1, d2 = t(k1), t(k2)
+        if d2 > d1:
+            diffs.append((d2 - d1) / (k2 - k1))
+    diffs.sort()
+    return diffs[len(diffs) // 2]
+
+
+def attn_bench(seqs=(128, 512, 2048)):
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+
+    B, H, D = 64, 12, 64
+    for T in seqs:
+        b = B if T <= 512 else 8
+        q = jnp.array(onp.random.randn(b, H, T, D) * 0.1, dtype=jnp.bfloat16)
+        k = jnp.array(onp.random.randn(b, H, T, D) * 0.1, dtype=jnp.bfloat16)
+        v = jnp.array(onp.random.randn(b, H, T, D) * 0.1, dtype=jnp.bfloat16)
+        vl = jnp.array(onp.random.randint(T // 2, T + 1, (b,)), dtype=jnp.int32)
+
+        for use_flash in (True, False):
+            def loss(q, k, v):
+                o = fa.attention(q, k, v, valid_length=vl,
+                                 use_flash=use_flash)
+                return jnp.sum(o.astype(jnp.float32))
+
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            try:
+                dt = timed(lambda: g(q, k, v),
+                           lambda r: onp.asarray(r[0].reshape(-1)[0]),
+                           k1=3, k2=33)
+            except Exception as e:
+                print(f"T{T} flash={use_flash}: FAIL {e}")
+                continue
+            fl = 4 * 2 * b * H * T * T * D * 3  # fwd+bwd ~3x, qk+av
+            print(f"attn T{T} b{b} flash={use_flash}: {dt*1e3:.3f} ms "
+                  f"({fl/dt/1e12:.1f} TF/s)")
+
+
+def rng_bench():
+    shape = (64, 128, 768)
+    for impl in ("threefry2x32", "rbg"):
+        key = jax.random.PRNGKey(0, impl=impl)
+
+        @jax.jit
+        def gen(key):
+            k1 = jax.random.fold_in(key, 1)
+            xs = [jax.random.bernoulli(jax.random.fold_in(k1, i), 0.9, shape)
+                  for i in range(10)]
+            s = jnp.zeros(shape[1:], jnp.float32)
+            for x in xs:
+                s = s + jnp.sum(x, axis=0)
+            return s
+
+        dt = timed(lambda: gen(key),
+                   lambda r: onp.asarray(r.reshape(-1)[0]), k1=2, k2=22)
+        per = dt / 10
+        nbytes = 64 * 128 * 768
+        print(f"rng {impl}: {per*1e3:.3f} ms per (64,128,768) bernoulli "
+              f"({nbytes/per/1e9:.0f} GB/s of mask)")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "attn"):
+        attn_bench()
+    if which in ("all", "rng"):
+        rng_bench()

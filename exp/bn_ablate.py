@@ -1,0 +1,165 @@
+"""Ablate BN formulation in the hand ResNet: two-pass vs single-pass vs none.
+
+Also: full train-step timing for each, and HLO op census.
+"""
+import collections
+import functools
+import re
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+sys.path.insert(0, "/root/repo/exp")
+from resnet_bound import BATCH, STAGES, init_params  # noqa: E402
+
+PEAK = 197e12
+
+
+def make_fwd(bn_mode):
+    dn = ("NHWC", "HWIO", "NHWC")
+
+    def conv(x, w, stride=1, pad=None):
+        k = w.shape[0]
+        if pad is None:
+            pad = (k - 1) // 2
+        return jax.lax.conv_general_dilated(
+            x, w, (stride, stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=dn)
+
+    def bnorm(x, g, b):
+        C = x.shape[3]
+        if bn_mode == "none":
+            return x + b.reshape(1, 1, 1, C)
+        if bn_mode == "onepass":
+            s = jnp.mean(x.astype(jnp.float32), axis=(0, 1, 2))
+            s2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=(0, 1, 2))
+            v = s2 - jnp.square(s)
+            inv = (g / jnp.sqrt(v + 1e-5).astype(g.dtype)).reshape(1, 1, 1, C)
+            return (x - s.astype(x.dtype).reshape(1, 1, 1, C)) * inv \
+                + b.reshape(1, 1, 1, C)
+        m = jnp.mean(x, axis=(0, 1, 2))
+        v = jnp.var(x, axis=(0, 1, 2))
+        sh = (1, 1, 1, C)
+        inv = (g / jnp.sqrt(v + 1e-5)).reshape(sh)
+        return (x - m.reshape(sh)) * inv + b.reshape(sh)
+
+    def fwd(p, x):
+        x = conv(x, p["conv0"], 2, pad=3)
+        x = jax.nn.relu(bnorm(x, p["bn0.g"], p["bn0.b"]))
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            [(0, 0), (1, 1), (1, 1), (0, 0)])
+        for si, (blocks, mid, stride) in enumerate(STAGES):
+            for bi in range(blocks):
+                st = stride if bi == 0 else 1
+                pre = f"s{si}b{bi}"
+                idn = x
+                y = jax.nn.relu(bnorm(conv(x, p[pre + ".c1"]),
+                                      p[pre + ".n1.g"], p[pre + ".n1.b"]))
+                y = jax.nn.relu(bnorm(conv(y, p[pre + ".c2"], st),
+                                      p[pre + ".n2.g"], p[pre + ".n2.b"]))
+                y = bnorm(conv(y, p[pre + ".c3"]),
+                          p[pre + ".n3.g"], p[pre + ".n3.b"])
+                if bi == 0:
+                    idn = bnorm(conv(idn, p[pre + ".cd"], st),
+                                p[pre + ".nd.g"], p[pre + ".nd.b"])
+                x = jax.nn.relu(y + idn)
+        x = jnp.mean(x, axis=(1, 2))
+        return x @ p["fc.w"] + p["fc.b"]
+
+    return fwd
+
+
+def train_time(bn_mode, batch=BATCH):
+    fwd = make_fwd(bn_mode)
+    params = init_params(jax.random.PRNGKey(0), True)
+    mom = {k: jnp.zeros_like(v) for k, v in params.items()}
+    x = jnp.array(onp.random.uniform(-1, 1, (batch, 224, 224, 3)),
+                  dtype=jnp.float32)
+    y = jnp.array(onp.random.randint(0, 1000, (batch,)), dtype=jnp.int32)
+
+    def loss_of(params, x, y):
+        pb = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
+              for k, v in params.items()}
+        logits = fwd(pb, x.astype(jnp.bfloat16)).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(lp, y[:, None], 1))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, mom, x, y):
+        l, g = jax.value_and_grad(loss_of)(params, x, y)
+        newp, newm = {}, {}
+        for k in params:
+            m = 0.9 * mom[k] + g[k] + 1e-4 * params[k]
+            newm[k] = m
+            newp[k] = params[k] - 0.1 * m
+        return newp, newm, l
+
+    compiled = step.lower(params, mom, x, y).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    flops = ca.get("flops", 0)
+    state = [params, mom]
+
+    def run():
+        p, m, l = compiled(state[0], state[1], x, y)
+        state[0], state[1] = p, m
+        return l
+
+    float(run())
+
+    def t(k):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(k):
+            r = run()
+        float(r)
+        return time.perf_counter() - t0
+
+    diffs = []
+    for _ in range(3):
+        d1, d2 = t(3), t(13)
+        if d2 > d1:
+            diffs.append((d2 - d1) / 10)
+    diffs.sort()
+    dt = diffs[len(diffs) // 2]
+    print(f"train bn={bn_mode} bs{batch}: {dt*1e3:.2f} ms  "
+          f"{batch/dt:.0f} img/s  MFU {flops/dt/PEAK:.3f} "
+          f"({flops/1e9/batch:.1f} GF/img)")
+    return compiled
+
+
+def hlo_census(compiled):
+    txt = compiled.as_text()
+    ops = collections.Counter()
+    bytes_by = collections.Counter()
+    for line in txt.splitlines():
+        m = re.match(r"\s*(?:ROOT )?%?[\w.-]+ = (\w+)\[([\d,]*)\]", line)
+        if not m:
+            continue
+        mm = re.search(r"= (\w+)\[([\d,]*)\][^ ]* (\w+)\(", line)
+        if not mm:
+            continue
+        dtype, shape, op = mm.group(1), mm.group(2), mm.group(3)
+        n = 1
+        for s in shape.split(","):
+            if s:
+                n *= int(s)
+        sz = n * (2 if dtype in ("bf16", "f16") else 4)
+        ops[op] += 1
+        bytes_by[op] += sz
+    for op, cnt in ops.most_common(18):
+        print(f"  {op:25s} x{cnt:4d}  out {bytes_by[op]/1e6:9.1f} MB")
+
+
+if __name__ == "__main__":
+    for mode in ("twopass", "onepass", "none"):
+        c = train_time(mode)
+        if mode == "twopass":
+            print("HLO census (twopass):")
+            hlo_census(c)
+    train_time("twopass", batch=512)

@@ -1,0 +1,140 @@
+"""Decompose the ResNet step: per-stage conv rates, BN cost, fwd vs train."""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+PEAK = 197e12
+HBM = 819e9
+
+
+def scan_rate(make_step, x0, m1=20, m2=220, reps=3):
+    @functools.partial(jax.jit, static_argnums=1)
+    def run(x, m):
+        def body(c, _):
+            return make_step(c), None
+        out, _ = jax.lax.scan(body, x, None, length=m)
+        return out
+
+    onp.asarray(jax.tree_util.tree_leaves(run(x0, m1))[0].reshape(-1)[0])
+    onp.asarray(jax.tree_util.tree_leaves(run(x0, m2))[0].reshape(-1)[0])
+
+    def t(m):
+        t0 = time.perf_counter()
+        r = run(x0, m)
+        onp.asarray(jax.tree_util.tree_leaves(r)[0].reshape(-1)[0])
+        return time.perf_counter() - t0
+
+    diffs = []
+    for _ in range(reps):
+        d1, d2 = t(m1), t(m2)
+        if d2 > d1:
+            diffs.append((d2 - d1) / (m2 - m1))
+    diffs.sort()
+    return diffs[len(diffs) // 2]
+
+
+def conv_probe():
+    B = 256
+    cases = [  # (H, Cin, Cout, k, stride-label)
+        (56, 64, 64, 3), (56, 64, 256, 1), (56, 256, 64, 1),
+        (28, 128, 128, 3), (28, 512, 128, 1),
+        (14, 256, 256, 3), (7, 512, 512, 3),
+    ]
+    for H, Ci, Co, k in cases:
+        x = jnp.array(onp.random.randn(B, H, H, Ci), dtype=jnp.bfloat16)
+        w = jnp.array(onp.random.randn(k, k, Ci, Co) * 0.05,
+                      dtype=jnp.bfloat16)
+        wb = jnp.array(onp.random.randn(1, 1, Co, Ci) * 0.05,
+                       dtype=jnp.bfloat16)
+        p = (k - 1) // 2
+
+        def step(x, w=w, wb=wb, p=p):
+            y = jax.lax.conv_general_dilated(
+                x, w, (1, 1), [(p, p), (p, p)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            # back to Cin so we can chain
+            return jax.lax.conv_general_dilated(
+                y, wb, (1, 1), [(0, 0), (0, 0)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        fl = 2 * B * H * H * Ci * Co * k * k + 2 * B * H * H * Ci * Co
+        # adapt scan length: target ~0.5s total diff
+        est = fl / (0.5 * PEAK)
+        m2 = max(40, min(1500, int(0.5 / est)))
+        dt = scan_rate(step, x, 20, 20 + m2)
+        print(f"conv {H}x{H} {Ci}->{Co} k{k} (+1x1 back): "
+              f"{dt*1e3:.3f} ms {fl/dt/1e12:.1f} TF/s ({fl/dt/PEAK*100:.0f}%)")
+
+
+def bn_probe():
+    B = 256
+    for H, C in [(56, 256), (28, 512), (14, 1024)]:
+        x = jnp.array(onp.random.randn(B, H, H, C), dtype=jnp.bfloat16)
+        g = jnp.ones(C, jnp.bfloat16)
+        b = jnp.zeros(C, jnp.bfloat16)
+
+        def step(x, g=g, b=b):
+            m = jnp.mean(x, axis=(0, 1, 2))
+            v = jnp.var(x, axis=(0, 1, 2))
+            return (x - m) * (g / jnp.sqrt(v + 1e-5)) + b
+
+        bytes_ = x.size * 2 * 2  # read + write
+        est = bytes_ * 3 / HBM  # ~3 passes
+        m2 = max(40, min(1000, int(0.5 / est)))
+        dt = scan_rate(step, x, 10, 10 + m2)
+        print(f"bn {H}x{H}x{C}: {dt*1e3:.3f} ms "
+              f"{x.size*2*2/dt/1e9:.0f} GB/s eff (r+w once)")
+
+
+def fwd_vs_train():
+    sys.path.insert(0, "/root/repo/exp")
+    from resnet_bound import BATCH, init_params, make_fwd
+
+    fwd = make_fwd(True)
+    params = init_params(jax.random.PRNGKey(0), True)
+    pb = {k: v.astype(jnp.bfloat16) for k, v in params.items()}
+    x = jnp.array(onp.random.uniform(-1, 1, (BATCH, 224, 224, 3)),
+                  dtype=jnp.bfloat16)
+
+    f = jax.jit(lambda p, x: fwd(p, x))
+    lowered = f.lower(pb, x)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    print("fwd counted GF/img:", ca.get("flops", 0) / 1e9 / BATCH)
+    r = compiled(pb, x)
+    onp.asarray(r[0, 0])
+
+    def t(k):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(k):
+            r = compiled(pb, x)
+        onp.asarray(r[0, 0])
+        return time.perf_counter() - t0
+
+    diffs = []
+    for _ in range(3):
+        d1, d2 = t(3), t(23)
+        if d2 > d1:
+            diffs.append((d2 - d1) / 20)
+    diffs.sort()
+    dt = diffs[len(diffs) // 2]
+    fl = ca.get("flops", 0)
+    print(f"fwd only: {dt*1e3:.2f} ms  {BATCH/dt:.0f} img/s  "
+          f"MFU {fl/dt/PEAK:.3f}")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "conv"):
+        conv_probe()
+    if which in ("all", "bn"):
+        bn_probe()
+    if which in ("all", "fwd"):
+        fwd_vs_train()

@@ -1,0 +1,117 @@
+"""Probe: int8 matmul and conv rates vs bf16 on v5e."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+PEAK_BF16 = 197e12
+
+
+def scan_rate(make_step, x0, flops, m1=20, m2=320, reps=3):
+    @functools.partial(jax.jit, static_argnums=1)
+    def run(x, m):
+        def body(c, _):
+            return make_step(c), None
+        out, _ = jax.lax.scan(body, x, None, length=m)
+        return out
+
+    onp.asarray(jax.tree_util.tree_leaves(run(x0, m1))[0].reshape(-1)[0])
+    onp.asarray(jax.tree_util.tree_leaves(run(x0, m2))[0].reshape(-1)[0])
+
+    def t(m):
+        t0 = time.perf_counter()
+        r = run(x0, m)
+        onp.asarray(jax.tree_util.tree_leaves(r)[0].reshape(-1)[0])
+        return time.perf_counter() - t0
+
+    diffs = []
+    for _ in range(reps):
+        d1, d2 = t(m1), t(m2)
+        if d2 > d1:
+            diffs.append((d2 - d1) / (m2 - m1))
+    diffs.sort()
+    return diffs[len(diffs) // 2], flops / (diffs[len(diffs) // 2])
+
+
+def probe_matmul():
+    n = 4096
+    w8 = jnp.array(onp.random.randint(-127, 127, (n, n)), dtype=jnp.int8)
+
+    x8 = jnp.array(onp.random.randint(-127, 127, (n, n)), dtype=jnp.int8)
+
+    def step_int8(x):
+        acc = jax.lax.dot_general(x, w8, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        return (acc >> 8).astype(jnp.int8)
+
+    dt, rate = scan_rate(step_int8, x8, 2 * n**3)
+    print(f"int8 matmul {n}: {dt*1e3:.3f} ms {rate/1e12:.1f} TOP/s "
+          f"({rate/PEAK_BF16:.2f}x bf16 peak)")
+
+
+def probe_conv():
+    B, C, H, K = 32, 256, 14, 256
+    x8 = jnp.array(onp.random.randint(-10, 10, (B, H, H, C)), dtype=jnp.int8)
+    w8 = jnp.array(onp.random.randint(-10, 10, (3, 3, C, K)), dtype=jnp.int8)
+    wb = jnp.array(onp.random.randint(-10, 10, (1, 1, K, C)), dtype=jnp.int8)
+
+    def step(x):
+        acc = jax.lax.conv_general_dilated(
+            x, w8, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.int32)
+        y = (acc >> 6).astype(jnp.int8)
+        acc2 = jax.lax.conv_general_dilated(
+            y, wb, (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.int32)
+        return (acc2 >> 6).astype(jnp.int8)
+
+    fl = 2 * B * H * H * C * K * 9 + 2 * B * H * H * C * K
+    dt, rate = scan_rate(step, x8, fl, m2=620)
+    print(f"int8 conv NHWC 14x14x256 b32: {dt*1e3:.3f} ms {rate/1e12:.1f} "
+          f"TOP/s ({rate/PEAK_BF16:.2f}x bf16 peak)")
+
+    # bf16 same conv for comparison
+    xb = x8.astype(jnp.bfloat16)
+    wbf = w8.astype(jnp.bfloat16)
+    wbb = wb.astype(jnp.bfloat16)
+
+    def stepb(x):
+        y = jax.lax.conv_general_dilated(
+            x, wbf, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) * 0.01
+        return jax.lax.conv_general_dilated(
+            y, wbb, (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) * 0.01
+
+    dt, rate = scan_rate(stepb, xb, fl, m2=620)
+    print(f"bf16 conv NHWC 14x14x256 b32: {dt*1e3:.3f} ms {rate/1e12:.1f} "
+          f"TF/s")
+
+    # NCHW int8 conv (the repo's current layout)
+    x8n = jnp.array(onp.random.randint(-10, 10, (B, C, H, H)), dtype=jnp.int8)
+    w8n = jnp.array(onp.random.randint(-10, 10, (K, C, 3, 3)), dtype=jnp.int8)
+    wbn = jnp.array(onp.random.randint(-10, 10, (C, K, 1, 1)), dtype=jnp.int8)
+
+    def stepn(x):
+        acc = jax.lax.conv_general_dilated(
+            x, w8n, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=jnp.int32)
+        y = (acc >> 6).astype(jnp.int8)
+        acc2 = jax.lax.conv_general_dilated(
+            y, wbn, (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=jnp.int32)
+        return (acc2 >> 6).astype(jnp.int8)
+
+    dt, rate = scan_rate(stepn, x8n, fl, m2=620)
+    print(f"int8 conv NCHW: {dt*1e3:.3f} ms {rate/1e12:.1f} TOP/s")
+
+
+if __name__ == "__main__":
+    probe_matmul()
+    probe_conv()

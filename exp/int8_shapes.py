@@ -1,0 +1,93 @@
+"""int8 vs bf16 conv rates at each ResNet-50 layer shape (bs32, NHWC)."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+
+def scan_rate(make_step, x0, flops, m1=20, m2=620, reps=3):
+    @functools.partial(jax.jit, static_argnums=1)
+    def run(x, m):
+        def body(c, _):
+            return make_step(c), None
+        out, _ = jax.lax.scan(body, x, None, length=m)
+        return out
+
+    onp.asarray(jax.tree_util.tree_leaves(run(x0, m1))[0].reshape(-1)[0])
+    onp.asarray(jax.tree_util.tree_leaves(run(x0, m2))[0].reshape(-1)[0])
+
+    def t(m):
+        t0 = time.perf_counter()
+        r = run(x0, m)
+        onp.asarray(jax.tree_util.tree_leaves(r)[0].reshape(-1)[0])
+        return time.perf_counter() - t0
+
+    diffs = []
+    for _ in range(reps):
+        d1, d2 = t(m1), t(m2)
+        if d2 > d1:
+            diffs.append((d2 - d1) / (m2 - m1))
+    diffs.sort()
+    return diffs[len(diffs) // 2]
+
+
+B = 32
+CASES = [
+    ("conv0 7x7s2", 224, 3, 64, 7, 2),
+    ("s0 1x1 64-64", 56, 64, 64, 1, 1),
+    ("s0 3x3 64-64", 56, 64, 64, 3, 1),
+    ("s0 1x1 64-256", 56, 64, 256, 1, 1),
+    ("s0 1x1 256-64", 56, 256, 64, 1, 1),
+    ("s1 3x3 128", 28, 128, 128, 3, 1),
+    ("s1 1x1 512-128", 28, 512, 128, 1, 1),
+    ("s2 3x3 256", 14, 256, 256, 3, 1),
+    ("s3 3x3 512", 7, 512, 512, 3, 1),
+]
+
+for name, H, Ci, Co, k, s in CASES:
+    oh = H // s
+    fl = 2 * B * oh * oh * Ci * Co * k * k
+    row = [name]
+    for mode in ("int8", "bf16"):
+        dt_ = []
+        if mode == "int8":
+            x = jnp.array(onp.random.randint(-10, 10, (B, H, H, Ci)),
+                          dtype=jnp.int8)
+            w = jnp.array(onp.random.randint(-10, 10, (k, k, Ci, Co)),
+                          dtype=jnp.int8)
+
+            def step(xx, w=w, k=k, s=s, Ci=Ci, Co=Co, H=H, oh=oh):
+                p = (k - 1) // 2 if k > 1 else 0
+                pads = [(p, p), (p, p)] if k > 1 else [(0, 0), (0, 0)]
+                if k == 7:
+                    pads = [(3, 3), (3, 3)]
+                acc = jax.lax.conv_general_dilated(
+                    xx, w, (s, s), pads,
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    preferred_element_type=jnp.int32)
+                y = (acc >> 6).astype(jnp.int8)
+                # project back to input shape cheaply for chaining
+                m = jnp.mean(y.astype(jnp.float32)) * 1e-9
+                return xx + m.astype(jnp.int8)
+        else:
+            x = jnp.array(onp.random.randn(B, H, H, Ci) * 0.1,
+                          dtype=jnp.bfloat16)
+            w = jnp.array(onp.random.randn(k, k, Ci, Co) * 0.1,
+                          dtype=jnp.bfloat16)
+
+            def step(xx, w=w, k=k, s=s, Ci=Ci, Co=Co, H=H, oh=oh):
+                p = (k - 1) // 2 if k > 1 else 0
+                pads = [(p, p), (p, p)] if k > 1 else [(0, 0), (0, 0)]
+                if k == 7:
+                    pads = [(3, 3), (3, 3)]
+                y = jax.lax.conv_general_dilated(
+                    xx, w, (s, s), pads,
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                m = jnp.mean(y.astype(jnp.float32)) * 1e-9
+                return xx + m.astype(xx.dtype)
+
+        dt = scan_rate(step, x, fl)
+        row.append(f"{mode} {dt*1e6:7.1f} us {fl/dt/1e12:6.1f} T/s")
+    print(" | ".join(row))

@@ -1,0 +1,53 @@
+"""Profile the fused int8 ResNet inference to find non-conv overhead."""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import functools  # noqa: E402
+import time  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, profiler  # noqa: E402
+from mxnet_tpu import np as mnp  # noqa: E402
+from mxnet_tpu.contrib.quantization import quantize_net  # noqa: E402
+from mxnet_tpu.parallel.functional import functionalize  # noqa: E402
+
+BATCH, SIZE = 32, 224
+net = gluon.model_zoo.vision.resnet50_v1()
+net.initialize(ctx=mx.cpu())
+with autograd.predict_mode():
+    net(mnp.array(onp.zeros((1, 3, 64, 64), dtype="float32"), ctx=mx.cpu()))
+xc = mnp.array(onp.random.uniform(-1, 1, (8, 3, SIZE, SIZE)).astype("float32"),
+               ctx=mx.cpu())
+quantize_net(net, calib_data=xc, calib_mode="naive")
+net.reset_ctx(mx.tpu())
+
+apply_fn, params = functionalize(net, train_mode=False)
+x = jnp.asarray(onp.random.uniform(-1, 1, (BATCH, 3, SIZE, SIZE))
+                .astype("float32"))
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def run(params, x, m):
+    def body(carry, _):
+        out = apply_fn(params, x + carry)
+        logits = jax.tree_util.tree_leaves(out)[0]
+        return jnp.mean(logits).astype(x.dtype) * 1e-12, None
+
+    c, _ = jax.lax.scan(body, jnp.zeros((), x.dtype), None, length=m)
+    return c
+
+
+with autograd.predict_mode():
+    onp.asarray(run(params, x, 16))
+    profiler.set_config(filename="/tmp/int8_prof.json")
+    profiler.set_state("run")
+    onp.asarray(run(params, x, 16))
+    profiler.set_state("stop")
+print(profiler.device_op_table(by_category=True, top=12))
+print()
+print(profiler.device_op_table(top=25))

@@ -1,0 +1,39 @@
+"""Per-op device profile of the hand ResNet train step."""
+import sys
+
+import jax
+import numpy as onp
+
+sys.path.insert(0, "/root/repo/exp")
+sys.path.insert(0, "/root/repo")
+
+from bn_ablate import train_time  # noqa: E402
+
+from mxnet_tpu import profiler  # noqa: E402
+
+mode = sys.argv[1] if len(sys.argv) > 1 else "twopass"
+compiled = train_time(mode)  # compiles + times, leaves compiled step
+
+# re-run under trace
+import functools  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from resnet_bound import BATCH, init_params  # noqa: E402
+
+params = init_params(jax.random.PRNGKey(0), True)
+mom = {k: jnp.zeros_like(v) for k, v in params.items()}
+x = jnp.array(onp.random.uniform(-1, 1, (BATCH, 224, 224, 3)),
+              dtype=jnp.float32)
+y = jnp.array(onp.random.randint(0, 1000, (BATCH,)), dtype=jnp.int32)
+p, m, l = compiled(params, mom, x, y)
+float(l)
+
+profiler.set_config(filename="/tmp/rn_prof.json")
+profiler.set_state("run")
+for _ in range(3):
+    p, m, l = compiled(p, m, x, y)
+float(l)
+profiler.set_state("stop")
+print(profiler.device_op_table(by_category=True, top=20))
+print()
+print(profiler.device_op_table(top=25))

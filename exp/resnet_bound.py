@@ -1,0 +1,193 @@
+"""Upper-bound experiment: hand-rolled ResNet-50 v1 train step in pure JAX.
+
+Variants: NCHW vs NHWC layouts, optional space-to-depth conv0.
+Mirrors ShardedTrainer's step content (bf16 compute, fp32 master, SGD+mom,
+donated buffers) to find what the repo path SHOULD deliver.
+"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+PEAK = 197e12
+BATCH = 256
+
+# resnet50 v1: stages (blocks, mid_channels, stride)
+STAGES = [(3, 64, 1), (4, 128, 2), (6, 256, 2), (3, 512, 2)]
+
+
+def init_params(key, nhwc, s2d=False):
+    p = {}
+    rng = onp.random.RandomState(0)
+
+    def conv_w(name, cin, cout, k):
+        if nhwc:
+            w = rng.randn(k, k, cin, cout) * (2.0 / (k * k * cin)) ** 0.5
+        else:
+            w = rng.randn(cout, cin, k, k) * (2.0 / (k * k * cin)) ** 0.5
+        p[name] = w.astype("float32")
+
+    def bn(name, c):
+        p[name + ".g"] = onp.ones(c, "float32")
+        p[name + ".b"] = onp.zeros(c, "float32")
+
+    if s2d:
+        conv_w("conv0", 3 * 16, 64, 2)  # 4x4 space-to-depth: 8x8 kernel -> 2x2
+    else:
+        conv_w("conv0", 3, 64, 7)
+    bn("bn0", 64)
+    cin = 64
+    for si, (blocks, mid, stride) in enumerate(STAGES):
+        cout = mid * 4
+        for bi in range(blocks):
+            st = stride if bi == 0 else 1
+            pre = f"s{si}b{bi}"
+            conv_w(pre + ".c1", cin, mid, 1)
+            bn(pre + ".n1", mid)
+            conv_w(pre + ".c2", mid, mid, 3)
+            bn(pre + ".n2", mid)
+            conv_w(pre + ".c3", mid, cout, 1)
+            bn(pre + ".n3", cout)
+            if bi == 0:
+                conv_w(pre + ".cd", cin, cout, 1)
+                bn(pre + ".nd", cout)
+            cin = cout
+    p["fc.w"] = (rng.randn(2048, 1000) * 0.01).astype("float32")
+    p["fc.b"] = onp.zeros(1000, "float32")
+    return {k: jnp.array(v) for k, v in p.items()}
+
+
+def make_fwd(nhwc, s2d=False):
+    dn = ("NHWC", "HWIO", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
+    caxis = 3 if nhwc else 1
+
+    def conv(x, w, stride=1, pad=None):
+        k = w.shape[0] if nhwc else w.shape[2]
+        if pad is None:
+            pad = (k - 1) // 2
+        return jax.lax.conv_general_dilated(
+            x, w, (stride, stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=dn)
+
+    def bnorm(x, g, b):
+        axes = tuple(i for i in range(4) if i != caxis)
+        m = jnp.mean(x, axis=axes)
+        v = jnp.var(x, axis=axes)
+        sh = [1, 1, 1, 1]
+        sh[caxis] = x.shape[caxis]
+        inv = (g / jnp.sqrt(v + 1e-5)).reshape(sh)
+        return (x - m.reshape(sh)) * inv + b.reshape(sh)
+
+    def fwd(p, x):
+        if s2d:
+            # x pre-transformed on host: (B,56,56,48) for nhwc
+            x = conv(x, p["conv0"], 2, pad=0)
+        else:
+            x = conv(x, p["conv0"], 2, pad=3)
+        x = jax.nn.relu(bnorm(x, p["bn0.g"], p["bn0.b"]))
+        # maxpool 3x3 s2
+        if nhwc:
+            x = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                [(0, 0), (1, 1), (1, 1), (0, 0)])
+        else:
+            x = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 1, 3, 3), (1, 1, 2, 2),
+                [(0, 0), (0, 0), (1, 1), (1, 1)])
+        cin = 64
+        for si, (blocks, mid, stride) in enumerate(STAGES):
+            for bi in range(blocks):
+                st = stride if bi == 0 else 1
+                pre = f"s{si}b{bi}"
+                idn = x
+                y = jax.nn.relu(bnorm(conv(x, p[pre + ".c1"]),
+                                      p[pre + ".n1.g"], p[pre + ".n1.b"]))
+                y = jax.nn.relu(bnorm(conv(y, p[pre + ".c2"], st),
+                                      p[pre + ".n2.g"], p[pre + ".n2.b"]))
+                y = bnorm(conv(y, p[pre + ".c3"]),
+                          p[pre + ".n3.g"], p[pre + ".n3.b"])
+                if bi == 0:
+                    idn = bnorm(conv(idn, p[pre + ".cd"], st),
+                                p[pre + ".nd.g"], p[pre + ".nd.b"])
+                x = jax.nn.relu(y + idn)
+        x = jnp.mean(x, axis=(1, 2) if nhwc else (2, 3))
+        return x @ p["fc.w"] + p["fc.b"]
+
+    return fwd
+
+
+def main(nhwc=True, s2d=False):
+    fwd = make_fwd(nhwc, s2d)
+    params = init_params(jax.random.PRNGKey(0), nhwc, s2d)
+    mom = {k: jnp.zeros_like(v) for k, v in params.items()}
+    if s2d:
+        shape = (BATCH, 56, 56, 48) if nhwc else (BATCH, 48, 56, 56)
+    else:
+        shape = (BATCH, 224, 224, 3) if nhwc else (BATCH, 3, 224, 224)
+    x = jnp.array(onp.random.uniform(-1, 1, shape), dtype=jnp.float32)
+    y = jnp.array(onp.random.randint(0, 1000, (BATCH,)), dtype=jnp.int32)
+
+    def loss_of(params, x, y):
+        pb = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
+              for k, v in params.items()}
+        logits = fwd(pb, x.astype(jnp.bfloat16)).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(lp, y[:, None], 1))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, mom, x, y):
+        l, g = jax.value_and_grad(loss_of)(params, x, y)
+        newp, newm = {}, {}
+        for k in params:
+            m = 0.9 * mom[k] + g[k] + 1e-4 * params[k]
+            newm[k] = m
+            newp[k] = params[k] - 0.1 * m
+        return newp, newm, l
+
+    lowered = step.lower(params, mom, x, y)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    flops = ca.get("flops", 0)
+
+    state = [params, mom]
+
+    def run():
+        p, m, l = compiled(state[0], state[1], x, y)
+        state[0], state[1] = p, m
+        return l
+
+    float(run())  # drain
+
+    def t(k):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(k):
+            r = run()
+        float(r)
+        return time.perf_counter() - t0
+
+    diffs = []
+    for _ in range(3):
+        d1, d2 = t(3), t(15)
+        if d2 > d1:
+            diffs.append((d2 - d1) / 12)
+    diffs.sort()
+    dt = diffs[len(diffs) // 2]
+    tag = ("NHWC" if nhwc else "NCHW") + ("+s2d" if s2d else "")
+    print(f"resnet50 {tag}: {dt*1e3:.2f} ms/step  {BATCH/dt:.0f} img/s  "
+          f"counted {flops/1e9/BATCH:.1f} GF/img  MFU {flops/dt/PEAK:.3f}")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "nhwc"):
+        main(True)
+    if which in ("all", "nchw"):
+        main(False)
+    if which in ("all", "s2d"):
+        main(True, True)

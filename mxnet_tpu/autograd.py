@@ -227,13 +227,28 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # py
 
     leaves = _run_backward(heads, head_grads, retain_graph)
     # write into registered grad buffers honoring grad_req
+    from .ndarray.sparse import RowSparseNDArray
+
     for leaf in leaves:
         ct = leaf._accum
         leaf._accum = None
         if ct is None or leaf.grad_req == "null" or leaf.grad_array is None:
             continue
         ga = leaf.grad_array
-        if leaf.grad_req == "add":
+        if isinstance(ct, RowSparseNDArray):
+            # sparse cotangent (embedding sparse_grad): keep it O(nnz)
+            # when the grad buffer is row_sparse; storage-fallback to
+            # dense otherwise (exec_utils.h:138 role)
+            if isinstance(ga, RowSparseNDArray):
+                if leaf.grad_req == "add":
+                    ga._set_sparse(ga + ct)
+                else:
+                    ga._set_sparse(ct)
+            elif leaf.grad_req == "add":
+                ga._set_data_internal(ga._data + ct._data)
+            else:
+                ga._set_data_internal(ct._data)
+        elif leaf.grad_req == "add":
             ga._set_data_internal(ga._data + ct)
         else:
             ga._set_data_internal(jnp.asarray(ct, ga.dtype) if ct.dtype != ga.dtype else ct)
@@ -328,6 +343,10 @@ def _run_backward(heads, head_grads, retain_graph, create_graph=False):
                 ct = lift(_zeros_like_aval(aval))
             else:
                 has_any = True
+                if not create_graph and hasattr(ct, "_stype"):
+                    # a sparse cotangent reaching a dense vjp: the
+                    # storage-fallback boundary — densify here
+                    ct = ct._data
             cts.append(ct)
         if not has_any:
             continue

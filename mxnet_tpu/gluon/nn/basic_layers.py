@@ -239,8 +239,10 @@ class Embedding(HybridBlock):
         self._input_dim = input_dim
         self._output_dim = output_dim
         self._sparse_grad = sparse_grad
-        self.weight = Parameter("weight", shape=(input_dim, output_dim),
-                                dtype=dtype, init=weight_initializer)
+        self.weight = Parameter(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer,
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def forward(self, x):
         return _nn.embedding(x, self.weight.data(), input_dim=self._input_dim,

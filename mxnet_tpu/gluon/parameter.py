@@ -161,8 +161,18 @@ class Parameter:
         for ctx, data in self._data.items():
             import jax
 
-            g = NDArray(jax.device_put(jnp.zeros(data.shape, data.dtype),
-                                       ctx.jax_device()))
+            if self._grad_stype == "row_sparse":
+                # O(nnz) gradient buffer: starts with zero stored rows;
+                # each backward adopts the produced (indices, values)
+                # without ever materializing the (vocab, dim) dense grad
+                from ..ndarray.sparse import RowSparseNDArray
+
+                g = RowSparseNDArray(
+                    NDArray(jnp.zeros((0,) + data.shape[1:], data.dtype)),
+                    NDArray(jnp.zeros((0,), jnp.int64)), data.shape)
+            else:
+                g = NDArray(jax.device_put(jnp.zeros(data.shape, data.dtype),
+                                           ctx.jax_device()))
             self._grad[ctx] = g
             autograd.mark_variables([data], [g], self._grad_req)
 
@@ -237,8 +247,16 @@ class Parameter:
             return
         import jax.numpy as jnp
 
+        from ..ndarray.sparse import RowSparseNDArray
+
         for g in self._grad.values():
-            g._set_data_internal(jnp.zeros(g.shape, g.dtype))
+            if isinstance(g, RowSparseNDArray):
+                # reset to zero stored rows — never a (vocab, dim) dense
+                g._set_sparse(RowSparseNDArray(
+                    NDArray(jnp.zeros((0,) + g.shape[1:], g.dtype)),
+                    NDArray(jnp.zeros((0,), jnp.int64)), g.shape))
+            else:
+                g._set_data_internal(jnp.zeros(g.shape, g.dtype))
 
     def reset_ctx(self, ctx):
         if isinstance(ctx, Context):

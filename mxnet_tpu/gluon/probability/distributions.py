@@ -435,7 +435,7 @@ class Poisson(Distribution):
         shape = self._shape(size, self.rate)
 
         def f(r):
-            return jr.poisson(key, r, shape).astype("float32")
+            return jr.poisson(_rng.as_threefry(key), r, shape).astype("float32")
 
         return _wrap(f, self.rate, name="poisson_sample")
 
@@ -874,7 +874,7 @@ class NegativeBinomial(_ProbLogitMixin, Distribution):
 
         def f(n, pp):
             lam = jr.gamma(k1, n, shape) * (pp / (1 - pp))
-            return jr.poisson(k2, lam).astype("float32")
+            return jr.poisson(_rng.as_threefry(k2), lam).astype("float32")
 
         return _wrap(f, self.n, p, name="negbinomial_sample")
 

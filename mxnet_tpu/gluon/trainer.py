@@ -148,6 +148,30 @@ class Trainer:
         opt = self._optimizer
         import numpy as _onp
 
+        from ..ndarray.sparse import RowSparseNDArray
+
+        sparse_is = {i for i, p in enumerate(self._params)
+                     if isinstance(p.grad(), RowSparseNDArray)}
+        if sparse_is:
+            # row-sparse grads take the per-param lazy path (reading them
+            # through the fused jit would densify); dense params continue
+            # through the fused executable below
+            self._step_count += 1
+            prev_rescale = opt.rescale_grad
+            opt.rescale_grad = scale
+            try:
+                for i in sorted(sparse_is):
+                    p = self._params[i]
+                    opt._index_update_count[i] = self._step_count - 1
+                    opt.update_multi_precision(i, p.data(), p.grad(),
+                                               self._states[i])
+            finally:
+                opt.rescale_grad = prev_rescale
+            self._step_count -= 1  # dense path below re-advances it
+            if len(sparse_is) == len(self._params):
+                self._step_count += 1
+                return
+
         fused_safe = getattr(opt, "fused_safe", True) and not (
             opt.multi_precision
             and any(p.dtype == _onp.float16 for p in self._params))
@@ -161,6 +185,8 @@ class Trainer:
             opt.rescale_grad = scale
             try:
                 for i, p in enumerate(self._params):
+                    if i in sparse_is:
+                        continue  # already updated via the lazy path
                     opt.update_multi_precision(i, p.data(), p.grad(),
                                                self._states[i])
             finally:
@@ -191,19 +217,21 @@ class Trainer:
 
         self._step_count += 1
         t = self._step_count
+        dense_is = [i for i in range(len(self._params))
+                    if i not in sparse_is]
         for i in range(len(self._params)):
             opt._index_update_count[i] = t
-        pdatas = [p.data()._data for p in self._params]
-        gdatas = [p.grad()._data for p in self._params]
-        sdatas = [tuple(s._data for s in _flatten_state(st))
-                  for st in self._states]
-        lrs = [opt._get_lr(i) for i in range(len(self._params))]
-        wds = [opt._get_wd(i) for i in range(len(self._params))]
+        pdatas = [self._params[i].data()._data for i in dense_is]
+        gdatas = [self._params[i].grad()._data for i in dense_is]
+        sdatas = [tuple(s._data for s in _flatten_state(self._states[i]))
+                  for i in dense_is]
+        lrs = [opt._get_lr(i) for i in dense_is]
+        wds = [opt._get_wd(i) for i in dense_is]
         new_p, new_s = self._fused(pdatas, gdatas, sdatas, lrs, wds, t)
-        for p, np_ in zip(self._params, new_p):
-            p.data()._set_data_internal(np_)
-        for st, ns in zip(self._states, new_s):
-            for s, nsd in zip(_flatten_state(st), ns):
+        for i, np_ in zip(dense_is, new_p):
+            self._params[i].data()._set_data_internal(np_)
+        for i, ns in zip(dense_is, new_s):
+            for s, nsd in zip(_flatten_state(self._states[i]), ns):
                 s._set_data_internal(nsd)
 
     # -- persistence ------------------------------------------------------

@@ -67,17 +67,25 @@ class KVStoreLocal(KVStoreBase):
                 src.copyto(d)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """O(nnz) row pull (``PullRowSparse``, include/mxnet/kvstore.h:161):
+        only the requested rows move; a row_sparse destination adopts
+        (row_ids, rows) buffers directly — the (vocab, dim) dense view is
+        never built."""
         keys, outs = _normalize_grouped(key, out)
         _, rids = _normalize_grouped(key, row_ids)
         for k, dsts, rid in zip(keys, outs, rids):
             src = self._store[k]
             for d, r in zip(dsts, rid):
-                picked = src.take(r.astype("int64"))
-                sparse_like = src.tostype("row_sparse") if d.stype == "row_sparse" else None
-                if sparse_like is not None:
-                    d._set_data_internal(src._data)
+                rows = r.astype("int64")
+                picked = src._data[rows._data]  # axis-0 row gather, O(nnz)
+                if d.stype == "row_sparse":
+                    from ..ndarray.ndarray import NDArray
+                    from ..ndarray.sparse import RowSparseNDArray
+
+                    d._set_sparse(RowSparseNDArray(
+                        NDArray(picked), rows, d.shape))
                 else:
-                    d._set_data_internal(picked._data)
+                    d._set_data_internal(picked)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)

@@ -118,6 +118,34 @@ class RowSparseNDArray(BaseSparseNDArray):
             return NDArray(self._data)
         raise MXNetError(f"cannot convert row_sparse to {stype}")
 
+    def _set_sparse(self, other: "RowSparseNDArray"):
+        """Adopt another row-sparse array's buffers WITHOUT densifying —
+        the write path sparse gradients and row_sparse_pull use (the
+        reference writes aux/data blobs directly for the same reason)."""
+        object.__setattr__(self, "indices", other.indices)
+        object.__setattr__(self, "values", other.values)
+        self._dense_cache = None
+        self._version += 1
+
+    def __add__(self, other):
+        """row_sparse + row_sparse stays sparse, O(nnz): concatenate and
+        merge duplicate rows by segment-sum over the unique index set.
+        Needed by gradient accumulation when one parameter receives several
+        sparse contributions in a backward walk. Mixed operands fall back
+        dense (the reference's storage-fallback rule)."""
+        if isinstance(other, RowSparseNDArray) \
+                and other._dense_shape == self._dense_shape:
+            jnp = _jnp()
+            idx = jnp.concatenate([self.indices._data.astype(jnp.int64),
+                                   other.indices._data.astype(jnp.int64)])
+            vals = jnp.concatenate([self.values._data, other.values._data])
+            uniq, inv = _unique_static(idx)
+            merged = jnp.zeros((uniq.shape[0],) + vals.shape[1:],
+                               vals.dtype).at[inv].add(vals)
+            return RowSparseNDArray(NDArray(merged), NDArray(uniq),
+                                    self._dense_shape)
+        return NDArray.__add__(self, other)
+
     def retain(self, indices):
         """Keep only the rows whose index appears in ``indices``
         (reference ``_retain`` / PullRowSparse row selection) — computed
@@ -129,6 +157,18 @@ class RowSparseNDArray(BaseSparseNDArray):
         return RowSparseNDArray(NDArray(self.values._data[mask]),
                                 NDArray(self.indices._data[mask]),
                                 self._dense_shape)
+
+
+def _unique_static(idx):
+    """(unique_sorted, inverse) for an int index vector, eager-only: sizes
+    are data-dependent, so sparse production happens outside jit traces
+    (the reference's dynamic-shape ops have the same restriction,
+    SURVEY §7 hard part 3)."""
+    import numpy as _host
+
+    jnp = _jnp()
+    uniq, inv = _host.unique(_host.asarray(idx), return_inverse=True)
+    return jnp.asarray(uniq.astype(_host.int64)), jnp.asarray(inv)
 
 
 class CSRNDArray(BaseSparseNDArray):
@@ -193,6 +233,66 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):  # pylint: disable
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):  # pylint: disable=unused-argument
     data, indptr, indices = arg1
     return CSRNDArray(NDArray(data, dtype=dtype), NDArray(indptr), NDArray(indices), shape)
+
+
+def _csr_row_ids(csr):
+    """Row id per stored nonzero, O(nnz): repeat(arange(rows), row_lens)."""
+    jnp = _jnp()
+    ip = csr.indptr._data.astype(jnp.int64)
+    return jnp.repeat(jnp.arange(csr.shape[0], dtype=jnp.int64),
+                      jnp.diff(ip), total_repeat_length=csr.values.shape[0])
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware matrix product, O(nnz · dense_cols) — the role of the
+    reference's sparse ``dot`` kernels (``src/operator/tensor/dot-inl.h``:
+    csr·dense forward, csr^T·dense for embedding-style backward, and
+    dense·csr), WITHOUT densifying either operand.
+
+    TPU-native formulation: gather the needed dense rows per stored
+    nonzero and segment-sum into the output — scatter-add is an XLA-native
+    op the compiler vectorizes; there is no SpMV kernel to hand-write.
+    Dense inputs route to the ordinary dense dot.
+    """
+    jnp = _jnp()
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_b:
+            raise MXNetError("dot(csr, dense, transpose_b=True) unsupported")
+        rows = _csr_row_ids(lhs)
+        cols = lhs.indices._data.astype(jnp.int64)
+        vals = lhs.values._data
+        r = rhs._data
+        if transpose_a:
+            # (k x m)^T view: out[c] += v * rhs[row]  -> (cols(lhs), n)
+            contrib = vals[:, None] * r[rows]
+            out = jnp.zeros((lhs.shape[1], r.shape[1]),
+                            contrib.dtype).at[cols].add(contrib)
+        else:
+            # out[row] += v * rhs[col]
+            contrib = vals[:, None] * r[cols]
+            out = jnp.zeros((lhs.shape[0], r.shape[1]),
+                            contrib.dtype).at[rows].add(contrib)
+        return NDArray(out)
+    if isinstance(rhs, CSRNDArray) and not isinstance(lhs, BaseSparseNDArray):
+        if transpose_a or transpose_b:
+            raise MXNetError("dot(dense, csr, transpose_*) unsupported")
+        rows = _csr_row_ids(rhs)
+        cols = rhs.indices._data.astype(jnp.int64)
+        vals = rhs.values._data
+        ld = lhs._data
+        # out[:, c] += lhs[:, row] * v
+        contrib = ld[:, rows] * vals[None, :]
+        out = jnp.zeros((ld.shape[0], rhs.shape[1]),
+                        contrib.dtype).at[:, cols].add(contrib)
+        return NDArray(out)
+    # dense–dense (or row_sparse: storage-fallback)
+    a = lhs._data if hasattr(lhs, "_data") else jnp.asarray(lhs)
+    b = rhs._data if hasattr(rhs, "_data") else jnp.asarray(rhs)
+    if transpose_a:
+        a = a.T
+    if transpose_b:
+        b = b.T
+    return NDArray(jnp.matmul(a, b))
 
 
 def dense_to_sparse(arr: NDArray, stype: str):

@@ -132,7 +132,8 @@ def exponential(scale=1.0, size=None, ctx=None, device=None):
 
 def poisson(lam=1.0, size=None, ctx=None, device=None):
     lam_ = lam._data if isinstance(lam, NDArray) else lam
-    return _place(_jr().poisson(_rng.next_key(), lam_, _size(size)),
+    return _place(_jr().poisson(_rng.as_threefry(_rng.next_key()), lam_,
+                                _size(size)),
                   ctx or device or current_context())
 
 

@@ -11,7 +11,16 @@ from __future__ import annotations
 from ..ops.nn import *  # noqa: F401,F403
 from ..ops import nn as _nn
 from ..ops.control_flow import cond, foreach, while_loop  # noqa: F401
+from ..ops.detection import (  # noqa: F401
+    box_nms,
+    multibox_detection,
+    multibox_prior,
+    multibox_target,
+    roi_align,
+)
 from ..ops.spatial import (  # noqa: F401
+    correlation,
+    deformable_convolution,
     bilinear_sampler,
     grid_generator,
     spatial_transformer,
@@ -97,4 +106,6 @@ __all__ = [n for n in dir(_nn) if not n.startswith("_")] + [
     "save", "load", "from_dlpack", "from_numpy", "to_dlpack_for_read",
     "to_dlpack_for_write", "bernoulli", "normal_n", "uniform_n",
     "grid_generator", "bilinear_sampler", "spatial_transformer",
+    "multibox_prior", "multibox_target", "multibox_detection", "box_nms",
+    "roi_align", "correlation", "deformable_convolution",
 ]

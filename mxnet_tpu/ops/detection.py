@@ -1,0 +1,404 @@
+"""Detection operator family: multibox priors/targets/detections, box_nms,
+ROIAlign — TPU-first (static shapes, vmapped batch, lax.scan where the
+reference loops).
+
+Reference semantics: ``src/operator/contrib/multibox_prior.cc`` (anchor
+math verified against the kernel at lines 30-73), ``multibox_target.cc``
+(bipartite + threshold matching, variance-encoded box targets),
+``multibox_detection.cc`` (per-anchor class pick + NMS),
+``src/operator/contrib/bounding_box.cc`` (box_nms contract: sorted by
+score, pruned entries filled with -1), ``src/operator/contrib/roi_align.cc``
+(Caffe2-style bilinear sampling, ``aligned`` offset).
+
+Design notes (SURVEY §7 hard part 3 — padding discipline): every output
+has a static shape; "suppressed"/"invalid" slots are filled with -1
+instead of shrinking, exactly the reference's convention, which is what
+makes these ops jit-compatible on TPU.
+"""
+from __future__ import annotations
+
+import math
+
+from .registry import apply as _apply
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# multibox_prior
+# ---------------------------------------------------------------------------
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Generate SSD anchor boxes for each feature-map cell of ``data``
+    (B, C, H, W) → (1, H*W*(num_sizes+num_ratios-1), 4) corner boxes.
+
+    Anchor set per cell (reference multibox_prior.cc:44-70): every size
+    with the first ratio, then the first size with every remaining ratio;
+    w = size * H/W * sqrt(ratio) / 2, h = size / sqrt(ratio) / 2 around
+    the (offset-shifted, step-scaled) cell center.
+    """
+    jnp = _jnp()
+    sizes = [float(s) for s in sizes]
+    ratios = [float(r) for r in ratios]
+    in_h, in_w = int(data.shape[2]), int(data.shape[3])
+    step_y = steps[0] if steps[0] > 0 else 1.0 / in_h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / in_w
+
+    def f(_x):
+        cy = (jnp.arange(in_h, dtype=jnp.float32) + offsets[0]) * step_y
+        cx = (jnp.arange(in_w, dtype=jnp.float32) + offsets[1]) * step_x
+        wh = []
+        r0 = math.sqrt(ratios[0]) if ratios else 1.0
+        for s in sizes:
+            wh.append((s * in_h / in_w * r0 / 2, s / r0 / 2))
+        for r in ratios[1:]:
+            sr = math.sqrt(r)
+            wh.append((sizes[0] * in_h / in_w * sr / 2, sizes[0] / sr / 2))
+        ws = jnp.asarray([w for w, _ in wh], jnp.float32)
+        hs = jnp.asarray([h for _, h in wh], jnp.float32)
+        # (H, W, A, 4)
+        cxg = jnp.broadcast_to(cx[None, :, None], (in_h, in_w, len(wh)))
+        cyg = jnp.broadcast_to(cy[:, None, None], (in_h, in_w, len(wh)))
+        out = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs], axis=-1)
+        out = out.reshape(1, -1, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out
+
+    return _apply(f, (data,), name="multibox_prior")
+
+
+# ---------------------------------------------------------------------------
+# box helpers
+# ---------------------------------------------------------------------------
+
+
+def _iou_corner(a, b):
+    """Pairwise IoU of corner boxes a (N,4) × b (M,4) → (N, M)."""
+    jnp = _jnp()
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(
+        a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _center_to_corner(b):
+    jnp = _jnp()
+    half_w, half_h = b[..., 2] / 2, b[..., 3] / 2
+    return jnp.stack([b[..., 0] - half_w, b[..., 1] - half_h,
+                      b[..., 0] + half_w, b[..., 1] + half_h], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# box_nms
+# ---------------------------------------------------------------------------
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Non-maximum suppression (reference ``bounding_box.cc`` box_nms).
+
+    ``data``: (..., N, K) with score at ``score_index`` and 4 coords at
+    ``coord_start``. Output has identical shape: entries are sorted by
+    descending score with pruned/invalid entries written as all -1 —
+    static-shape NMS, no dynamic compaction.
+    """
+    import jax
+
+    jnp = _jnp()
+
+    def nms_single(d):
+        n = d.shape[0]
+        scores = d[:, score_index]
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= d[:, id_index] != background_id
+        boxes = d[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            boxes = _center_to_corner(boxes)
+        order = jnp.argsort(jnp.where(valid, -scores, jnp.inf))
+        ds = d[order]
+        boxes = boxes[order]
+        valid = valid[order]
+        if topk > 0:
+            valid &= jnp.arange(n) < topk
+        iou = _iou_corner(boxes, boxes)
+        if id_index >= 0 and not force_suppress:
+            same = ds[:, id_index][:, None] == ds[:, id_index][None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+        def body(keep, i):
+            sup = (iou[i] > overlap_thresh) & keep[i] & \
+                (jnp.arange(n) > i)
+            return keep & ~sup, None
+
+        keep, _ = jax.lax.scan(body, valid, jnp.arange(n))
+        # survivors first (stable by score order), pruned rows = -1
+        out_order = jnp.argsort(~keep, stable=True)
+        ds = ds[out_order]
+        keep_s = keep[out_order]
+        if out_format == "center" and in_format == "corner":
+            c = ds[:, coord_start:coord_start + 4]
+            ctr = jnp.stack([(c[:, 0] + c[:, 2]) / 2,
+                             (c[:, 1] + c[:, 3]) / 2,
+                             c[:, 2] - c[:, 0], c[:, 3] - c[:, 1]], axis=-1)
+            ds = ds.at[:, coord_start:coord_start + 4].set(ctr)
+        return jnp.where(keep_s[:, None], ds, -1.0)
+
+    def f(x):
+        flat = x.reshape((-1,) + x.shape[-2:])
+        out = __import__("jax").vmap(nms_single)(flat)
+        return out.reshape(x.shape)
+
+    return _apply(f, (data,), name="box_nms")
+
+
+# ---------------------------------------------------------------------------
+# multibox_target
+# ---------------------------------------------------------------------------
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (reference ``multibox_target.cc``).
+
+    anchor (1, N, 4) corners; label (B, M, 5) rows [cls, xmin, ymin,
+    xmax, ymax] with cls = -1 padding; cls_pred (B, C+1, N) used only for
+    hard-negative mining. Returns (box_target (B, N*4), box_mask (B, N*4),
+    cls_target (B, N)) where cls_target is gt_class+1 for matched anchors,
+    0 for background, ``ignore_label`` for mined-away negatives.
+
+    Matching = reference two-phase: greedy bipartite (each gt claims its
+    best unclaimed anchor, in global-IoU order, via lax.scan) then
+    threshold matching (anchor's best gt if IoU > overlap_threshold).
+    """
+    import jax
+
+    jnp = _jnp()
+
+    def one_sample(anc, lab, cpred):
+        n = anc.shape[0]
+        m = lab.shape[0]
+        gt_valid = lab[:, 0] >= 0
+        iou = jnp.where(gt_valid[None, :], _iou_corner(anc, lab[:, 1:5]),
+                        -1.0)  # (N, M)
+
+        # phase 1: bipartite, M rounds of global argmax
+        def bip(carry, _):
+            iou_w, match = carry
+            flat = jnp.argmax(iou_w)
+            ai = (flat // m).astype(jnp.int32)
+            gi = (flat % m).astype(jnp.int32)
+            best = iou_w[ai, gi]
+            do = best > 1e-12
+            match = jnp.where(do, match.at[ai].set(gi), match)
+            iou_w = jnp.where(do, iou_w.at[ai, :].set(-1.0), iou_w)
+            iou_w = jnp.where(do, iou_w.at[:, gi].set(-1.0), iou_w)
+            return (iou_w, match), None
+
+        match0 = jnp.full((n,), -1, jnp.int32)
+        (_, match), _ = jax.lax.scan(bip, (iou, match0), None, length=m)
+
+        # phase 2: threshold matching for still-unmatched anchors
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        match = jnp.where((match < 0) & (best_iou > overlap_threshold),
+                          best_gt, match)
+
+        matched = match >= 0
+        gt = lab[jnp.maximum(match, 0)]
+        cls_t = jnp.where(matched, gt[:, 0] + 1.0, 0.0)
+
+        if negative_mining_ratio > 0:
+            # hard-negative mining (reference multibox_target.cc): an
+            # unmatched anchor is a negative CANDIDATE only if its best
+            # IoU < negative_mining_thresh (higher-overlap unmatched
+            # anchors are "too hard" and ignored); candidates are ranked
+            # by max non-background predicted probability (hardest first)
+            # and the top ratio*num_pos (>= minimum_negative_samples)
+            # train as background — every other unmatched anchor gets
+            # ignore_label.
+            neg_score = jnp.max(cpred[1:, :], axis=0)
+            cand = (~matched) & (best_iou < negative_mining_thresh)
+            num_pos = jnp.sum(matched)
+            quota = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                minimum_negative_samples)
+            rank = jnp.argsort(jnp.argsort(
+                jnp.where(cand, -neg_score, jnp.inf)))
+            keep_neg = cand & (rank < quota)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(keep_neg, 0.0,
+                                        float(ignore_label)))
+
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        ax = (anc[:, 0] + anc[:, 2]) / 2
+        ay = (anc[:, 1] + anc[:, 3]) / 2
+        gw = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+        gh = jnp.maximum(gt[:, 4] - gt[:, 2], 1e-8)
+        gx = (gt[:, 1] + gt[:, 3]) / 2
+        gy = (gt[:, 2] + gt[:, 4]) / 2
+        t = jnp.stack([
+            (gx - ax) / aw / variances[0],
+            (gy - ay) / ah / variances[1],
+            jnp.log(gw / aw) / variances[2],
+            jnp.log(gh / ah) / variances[3],
+        ], axis=-1)
+        mask = matched[:, None].astype(anc.dtype)
+        box_t = (t * mask).reshape(-1)
+        box_m = jnp.broadcast_to(mask, (n, 4)).reshape(-1)
+        return box_t, box_m, cls_t
+
+    def f(anc, lab, cpred):
+        import jax as _jax
+
+        a = anc[0]
+        return _jax.vmap(lambda l, cp: one_sample(a, l, cp))(lab, cpred)
+
+    return _apply(f, (anchor, label, cls_pred), name="multibox_target")
+
+
+# ---------------------------------------------------------------------------
+# multibox_detection
+# ---------------------------------------------------------------------------
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode SSD predictions into detections (reference
+    ``multibox_detection.cc``): per anchor pick the best non-background
+    class, decode the variance-encoded offsets against its anchor, then
+    NMS. Output (B, N, 6) rows [class_id, score, xmin, ymin, xmax, ymax];
+    invalid/suppressed rows are -1. class ids are 0-based with background
+    removed (reference convention: out id = argmax class - 1)."""
+    jnp = _jnp()
+
+    def f(cp, lp, anc):
+        b, n = cp.shape[0], anc.shape[1]
+        a = anc[0]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        ax = (a[:, 0] + a[:, 2]) / 2
+        ay = (a[:, 1] + a[:, 3]) / 2
+        loc = lp.reshape(b, n, 4)
+        cx = loc[..., 0] * variances[0] * aw + ax
+        cy = loc[..., 1] * variances[1] * ah + ay
+        w = jnp.exp(loc[..., 2] * variances[2]) * aw
+        h = jnp.exp(loc[..., 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        probs = cp.transpose(0, 2, 1)  # (B, N, C+1)
+        masked = probs.at[..., background_id].set(-jnp.inf)
+        best = jnp.argmax(masked, axis=-1)
+        score = jnp.take_along_axis(probs, best[..., None],
+                                    axis=-1)[..., 0]
+        cls_id = jnp.where(best > background_id, best - 1, best).astype(
+            cp.dtype)
+        ok = score > threshold
+        rows = jnp.concatenate([
+            jnp.where(ok, cls_id, -1.0)[..., None],
+            jnp.where(ok, score, 0.0)[..., None], boxes], axis=-1)
+        return rows
+
+    rows = _apply(f, (cls_prob, loc_pred, anchor),
+                  name="multibox_detection_decode")
+    return box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# roi_align
+# ---------------------------------------------------------------------------
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
+              position_sensitive=False, aligned=False):
+    """ROIAlign (reference ``roi_align.cc``, the Caffe2 kernel semantics):
+    average of bilinear samples on a regular grid inside each output bin.
+
+    data (B, C, H, W); rois (R, 5) rows [batch_idx, x1, y1, x2, y2] in
+    image coordinates (scaled by ``spatial_scale``). ``aligned=True``
+    applies the half-pixel offset fix. ``sample_ratio`` < 1 falls back to
+    a static 2x2 sample grid (the adaptive ceil(roi/bin) grid of the
+    reference is value-dependent, incompatible with static shapes; 2 is
+    Detectron's default).
+    """
+    import jax
+
+    jnp = _jnp()
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    sr = sample_ratio if sample_ratio and sample_ratio > 0 else 2
+
+    def f(x, r):
+        B, C, H, W = x.shape
+        off = 0.5 if aligned else 0.0
+
+        def one_roi(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x1 = roi[1] * spatial_scale - off
+            y1 = roi[2] * spatial_scale - off
+            x2 = roi[3] * spatial_scale - off
+            y2 = roi[4] * spatial_scale - off
+            rw = x2 - x1
+            rh = y2 - y1
+            if not aligned:  # reference: force malformed ROIs to 1x1
+                rw = jnp.maximum(rw, 1.0)
+                rh = jnp.maximum(rh, 1.0)
+            bin_w = rw / pw
+            bin_h = rh / ph
+            # sample grid: (ph*sr, pw*sr) points
+            gy = y1 + (jnp.arange(ph * sr) + 0.5) * rh / (ph * sr)
+            gx = x1 + (jnp.arange(pw * sr) + 0.5) * rw / (pw * sr)
+
+            def bilinear(img, ys, xs):
+                # Caffe2 contract: points beyond the image by MORE than
+                # one pixel contribute zero; in-range points clamp
+                ok = ((ys >= -1.0) & (ys <= H))[:, None] \
+                    & ((xs >= -1.0) & (xs <= W))[None, :]
+                ys = jnp.clip(ys, 0.0, H - 1.0)
+                xs = jnp.clip(xs, 0.0, W - 1.0)
+                y0 = jnp.floor(ys).astype(jnp.int32)
+                x0 = jnp.floor(xs).astype(jnp.int32)
+                y1_ = jnp.minimum(y0 + 1, H - 1)
+                x1_ = jnp.minimum(x0 + 1, W - 1)
+                wy = ys - y0
+                wx = xs - x0
+                g = lambda yy, xx: img[:, yy, :][:, :, xx]  # noqa: E731
+                v = (g(y0, x0) * ((1 - wy)[None, :, None] * (1 - wx)[None, None, :])
+                     + g(y1_, x0) * (wy[None, :, None] * (1 - wx)[None, None, :])
+                     + g(y0, x1_) * ((1 - wy)[None, :, None] * wx[None, None, :])
+                     + g(y1_, x1_) * (wy[None, :, None] * wx[None, None, :]))
+                return jnp.where(ok[None], v, 0.0)  # (C, len(ys), len(xs))
+
+            img = x[bidx]
+            samples = bilinear(img, gy, gx)  # (C, ph*sr, pw*sr)
+            pooled = samples.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+            del bin_w, bin_h
+            return pooled
+
+        return jax.vmap(one_roi)(r)
+
+    return _apply(f, (data, rois), name="roi_align")

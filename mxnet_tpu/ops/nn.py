@@ -583,11 +583,51 @@ def dropout(data, p=0.5, mode="training", axes=(), **kwargs):  # pylint: disable
 
 def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
               sparse_grad=False, **kwargs):  # pylint: disable=unused-argument
-    """Embedding lookup (reference ``src/operator/tensor/indexing_op.cc``)."""
+    """Embedding lookup (reference ``src/operator/tensor/indexing_op.cc``).
+
+    ``sparse_grad=True``: the weight's gradient is produced as a
+    ``RowSparseNDArray`` holding only the touched rows (unique indices,
+    duplicate contributions segment-summed) — O(nnz) end to end, the
+    reference's ``SparseEmbedding`` backward contract. Sparse production
+    needs concrete indices, so inside a jit/hybridize trace the dense
+    gradient path is used instead.
+    """
     jnp = _jnp()
 
     def f(idx, w):
         return jnp.take(w, idx.astype(jnp.int32), axis=0)
+
+    if sparse_grad and autograd.is_recording() and not _rng.in_trace():
+        import jax
+
+        from ..ndarray.ndarray import NDArray, _slot_of, _tracked
+        from ..ndarray.sparse import RowSparseNDArray, _unique_static
+
+        idx_nd = data if isinstance(data, NDArray) else NDArray(data)
+        w_nd = weight if isinstance(weight, NDArray) else NDArray(weight)
+        if isinstance(idx_nd._data, jax.core.Tracer) \
+                or isinstance(w_nd._data, jax.core.Tracer):
+            return _apply(f, (data, weight), name="embedding")
+        out_data = f(idx_nd._data, w_nd._data)
+        out = NDArray(out_data)
+        if _tracked(w_nd):
+            idx_flat = idx_nd._data.reshape(-1).astype(jnp.int64)
+            vocab, dim = w_nd.shape
+            uniq, inv = _unique_static(idx_flat)
+
+            def vjp_fn(ct, _u=uniq, _i=inv, _v=vocab, _d=dim):
+                ctf = ct.reshape(-1, _d)
+                vals = jnp.zeros((_u.shape[0], _d),
+                                 ctf.dtype).at[_i].add(ctf)
+                return (None,
+                        RowSparseNDArray(NDArray(vals), NDArray(_u),
+                                         (_v, _d)))
+
+            node = autograd.TapeNode(
+                vjp_fn, [_slot_of(idx_nd), _slot_of(w_nd)],
+                [(out.shape, out.dtype)], name="embedding_sparse")
+            out._tape = (node, 0)
+        return out
 
     return _apply(f, (data, weight), name="embedding")
 

@@ -123,5 +123,147 @@ def spatial_transformer(data, loc, target_shape=None,
     return _apply(f, (data, loc), name="spatial_transformer")
 
 
-for _name in ("grid_generator", "bilinear_sampler", "spatial_transformer"):
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation / cost volume (reference
+    ``src/operator/correlation.cc`` CorrelationForward): for each output
+    position, correlate a kernel patch of data1 with patches of data2 at
+    all displacements in a (2d/stride2+1)^2 neighborhood; mean over the
+    patch and channels (/ kernel²·C).
+
+    TPU formulation: one `jnp.roll`-free shifted slice per displacement
+    (static python loop over the displacement grid — its size is a
+    compile-time constant), each an elementwise multiply + channel/patch
+    reduction XLA fuses; no gather kernels needed.
+    """
+    jnp = _jnp()
+    if kernel_size % 2 == 0:
+        raise MXNetError("correlation kernel_size must be odd")
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    ngr = max_displacement // stride2           # neighborhood grid radius
+    ngw = 2 * ngr + 1
+
+    def f(d1, d2):
+        import math as _m
+
+        b, c, h, w = d1.shape
+        ph, pw = h + 2 * pad_size, w + 2 * pad_size
+        top_h = _m.ceil((ph - 2 * border) / stride1)
+        top_w = _m.ceil((pw - 2 * border) / stride1)
+        pad = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
+        p1 = jnp.pad(d1, pad)
+        p2 = jnp.pad(d2, pad)
+        sumelems = kernel_size * kernel_size * c
+        outs = []
+        for tc in range(ngw * ngw):
+            s2o = (tc % ngw - ngr) * stride2    # x displacement
+            s2p = (tc // ngw - ngr) * stride2   # y displacement
+            acc = None
+            for hh in range(kernel_size):
+                for ww in range(kernel_size):
+                    y1 = max_displacement + hh
+                    x1 = max_displacement + ww
+                    a = p1[:, :,
+                           y1:y1 + (top_h - 1) * stride1 + 1:stride1,
+                           x1:x1 + (top_w - 1) * stride1 + 1:stride1]
+                    bb = p2[:, :,
+                            y1 + s2p:y1 + s2p + (top_h - 1) * stride1 + 1:stride1,
+                            x1 + s2o:x1 + s2o + (top_w - 1) * stride1 + 1:stride1]
+                    term = a * bb if is_multiply else jnp.abs(a - bb)
+                    t = term.sum(axis=1)
+                    acc = t if acc is None else acc + t
+            outs.append(acc / sumelems)
+        return jnp.stack(outs, axis=1)  # (B, ngw*ngw, top_h, top_w)
+
+    return _apply(f, (data1, data2), name="correlation")
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=None, num_group=1,
+                           num_deformable_group=1, no_bias=False, **kwargs):  # pylint: disable=unused-argument
+    """Deformable convolution v1 (reference
+    ``src/operator/contrib/nn/deformable_im2col.h`` semantics): each
+    kernel tap's sampling position is shifted by a learned per-position
+    offset, sampled bilinearly (zero outside), then the ordinary conv
+    reduction.
+
+    TPU formulation: build the deformed patch tensor with the same
+    gather-based bilinear sampler the spatial family uses, then contract
+    patches × weights with one einsum (MXU); the reference's
+    deformable_im2col + GEMM, minus the hand-written scatter backward —
+    jax.vjp differentiates the sampler.
+
+    offset layout (reference): (B, 2 * dg * kh * kw, OH, OW) ordered
+    [dg][kh][kw][(y, x)].
+    """
+    jnp = _jnp()
+
+    def f(x, off, wgt, *mb):
+        import jax
+
+        b, c, h, w = x.shape
+        o, cg, kh, kw = wgt.shape
+        dg = num_deformable_group
+        sy, sx = stride
+        dy, dx = dilate
+        py, px = pad
+        oh = (h + 2 * py - dy * (kh - 1) - 1) // sy + 1
+        ow = (w + 2 * px - dx * (kw - 1) - 1) // sx + 1
+        # base sampling positions per tap (kh*kw, oh, ow)
+        gy0 = (jnp.arange(oh) * sy - py)[None, :, None]
+        gx0 = (jnp.arange(ow) * sx - px)[None, None, :]
+        ky = (jnp.arange(kh) * dy)[:, None, None, None]
+        kx = (jnp.arange(kw) * dx)[None, :, None, None]
+        base_y = jnp.broadcast_to(gy0[None] + ky, (kh, kw, oh, ow))
+        base_x = jnp.broadcast_to(gx0[None] + kx, (kh, kw, oh, ow))
+        off = off.reshape(b, dg, kh, kw, 2, oh, ow)
+        pos_y = base_y[None, None] + off[:, :, :, :, 0]  # (B,dg,kh,kw,oh,ow)
+        pos_x = base_x[None, None] + off[:, :, :, :, 1]
+
+        def sample_group(xg, py_, px_):
+            # xg (C/dg, H, W); py_/px_ (kh,kw,oh,ow) -> (C/dg,kh,kw,oh,ow)
+            y0 = jnp.floor(py_)
+            x0 = jnp.floor(px_)
+            wy = py_ - y0
+            wx = px_ - x0
+
+            def gat(yi, xi):
+                valid = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+                yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                v = xg[:, yc, xc]  # (C/dg, kh, kw, oh, ow)
+                return jnp.where(valid[None], v, 0.0)
+
+            return (gat(y0, x0) * ((1 - wy) * (1 - wx))[None]
+                    + gat(y0, x0 + 1) * ((1 - wy) * wx)[None]
+                    + gat(y0 + 1, x0) * (wy * (1 - wx))[None]
+                    + gat(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+        cg_d = c // dg
+        patches = jax.vmap(              # over batch
+            jax.vmap(sample_group))(     # over deformable groups
+            x.reshape(b, dg, cg_d, h, w), pos_y, pos_x)
+        patches = patches.reshape(b, c, kh, kw, oh, ow)
+        # grouped contraction: (B,G,C/G,kh,kw,oh,ow) x (G,O/G,C/G,kh,kw)
+        g = num_group
+        pg = patches.reshape(b, g, c // g, kh, kw, oh, ow)
+        wg = wgt.reshape(g, o // g, cg, kh, kw)
+        out = jnp.einsum(
+            "bgcxhw,gocx->bgohw",
+            pg.reshape(b, g, c // g, kh * kw, oh, ow),
+            wg.reshape(g, o // g, cg, kh * kw))
+        out = out.reshape(b, o, oh, ow)
+        if mb:
+            out = out + mb[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = (data, offset, weight) if (no_bias or bias is None) \
+        else (data, offset, weight, bias)
+    return _apply(f, args, name="deformable_convolution")
+
+
+for _name in ("grid_generator", "bilinear_sampler", "spatial_transformer",
+              "correlation", "deformable_convolution"):
     _register(_name, globals()[_name], wrapper=True)

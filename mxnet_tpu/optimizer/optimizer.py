@@ -128,6 +128,11 @@ class Optimizer:
             self._update_one(index, weight, grad, state)
 
     def _update_one(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray) \
+                and getattr(self, "lazy_update", False):
+            return self._update_one_lazy(index, weight, grad, state)
         t = self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
@@ -138,6 +143,26 @@ class Optimizer:
         weight._set_data_internal(new_p)
         for s, ns in zip(states, new_s):
             s._set_data_internal(ns)
+
+    def _update_one_lazy(self, index, weight, grad, state):
+        """Row-sparse lazy update: gather the touched rows of weight and
+        state, run the SAME ``_update_raw`` rule on just those rows, and
+        scatter back — O(nnz·cols) FLOPs regardless of vocab size. This is
+        the reference's ``lazy_update`` contract
+        (``src/operator/optimizer_op.cc`` SGD/Adam row_sparse kernels):
+        momentum/wd are applied ONLY to rows present in the gradient."""
+        t = self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        rows = grad.indices._data
+        g = self._prep_grad(grad.values._data.astype(weight.dtype))
+        pd = weight._data
+        states = _states_tuple(state)
+        new_p_rows, new_s_rows = self._update_raw(
+            pd[rows], g, tuple(s._data[rows] for s in states), lr, wd, t)
+        weight._set_data_internal(pd.at[rows].set(new_p_rows))
+        for s, ns in zip(states, new_s_rows):
+            s._set_data_internal(s._data.at[rows].set(ns))
 
     def update_multi_precision(self, index, weight, grad, state):
         if (self.multi_precision and isinstance(state, tuple) and len(state) == 2
@@ -181,7 +206,9 @@ def _zeros_like(weight):
 class SGD(Optimizer):
     """SGD with momentum (reference ``optimizer/sgd.py``)."""
 
-    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False, **kwargs):
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True, **kwargs):
+        # lazy_update=True is the reference default (optimizer/sgd.py):
+        # row_sparse grads update only their stored rows
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
@@ -221,10 +248,14 @@ class NAG(Optimizer):
 @register
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, correct_bias=True, **kwargs):
+                 epsilon=1e-8, correct_bias=True, lazy_update=False,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.correct_bias = correct_bias
+        # opt-in (reference adam.py): row_sparse grads touch only stored
+        # rows — moment decay is skipped for absent rows
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (_zeros_like(weight), _zeros_like(weight))

@@ -11,7 +11,7 @@ from .mesh import get_mesh, initialize_distributed, make_mesh, mesh_scope, set_m
 from . import functional
 from .functional import ShardedTrainer, ShardingRules, functionalize
 from . import pipeline
-from .pipeline import pipeline_apply, stack_stage_params
+from .pipeline import PipelinedBlock, pipeline_apply, stack_stage_params
 from . import moe
 from .moe import MoEBlock, moe_dispatch_combine, moe_sharding_rules
 from . import ring_attention

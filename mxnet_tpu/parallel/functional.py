@@ -134,7 +134,9 @@ class ShardingRules:
         P = _P()
         for pat, spec in self.rules:
             if pat.search(name):
-                return spec
+                # rank-dependent rules (pipeline-stacked leaves) are
+                # callables shape -> PartitionSpec
+                return spec(shape) if callable(spec) else spec
         if self.default_axis and self.default_axis in mesh.axis_names:
             n = mesh.shape[self.default_axis]
             # largest dim divisible by the fsdp axis size, else replicate
@@ -222,17 +224,48 @@ class ShardedTrainer:
             batch_spec = P("dp") if "dp" in self.mesh.axis_names else P()
         self.batch_spec = batch_spec
 
-        self._apply_fn, params = functionalize(block, train_mode=True)
-        params_od = block.collect_params()
-        self._train_names = [n for n in params
-                             if params_od[n].grad_req != "null"]
-        self._state_names = [n for n in params
-                             if params_od[n].grad_req == "null"]
-        # per-param lr_mult/wd_mult flow through the optimizer's param_dict,
-        # same wiring as the eager gluon.Trainer (trainer.py) — frozen layers
-        # (lr_mult=0) stay frozen under the SPMD step too
-        self.optimizer.param_dict = {
-            i: params_od[n] for i, n in enumerate(self._train_names)}
+        self._pp_meta = None
+        pp_axis = getattr(block, "_pp_axis", None)
+        if hasattr(block, "_pp_functionalize") \
+                and pp_axis in self.mesh.axis_names:
+            # pipeline-parallel path (parallel/pipeline.PipelinedBlock):
+            # body layers arrive stacked as `pp::<rel>` leaves sharded
+            # P(pp) — one stage's params per device along the pp axis
+            self._apply_fn, params, self._pp_meta = \
+                block._pp_functionalize(self.mesh)
+            params_od = block.collect_params()
+            # trainer-local copy: the injected pp:: rule must not leak
+            # into (or stack up in) the caller's ShardingRules object
+            rules_copy = ShardingRules(default_axis=self.rules.default_axis)
+            rules_copy.rules = [(
+                re.compile(r"^pp::"),
+                lambda shape, _a=pp_axis: _P()(
+                    _a, *([None] * (len(shape) - 1))))] + list(self.rules.rules)
+            self.rules = rules_copy
+            self._train_names = [
+                n for n in params
+                if n.startswith("pp::") or params_od[n].grad_req != "null"]
+            self._state_names = [
+                n for n in params
+                if not n.startswith("pp::")
+                and params_od[n].grad_req == "null"]
+            self.optimizer.param_dict = {
+                i: params_od[n]
+                for i, n in enumerate(self._train_names)
+                if n in params_od}
+        else:
+            self._apply_fn, params = functionalize(block, train_mode=True)
+            params_od = block.collect_params()
+            self._train_names = [n for n in params
+                                 if params_od[n].grad_req != "null"]
+            self._state_names = [n for n in params
+                                 if params_od[n].grad_req == "null"]
+            # per-param lr_mult/wd_mult flow through the optimizer's
+            # param_dict, same wiring as the eager gluon.Trainer
+            # (trainer.py) — frozen layers (lr_mult=0) stay frozen under
+            # the SPMD step too
+            self.optimizer.param_dict = {
+                i: params_od[n] for i, n in enumerate(self._train_names)}
         # placement: params + optimizer state onto the mesh by rule
         self.params = self.rules.shard(params, self.mesh)
         self._opt_states = self._init_opt_states()
@@ -555,9 +588,22 @@ class ShardedTrainer:
 
     def sync_to_block(self):
         """Copy trained weights back into the Block's Parameters (a copy —
-        the trainer's own arrays get donated on the next step)."""
+        the trainer's own arrays get donated on the next step). Pipeline
+        runs unstack the ``pp::`` leaves back into the per-layer params."""
         import jax.numpy as jnp
 
         params_od = self.block.collect_params()
         for n, arr in self.params.items():
-            params_od[n].data()._set_data_internal(jnp.array(arr, copy=True))
+            if self._pp_meta is not None and n.startswith("pp::"):
+                import jax
+
+                # device_get: the stacked leaf is sharded over pp — the
+                # unstacked per-layer weights must land whole on the
+                # default device for eager use
+                flat = jnp.asarray(jax.device_get(arr)).reshape(
+                    (-1,) + arr.shape[2:])  # (S, per_stage, ...) -> (L, ...)
+                for li, pname in enumerate(self._pp_meta[n]):
+                    params_od[pname].data()._set_data_internal(flat[li])
+            else:
+                params_od[n].data()._set_data_internal(
+                    jnp.array(arr, copy=True))

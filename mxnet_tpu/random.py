@@ -96,6 +96,27 @@ def next_key():
     return _global_state.next_key()
 
 
+def as_threefry(key):
+    """Derive a threefry2x32 key from any PRNG key.
+
+    A few jax samplers (``jax.random.poisson``) are implemented only for
+    threefry; under the framework's rbg default (see ``mxnet_tpu/__init__``)
+    their call sites derive a threefry key from the active key's raw bits
+    — deterministic per draw, independent across draws.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if jax.dtypes.issubdtype(getattr(key, "dtype", None), jax.dtypes.prng_key):
+        data = jax.random.key_data(key)
+    else:
+        data = key
+    folded = jnp.asarray(data, jnp.uint32).reshape(-1)[:2]
+    if folded.shape[0] < 2:
+        folded = jnp.pad(folded, (0, 2 - folded.shape[0]))
+    return jax.random.wrap_key_data(folded, impl="threefry2x32")
+
+
 def push_trace_rng(base_key) -> TraceRNG:
     rng = TraceRNG(base_key)
     _trace_stack.stack.append(rng)

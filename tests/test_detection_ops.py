@@ -1,0 +1,342 @@
+"""Detection op family vs numpy oracles (r2 verdict Next #5).
+
+Reference: src/operator/contrib/multibox_prior.cc (anchor math),
+multibox_target.cc, multibox_detection.cc, bounding_box.cc (box_nms),
+roi_align.cc.
+"""
+import math
+
+import numpy as onp
+
+from mxnet_tpu import np, npx
+
+
+def test_multibox_prior_matches_reference_math():
+    """Oracle: the exact loop of multibox_prior.cc:30-73."""
+    H, W = 3, 4
+    sizes = [0.4, 0.8]
+    ratios = [1.0, 2.0]
+    x = np.array(onp.zeros((1, 2, H, W), "float32"))
+    out = npx.multibox_prior(x, sizes=sizes, ratios=ratios).asnumpy()
+    assert out.shape == (1, H * W * (len(sizes) + len(ratios) - 1), 4)
+
+    expect = []
+    step_x, step_y = 1.0 / W, 1.0 / H
+    for r in range(H):
+        cy = (r + 0.5) * step_y
+        for c in range(W):
+            cx = (c + 0.5) * step_x
+            rt = math.sqrt(ratios[0])
+            for s in sizes:
+                w = s * H / W * rt / 2
+                h = s / rt / 2
+                expect.append([cx - w, cy - h, cx + w, cy + h])
+            for rr in ratios[1:]:
+                rt2 = math.sqrt(rr)
+                w = sizes[0] * H / W * rt2 / 2
+                h = sizes[0] / rt2 / 2
+                expect.append([cx - w, cy - h, cx + w, cy + h])
+    onp.testing.assert_allclose(out[0], onp.array(expect, "float32"),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_prior_clip_and_steps():
+    x = np.array(onp.zeros((1, 1, 2, 2), "float32"))
+    out = npx.multibox_prior(x, sizes=[1.5], clip=True).asnumpy()
+    assert out.min() >= 0.0 and out.max() <= 1.0
+    out2 = npx.multibox_prior(x, sizes=[0.5], steps=(0.4, 0.3),
+                              offsets=(0.0, 0.0)).asnumpy()
+    # first anchor center at (0*0.3, 0*0.4) = (0, 0)
+    c = out2[0, 0]
+    onp.testing.assert_allclose([(c[0] + c[2]) / 2, (c[1] + c[3]) / 2],
+                                [0.0, 0.0], atol=1e-6)
+
+
+def _iou_np(a, b):
+    tlx, tly = max(a[0], b[0]), max(a[1], b[1])
+    brx, bry = min(a[2], b[2]), min(a[3], b[3])
+    iw, ih = max(brx - tlx, 0), max(bry - tly, 0)
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_box_nms_basic():
+    # rows: [id, score, x1, y1, x2, y2]
+    d = onp.array([
+        [0, 0.9, 0.0, 0.0, 0.5, 0.5],
+        [0, 0.8, 0.05, 0.05, 0.55, 0.55],   # overlaps the first -> pruned
+        [0, 0.7, 0.6, 0.6, 0.9, 0.9],       # separate -> kept
+        [1, 0.85, 0.02, 0.02, 0.52, 0.52],  # different class -> kept
+    ], "float32")
+    out = npx.box_nms(np.array(d[None]), overlap_thresh=0.5, coord_start=2,
+                      score_index=1, id_index=0).asnumpy()[0]
+    # sorted by score: 0.9, 0.85(class 1), 0.7 survive; 0.8 pruned
+    assert out[0][1] == onp.float32(0.9)
+    assert out[1][1] == onp.float32(0.85)
+    assert out[2][1] == onp.float32(0.7)
+    assert (out[3] == -1).all()
+
+
+def test_box_nms_force_suppress_and_topk():
+    d = onp.array([
+        [0, 0.9, 0.0, 0.0, 0.5, 0.5],
+        [1, 0.8, 0.05, 0.05, 0.55, 0.55],
+        [2, 0.7, 0.6, 0.6, 0.9, 0.9],
+    ], "float32")
+    out = npx.box_nms(np.array(d[None]), overlap_thresh=0.5,
+                      coord_start=2, score_index=1, id_index=0,
+                      force_suppress=True).asnumpy()[0]
+    assert out[0][1] == onp.float32(0.9)
+    assert out[1][1] == onp.float32(0.7)  # 0.8 suppressed across classes
+    assert (out[2] == -1).all()
+    out = npx.box_nms(np.array(d[None]), overlap_thresh=0.5,
+                      coord_start=2, score_index=1, id_index=0,
+                      topk=1).asnumpy()[0]
+    assert out[0][1] == onp.float32(0.9) and (out[1:] == -1).all()
+
+
+def test_box_nms_valid_thresh_and_center_format():
+    d = onp.array([
+        [0.9, 0.25, 0.25, 0.5, 0.5],   # center format box
+        [0.05, 0.7, 0.7, 0.2, 0.2],    # below valid_thresh
+    ], "float32")
+    out = npx.box_nms(np.array(d[None]), overlap_thresh=0.5, coord_start=1,
+                      score_index=0, valid_thresh=0.1,
+                      in_format="center").asnumpy()[0]
+    assert out[0][0] == onp.float32(0.9)
+    assert (out[1] == -1).all()
+
+
+def test_multibox_target_matching_and_encoding():
+    anchors = onp.array([[0.0, 0.0, 0.4, 0.4],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.6, 0.3, 0.9]], "float32")
+    # one gt box overlapping anchor 0; class 2
+    label = onp.array([[[2, 0.05, 0.05, 0.45, 0.45],
+                        [-1, 0, 0, 0, 0]]], "float32")
+    cls_pred = onp.zeros((1, 4, 3), "float32")
+    bt, bm, ct = npx.multibox_target(
+        np.array(anchors[None]), np.array(label), np.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    bm = bm.asnumpy()[0].reshape(3, 4)
+    bt = bt.asnumpy()[0].reshape(3, 4)
+    assert ct.tolist() == [3.0, 0.0, 0.0]  # gt class 2 -> target 3
+    assert bm[0].tolist() == [1, 1, 1, 1]
+    assert bm[1].tolist() == [0, 0, 0, 0]
+    # encoding oracle for anchor 0 vs gt, variances (0.1,.1,.2,.2)
+    aw = ah = 0.4
+    ax = ay = 0.2
+    gx = gy = 0.25
+    gw = gh = 0.4
+    expect = [(gx - ax) / aw / 0.1, (gy - ay) / ah / 0.1,
+              math.log(gw / aw) / 0.2, math.log(gh / ah) / 0.2]
+    onp.testing.assert_allclose(bt[0], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_target_bipartite_beats_threshold():
+    """Every valid gt claims its best anchor even below the overlap
+    threshold (the bipartite phase of multibox_target.cc)."""
+    anchors = onp.array([[0.0, 0.0, 0.2, 0.2],
+                         [0.8, 0.8, 1.0, 1.0]], "float32")
+    label = onp.array([[[0, 0.15, 0.15, 0.5, 0.5]]], "float32")  # IoU ~ tiny
+    cls_pred = onp.zeros((1, 2, 2), "float32")
+    _, _, ct = npx.multibox_target(np.array(anchors[None]), np.array(label),
+                                   np.array(cls_pred),
+                                   overlap_threshold=0.5)
+    assert ct.asnumpy()[0].tolist() == [1.0, 0.0]
+
+
+def test_multibox_detection_roundtrip():
+    """Encode with multibox_target's convention, decode with
+    multibox_detection: recovered box must equal the gt box."""
+    anchors = onp.array([[0.1, 0.1, 0.5, 0.5],
+                         [0.6, 0.6, 0.9, 0.9]], "float32")
+    gt = [0.15, 0.2, 0.55, 0.5]
+    aw, ah = 0.4, 0.4
+    ax, ay = 0.3, 0.3
+    gx, gy = (gt[0] + gt[2]) / 2, (gt[1] + gt[3]) / 2
+    gw, gh = gt[2] - gt[0], gt[3] - gt[1]
+    enc = [(gx - ax) / aw / 0.1, (gy - ay) / ah / 0.1,
+           math.log(gw / aw) / 0.2, math.log(gh / ah) / 0.2]
+    loc_pred = onp.array([enc + [0, 0, 0, 0]], "float32")  # (1, N*4)
+    cls_prob = onp.array([[[0.1, 0.2], [0.9, 0.1], [0.0, 0.7]]], "float32")
+    # anchor0 -> class 1 (idx1, p=.9), anchor1 -> class 2 (idx2, p=.7)
+    out = npx.multibox_detection(
+        np.array(cls_prob), np.array(loc_pred), np.array(anchors[None]),
+        clip=False).asnumpy()[0]
+    assert out[0][0] == 0.0 and abs(out[0][1] - 0.9) < 1e-6
+    onp.testing.assert_allclose(out[0][2:], gt, rtol=1e-4, atol=1e-5)
+    assert out[1][0] == 1.0  # second anchor's class id (0-based, no bg)
+
+
+def test_roi_align_oracle():
+    """2x2 bins on a linear ramp image: analytic bilinear average."""
+    H = W = 6
+    img = onp.arange(H * W, dtype="float32").reshape(1, 1, H, W)
+    rois = onp.array([[0, 1.0, 1.0, 5.0, 5.0]], "float32")
+    out = npx.roi_align(np.array(img), np.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0, sample_ratio=2).asnumpy()
+    assert out.shape == (1, 1, 2, 2)
+
+    def bilinear(y, x):
+        y0, x0 = int(onp.floor(y)), int(onp.floor(x))
+        y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        wy, wx = y - y0, x - x0
+        im = img[0, 0]
+        return (im[y0, x0] * (1 - wy) * (1 - wx) + im[y1, x0] * wy * (1 - wx)
+                + im[y0, x1] * (1 - wy) * wx + im[y1, x1] * wy * wx)
+
+    expect = onp.zeros((2, 2))
+    roi_h = roi_w = 4.0
+    for by in range(2):
+        for bx in range(2):
+            acc = 0.0
+            for sy in range(2):
+                for sx in range(2):
+                    yy = 1.0 + (by * 2 + sy + 0.5) * roi_h / 4
+                    xx = 1.0 + (bx * 2 + sx + 0.5) * roi_w / 4
+                    acc += bilinear(yy, xx)
+            expect[by, bx] = acc / 4
+    onp.testing.assert_allclose(out[0, 0], expect, rtol=1e-5)
+
+
+def test_roi_align_batch_index_and_aligned():
+    img = onp.stack([onp.zeros((1, 4, 4), "float32"),
+                     onp.ones((1, 4, 4), "float32")])
+    rois = onp.array([[1, 0, 0, 4, 4], [0, 0, 0, 4, 4]], "float32")
+    out = npx.roi_align(np.array(img), np.array(rois), pooled_size=2,
+                        aligned=True).asnumpy()
+    onp.testing.assert_allclose(out[0], onp.ones((1, 2, 2)), atol=1e-6)
+    onp.testing.assert_allclose(out[1], onp.zeros((1, 2, 2)), atol=1e-6)
+
+
+def test_detection_ops_jittable():
+    """Static-shape contract: the whole pipeline compiles under jit."""
+    import jax
+
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.ops import detection as det
+
+    anchors = onp.random.rand(1, 8, 4).astype("float32")
+    cls_prob = onp.random.rand(2, 3, 8).astype("float32")
+    loc = onp.random.randn(2, 32).astype("float32")
+
+    @jax.jit
+    def pipeline(cp, lp, anc):
+        out = det.multibox_detection(cp, lp, anc)
+        return out._data if hasattr(out, "_data") else out
+
+    r = pipeline(cls_prob, loc, anchors)
+    assert r.shape == (2, 8, 6)
+
+
+def test_correlation_oracle():
+    """Oracle: the reference CorrelationForward loop (correlation.cc:40)."""
+    rng = onp.random.RandomState(5)
+    B, C, H, W = 1, 3, 6, 6
+    d1 = rng.randn(B, C, H, W).astype("float32")
+    d2 = rng.randn(B, C, H, W).astype("float32")
+    md, ks, pad = 2, 1, 2
+    out = npx.correlation(np.array(d1), np.array(d2), kernel_size=ks,
+                          max_displacement=md, stride1=1, stride2=1,
+                          pad_size=pad, is_multiply=True).asnumpy()
+    ngw = 2 * md + 1
+    border = md  # + kernel_radius(0)
+    ph, pw = H + 2 * pad, W + 2 * pad
+    th, tw = ph - 2 * border, pw - 2 * border
+    assert out.shape == (B, ngw * ngw, th, tw)
+    p1 = onp.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = onp.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    expect = onp.zeros_like(out)
+    for i in range(th):
+        for j in range(tw):
+            x1, y1 = j + md, i + md
+            for tc in range(ngw * ngw):
+                s2o = (tc % ngw - md)
+                s2p = (tc // ngw - md)
+                v = (p1[0, :, y1, x1] * p2[0, :, y1 + s2p, x1 + s2o]).sum()
+                expect[0, tc, i, j] = v / C
+    onp.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_subtract_mode():
+    d1 = onp.ones((1, 2, 4, 4), "float32")
+    d2 = onp.zeros((1, 2, 4, 4), "float32")
+    out = npx.correlation(np.array(d1), np.array(d2), kernel_size=1,
+                          max_displacement=0, pad_size=0,
+                          is_multiply=False).asnumpy()
+    onp.testing.assert_allclose(out, onp.ones((1, 1, 4, 4)))
+
+
+def test_deformable_convolution_zero_offset_equals_conv():
+    """With all-zero offsets, deformable conv == ordinary convolution."""
+    rng = onp.random.RandomState(6)
+    B, C, H, W, O, K = 2, 4, 7, 7, 6, 3
+    x = rng.randn(B, C, H, W).astype("float32")
+    wgt = (rng.randn(O, C, K, K) * 0.1).astype("float32")
+    off = onp.zeros((B, 2 * K * K, H, W), "float32")
+    out = npx.deformable_convolution(
+        np.array(x), np.array(off), np.array(wgt), kernel=(K, K),
+        pad=(1, 1), num_filter=O, no_bias=True).asnumpy()
+    ref = npx.convolution(np.array(x), np.array(wgt), kernel=(K, K),
+                          pad=(1, 1), num_filter=O, no_bias=True).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_integer_shift():
+    """An integer offset of (0, +1) on every tap shifts the sampled input
+    one pixel right: equals conv of the shifted image (interior)."""
+    rng = onp.random.RandomState(7)
+    B, C, H, W, O, K = 1, 2, 6, 6, 3, 3
+    x = rng.randn(B, C, H, W).astype("float32")
+    wgt = (rng.randn(O, C, K, K) * 0.1).astype("float32")
+    off = onp.zeros((B, 2 * K * K, H, W), "float32")
+    off[:, 1::2] = 1.0  # x offsets
+    out = npx.deformable_convolution(
+        np.array(x), np.array(off), np.array(wgt), kernel=(K, K),
+        pad=(1, 1), num_filter=O, no_bias=True).asnumpy()
+    xs = onp.zeros_like(x)
+    xs[..., :-1] = x[..., 1:]
+    ref = npx.convolution(np.array(xs), np.array(wgt), kernel=(K, K),
+                          pad=(1, 1), num_filter=O, no_bias=True).asnumpy()
+    # interior columns only (border columns see zero-padding differences)
+    onp.testing.assert_allclose(out[..., 1:-2], ref[..., 1:-2],
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_grad_flows_to_offset():
+    from mxnet_tpu import autograd
+
+    rng = onp.random.RandomState(8)
+    x = np.array(rng.randn(1, 2, 5, 5).astype("float32"))
+    wgt = np.array((rng.randn(2, 2, 3, 3) * 0.1).astype("float32"))
+    off = np.array((rng.rand(1, 18, 5, 5) * 0.3).astype("float32"))
+    off.attach_grad()
+    with autograd.record():
+        y = npx.deformable_convolution(x, off, wgt, kernel=(3, 3),
+                                       pad=(1, 1), num_filter=2,
+                                       no_bias=True)
+        y.sum().backward()
+    g = off.grad.asnumpy()
+    assert onp.abs(g).max() > 0
+
+
+def test_multibox_target_negative_mining():
+    """Mining: unmatched low-IoU anchors are candidates, top ratio*num_pos
+    by predicted score train as background, the rest get ignore_label."""
+    anchors = onp.array([[0.0, 0.0, 0.4, 0.4],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.6, 0.3, 0.9],
+                         [0.6, 0.0, 0.9, 0.3]], "float32")
+    label = onp.array([[[1, 0.05, 0.05, 0.45, 0.45]]], "float32")
+    # predicted class scores: anchor 1 is the hardest negative
+    cls_pred = onp.zeros((1, 3, 4), "float32")
+    cls_pred[0, 1] = [0.0, 0.9, 0.2, 0.1]
+    _, _, ct = npx.multibox_target(
+        np.array(anchors[None]), np.array(label), np.array(cls_pred),
+        negative_mining_ratio=1.0, negative_mining_thresh=0.5)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 2.0            # matched -> class 1 + 1
+    assert ct[1] == 0.0            # hardest negative kept (quota 1*1)
+    assert ct[2] == -1.0 and ct[3] == -1.0  # mined away -> ignore_label

@@ -155,3 +155,116 @@ def test_moe_block_trains_and_shards():
         assert losses[-1] < losses[0]
         w1 = tr.params["moe.w1"]
         assert w1.sharding.spec[0] == "ep"  # experts live on their devices
+
+
+def test_pipelined_block_trainer_loss_parity():
+    """A real transformer (not a toy stage_fn) trained through
+    ShardedTrainer over a pp mesh matches single-device training losses
+    step for step (r2 verdict Next #7 Done criterion)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.parallel import (
+        PipelinedBlock,
+        ShardedTrainer,
+        ShardingRules,
+        make_mesh,
+    )
+
+    D, L, B, T = 16, 4, 8, 6
+
+    class FFBlock(gluon.block.HybridBlock):
+        """Shape-preserving transformer-ish layer: LN + MLP residual."""
+
+        def __init__(self):
+            super().__init__()
+            self.ln = gluon.nn.LayerNorm()
+            self.f1 = gluon.nn.Dense(D * 2, flatten=False)
+            self.f2 = gluon.nn.Dense(D, flatten=False)
+
+        def forward(self, x):
+            from mxnet_tpu import npx
+
+            return x + self.f2(npx.relu(self.f1(self.ln(x))))
+
+    def build(seed):
+        mx.random.seed(seed)
+        prefix = gluon.nn.Dense(D, flatten=False)
+        layers = [FFBlock() for _ in range(L)]
+        suffix = gluon.nn.Dense(4, flatten=False)
+        net = PipelinedBlock(layers, prefix=prefix, suffix=suffix,
+                             num_microbatches=4)
+        net.initialize()
+        with autograd.predict_mode():
+            net(mnp.array(onp.zeros((2, T, 8), "float32")))
+        return net
+
+    rng = onp.random.RandomState(3)
+    x = rng.randn(B, T, 8).astype("float32")
+    y = rng.randint(0, 4, (B, T)).astype("int32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def losses_for(mesh_axes):
+        net = build(42)
+        mesh = make_mesh(mesh_axes)
+        tr = ShardedTrainer(net, loss_fn, "sgd", {"learning_rate": 0.2},
+                            mesh=mesh,
+                            rules=ShardingRules(default_axis=None))
+        out = []
+        for _ in range(4):
+            out.append(float(tr.step(x, y).asnumpy().reshape(-1)[0]))
+        return out
+
+    pp_losses = losses_for({"pp": 4})
+    ref_losses = losses_for({"dp": 1})  # single-logical-device baseline
+    onp.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-4, atol=2e-5)
+    assert pp_losses[-1] < pp_losses[0]  # it actually trains
+
+
+def test_pipelined_block_sync_to_block_roundtrip():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.parallel import PipelinedBlock, ShardedTrainer, \
+        ShardingRules, make_mesh
+
+    D = 8
+
+    class Lay(gluon.block.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.f = gluon.nn.Dense(D, flatten=False)
+
+        def forward(self, x):
+            return x + self.f(x)
+
+    mx.random.seed(9)
+    net = PipelinedBlock([Lay() for _ in range(2)])
+    net.initialize()
+    x = onp.random.randn(4, D).astype("float32")
+    with autograd.predict_mode():
+        net(mnp.array(x))
+    loss_fn = gluon.loss.L2Loss()
+    tr = ShardedTrainer(net, loss_fn, "sgd", {"learning_rate": 0.1},
+                        mesh=make_mesh({"pp": 2}),
+                        rules=ShardingRules(default_axis=None))
+    y = onp.zeros((4, D), "float32")
+    tr.step(x, y)
+    tr.sync_to_block()
+    # every per-layer Parameter now holds its slice of the TRAINED stack
+    for n, arr in tr.params.items():
+        if not n.startswith("pp::"):
+            continue
+        host = onp.asarray(arr).reshape((-1,) + arr.shape[2:])
+        for li, pname in enumerate(tr._pp_meta[n]):
+            onp.testing.assert_allclose(
+                net.collect_params()[pname].data().asnumpy(), host[li],
+                rtol=1e-6)
+    # and the weights really changed from init
+    assert any(
+        onp.abs(onp.asarray(v)).sum() > 0
+        for k, v in tr.params.items() if k.startswith("pp::"))

@@ -1,0 +1,195 @@
+"""O(nnz) sparse compute: csr dot kernels, sparse embedding gradients,
+lazy_update optimizers, row_sparse_pull — r2 verdict Next #4.
+
+Reference: ``src/operator/tensor/dot-inl.h`` (sparse dot),
+``src/operator/optimizer_op.cc`` (lazy_update row kernels),
+``include/mxnet/kvstore.h:161`` (PullRowSparse),
+``python/mxnet/optimizer/sgd.py`` (lazy_update default True).
+
+The O(nnz) contract is asserted through ``is_materialized()``: any code
+path that touches a sparse array's dense view flips it.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+
+def _rand_csr(rng, m, k, nnz_per_row=2):
+    indptr = [0]
+    cols = []
+    vals = []
+    for _ in range(m):
+        c = rng.choice(k, size=nnz_per_row, replace=False)
+        c.sort()
+        cols.extend(c.tolist())
+        vals.extend(rng.randn(nnz_per_row).tolist())
+        indptr.append(len(cols))
+    return sparse.csr_matrix(
+        (onp.array(vals, "float32"), onp.array(indptr, "int64"),
+         onp.array(cols, "int64")), shape=(m, k))
+
+
+def test_csr_dot_dense_matches_numpy_and_stays_sparse():
+    rng = onp.random.RandomState(0)
+    a = _rand_csr(rng, 6, 50)
+    b = np.array(rng.randn(50, 4).astype("float32"))
+    out = sparse.dot(a, b)
+    out.asnumpy()
+    assert not a.is_materialized()  # the kernel never built the dense view
+    onp.testing.assert_allclose(
+        out.asnumpy(), a.tostype("default").asnumpy() @ b.asnumpy(),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_csr_dot_transpose_a():
+    rng = onp.random.RandomState(1)
+    a = _rand_csr(rng, 6, 50)
+    b = np.array(rng.randn(6, 3).astype("float32"))
+    out = sparse.dot(a, b, transpose_a=True)
+    out.asnumpy()
+    assert not a.is_materialized()
+    onp.testing.assert_allclose(
+        out.asnumpy(), a.tostype("default").asnumpy().T @ b.asnumpy(),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_dense_dot_csr():
+    rng = onp.random.RandomState(2)
+    a = np.array(rng.randn(3, 6).astype("float32"))
+    b = _rand_csr(rng, 6, 40)
+    out = sparse.dot(a, b)
+    out.asnumpy()
+    assert not b.is_materialized()
+    onp.testing.assert_allclose(
+        out.asnumpy(), a.asnumpy() @ b.tostype("default").asnumpy(),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_row_sparse_add_merges_duplicates():
+    v1 = RowSparseNDArray(np.array(onp.ones((2, 3), "float32")),
+                          np.array(onp.array([1, 4], "int64")), (8, 3))
+    v2 = RowSparseNDArray(np.array(onp.full((2, 3), 2.0, "float32")),
+                          np.array(onp.array([4, 7], "int64")), (8, 3))
+    s = v1 + v2
+    assert isinstance(s, RowSparseNDArray)
+    assert s.indices.asnumpy().tolist() == [1, 4, 7]
+    onp.testing.assert_allclose(s.values.asnumpy(),
+                                [[1] * 3, [3] * 3, [2] * 3])
+    assert not s.is_materialized()
+
+
+def test_embedding_sparse_grad_is_row_sparse_o_nnz():
+    """The verdict's Done criterion: an embedding training step where the
+    gradient and update scale with nnz, not vocab — asserted by the dense
+    view never being materialized on the (vocab, dim) grad."""
+    VOCAB, DIM = 5000, 16
+    emb = gluon.nn.Embedding(VOCAB, DIM, sparse_grad=True)
+    emb.initialize()
+    tr = gluon.Trainer(emb.collect_params(), "sgd",
+                       {"learning_rate": 0.5, "momentum": 0.9})
+    idx = np.array(onp.array([[3, 17, 3], [99, 17, 4999]], "int64"))
+    w_before = emb.weight.data().asnumpy().copy()
+    with autograd.record():
+        out = emb(idx)
+        loss = (out * out).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert sorted(g.indices.asnumpy().tolist()) == [3, 17, 99, 4999]
+    # duplicate index 3 contributions summed
+    ref_row3 = 2 * 2 * w_before[3]  # d/dw sum(w[i]^2) per occurrence
+    onp.testing.assert_allclose(
+        g.values.asnumpy()[g.indices.asnumpy().tolist().index(3)],
+        ref_row3, rtol=1e-5)
+    tr.step(1)
+    assert not g.is_materialized(), \
+        "dense grad view was built: update was not O(nnz)"
+    w_after = emb.weight.data().asnumpy()
+    touched = [3, 17, 99, 4999]
+    untouched = onp.setdiff1d(onp.arange(VOCAB), touched)
+    # lazy_update semantics: untouched rows bit-identical (no wd/momentum)
+    onp.testing.assert_array_equal(w_after[untouched], w_before[untouched])
+    assert (w_after[touched] != w_before[touched]).any()
+
+
+def test_lazy_update_momentum_only_touched_rows():
+    """Momentum state rows outside the gradient stay exactly zero across
+    steps (the reference lazy_update contract)."""
+    VOCAB, DIM = 100, 4
+    w = np.array(onp.ones((VOCAB, DIM), "float32"))
+    w.attach_grad()
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    state = opt.create_state_multi_precision(0, w)
+    g = RowSparseNDArray(np.array(onp.ones((2, DIM), "float32")),
+                         np.array(onp.array([5, 42], "int64")),
+                         (VOCAB, DIM))
+    for _ in range(3):
+        opt.update_multi_precision(0, w, g, state)
+    mom = state[0].asnumpy() if isinstance(state, tuple) else state.asnumpy()
+    nz_rows = onp.where(onp.any(mom != 0, axis=1))[0]
+    assert nz_rows.tolist() == [5, 42]
+
+
+def test_adam_lazy_update_optin():
+    VOCAB, DIM = 50, 4
+    w = np.array(onp.ones((VOCAB, DIM), "float32"))
+    opt = mx.optimizer.create("adam", learning_rate=0.1, lazy_update=True)
+    state = opt.create_state_multi_precision(0, w)
+    g = RowSparseNDArray(np.array(onp.ones((1, DIM), "float32")),
+                         np.array(onp.array([7], "int64")), (VOCAB, DIM))
+    before = w.asnumpy().copy()
+    opt.update_multi_precision(0, w, g, state)
+    after = w.asnumpy()
+    assert (after[7] != before[7]).all()
+    untouched = onp.setdiff1d(onp.arange(VOCAB), [7])
+    onp.testing.assert_array_equal(after[untouched], before[untouched])
+
+
+def test_kvstore_row_sparse_pull_o_nnz():
+    kv = mx.kv.create("local")
+    VOCAB, DIM = 1000, 8
+    w = np.array(onp.random.randn(VOCAB, DIM).astype("float32"))
+    kv.init("emb", w)
+    dst = RowSparseNDArray(np.array(onp.zeros((0, DIM), "float32")),
+                           np.array(onp.zeros((0,), "int64")), (VOCAB, DIM))
+    rows = np.array(onp.array([2, 30, 500], "int64"))
+    kv.row_sparse_pull("emb", out=dst, row_ids=rows)
+    assert not dst.is_materialized()
+    onp.testing.assert_allclose(dst.values.asnumpy(),
+                                w.asnumpy()[[2, 30, 500]], rtol=1e-6)
+    assert dst.indices.asnumpy().tolist() == [2, 30, 500]
+
+
+def test_zero_grad_keeps_sparse_empty():
+    emb = gluon.nn.Embedding(300, 4, sparse_grad=True)
+    emb.initialize()
+    idx = np.array(onp.array([1, 2], "int64"))
+    with autograd.record():
+        emb(idx).sum().backward()
+    g = emb.weight.grad()
+    assert g.indices.shape[0] > 0
+    emb.collect_params().zero_grad()
+    assert g.indices.shape[0] == 0 and not g.is_materialized()
+
+
+def test_sparse_grad_falls_back_dense_under_hybridize():
+    """Inside a CachedOp trace the indices are tracers: the embedding
+    must silently take the dense-grad path and still train."""
+    emb = gluon.nn.Embedding(50, 4, sparse_grad=True)
+    emb.initialize()
+    net = gluon.nn.HybridSequential()
+    net.add(emb)
+    net.hybridize()
+    idx = np.array(onp.array([1, 2], "int64"))
+    with autograd.record():
+        loss = net(idx).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    gn = g.asnumpy() if not isinstance(g, RowSparseNDArray) \
+        else g.tostype("default").asnumpy()
+    assert gn[1].sum() != 0 and gn[0].sum() == 0
