@@ -997,7 +997,13 @@ class FisherSnedecor(Distribution):
 
     @property
     def mean(self):
-        return self.df2 / (self.df2 - 2)
+        # undefined for df2 <= 2 (same guard discipline as Pareto.mean)
+        jnp = _jnp()
+
+        d2 = self.df2._data if hasattr(self.df2, "_data") else self.df2
+        from ...ndarray.ndarray import NDArray
+
+        return NDArray(jnp.where(d2 > 2, d2 / (d2 - 2), jnp.nan))
 
 
 class HalfCauchy(Distribution):
@@ -1485,20 +1491,18 @@ def _kl_halfcauchy_halfcauchy(p, q):
 @register_kl(Binomial, Binomial)
 def _kl_binomial_binomial(p, q):
     jnp = _jnp()
-    import numpy as onp
 
     # closed form only exists for equal counts; p.n > q.n has disjoint
-    # support (KL = inf); p.n < q.n has no closed form (same contract as
-    # torch's _kl_binomial_binomial)
-    if bool(onp.any(p.n.asnumpy() < q.n.asnumpy())):
-        raise MXNetError(
-            "KL(Binomial(n1) || Binomial(n2)) with n1 < n2 has no closed "
-            "form; use empirical_kl")
+    # support (KL = inf); p.n < q.n has no closed form — returned as nan
+    # INSIDE the traced computation (an eager asnumpy() check here would
+    # force a host sync and break kl_divergence under jit; every other
+    # registered KL stays on-device)
 
     def f(n1, n2, p1, p2):
         kl = n1 * (p1 * (jnp.log(p1) - jnp.log(p2))
                    + (1 - p1) * (jnp.log1p(-p1) - jnp.log1p(-p2)))
-        return jnp.where(n1 == n2, kl, jnp.inf)
+        return jnp.where(n1 == n2, kl,
+                         jnp.where(n1 > n2, jnp.inf, jnp.nan))
 
     return _wrap(f, p.n, q.n, p.prob, q.prob, name="kl_binomial")
 

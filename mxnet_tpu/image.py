@@ -808,8 +808,19 @@ class ImageDetIter:
         header_w = int(raw[0])
         obj_w = int(raw[1])
         body = raw[header_w:]
+        if body.size % obj_w:
+            raise MXNetError(
+                f"ImageDetIter label body of {body.size} values does not "
+                f"divide into obj_width={obj_w} rows (corrupt record?)")
         n = body.size // obj_w
-        return body[:n * obj_w].reshape(n, obj_w)[:, :self._label_width]
+        rows = body.reshape(n, obj_w)
+        if obj_w < self._label_width:
+            # narrow object rows pad with -1 to label_width (reference
+            # pads missing extras rather than shrinking the batch array)
+            rows = _onp.concatenate(
+                [rows, -_onp.ones((n, self._label_width - obj_w),
+                                  rows.dtype)], axis=1)
+        return rows[:, :self._label_width]
 
     def __next__(self):
         from . import numpy as mnp

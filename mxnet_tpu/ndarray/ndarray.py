@@ -346,10 +346,23 @@ class NDArray:
 
         ours = getattr(mnp, func.__name__, None)
         if ours is not None and callable(ours):
+            # fall back to host numpy ONLY for kwargs our implementation
+            # doesn't take (out=/where=/order=...), decided up front — a
+            # blanket TypeError catch would silently recompute genuine
+            # user errors on host and hand back a numpy array
+            import inspect
+
             try:
+                sig = inspect.signature(ours)
+                has_varkw = any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in sig.parameters.values())
+                unsupported = not has_varkw and any(
+                    k not in sig.parameters for k in kwargs)
+            except (TypeError, ValueError):  # builtins without signatures
+                unsupported = False
+            if not unsupported:
                 return ours(*args, **kwargs)
-            except TypeError:
-                pass  # signature mismatch (e.g. out=/where=): host fallback
         host = [a.asnumpy() if isinstance(a, NDArray) else a for a in args]
         return func(*host, **kwargs)
 

@@ -232,6 +232,18 @@ def spacing(x):
     return _registry.apply(f, (x,), name="spacing", record=False)
 
 
+def require(a, dtype=None, requirements=None):
+    """numpy.require parity: dtype coercion; layout requirement flags
+    (C/F/ALIGNED/OWNDATA/WRITEABLE) are moot for XLA-managed buffers (the
+    compiler owns layout), so they are accepted and ignored."""
+    import jax.numpy as jnp
+
+    arr = a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+    if dtype is not None and arr.dtype != dtype:
+        return arr.astype(dtype)
+    return arr
+
+
 def fill_diagonal(a, val, wrap=False):
     """In-place diagonal fill (numpy mutation semantics via rebind)."""
     import jax.numpy as jnp
@@ -295,7 +307,7 @@ def _install_extras(ns, wrap):
     for nm in ("pv", "npv", "mirr", "pmt", "ppmt", "ipmt", "fv", "rate",
                "shares_memory", "may_share_memory", "set_printoptions",
                "msort", "alltrue", "apply_over_axes", "spacing",
-               "fill_diagonal"):
+               "fill_diagonal", "require"):
         ns.setdefault(nm, globals()[nm])
 
 

@@ -150,12 +150,19 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
         out_order = jnp.argsort(~keep, stable=True)
         ds = ds[out_order]
         keep_s = keep[out_order]
-        if out_format == "center" and in_format == "corner":
-            c = ds[:, coord_start:coord_start + 4]
-            ctr = jnp.stack([(c[:, 0] + c[:, 2]) / 2,
-                             (c[:, 1] + c[:, 3]) / 2,
-                             c[:, 2] - c[:, 0], c[:, 3] - c[:, 1]], axis=-1)
-            ds = ds.at[:, coord_start:coord_start + 4].set(ctr)
+        # emit in out_format regardless of in_format (the two args are
+        # independent in the reference bounding_box.cc)
+        if out_format != in_format:
+            if out_format == "corner":  # center in -> corner out
+                ds = ds.at[:, coord_start:coord_start + 4].set(
+                    boxes[out_order])
+            else:                       # corner in -> center out
+                c = ds[:, coord_start:coord_start + 4]
+                ctr = jnp.stack([(c[:, 0] + c[:, 2]) / 2,
+                                 (c[:, 1] + c[:, 3]) / 2,
+                                 c[:, 2] - c[:, 0], c[:, 3] - c[:, 1]],
+                                axis=-1)
+                ds = ds.at[:, coord_start:coord_start + 4].set(ctr)
         return jnp.where(keep_s[:, None], ds, -1.0)
 
     def f(x):
@@ -396,6 +403,17 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
             img = x[bidx]
             samples = bilinear(img, gy, gx)  # (C, ph*sr, pw*sr)
             pooled = samples.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+            if position_sensitive:
+                # PS-ROIAlign (R-FCN, reference roi_align.cc PS mode):
+                # bin (i, j) of output channel c reads input channel
+                # c*ph*pw + i*pw + j
+                c_out = C // (ph * pw)
+                sel = (jnp.arange(c_out)[:, None, None] * (ph * pw)
+                       + jnp.arange(ph)[None, :, None] * pw
+                       + jnp.arange(pw)[None, None, :])  # (c_out, ph, pw)
+                pooled = pooled[sel,
+                                jnp.arange(ph)[None, :, None],
+                                jnp.arange(pw)[None, None, :]]
             del bin_w, bin_h
             return pooled
 

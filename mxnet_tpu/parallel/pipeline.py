@@ -19,17 +19,10 @@ from ..base import MXNetError
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
-    """shard_map across jax versions (check_vma vs check_rep kwarg)."""
-    try:
-        from jax import shard_map
+    """shard_map across jax versions — shared shim in parallel.mesh."""
+    from .mesh import shard_map_compat
 
-        return shard_map(f, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
-    except (ImportError, TypeError):
-        from jax.experimental.shard_map import shard_map as sm
-
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
+    return shard_map_compat(f, mesh, in_specs, out_specs)
 
 
 def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp",
@@ -222,6 +215,14 @@ class PipelinedBlock:
             if list(od) != rel_keys:
                 raise MXNetError(
                     "pipeline layers are not structurally uniform")
+        for od in layer_ods:
+            for k, p in od.items():
+                if p.grad_req == "null":
+                    raise MXNetError(
+                        "PipelinedBlock does not support mutable-state "
+                        f"layers (BatchNorm running stats: {k}) in the "
+                        "pipeline body; use stateless normalization "
+                        "(LayerNorm)")
         layer0 = self._body[0]
         layer0_arrays = [p.data() for p in layer_ods[0].values()]
 

@@ -23,9 +23,9 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
     from . import mesh as mesh_mod
+    from .mesh import shard_map_compat
 
     mesh = mesh if mesh is not None else mesh_mod.get_mesh(create=True)
     if mesh is None or axis not in mesh.axis_names:
@@ -40,9 +40,10 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
 
     spec = P(None, None, axis, None)
 
-    @functools.partial(
-        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_rep=False)
+    def _wrap(fn):
+        return shard_map_compat(fn, mesh, (spec, spec, spec), spec)
+
+    @_wrap
     def inner(ql, kl, vl):
         # ql/kl/vl: (B, H, Tl, D) local blocks
         b, h, tl, dd = ql.shape

@@ -45,6 +45,42 @@ def print_summary(symbol, shape=None, line_length=98, positions=None):
     from .symbol import Symbol
 
     positions = positions or [0.44, 0.64, 0.74, 1.0]
+
+    def _derive_param_shapes(op, x_shape, kw):
+        """Parameter shapes of the layer ops, from the input shape + op
+        config — per-position ({arg_index: shape})."""
+        import numpy as _np_
+
+        if op == "fully_connected":
+            nh = int(kw.get("num_hidden"))
+            flat = kw.get("flatten", True)
+            in_f = int(_np_.prod(x_shape[1:])) if flat else int(x_shape[-1])
+            return {1: (nh, in_f), 2: (nh,)}
+        if op == "convolution":
+            nf = int(kw.get("num_filter"))
+            g = int(kw.get("num_group", 1) or 1)
+            kern = tuple(kw.get("kernel") or ())
+            return {1: (nf, int(x_shape[1]) // g) + kern, 2: (nf,)}
+        if op == "deconvolution":
+            nf = int(kw.get("num_filter"))
+            g = int(kw.get("num_group", 1) or 1)
+            kern = tuple(kw.get("kernel") or ())
+            return {1: (int(x_shape[1]), nf // g) + kern, 2: (nf,)}
+        if op == "batch_norm":
+            ax = int(kw.get("axis", 1))
+            c = (int(x_shape[ax]),)
+            return {1: c, 2: c, 3: c, 4: c}
+        if op in ("layer_norm", "group_norm", "instance_norm"):
+            ax = int(kw.get("axis", -1))
+            c = (int(x_shape[ax]),)
+            return {1: c, 2: c}
+        if op == "rms_norm":
+            return {1: (int(x_shape[int(kw.get('axis', -1))]),)}
+        if op == "embedding":
+            return {1: (int(kw.get("input_dim")),
+                        int(kw.get("output_dim")))}
+        return {}
+
     order = _walk(symbol)
     shapes = {}
     if shape is not None:
@@ -57,12 +93,32 @@ def print_summary(symbol, shape=None, line_length=98, positions=None):
 
         bindings = {k: mnp.array(onp.zeros(v, "float32"))
                     for k, v in shape.items()}
+        # reference-style partial inference: weight/bias/stat shapes of the
+        # layer ops are DERIVED from the data shape flowing forward (the
+        # role InferShape plays per-op in the reference), so
+        # print_summary(sym, shape={'data': ...}) works without listing
+        # every parameter
+        memo = {}
+        for node in order:
+            if node._op is None:
+                continue
+            unbound = [
+                (i, a) for i, a in enumerate(node._args)
+                if isinstance(a, Symbol) and a._op is None
+                and a.name not in bindings]
+            if unbound and node._args and isinstance(node._args[0], Symbol):
+                x = node._args[0]._eval_with(bindings, memo=memo)
+                derived = _derive_param_shapes(
+                    node._op, tuple(x.shape), node._kwargs)
+                for i, a in unbound:
+                    if i in derived:
+                        bindings[a.name] = mnp.array(
+                            onp.zeros(derived[i], "float32"))
         for node in order:
             if node._op is None and node.name not in bindings:
                 raise MXNetError(
-                    "shape= must cover every free variable; missing %r"
-                    % node.name)
-        memo = {}
+                    "shape= must cover every free variable and "
+                    "underivable parameter; missing %r" % node.name)
         symbol._eval_with(bindings, memo=memo)
         for node in order:
             out = memo.get(id(node))
@@ -86,12 +142,11 @@ def print_summary(symbol, shape=None, line_length=98, positions=None):
         out_shape = shapes.get(id(node), "")
         prev = ", ".join(_node_label(a) for a in node._args
                          if isinstance(a, Symbol))
-        # parameter count is only known for variables with given shapes
+        # parameter count: given OR derived variable shapes both count
         params = 0
-        if node._op is None and shape is not None \
-                and node.name in (shape or {}):
+        if node._op is None and shapes.get(id(node)):
             n = 1
-            for d in shape[node.name]:
+            for d in shapes[id(node)]:
                 n *= d
             params = n
         total += params

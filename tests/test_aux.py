@@ -270,3 +270,43 @@ def test_estimator_checkpoint(tmp_path):
     import os
 
     assert any(f.endswith(".params") for f in os.listdir(tmp_path))
+
+
+def test_profiler_device_op_stats_parses_trace(tmp_path):
+    """Per-op device table (reference aggregate_stats.cc role): parse a
+    chrome trace with device pid rows carrying device_duration_ps /
+    model_flops / bytes_accessed."""
+    import gzip
+    import json
+
+    from mxnet_tpu import profiler
+
+    d = tmp_path / "plugins" / "profile" / "2026_01_01"
+    d.mkdir(parents=True)
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 3, "tid": 1, "ts": 0, "dur": 5, "name": "fusion.1",
+         "args": {"device_duration_ps": "5000000",
+                  "model_flops": "1000000", "bytes_accessed": "2048",
+                  "hlo_category": "convolution fusion"}},
+        {"ph": "X", "pid": 3, "tid": 1, "ts": 9, "dur": 5, "name": "fusion.1",
+         "args": {"device_duration_ps": "5000000",
+                  "model_flops": "1000000", "bytes_accessed": "2048",
+                  "hlo_category": "convolution fusion"}},
+        {"ph": "X", "pid": 9, "tid": 1, "ts": 0, "dur": 99,
+         "name": "host_thing", "args": {}},
+    ]
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    rows = profiler.device_op_stats(str(tmp_path))
+    assert len(rows) == 1  # host events excluded
+    r = rows[0]
+    assert r["name"] == "fusion.1" and r["calls"] == 2
+    assert abs(r["total_us"] - 10.0) < 1e-9
+    assert r["flops"] == 2000000
+    assert r["tflops_s"] > 0 and r["gb_s"] > 0
+    table = profiler.device_op_table(str(tmp_path), by_category=True)
+    assert "convolution fusion" in table

@@ -200,3 +200,14 @@ def test_negative_global_step_varint():
     # must terminate and produce the 10-byte two's-complement int64 form
     enc = _varint(-1)
     assert len(enc) == 10 and enc[-1] == 0x01
+
+
+def test_print_summary_derives_param_shapes():
+    """Reference-style call: only the data shape given; layer-op parameter
+    shapes (fc weight/bias) are inferred forward from it."""
+    data = mx.sym.var("data")
+    w = mx.sym.var("fc_weight")
+    b = mx.sym.var("fc_bias")
+    out = mx.sym.tanh(mx.sym.fully_connected(data, w, b, num_hidden=16))
+    total = mx.visualization.print_summary(out, shape={"data": (2, 8)})
+    assert total == 2 * 8 + 16 * 8 + 16  # data + derived weight + bias

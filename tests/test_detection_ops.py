@@ -340,3 +340,30 @@ def test_multibox_target_negative_mining():
     assert ct[0] == 2.0            # matched -> class 1 + 1
     assert ct[1] == 0.0            # hardest negative kept (quota 1*1)
     assert ct[2] == -1.0 and ct[3] == -1.0  # mined away -> ignore_label
+
+
+def test_box_nms_center_in_corner_out():
+    d = onp.array([[0.9, 0.3, 0.3, 0.2, 0.2]], "float32")  # center fmt
+    out = npx.box_nms(np.array(d[None]), coord_start=1, score_index=0,
+                      in_format="center", out_format="corner").asnumpy()[0]
+    onp.testing.assert_allclose(out[0][1:], [0.2, 0.2, 0.4, 0.4],
+                                rtol=1e-5)
+
+
+def test_roi_align_position_sensitive():
+    """PS mode: bin (i,j) of out channel c reads in channel c*ph*pw+i*pw+j.
+    Constant-per-channel input makes the expectation exact."""
+    ph = pw = 2
+    c_out, H, W = 3, 4, 4
+    C = c_out * ph * pw
+    img = onp.zeros((1, C, H, W), "float32")
+    for c in range(C):
+        img[0, c] = c
+    rois = onp.array([[0, 0, 0, 4, 4]], "float32")
+    out = npx.roi_align(np.array(img), np.array(rois), pooled_size=(ph, pw),
+                        position_sensitive=True).asnumpy()
+    assert out.shape == (1, c_out, ph, pw)
+    for c in range(c_out):
+        for i in range(ph):
+            for j in range(pw):
+                assert out[0, c, i, j] == c * ph * pw + i * pw + j

@@ -35,3 +35,8 @@ def test_bert_finetune_tiny():
     out = _run("bert_finetune.py", "--steps", "8", "--batch-size", "8",
                "--seq-len", "32", "--layers", "1")
     assert "loss" in out
+
+
+def test_ssd_detection_tiny():
+    out = _run("ssd_detection.py", "--steps", "10", "--batch", "8")
+    assert "top detections" in out

@@ -195,13 +195,14 @@ def test_multinomial_zero_prob_category_logp():
 
 
 def test_binomial_kl_count_mismatch():
-    # disjoint support -> inf; n1 < n2 has no closed form -> error
+    # disjoint support -> inf; n1 < n2 has no closed form -> nan (decided
+    # inside the traced computation: no host sync, jit-safe)
     kl = prob.kl_divergence(prob.Binomial(10, prob=0.3),
                             prob.Binomial(5, prob=0.3))
     assert float(kl.asnumpy()) == np.inf
-    with pytest.raises(mx.MXNetError, match="no closed"):
-        prob.kl_divergence(prob.Binomial(5, prob=0.3),
-                           prob.Binomial(10, prob=0.3))
+    kl = prob.kl_divergence(prob.Binomial(5, prob=0.3),
+                            prob.Binomial(10, prob=0.3))
+    assert np.isnan(float(kl.asnumpy()))
 
 
 def test_glove_vocabulary_mode(tmp_path):
