@@ -98,7 +98,10 @@ def main():
         with autograd.record():
             _, cls_pred, loc_pred = net(x)
             box_t, box_m, cls_t = npx.multibox_target(anchors, y, cls_pred)
-            cls_l = ce(cls_pred, cls_t).mean()
+            # mask ignore_label (-1) anchors out of the classification
+            # loss (they appear once hard-negative mining is enabled)
+            valid = cls_t >= 0
+            cls_l = ce(cls_pred, cls_t * valid, sample_weight=valid).mean()
             # box_target is already zero-masked; mask the predictions the
             # same way so unmatched anchors contribute no location loss
             loc_l = l1(loc_pred * box_m, box_t).mean()

@@ -91,8 +91,6 @@ class SoftmaxCrossEntropyLoss(Loss):
             # Never materializes the (..., V) log-probability tensor — at
             # BERT's 30k-vocab MLM head the log_softmax+pick form costs
             # two extra HBM sweeps of a (B, T, V) array (profiled on v5e)
-            from ..ops.registry import apply as _op_apply
-
             def f(z, lab):
                 import jax
                 import jax.numpy as jnp
@@ -104,7 +102,7 @@ class SoftmaxCrossEntropyLoss(Loss):
                     axis=self._axis).squeeze(self._axis)
                 return lse - picked.astype(jnp.float32)
 
-            loss = _op_apply(f, (pred, label), name="softmax_ce_fused")
+            loss = _apply(f, (pred, label), name="softmax_ce_fused")
         else:
             if not self._from_logits:
                 logp = _nn.log_softmax(pred, axis=self._axis)

@@ -808,12 +808,10 @@ class ImageDetIter:
         header_w = int(raw[0])
         obj_w = int(raw[1])
         body = raw[header_w:]
-        if body.size % obj_w:
-            raise MXNetError(
-                f"ImageDetIter label body of {body.size} values does not "
-                f"divide into obj_width={obj_w} rows (corrupt record?)")
+        # trailing partial values are tail padding in fixed-width label
+        # records: truncate to whole object rows
         n = body.size // obj_w
-        rows = body.reshape(n, obj_w)
+        rows = body[:n * obj_w].reshape(n, obj_w)
         if obj_w < self._label_width:
             # narrow object rows pad with -1 to label_width (reference
             # pads missing extras rather than shrinking the batch array)

@@ -72,6 +72,11 @@ def _to_jax(value, dtype=None, ctx: Context = None):
     return jax.device_put(host, dev)
 
 
+# per-function signature facts for __array_function__ kwarg screening:
+# (has_varkw, parameter-name set)
+_SIG_CACHE = {}
+
+
 class NDArray:
     """Mutable array handle; also serves as ``mx.np.ndarray``."""
 
@@ -349,18 +354,24 @@ class NDArray:
             # fall back to host numpy ONLY for kwargs our implementation
             # doesn't take (out=/where=/order=...), decided up front — a
             # blanket TypeError catch would silently recompute genuine
-            # user errors on host and hand back a numpy array
-            import inspect
+            # user errors on host and hand back a numpy array. Signature
+            # facts are cached per function: this is a hot interop path.
+            facts = _SIG_CACHE.get(ours)
+            if facts is None:
+                import inspect
 
-            try:
-                sig = inspect.signature(ours)
-                has_varkw = any(
-                    p.kind is inspect.Parameter.VAR_KEYWORD
-                    for p in sig.parameters.values())
-                unsupported = not has_varkw and any(
-                    k not in sig.parameters for k in kwargs)
-            except (TypeError, ValueError):  # builtins without signatures
-                unsupported = False
+                try:
+                    sig = inspect.signature(ours)
+                    has_varkw = any(
+                        p.kind is inspect.Parameter.VAR_KEYWORD
+                        for p in sig.parameters.values())
+                    facts = (has_varkw, frozenset(sig.parameters))
+                except (TypeError, ValueError):  # builtins w/o signatures
+                    facts = (True, frozenset())
+                _SIG_CACHE[ours] = facts
+            has_varkw, param_names = facts
+            unsupported = not has_varkw and any(
+                k not in param_names for k in kwargs)
             if not unsupported:
                 return ours(*args, **kwargs)
         host = [a.asnumpy() if isinstance(a, NDArray) else a for a in args]

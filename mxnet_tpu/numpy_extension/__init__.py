@@ -17,6 +17,7 @@ from ..ops.detection import (  # noqa: F401
     multibox_prior,
     multibox_target,
     roi_align,
+    roi_pooling,
 )
 from ..ops.spatial import (  # noqa: F401
     correlation,
@@ -107,5 +108,5 @@ __all__ = [n for n in dir(_nn) if not n.startswith("_")] + [
     "to_dlpack_for_write", "bernoulli", "normal_n", "uniform_n",
     "grid_generator", "bilinear_sampler", "spatial_transformer",
     "multibox_prior", "multibox_target", "multibox_detection", "box_nms",
-    "roi_align", "correlation", "deformable_convolution",
+    "roi_align", "roi_pooling", "correlation", "deformable_convolution",
 ]

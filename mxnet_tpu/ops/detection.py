@@ -335,6 +335,64 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
                    force_suppress=force_suppress)
 
 
+def roi_pooling(data, rois, pooled_size, spatial_scale=1.0):
+    """Classic max ROIPooling (reference ``src/operator/roi_pooling.cc``):
+    ROI coords are rounded to the feature grid, each output bin max-pools
+    its quantized pixel span; empty bins yield 0.
+
+    TPU formulation: instead of per-bin dynamic slices (data-dependent
+    sizes don't jit), every pixel computes its bin index and a masked
+    scatter-max accumulates — one static-shape pass per ROI.
+    """
+    import jax
+
+    jnp = _jnp()
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+
+    def f(x, r):
+        B, C, H, W = x.shape
+
+        def one_roi(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+            roi_h = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+            roi_w = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+            # reference bin spans OVERLAP: bin b covers
+            # [floor(b*roi/p), ceil((b+1)*roi/p)) — a pixel can belong to
+            # two adjacent bins, so membership is a (bins, pixels) mask,
+            # not an inverse map
+            hs = jnp.arange(H)
+            ws = jnp.arange(W)
+            bh = jnp.arange(ph).astype(jnp.float32)
+            bw = jnp.arange(pw).astype(jnp.float32)
+            h_rel = (hs - y1)[None, :]
+            w_rel = (ws - x1)[None, :]
+            mh = ((h_rel >= jnp.floor(bh[:, None] * roi_h / ph))
+                  & (h_rel < jnp.ceil((bh[:, None] + 1) * roi_h / ph))
+                  & (hs >= y1)[None, :] & (hs <= y2)[None, :])  # (ph, H)
+            mw = ((w_rel >= jnp.floor(bw[:, None] * roi_w / pw))
+                  & (w_rel < jnp.ceil((bw[:, None] + 1) * roi_w / pw))
+                  & (ws >= x1)[None, :] & (ws <= x2)[None, :])  # (pw, W)
+            img = x[bidx]  # (C, H, W)
+            neg = jnp.finfo(img.dtype).min
+            # two-stage masked max: over W per bw, then over H per bh
+            tmp = jnp.max(
+                jnp.where(mw[None, None], img[:, :, None, :], neg),
+                axis=-1)  # (C, H, pw)
+            out = jnp.max(
+                jnp.where(mh[None, :, :, None], tmp[:, None], neg),
+                axis=2)  # (C, ph, pw)
+            return jnp.where(out == neg, 0.0, out)
+
+        return jax.vmap(one_roi)(r)
+
+    return _apply(f, (data, rois), name="roi_pooling")
+
+
 # ---------------------------------------------------------------------------
 # roi_align
 # ---------------------------------------------------------------------------

@@ -242,18 +242,19 @@ class ShardedTrainer:
                 lambda shape, _a=pp_axis: _P()(
                     _a, *([None] * (len(shape) - 1))))] + list(self.rules.rules)
             self.rules = rules_copy
-            self._train_names = [
-                n for n in params
-                if n.startswith("pp::") or params_od[n].grad_req != "null"]
-            self._state_names = [
-                n for n in params
-                if not n.startswith("pp::")
-                and params_od[n].grad_req == "null"]
+            # frozen leaves (intentionally grad_req='null' weights,
+            # Constants) flow through the step as inputs but are returned
+            # un-updated — see the skip in the compiled step
+            self._frozen_names = set(
+                self._pp_meta.pop("__frozen__", set()))
+            self._train_names = list(params)
+            self._state_names = []
             self.optimizer.param_dict = {
                 i: params_od[n]
                 for i, n in enumerate(self._train_names)
                 if n in params_od}
         else:
+            self._frozen_names = set()
             self._apply_fn, params = functionalize(block, train_mode=True)
             params_od = block.collect_params()
             self._train_names = [n for n in params
@@ -371,7 +372,14 @@ class ShardedTrainer:
                                        labels, key)
             new_train = {}
             new_opt = {}
+            frozen = self._frozen_names
             for i, n in enumerate(train_names):
+                if n in frozen:
+                    # frozen leaf: participates in forward/backward but
+                    # the optimizer never moves it
+                    new_train[n] = train_params[n]
+                    new_opt[n] = opt_states[n]
+                    continue
                 g = grads[n].astype(train_params[n].dtype)
                 # ZeRO discipline: pin the grad to the PARAM's sharding
                 # before the update. For fsdp-sharded params this makes the
@@ -594,6 +602,8 @@ class ShardedTrainer:
 
         params_od = self.block.collect_params()
         for n, arr in self.params.items():
+            if n.startswith("__"):
+                continue
             if self._pp_meta is not None and n.startswith("pp::"):
                 import jax
 

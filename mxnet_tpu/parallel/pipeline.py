@@ -194,19 +194,40 @@ class PipelinedBlock:
         all_od = self.collect_params()
         id2name = {id(p): n for n, p in all_od.items()}
 
+        def _is_running_stat(block_or_list, pname):
+            # BatchNorm-style state is identified by its layer, not by
+            # grad_req: frozen (grad_req='null') ordinary weights and
+            # Constants are legitimate and handled as non-trained leaves
+            from ..gluon.nn.basic_layers import BatchNorm
+
+            blocks = block_or_list if isinstance(block_or_list, list) \
+                else [block_or_list]
+            for b in blocks:
+                stack = [b]
+                while stack:
+                    cur = stack.pop()
+                    for p in getattr(cur, "_reg_params", {}).values():
+                        if p is pname and isinstance(cur, BatchNorm):
+                            return True
+                    stack.extend(getattr(cur, "_children", {}).values())
+            return False
+
+        frozen = set()
         outer = [b for b in (self._prefix, self._suffix) if b is not None]
         outer_names = []
         outer_params = []
         for b in outer:
             for p in b.collect_params().values():
-                outer_names.append(id2name[id(p)])
+                n = id2name[id(p)]
+                outer_names.append(n)
                 outer_params.append(p)
-        for n, p in zip(outer_names, outer_params):
-            if p.grad_req == "null":
-                raise MXNetError(
-                    "PipelinedBlock does not support mutable-state layers "
-                    f"(BatchNorm running stats: {n}) in prefix/suffix; use "
-                    "stateless normalization (LayerNorm)")
+                if p.grad_req == "null":
+                    if _is_running_stat(b, p):
+                        raise MXNetError(
+                            "PipelinedBlock does not support mutable-state "
+                            f"layers (BatchNorm running stats: {n}); use "
+                            "stateless normalization (LayerNorm)")
+                    frozen.add(n)  # intentionally frozen: carried untrained
         outer_arrays = [p.data() for p in outer_params]
 
         layer_ods = [b.collect_params() for b in self._body]
@@ -215,14 +236,19 @@ class PipelinedBlock:
             if list(od) != rel_keys:
                 raise MXNetError(
                     "pipeline layers are not structurally uniform")
-        for od in layer_ods:
+        for b, od in zip(self._body, layer_ods):
             for k, p in od.items():
                 if p.grad_req == "null":
-                    raise MXNetError(
-                        "PipelinedBlock does not support mutable-state "
-                        f"layers (BatchNorm running stats: {k}) in the "
-                        "pipeline body; use stateless normalization "
-                        "(LayerNorm)")
+                    if _is_running_stat(b, p):
+                        raise MXNetError(
+                            "PipelinedBlock does not support mutable-state "
+                            f"layers (BatchNorm running stats: {k}) in the "
+                            "pipeline body; use stateless normalization "
+                            "(LayerNorm)")
+                    # frozen body param: the whole stacked leaf is frozen
+                    # (conservative — any layer frozen freezes the leaf,
+                    # since one leaf updates as a unit)
+                    frozen.add(f"pp::{k}")
         layer0 = self._body[0]
         layer0_arrays = [p.data() for p in layer_ods[0].values()]
 
@@ -280,6 +306,7 @@ class PipelinedBlock:
                 autograd.set_recording(prev_rec)
                 _rng.pop_trace_rng()
 
+        meta["__frozen__"] = frozen
         return apply_fn, params, meta
 
 

@@ -11,7 +11,6 @@ linearly with ring size.
 """
 from __future__ import annotations
 
-import functools
 import math
 
 from ..base import MXNetError

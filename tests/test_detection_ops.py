@@ -367,3 +367,44 @@ def test_roi_align_position_sensitive():
         for i in range(ph):
             for j in range(pw):
                 assert out[0, c, i, j] == c * ph * pw + i * pw + j
+
+
+def test_roi_pooling_oracle():
+    """Overlapping floor/ceil bin spans vs a python loop oracle — the
+    reference roi_pooling.cc bin geometry (a pixel can land in TWO
+    adjacent bins when roi size doesn't divide pooled size)."""
+    rng = onp.random.RandomState(9)
+    H = W = 7
+    img = rng.randn(1, 2, H, W).astype("float32")
+    rois = onp.array([[0, 1, 1, 3, 3]], "float32")  # roi 3x3 -> bins 2x2
+    ph = pw = 2
+    out = npx.roi_pooling(np.array(img), np.array(rois),
+                          pooled_size=(ph, pw), spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 2, ph, pw)
+    x1, y1, x2, y2 = 1, 1, 3, 3
+    roi_h, roi_w = y2 - y1 + 1, x2 - x1 + 1
+    import math as _m
+
+    for c in range(2):
+        acc = onp.full((ph, pw), -onp.inf)
+        for bh in range(ph):
+            for bw in range(pw):
+                h0 = y1 + _m.floor(bh * roi_h / ph)
+                h1 = y1 + _m.ceil((bh + 1) * roi_h / ph)
+                w0 = x1 + _m.floor(bw * roi_w / pw)
+                w1 = x1 + _m.ceil((bw + 1) * roi_w / pw)
+                for h in range(h0, min(h1, y2 + 1)):
+                    for w in range(w0, min(w1, x2 + 1)):
+                        acc[bh, bw] = max(acc[bh, bw], img[0, c, h, w])
+        expect = onp.where(onp.isinf(acc), 0, acc)
+        onp.testing.assert_allclose(out[0, c], expect, rtol=1e-6)
+
+
+def test_roi_pooling_empty_bin_zero():
+    img = onp.ones((1, 1, 8, 8), "float32")
+    # 1-pixel roi pooled to 2x2: three bins are empty -> 0
+    rois = onp.array([[0, 2, 2, 2, 2]], "float32")
+    out = npx.roi_pooling(np.array(img), np.array(rois),
+                          pooled_size=2).asnumpy()[0, 0]
+    assert out[0, 0] == 1.0
+    assert (out.reshape(-1)[1:] >= 0).all()

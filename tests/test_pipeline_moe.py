@@ -268,3 +268,44 @@ def test_pipelined_block_sync_to_block_roundtrip():
     assert any(
         onp.abs(onp.asarray(v)).sum() > 0
         for k, v in tr.params.items() if k.startswith("pp::"))
+
+
+def test_pipelined_block_frozen_layer_not_updated():
+    """grad_req='null' body layers are carried as frozen leaves: they
+    flow through forward/backward but the optimizer never moves them
+    (and no misleading BatchNorm error is raised)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.parallel import PipelinedBlock, ShardedTrainer, \
+        ShardingRules, make_mesh
+
+    D = 8
+
+    class Lay(gluon.block.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.f = gluon.nn.Dense(D, flatten=False)
+
+        def forward(self, x):
+            return x + self.f(x)
+
+    mx.random.seed(5)
+    net = PipelinedBlock([Lay() for _ in range(2)])
+    net.initialize()
+    x = onp.random.randn(4, D).astype("float32")
+    with autograd.predict_mode():
+        net(mnp.array(x))
+    # freeze the whole body (standard fine-tune workflow)
+    net.collect_params().setattr("grad_req", "null")
+    tr = ShardedTrainer(net, gluon.loss.L2Loss(), "sgd",
+                        {"learning_rate": 0.5},
+                        mesh=make_mesh({"pp": 2}),
+                        rules=ShardingRules(default_axis=None))
+    before = {k: onp.asarray(v).copy() for k, v in tr.params.items()}
+    tr.step(x, onp.zeros((4, D), "float32"))
+    for k, v in tr.params.items():
+        onp.testing.assert_array_equal(onp.asarray(v), before[k],
+                                       err_msg=f"frozen {k} moved")
