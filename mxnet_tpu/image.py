@@ -808,10 +808,16 @@ class ImageDetIter:
         header_w = int(raw[0])
         obj_w = int(raw[1])
         body = raw[header_w:]
-        # trailing partial values are tail padding in fixed-width label
-        # records: truncate to whole object rows
+        if body.size % obj_w:
+            # reference ImageDetIter raises here: a body that doesn't
+            # divide into object rows means a corrupt/mis-written record,
+            # and silently dropping the partial object trains on wrong
+            # ground truth
+            raise MXNetError(
+                f"ImageDetIter label body of {body.size} values does not "
+                f"divide into obj_width={obj_w} rows (corrupt record?)")
         n = body.size // obj_w
-        rows = body[:n * obj_w].reshape(n, obj_w)
+        rows = body.reshape(n, obj_w)
         if obj_w < self._label_width:
             # narrow object rows pad with -1 to label_width (reference
             # pads missing extras rather than shrinking the batch array)

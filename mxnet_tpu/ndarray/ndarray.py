@@ -634,6 +634,13 @@ class NDArray:
         return _apply(lambda x: _jnp().round(x, decimals), (self,), name="round")
 
     def dot(self, other):
+        # sparse operands route to the O(nnz) kernels (reference mx.nd.dot
+        # dispatches on stype the same way, src/operator/tensor/dot-inl.h)
+        if getattr(self, "_stype", "default") != "default" or \
+                getattr(other, "_stype", "default") != "default":
+            from .sparse import dot as _sparse_dot
+
+            return _sparse_dot(self, other)
         return self._binop(other, _jnp().dot, "dot")
 
     def norm(self, ord=None, axis=None, keepdims=False):

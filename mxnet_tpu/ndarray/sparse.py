@@ -253,11 +253,21 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     nonzero and segment-sum into the output — scatter-add is an XLA-native
     op the compiler vectorizes; there is no SpMV kernel to hand-write.
     Dense inputs route to the ordinary dense dot.
+
+    Autograd: the DENSE operand's gradient is itself an O(nnz) sparse dot
+    (d/dW dot(csr, W) = dot(csr^T, cotangent), the exact pairing
+    dot-inl.h registers); a recorded call puts that vjp on the tape. A
+    tracked SPARSE operand storage-falls-back to the dense recorded path.
     """
+    from .. import autograd
+    from .ndarray import _slot_of, _tracked
+
     jnp = _jnp()
     if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
         if transpose_b:
             raise MXNetError("dot(csr, dense, transpose_b=True) unsupported")
+        if autograd.is_recording() and _tracked(lhs):
+            return NDArray(lhs._data).dot(rhs)  # dense fallback, recorded
         rows = _csr_row_ids(lhs)
         cols = lhs.indices._data.astype(jnp.int64)
         vals = lhs.values._data
@@ -272,10 +282,24 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
             contrib = vals[:, None] * r[cols]
             out = jnp.zeros((lhs.shape[0], r.shape[1]),
                             contrib.dtype).at[rows].add(contrib)
-        return NDArray(out)
+        out_nd = NDArray(out)
+        if autograd.is_recording() and _tracked(rhs):
+            csr, ta = lhs, transpose_a
+
+            def vjp_fn(ct):
+                g = dot(csr, NDArray(ct), transpose_a=not ta)
+                return (None, g._data)
+
+            node = autograd.TapeNode(
+                vjp_fn, [None, _slot_of(rhs)],
+                [(out_nd.shape, out_nd.dtype)], name="sparse_dot")
+            out_nd._tape = (node, 0)
+        return out_nd
     if isinstance(rhs, CSRNDArray) and not isinstance(lhs, BaseSparseNDArray):
         if transpose_a or transpose_b:
             raise MXNetError("dot(dense, csr, transpose_*) unsupported")
+        if autograd.is_recording() and _tracked(rhs):
+            return lhs.dot(NDArray(rhs._data))  # dense fallback, recorded
         rows = _csr_row_ids(rhs)
         cols = rhs.indices._data.astype(jnp.int64)
         vals = rhs.values._data
@@ -284,7 +308,21 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         contrib = ld[:, rows] * vals[None, :]
         out = jnp.zeros((ld.shape[0], rhs.shape[1]),
                         contrib.dtype).at[:, cols].add(contrib)
-        return NDArray(out)
+        out_nd = NDArray(out)
+        if autograd.is_recording() and _tracked(lhs):
+            csr = rhs
+
+            def vjp_fn(ct):
+                # d(lhs) = ct @ csr^T = (csr @ ct^T)^T — the csr-lhs
+                # kernel again, O(nnz · m)
+                g = dot(csr, NDArray(jnp.swapaxes(ct, 0, 1)))
+                return (jnp.swapaxes(g._data, 0, 1), None)
+
+            node = autograd.TapeNode(
+                vjp_fn, [_slot_of(lhs), None],
+                [(out_nd.shape, out_nd.dtype)], name="sparse_dot")
+            out_nd._tape = (node, 0)
+        return out_nd
     # dense–dense (or row_sparse: storage-fallback)
     a = lhs._data if hasattr(lhs, "_data") else jnp.asarray(lhs)
     b = rhs._data if hasattr(rhs, "_data") else jnp.asarray(rhs)

@@ -927,6 +927,99 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):  # pylint: disa
     return NDArray(jnp.arange(n) * step + start)
 
 
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """Piecewise-linear sigmoid (reference ``HardSigmoid`` in
+    ``src/operator/nn/activation``-adjacent LeakyReLU family)."""
+    jnp = _jnp()
+    return _apply(lambda x: jnp.clip(alpha * x + beta, 0.0, 1.0), (data,),
+                  name="hard_sigmoid")
+
+
+def gamma(data):
+    """Elementwise gamma function Γ(x) (reference ``nd.gamma``,
+    ``src/operator/tensor/elemwise_unary_op``)."""
+
+    def f(x):
+        import jax.scipy.special as jsp
+
+        jnp = _jnp()
+        # Γ via lgamma: |Γ(x)| = exp(lgamma(x)); gammasgn restores the
+        # alternating sign on the negative axis
+        mag = jnp.exp(jsp.gammaln(x))
+        return jsp.gammasgn(x) * mag if hasattr(jsp, "gammasgn") else mag
+
+    return _apply(f, (data,), name="gamma")
+
+
+def gammaln(data):
+    def f(x):
+        import jax.scipy.special as jsp
+
+        return jsp.gammaln(x)
+
+    return _apply(f, (data,), name="gammaln")
+
+
+def erfinv(data):
+    import jax
+
+    return _apply(jax.lax.erf_inv, (data,), name="erfinv")
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """Copy rows of ``new_tensor`` into ``old_tensor`` at ``index_vector``
+    (reference ``src/operator/contrib/index_copy.cc``)."""
+    jnp = _jnp()
+
+    def f(old, idx, new):
+        return old.at[idx.astype(jnp.int32)].set(new)
+
+    return _apply(f, (old_tensor, index_vector, new_tensor),
+                  name="index_copy")
+
+
+def index_array(data, axes=None):
+    """Element-index grid of ``data``'s shape (reference
+    ``src/operator/contrib/index_array.cc``): out[..., k] = index along
+    the k-th listed axis."""
+    jnp = _jnp()
+    axes_t = tuple(axes) if axes is not None else None
+
+    def f(x):
+        sel = axes_t if axes_t is not None else tuple(range(x.ndim))
+        grids = [jnp.broadcast_to(
+            jnp.arange(x.shape[a]).reshape(
+                [-1 if i == a else 1 for i in range(x.ndim)]), x.shape)
+            for a in sel]
+        return jnp.stack(grids, axis=-1).astype(jnp.int64)
+
+    return _apply(f, (data,), name="index_array", record=False)
+
+
+def boolean_mask(data, index, axis=0):
+    """Select slices where ``index`` is nonzero (reference
+    ``src/operator/contrib/boolean_mask.cc``). Output size is
+    data-dependent, so this op is EAGER-ONLY (SURVEY §7 hard part 3) —
+    inside jit use ``jnp.where``-style masking instead."""
+    import jax
+    import numpy as onp
+
+    from ..base import MXNetError
+    from ..ndarray.ndarray import NDArray
+
+    d = data._data if isinstance(data, NDArray) else data
+    m = index._data if isinstance(index, NDArray) else index
+    if isinstance(d, jax.core.Tracer) or isinstance(m, jax.core.Tracer):
+        raise MXNetError(
+            "boolean_mask has a data-dependent output shape and cannot run "
+            "under jit/hybridize; use arithmetic masking inside traces")
+    keep = onp.nonzero(onp.asarray(m) != 0)[0]
+    jnp = _jnp()
+    return _apply(
+        lambda x: jnp.take(x, jnp.asarray(keep), axis=axis), (data,),
+        name="boolean_mask", cacheable=False)
+
+
 # register the public ops in the global registry for list_ops parity
 for _name in (
     "activation", "fully_connected", "convolution", "deconvolution", "pooling",
@@ -935,5 +1028,7 @@ for _name in (
     "one_hot", "pick", "topk", "sequence_mask", "sequence_last",
     "sequence_reverse", "ctc_loss", "attention", "leaky_relu", "relu",
     "sigmoid", "tanh", "batch_dot", "gather_nd", "scatter_nd", "concat",
+    "hard_sigmoid", "gamma", "gammaln", "erfinv", "index_copy",
+    "index_array", "boolean_mask",
 ):
     _register(_name, globals()[_name], wrapper=True)

@@ -287,6 +287,11 @@ class ShardedTrainer:
 
         states = {}
         for i, n in enumerate(self._train_names):
+            if n in self._frozen_names:
+                # frozen leaves are never updated: no momentum/variance
+                # buffers (they'd waste 2x the frozen size in HBM)
+                states[n] = ()
+                continue
             w = NDArray(self.params[n])
             st = self.optimizer.create_state_multi_precision(i, w)
             flat = [s._data for s in _flatten_state(st)]

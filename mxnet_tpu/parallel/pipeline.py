@@ -236,6 +236,7 @@ class PipelinedBlock:
             if list(od) != rel_keys:
                 raise MXNetError(
                     "pipeline layers are not structurally uniform")
+        frozen_count = {}
         for b, od in zip(self._body, layer_ods):
             for k, p in od.items():
                 if p.grad_req == "null":
@@ -245,10 +246,18 @@ class PipelinedBlock:
                             f"layers (BatchNorm running stats: {k}) in the "
                             "pipeline body; use stateless normalization "
                             "(LayerNorm)")
-                    # frozen body param: the whole stacked leaf is frozen
-                    # (conservative — any layer frozen freezes the leaf,
-                    # since one leaf updates as a unit)
-                    frozen.add(f"pp::{k}")
+                    frozen_count[k] = frozen_count.get(k, 0) + 1
+        for k, c in frozen_count.items():
+            if c != len(self._body):
+                # one stacked leaf updates as a unit: freezing SOME layers
+                # of it cannot be honored — reject loudly rather than
+                # silently freezing the rest
+                raise MXNetError(
+                    f"pipeline body param {k!r} is frozen in {c} of "
+                    f"{len(self._body)} layers; freezing must be uniform "
+                    "across the pipeline body (one stacked leaf trains as "
+                    "a unit)")
+            frozen.add(f"pp::{k}")
         layer0 = self._body[0]
         layer0_arrays = [p.data() for p in layer_ods[0].values()]
 

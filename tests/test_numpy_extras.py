@@ -225,3 +225,49 @@ def test_npx_framework_extras(tmp_path):
     mx.npx.save(str(tmp_path / "x.nd"), {"w": a})
     onp.testing.assert_allclose(
         mx.npx.load(str(tmp_path / "x.nd"))["w"].asnumpy(), [1, 2])
+
+
+def test_npx_contrib_op_additions():
+    """gamma/gammaln/erfinv/hard_sigmoid/index_copy/index_array/
+    boolean_mask (reference contrib + unary families)."""
+    import scipy.special as ssp
+
+    from mxnet_tpu import npx
+
+    x = np.array(onp.array([0.5, 1.5, 3.0], "float32"))
+    onp.testing.assert_allclose(npx.gamma(x).asnumpy(),
+                                ssp.gamma([0.5, 1.5, 3.0]), rtol=1e-5)
+    onp.testing.assert_allclose(npx.gammaln(x).asnumpy(),
+                                ssp.gammaln([0.5, 1.5, 3.0]), rtol=1e-5)
+    onp.testing.assert_allclose(
+        npx.erfinv(np.array(onp.array([0.1, 0.5], "float32"))).asnumpy(),
+        ssp.erfinv([0.1, 0.5]), rtol=1e-5)
+    onp.testing.assert_allclose(
+        npx.hard_sigmoid(
+            np.array(onp.array([-5.0, 0.0, 5.0], "float32"))).asnumpy(),
+        [0.0, 0.5, 1.0], atol=1e-6)
+    old = np.array(onp.zeros((5, 3), "float32"))
+    new = np.array(onp.ones((2, 3), "float32"))
+    idx = np.array(onp.array([1, 3], "int64"))
+    got = npx.index_copy(old, idx, new).asnumpy()
+    assert got[1].sum() == 3 and got[3].sum() == 3 and got[0].sum() == 0
+    ia = npx.index_array(np.array(onp.zeros((2, 3), "float32"))).asnumpy()
+    assert ia.shape == (2, 3, 2) and ia[1, 2].tolist() == [1, 2]
+    bm = npx.boolean_mask(
+        np.array(onp.arange(12).reshape(4, 3).astype("float32")),
+        np.array(onp.array([1, 0, 1, 0]))).asnumpy()
+    assert bm.shape == (2, 3) and bm[1, 0] == 6
+
+
+def test_boolean_mask_rejects_jit():
+    import jax
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import npx
+
+    def traced(x):
+        return npx.boolean_mask(x, x > 0)
+
+    with pytest.raises(mx.MXNetError, match="data-dependent"):
+        jax.jit(traced)(onp.ones((4,), "float32"))

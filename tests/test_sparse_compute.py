@@ -193,3 +193,55 @@ def test_sparse_grad_falls_back_dense_under_hybridize():
     gn = g.asnumpy() if not isinstance(g, RowSparseNDArray) \
         else g.tostype("default").asnumpy()
     assert gn[1].sum() != 0 and gn[0].sum() == 0
+
+
+def test_ndarray_dot_dispatches_sparse():
+    """a.dot(b) with a CSR operand routes to the O(nnz) kernel (the
+    reference's stype dispatch in mx.nd.dot)."""
+    rng = onp.random.RandomState(5)
+    a = _rand_csr(rng, 4, 20)
+    b = np.array(rng.randn(20, 3).astype("float32"))
+    out = a.dot(b)
+    out.asnumpy()
+    assert not a.is_materialized()
+    onp.testing.assert_allclose(
+        out.asnumpy(), a.tostype("default").asnumpy() @ b.asnumpy(),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_dot_gradient_to_dense_operand():
+    """dot(csr, W) under autograd: W's gradient = dot(csr^T, ct), an
+    O(nnz) sparse kernel on the tape (dot-inl.h backward pairing)."""
+    from mxnet_tpu import autograd
+
+    rng = onp.random.RandomState(11)
+    a = _rand_csr(rng, 5, 12)
+    w = np.array(rng.randn(12, 3).astype("float32"))
+    w.attach_grad()
+    with autograd.record():
+        out = a.dot(w)
+        loss = (out * out).sum()
+    loss.backward()
+    assert not a.is_materialized()
+    ad = a.tostype("default").asnumpy()
+    expect = 2 * ad.T @ (ad @ w.asnumpy())
+    onp.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_dense_dot_csr_gradient_to_dense_operand():
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray import sparse as sp
+
+    rng = onp.random.RandomState(12)
+    b = _rand_csr(rng, 6, 9)
+    a = np.array(rng.randn(4, 6).astype("float32"))
+    a.attach_grad()
+    with autograd.record():
+        out = sp.dot(a, b)
+        loss = out.sum()
+    loss.backward()
+    assert not b.is_materialized()
+    bd = b.tostype("default").asnumpy()
+    onp.testing.assert_allclose(a.grad.asnumpy(),
+                                onp.ones((4, 9)) @ bd.T, rtol=1e-4)
