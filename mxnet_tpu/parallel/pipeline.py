@@ -129,11 +129,17 @@ class PipelinedBlock:
     _pp_axis = "pp"
 
     def __init__(self, layers, prefix=None, suffix=None, axis="pp",
-                 num_microbatches=None):
+                 num_microbatches=None, remat=False):
         from ..gluon.nn import HybridSequential
 
         self._pp_axis = axis
         self._num_microbatches = num_microbatches
+        # remat=True wraps each stage application in jax.checkpoint:
+        # activations recompute in backward instead of being stored per
+        # pipeline tick — the peak-activation-memory benefit 1F1B exists
+        # for, delivered compiler-natively (the GPipe bubble itself is
+        # schedule-equivalent: (S-1)/(M+S-1) either way)
+        self._remat = remat
         self._body = list(layers)
         if not self._body:
             raise MXNetError("PipelinedBlock needs at least one layer")
@@ -278,14 +284,21 @@ class PipelinedBlock:
         prefix, suffix = self._prefix, self._suffix
         num_mb = self._num_microbatches
 
+        def _one_layer(tracer_list, h):
+            with _ParamBinding(layer0_arrays, list(tracer_list)):
+                return layer0.forward(NDArray(h))._data
+
+        if self._remat:
+            _one_layer = jax.checkpoint(_one_layer)
+
         def stage_fn(pslice, mb):
             # pslice leaves: (per_stage, ...) — apply the per_stage layers
             # this device owns, sequentially, re-binding layer0's arrays
             h = mb
             for li in range(per_stage):
-                tracers = [pslice[f"pp::{rel}"][li] for rel in rel_keys]
-                with _ParamBinding(layer0_arrays, tracers):
-                    h = layer0.forward(NDArray(h))._data
+                tracers = tuple(
+                    pslice[f"pp::{rel}"][li] for rel in rel_keys)
+                h = _one_layer(tracers, h)
             return h
 
         def apply_fn(param_datas, x, rng_key=None):
