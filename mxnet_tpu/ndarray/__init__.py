@@ -14,6 +14,18 @@ from . import sparse
 ndarray = NDArray
 
 
+def __getattr__(name):
+    # lazy: nd.contrib pulls in the quantization/detection modules, which
+    # must not load during core-array import
+    if name == "contrib":
+        import importlib
+
+        mod = importlib.import_module(".contrib", __name__)
+        globals()["contrib"] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute {name!r}")
+
+
 def _populate():
     """Fill mx.nd with the np-style functions + legacy-name aliases."""
     from .. import numpy as _mxnp

@@ -1,0 +1,74 @@
+"""``mx.nd.contrib`` namespace (reference ``python/mxnet/ndarray/contrib.py``
+plus the generated contrib op surface): both the reference's CamelCase op
+names (``MultiBoxPrior``) and the snake_case forms resolve to the same
+TPU-native kernels in ``ops/detection.py`` / ``ops/spatial.py``.
+"""
+from __future__ import annotations
+
+from ..contrib.quantization import dequantize, quantize, requantize  # noqa: F401
+from ..ops.detection import (  # noqa: F401
+    box_nms,
+    multibox_detection,
+    multibox_prior,
+    multibox_target,
+    roi_align,
+    roi_pooling,
+)
+from ..ops.nn import (  # noqa: F401
+    arange_like,
+    boolean_mask,
+    erfinv,
+    index_array,
+    index_copy,
+)
+from ..ops.spatial import (  # noqa: F401
+    bilinear_sampler,
+    correlation,
+    deformable_convolution,
+    grid_generator,
+    spatial_transformer,
+)
+
+# reference CamelCase aliases (the C-registry names the generated
+# nd.contrib module exposed)
+MultiBoxPrior = multibox_prior
+MultiBoxTarget = multibox_target
+MultiBoxDetection = multibox_detection
+ROIAlign = roi_align
+ROIPooling = roi_pooling
+DeformableConvolution = deformable_convolution
+Correlation = correlation
+BilinearResize2D = None  # set below
+SpatialTransformer = spatial_transformer
+
+
+def _bilinear_resize2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, **kwargs):  # pylint: disable=unused-argument
+    """``contrib.BilinearResize2D`` (reference
+    ``src/operator/contrib/bilinear_resize.cc``): bilinear up/downsample
+    of NCHW maps via jax.image.resize."""
+    from ..ops.registry import apply as _apply
+
+    def f(x):
+        import jax
+
+        h = int(height) if height else int(round(x.shape[2] * scale_height))
+        w = int(width) if width else int(round(x.shape[3] * scale_width))
+        return jax.image.resize(x, x.shape[:2] + (h, w), method="bilinear")
+
+    return _apply(f, (data,), name="bilinear_resize2d")
+
+
+BilinearResize2D = _bilinear_resize2d
+bilinear_resize_2d = _bilinear_resize2d
+
+__all__ = [
+    "quantize", "dequantize", "requantize", "box_nms", "multibox_prior",
+    "multibox_target", "multibox_detection", "roi_align", "roi_pooling",
+    "arange_like", "boolean_mask", "erfinv", "index_array", "index_copy",
+    "bilinear_sampler", "correlation", "deformable_convolution",
+    "grid_generator", "spatial_transformer", "MultiBoxPrior",
+    "MultiBoxTarget", "MultiBoxDetection", "ROIAlign", "ROIPooling",
+    "DeformableConvolution", "Correlation", "SpatialTransformer",
+    "BilinearResize2D", "bilinear_resize_2d",
+]

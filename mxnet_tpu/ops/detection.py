@@ -20,6 +20,7 @@ from __future__ import annotations
 import math
 
 from .registry import apply as _apply
+from .registry import register as _register
 
 
 def _jnp():
@@ -478,3 +479,9 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
         return jax.vmap(one_roi)(r)
 
     return _apply(f, (data, rois), name="roi_align")
+
+
+# registry entries: list_ops parity + mx.sym.<op> symbol constructors
+for _name in ("multibox_prior", "multibox_target", "multibox_detection",
+              "box_nms", "roi_align", "roi_pooling"):
+    _register(_name, globals()[_name], wrapper=True)

@@ -315,3 +315,32 @@ def __getattr__(name):
     except MXNetError:
         raise AttributeError(name) from None
     return _make_op(name)
+
+
+class _ContribNamespace:
+    """``mx.sym.contrib``: contrib op symbol constructors under both the
+    snake_case and reference CamelCase names."""
+
+    _ALIASES = {
+        "MultiBoxPrior": "multibox_prior",
+        "MultiBoxTarget": "multibox_target",
+        "MultiBoxDetection": "multibox_detection",
+        "ROIAlign": "roi_align",
+        "ROIPooling": "roi_pooling",
+        "DeformableConvolution": "deformable_convolution",
+        "Correlation": "correlation",
+        "SpatialTransformer": "spatial_transformer",
+    }
+
+    def __getattr__(self, name):
+        from .ops import detection, spatial  # noqa: F401  (registration)
+
+        target = self._ALIASES.get(name, name)
+        try:
+            _resolve_op(target)
+        except MXNetError:
+            raise AttributeError(name) from None
+        return _make_op(target)
+
+
+contrib = _ContribNamespace()

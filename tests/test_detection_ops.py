@@ -408,3 +408,23 @@ def test_roi_pooling_empty_bin_zero():
                           pooled_size=2).asnumpy()[0, 0]
     assert out[0, 0] == 1.0
     assert (out.reshape(-1)[1:] >= 0).all()
+
+
+def test_nd_and_sym_contrib_namespaces():
+    """mx.nd.contrib / mx.sym.contrib expose the contrib family under
+    both reference CamelCase and snake_case names."""
+    import mxnet_tpu as mx
+
+    x = np.array(onp.zeros((1, 1, 2, 2), "float32"))
+    out = mx.nd.contrib.MultiBoxPrior(x, sizes=[0.5])
+    assert out.shape == (1, 4, 4)
+    out = mx.nd.contrib.BilinearResize2D(
+        np.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4)),
+        height=8, width=8)
+    assert out.shape == (1, 1, 8, 8)
+    d = mx.sym.var("data")
+    s = mx.sym.contrib.box_nms(d, overlap_thresh=0.5, coord_start=1,
+                               score_index=0)
+    r = s.eval(data=np.array(
+        onp.array([[[0.9, 0.1, 0.1, 0.4, 0.4]]], "float32")))[0]
+    assert r.shape == (1, 1, 5)
