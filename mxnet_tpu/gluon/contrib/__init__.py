@@ -1,2 +1,3 @@
 """Gluon contrib (reference: ``python/mxnet/gluon/contrib/``)."""
 from . import estimator
+from . import nn

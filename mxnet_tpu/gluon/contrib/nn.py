@@ -1,0 +1,115 @@
+"""``gluon.contrib.nn`` (reference
+``python/mxnet/gluon/contrib/nn/basic_layers.py``): Concurrent branches,
+Identity, SparseEmbedding, PixelShuffle upsamplers, SyncBatchNorm.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from .. import nn as _nn
+from ..block import HybridBlock
+from ..nn import HybridSequential
+
+
+class HybridConcurrent(HybridSequential):
+    """Apply every child to the SAME input and concatenate the outputs
+    along ``axis`` (reference contrib HybridConcurrent — the Inception
+    branch combinator)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import np as mnp
+
+        outs = [child(x) for child in self._children.values()]
+        return mnp.concatenate(outs, axis=self.axis)
+
+
+class Concurrent(HybridConcurrent):
+    """Alias (the reference keeps both imperative/hybrid names)."""
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class SparseEmbedding(_nn.Embedding):
+    """Embedding whose weight gradient is row_sparse (reference contrib
+    SparseEmbedding); on this stack that is ``Embedding(sparse_grad=True)``
+    — the O(nnz) gradient/update path in ndarray/sparse.py."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, **kwargs)
+
+
+def _pixel_shuffle(x, factors, ndim):
+    import jax.numpy as jnp
+
+    from ...ops.registry import apply as _apply
+
+    if isinstance(factors, int):
+        factors = (factors,) * ndim
+    f = tuple(int(v) for v in factors)
+
+    def fn(a):
+        b, c = a.shape[0], a.shape[1]
+        spatial = a.shape[2:]
+        import numpy as _np
+
+        cw = c // int(_np.prod(f))
+        # (B, C', f1..fn, s1..sn) -> interleave f_i after s_i
+        a = a.reshape((b, cw) + f + spatial)
+        perm = [0, 1]
+        for i in range(ndim):
+            perm += [2 + ndim + i, 2 + i]
+        a = a.transpose(perm)
+        out_sp = tuple(s * fi for s, fi in zip(spatial, f))
+        return a.reshape((b, cw) + out_sp)
+
+    return _apply(fn, (x,), name="pixel_shuffle")
+
+
+class PixelShuffle1D(HybridBlock):
+    """(B, C·f, W) → (B, C, W·f) sub-pixel upsampling (reference contrib
+    PixelShuffle1D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = factor
+
+    def forward(self, x):
+        return _pixel_shuffle(x, self._factor, 1)
+
+
+class PixelShuffle2D(HybridBlock):
+    """(B, C·f1·f2, H, W) → (B, C, H·f1, W·f2)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = factor
+
+    def forward(self, x):
+        return _pixel_shuffle(x, self._factor, 2)
+
+
+class PixelShuffle3D(HybridBlock):
+    """(B, C·f1·f2·f3, D, H, W) → (B, C, D·f1, H·f2, W·f3)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = factor
+
+    def forward(self, x):
+        return _pixel_shuffle(x, self._factor, 3)
+
+
+SyncBatchNorm = _nn.SyncBatchNorm
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
+           "SyncBatchNorm"]

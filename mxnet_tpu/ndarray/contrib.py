@@ -15,6 +15,7 @@ from ..ops.detection import (  # noqa: F401
     roi_pooling,
 )
 from ..ops.nn import (  # noqa: F401
+    adaptive_avg_pooling2d,
     arange_like,
     boolean_mask,
     erfinv,
@@ -40,6 +41,7 @@ DeformableConvolution = deformable_convolution
 Correlation = correlation
 BilinearResize2D = None  # set below
 SpatialTransformer = spatial_transformer
+AdaptiveAvgPooling2D = adaptive_avg_pooling2d
 
 
 def _bilinear_resize2d(data, height=None, width=None, scale_height=None,
@@ -70,5 +72,6 @@ __all__ = [
     "grid_generator", "spatial_transformer", "MultiBoxPrior",
     "MultiBoxTarget", "MultiBoxDetection", "ROIAlign", "ROIPooling",
     "DeformableConvolution", "Correlation", "SpatialTransformer",
-    "BilinearResize2D", "bilinear_resize_2d",
+    "BilinearResize2D", "bilinear_resize_2d", "AdaptiveAvgPooling2D",
+    "adaptive_avg_pooling2d",
 ]

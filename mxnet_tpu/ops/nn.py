@@ -927,6 +927,37 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):  # pylint: disa
     return NDArray(jnp.arange(n) * step + start)
 
 
+def adaptive_avg_pooling2d(data, output_size=1):
+    """Adaptive average pooling (reference
+    ``src/operator/contrib/adaptive_avg_pooling.cc``): output bin (i, j)
+    averages input span [floor(i·H/oh), ceil((i+1)·H/oh)) — the
+    overlapping-span geometry, computed as two masked mean reductions
+    (static shapes; the spans are compile-time constants)."""
+    jnp = _jnp()
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+
+    def f(x):
+        import math as _m
+
+        B, C, H, W = x.shape
+
+        def masks(n, o):
+            m = _onp.zeros((o, n), "float32")
+            for b in range(o):
+                lo = _m.floor(b * n / o)
+                hi = _m.ceil((b + 1) * n / o)
+                m[b, lo:hi] = 1.0 / (hi - lo)
+            return jnp.asarray(m)
+
+        mh = masks(H, oh)  # (oh, H), rows sum to 1
+        mw = masks(W, ow)  # (ow, W)
+        t = jnp.einsum("bchw,ow->bcho", x, mw)
+        return jnp.einsum("bcho,ph->bcpo", t, mh)
+
+    return _apply(f, (data,), name="adaptive_avg_pooling2d")
+
+
 def hard_sigmoid(data, alpha=0.2, beta=0.5):
     """Piecewise-linear sigmoid (reference ``HardSigmoid`` in
     ``src/operator/nn/activation``-adjacent LeakyReLU family)."""
@@ -1029,6 +1060,7 @@ for _name in (
     "sequence_reverse", "ctc_loss", "attention", "leaky_relu", "relu",
     "sigmoid", "tanh", "batch_dot", "gather_nd", "scatter_nd", "concat",
     "hard_sigmoid", "gamma", "gammaln", "erfinv", "index_copy",
+    "adaptive_avg_pooling2d",
     "index_array", "boolean_mask",
 ):
     _register(_name, globals()[_name], wrapper=True)

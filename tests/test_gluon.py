@@ -200,3 +200,38 @@ def test_export_symbolblock_roundtrip(tmp_path):
     loaded = gluon.SymbolBlock.imports(sym_file, param_file=param_file)
     got = loaded(x).asnumpy()
     onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_contrib_nn_layers():
+    """contrib.nn: Concurrent branches, Identity, SparseEmbedding,
+    PixelShuffle (reference gluon/contrib/nn/basic_layers.py)."""
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    con = cnn.HybridConcurrent(axis=-1)
+    con.add(gluon.nn.Dense(3), cnn.Identity(), gluon.nn.Dense(2))
+    con.initialize()
+    x = np.array(onp.random.randn(4, 5).astype("float32"))
+    out = con(x)
+    assert out.shape == (4, 3 + 5 + 2)
+
+    ps = cnn.PixelShuffle2D(2)
+    y = ps(np.array(onp.arange(32, dtype="float32").reshape(1, 8, 2, 2)))
+    assert y.shape == (1, 2, 4, 4)
+    # channel blocks interleave into space: exact layout oracle
+    xin = onp.arange(16, dtype="float32").reshape(1, 4, 2, 2)
+    got = cnn.PixelShuffle2D(2)(np.array(xin)).asnumpy()
+    assert got.shape == (1, 1, 4, 4)
+    # out[0,0,h*2+i, w*2+j] == xin[0, i*2+j, h, w]
+    for h in range(2):
+        for w in range(2):
+            for i in range(2):
+                for j in range(2):
+                    assert got[0, 0, h * 2 + i, w * 2 + j] == \
+                        xin[0, i * 2 + j, h, w]
+
+    emb = cnn.SparseEmbedding(50, 4)
+    emb.initialize()
+    with autograd.record():
+        emb(np.array(onp.array([1, 2], "int64"))).sum().backward()
+    assert isinstance(emb.weight.grad(), RowSparseNDArray)

@@ -271,3 +271,16 @@ def test_boolean_mask_rejects_jit():
 
     with pytest.raises(mx.MXNetError, match="data-dependent"):
         jax.jit(traced)(onp.ones((4,), "float32"))
+
+
+def test_adaptive_avg_pooling2d_torch_oracle():
+    import torch
+
+    import mxnet_tpu as mx
+
+    x = onp.random.RandomState(7).randn(2, 3, 7, 5).astype("float32")
+    got = mx.nd.contrib.AdaptiveAvgPooling2D(
+        np.array(x), output_size=(3, 2)).asnumpy()
+    want = torch.nn.functional.adaptive_avg_pool2d(
+        torch.tensor(x), (3, 2)).numpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
