@@ -16,3 +16,4 @@ from . import moe
 from .moe import MoEBlock, moe_dispatch_combine, moe_sharding_rules
 from . import ring_attention
 from .ring_attention import ring_attention as ring_attention_fn  # noqa: F401
+from .ring_attention import sequence_sharded, ulysses_attention  # noqa: F401

@@ -599,6 +599,76 @@ class ShardedTrainer:
         losses = self._run_compiled(sig, self._stepn_jit, args)
         return NDArray(losses)
 
+    def save_checkpoint(self, path):
+        """Checkpoint the FULL training state — params, optimizer state,
+        step count — for exact resume (the SPMD analog of
+        ``Trainer.save_states`` + ``save_parameters``; reference
+        ``gluon/trainer.py:482``). Sharded arrays are gathered to host;
+        ``load_checkpoint`` re-places them with the live shardings."""
+        import pickle
+
+        import jax
+
+        blob = {
+            "params": {n: jax.device_get(a)
+                       for n, a in self.params.items()},
+            "opt_states": {n: tuple(jax.device_get(s) for s in st)
+                           for n, st in self._opt_states.items()},
+            "step_count": self._step_count,
+            # the dropout/RNG stream position: without it a resumed run
+            # would replay earlier steps' masks
+            "rng_key": jax.device_get(self._key),
+        }
+        with open(path, "wb") as f:
+            pickle.dump(blob, f)
+
+    def load_checkpoint(self, path):
+        """Restore a ``save_checkpoint`` blob onto the CURRENT mesh: each
+        array is device_put with the trainer's live sharding, so resume
+        works across process restarts (and across mesh shapes, as long as
+        the rules still divide the shapes)."""
+        import pickle
+
+        import jax
+
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if set(blob["params"]) != set(self.params):
+            raise MXNetError(
+                "checkpoint params do not match this trainer's params: "
+                f"missing {set(self.params) - set(blob['params'])}, "
+                f"unexpected {set(blob['params']) - set(self.params)}")
+        # optimizer-state structure must line up with THIS trainer's
+        # optimizer (same names, same per-param arity/shapes) — a
+        # mismatched load (adam ckpt into sgd trainer) must fail here
+        # with a clear error, not as a tracing failure steps later
+        if set(blob["opt_states"]) != set(self._opt_states):
+            raise MXNetError(
+                "checkpoint optimizer state does not match this trainer: "
+                f"missing {set(self._opt_states) - set(blob['opt_states'])}, "
+                f"unexpected {set(blob['opt_states']) - set(self._opt_states)}")
+        for n, st in blob["opt_states"].items():
+            live = self._opt_states[n]
+            if len(st) != len(live) or any(
+                    tuple(h.shape) != tuple(s.shape)
+                    for h, s in zip(st, live)):
+                raise MXNetError(
+                    f"checkpoint optimizer state for {n!r} has structure "
+                    f"{[tuple(h.shape) for h in st]} but this trainer's "
+                    f"optimizer ({type(self.optimizer).__name__}) expects "
+                    f"{[tuple(s.shape) for s in live]}")
+        for n, host in blob["params"].items():
+            self.params[n] = jax.device_put(host, self.params[n].sharding)
+        self._opt_states = {
+            n: tuple(jax.device_put(h, live_s.sharding)
+                     for h, live_s in zip(st, self._opt_states[n]))
+            for n, st in blob["opt_states"].items()}
+        self._step_count = int(blob["step_count"])
+        if "rng_key" in blob:
+            self._key = jax.device_put(blob["rng_key"])
+        for i in range(len(self._train_names)):
+            self.optimizer._index_update_count[i] = self._step_count
+
     def sync_to_block(self):
         """Copy trained weights back into the Block's Parameters (a copy —
         the trainer's own arrays get donated on the next step). Pipeline

@@ -103,3 +103,70 @@ def sequence_sharded(x, mesh=None, axis="sp", dim=2):
     parts = [None] * x.ndim
     parts[dim] = axis
     return jax.device_put(x, NamedSharding(mesh, P(*parts)))
+
+
+def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False,
+                      scale=None):
+    """DeepSpeed-Ulysses-style sequence parallelism: the all-to-all
+    alternative to the ring schedule (SURVEY §5 mandates one of the two;
+    this stack ships both).
+
+    Inputs (B, H, T, D) with T sharded over ``axis``. Two
+    ``lax.all_to_all`` collectives re-partition sequence-sharded
+    activations into HEAD-sharded ones (each device holds H/n full-length
+    heads), plain attention runs locally at full sequence length, and the
+    inverse all-to-all restores sequence sharding. Communication is
+    2 all-to-alls of the qkv/out tensors over ICI vs the ring's n-1
+    neighbor permutes; compute is a single dense attention — better MXU
+    shape than ring blocks at moderate T, while ring wins when T²/n
+    scores no longer fit.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from . import mesh as mesh_mod
+    from .mesh import shard_map_compat
+
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh(create=True)
+    if mesh is None or axis not in mesh.axis_names:
+        raise MXNetError(f"ulysses_attention needs a mesh with axis {axis!r}")
+    n = mesh.shape[axis]
+    if q.shape[1] % n != 0:
+        raise MXNetError(
+            f"num_heads {q.shape[1]} not divisible by {axis}={n} "
+            "(Ulysses shards heads during compute)")
+    if q.shape[2] % n != 0:
+        raise MXNetError(
+            f"sequence length {q.shape[2]} not divisible by {axis}={n}")
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    spec = P(None, None, axis, None)
+
+    def _wrap(fn):
+        return shard_map_compat(fn, mesh, (spec, spec, spec), spec)
+
+    @_wrap
+    def inner(ql, kl, vl):
+        # local blocks (B, H, T/n, D) -> all_to_all -> (B, H/n, T, D)
+        def seq2head(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        def head2seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        qh = seq2head(ql).astype(jnp.float32)
+        kh = seq2head(kl).astype(jnp.float32)
+        vh = seq2head(vl).astype(jnp.float32)
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+        if causal:
+            t = sc.shape[-1]
+            cm = jnp.tril(jnp.ones((t, t), bool))
+            sc = jnp.where(cm, sc, -jnp.inf)
+        w = jax.nn.softmax(sc, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
+        return head2seq(out).astype(ql.dtype)
+
+    return inner(q, k, v)
