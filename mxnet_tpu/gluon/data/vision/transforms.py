@@ -299,3 +299,51 @@ class RandomGray:
                     @ _onp.array([0.299, 0.587, 0.114], dtype=_onp.float32))
             x = _onp.repeat(gray[..., None], 3, axis=-1)
         return x
+
+
+class CropResize:
+    """Crop a fixed region then optionally resize (reference
+    ``transforms.CropResize``): x[y:y+h, x:x+w] -> (size)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        self._x0 = x
+        self._y0 = y
+        self._w = width
+        self._h = height
+        self._size = ((size, size) if isinstance(size, numbers.Number)
+                      else tuple(size)) if size is not None else None
+        self._interp = interpolation
+
+    def __call__(self, x):
+        img = _to_numpy(x)
+        crop = img[self._y0:self._y0 + self._h,
+                   self._x0:self._x0 + self._w]
+        if self._size is not None:
+            crop = _resize_img(crop, self._size, self._interp)
+        return crop
+
+
+class RandomRotation:
+    """Random rotation within ``angle_limits`` degrees (reference
+    ``transforms.RandomRotation``, backed by ``image.imrotate``)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        lo, hi = angle_limits
+        if lo >= hi:
+            raise MXNetError("angle_limits must be (low, high) with low<high")
+        self._limits = (lo, hi)
+        self._zoom_in = zoom_in
+        self._zoom_out = zoom_out
+        self._p = rotate_with_proba
+
+    def __call__(self, x):
+        import numpy as onp
+
+        from ....image import imrotate
+
+        if onp.random.rand() > self._p:
+            return _to_numpy(x)
+        deg = float(onp.random.uniform(*self._limits))
+        return _to_numpy(imrotate(_to_numpy(x), deg, zoom_in=self._zoom_in,
+                                  zoom_out=self._zoom_out))

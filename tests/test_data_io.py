@@ -241,3 +241,21 @@ def test_recordio_multipart_write(tmp_path, monkeypatch):
     r = recordio.MXRecordIO(path, "r")
     assert r.read() == payload
     assert r.read() == b"tail"
+
+
+def test_transforms_crop_resize_and_rotation():
+    import numpy as np_
+
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    img = np_.arange(10 * 12 * 3, dtype="uint8").reshape(10, 12, 3)
+    c = T.CropResize(2, 1, 6, 5)(img)
+    np_.testing.assert_array_equal(np_.asarray(c), img[1:6, 2:8])
+    c2 = T.CropResize(2, 1, 6, 5, size=(4, 4))(img)
+    assert np_.asarray(c2).shape == (4, 4, 3)
+    r = T.RandomRotation((-30, 30))(img.astype("float32"))
+    assert np_.asarray(r).shape == (10, 12, 3)
+    # rotate_with_proba=0: identity
+    r0 = T.RandomRotation((-30, 30), rotate_with_proba=0.0)(
+        img.astype("float32"))
+    np_.testing.assert_array_equal(np_.asarray(r0), img.astype("float32"))
