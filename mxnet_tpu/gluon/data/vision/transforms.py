@@ -316,6 +316,15 @@ class CropResize:
 
     def __call__(self, x):
         img = _to_numpy(x)
+        h, w = img.shape[:2]
+        if (self._x0 < 0 or self._y0 < 0 or self._w <= 0 or self._h <= 0
+                or self._x0 + self._w > w or self._y0 + self._h > h):
+            # reference errors on invalid regions — silent clamping would
+            # hand back wrong content at the right shape
+            raise MXNetError(
+                f"CropResize region (x={self._x0}, y={self._y0}, "
+                f"w={self._w}, h={self._h}) out of bounds for a "
+                f"{w}x{h} image")
         crop = img[self._y0:self._y0 + self._h,
                    self._x0:self._x0 + self._w]
         if self._size is not None:
@@ -338,12 +347,16 @@ class RandomRotation:
         self._p = rotate_with_proba
 
     def __call__(self, x):
-        import numpy as onp
-
         from ....image import imrotate
 
-        if onp.random.rand() > self._p:
+        if _onp.random.rand() > self._p:
             return _to_numpy(x)
-        deg = float(onp.random.uniform(*self._limits))
-        return _to_numpy(imrotate(_to_numpy(x), deg, zoom_in=self._zoom_in,
-                                  zoom_out=self._zoom_out))
+        deg = float(_onp.random.uniform(*self._limits))
+        img = _to_numpy(x)
+        # this module's contract is HWC; imrotate (image.py) rotates CHW
+        # float32 — transpose/cast around it and hand back the input's
+        # layout and dtype
+        chw = img.transpose(2, 0, 1).astype(_onp.float32)
+        rot = _to_numpy(imrotate(chw, deg, zoom_in=self._zoom_in,
+                                 zoom_out=self._zoom_out))
+        return rot.transpose(1, 2, 0).astype(img.dtype)
