@@ -334,7 +334,12 @@ class CropResize:
 
 class RandomRotation:
     """Random rotation within ``angle_limits`` degrees (reference
-    ``transforms.RandomRotation``, backed by ``image.imrotate``)."""
+    ``transforms/image.py:174`` RandomRotation over ``image.imrotate``).
+
+    NOTE the reference's own layout asymmetry, kept here: unlike the rest
+    of this module (HWC uint8/float), RandomRotation is a POST-ToTensor
+    transform taking float32 **(C, H, W)** (or (N, C, H, W)) — compose it
+    after ``ToTensor``."""
 
     def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
                  rotate_with_proba=1.0):
@@ -349,14 +354,13 @@ class RandomRotation:
     def __call__(self, x):
         from ....image import imrotate
 
-        if _onp.random.rand() > self._p:
-            return _to_numpy(x)
-        deg = float(_onp.random.uniform(*self._limits))
         img = _to_numpy(x)
-        # this module's contract is HWC; imrotate (image.py) rotates CHW
-        # float32 — transpose/cast around it and hand back the input's
-        # layout and dtype
-        chw = img.transpose(2, 0, 1).astype(_onp.float32)
-        rot = _to_numpy(imrotate(chw, deg, zoom_in=self._zoom_in,
-                                 zoom_out=self._zoom_out))
-        return rot.transpose(1, 2, 0).astype(img.dtype)
+        if img.dtype != _onp.float32:
+            raise MXNetError(
+                "RandomRotation only supports float32 (C, H, W) inputs — "
+                "compose it after ToTensor (reference contract)")
+        if _onp.random.rand() > self._p:
+            return img
+        deg = float(_onp.random.uniform(*self._limits))
+        return _to_numpy(imrotate(img, deg, zoom_in=self._zoom_in,
+                                  zoom_out=self._zoom_out))

@@ -927,6 +927,126 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):  # pylint: disa
     return NDArray(jnp.arange(n) * step + start)
 
 
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape ``lhs`` to ``rhs``'s shape (reference
+    ``src/operator/tensor/elemwise_unary_op_basic.cc`` reshape_like);
+    the begin/end variants splice a sub-range of rhs dims."""
+    shape = list(rhs.shape)
+    if any(v is not None for v in (lhs_begin, lhs_end, rhs_begin, rhs_end)):
+        lb = 0 if lhs_begin is None else lhs_begin
+        le = len(lhs.shape) if lhs_end is None else lhs_end
+        rb = 0 if rhs_begin is None else rhs_begin
+        re_ = len(shape) if rhs_end is None else rhs_end
+        shape = list(lhs.shape[:lb]) + shape[rb:re_] + list(lhs.shape[le:])
+    t = tuple(int(s) for s in shape)
+    return _apply(lambda x: x.reshape(t), (lhs,), name="reshape_like")
+
+
+def stop_gradient(data):
+    """Identity whose gradient is blocked (reference ``BlockGrad``)."""
+    return _apply(lambda x: x, (data,), name="stop_gradient", record=False)
+
+
+def cast_storage(data, stype="default"):
+    """Convert between dense and sparse storage (reference
+    ``src/operator/tensor/cast_storage.cc``)."""
+    from ..ndarray.ndarray import NDArray
+    from ..ndarray.sparse import BaseSparseNDArray, dense_to_sparse
+
+    if isinstance(data, BaseSparseNDArray):
+        return data.tostype(stype)
+    nd = data if isinstance(data, NDArray) else NDArray(data)
+    if stype == "default":
+        return nd
+    return dense_to_sparse(nd, stype)
+
+
+def depth_to_space(data, block_size):
+    """(B, C·b², H, W) → (B, C, H·b, W·b) (reference
+    ``src/operator/tensor/matrix_op.cc`` DepthToSpace: DCR order)."""
+    b = int(block_size)
+
+    def f(x):
+        n, c, h, w = x.shape
+        x = x.reshape(n, b, b, c // (b * b), h, w)
+        x = x.transpose(0, 3, 4, 1, 5, 2)
+        return x.reshape(n, c // (b * b), h * b, w * b)
+
+    return _apply(f, (data,), name="depth_to_space")
+
+
+def space_to_depth(data, block_size):
+    """(B, C, H·b, W·b) → (B, C·b², H, W) — exact inverse of
+    ``depth_to_space``."""
+    b = int(block_size)
+
+    def f(x):
+        n, c, hb, wb = x.shape
+        h, w = hb // b, wb // b
+        x = x.reshape(n, c, h, b, w, b)
+        x = x.transpose(0, 3, 5, 1, 2, 4)
+        return x.reshape(n, c * b * b, h, w)
+
+    return _apply(f, (data,), name="space_to_depth")
+
+
+def im2col(data, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """Sliding-window patch extraction (reference
+    ``src/operator/nn/im2col.h`` semantics): (B, C, H, W) →
+    (B, C·kh·kw, OH·OW) with (C, kh, kw) channel-major patch order."""
+    kh, kw = _tup(kernel, 2)
+    sh, sw = _tup(stride, 2)
+    dh, dw = _tup(dilate, 2)
+    ph, pw = _tup(pad, 2)
+
+    def f(x):
+        import jax
+
+        n, c = x.shape[0], x.shape[1]
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # (B, C*kh*kw, OH, OW) with channel-major order already
+        return patches.reshape(n, c * kh * kw, -1)
+
+    return _apply(f, (data,), name="im2col")
+
+
+def col2im(data, output_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    """Inverse of :func:`im2col`: overlapping patches scatter-ADD back
+    into the (B, C, H, W) image (reference ``col2im`` in
+    ``src/operator/nn/im2col.h``). Implemented as the exact vjp of the
+    patch extraction — transposes are the compiler's problem."""
+    oh, ow = _tup(output_size, 2)
+    kh, kw = _tup(kernel, 2)
+    sh, sw = _tup(stride, 2)
+    dh, dw = _tup(dilate, 2)
+    ph, pw = _tup(pad, 2)
+
+    def f(cols):
+        import jax
+
+        n = cols.shape[0]
+        c = cols.shape[1] // (kh * kw)
+
+        def fwd(img):
+            p = jax.lax.conv_general_dilated_patches(
+                img, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+                rhs_dilation=(dh, dw),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            return p.reshape(n, c * kh * kw, -1)
+
+        zero = _jnp().zeros((n, c, oh, ow), cols.dtype)
+        _, vjp = jax.vjp(fwd, zero)
+        (img,) = vjp(cols)
+        return img
+
+    return _apply(f, (data,), name="col2im")
+
+
 def adaptive_avg_pooling2d(data, output_size=1):
     """Adaptive average pooling (reference
     ``src/operator/contrib/adaptive_avg_pooling.cc``): output bin (i, j)
@@ -1060,7 +1180,8 @@ for _name in (
     "sequence_reverse", "ctc_loss", "attention", "leaky_relu", "relu",
     "sigmoid", "tanh", "batch_dot", "gather_nd", "scatter_nd", "concat",
     "hard_sigmoid", "gamma", "gammaln", "erfinv", "index_copy",
-    "adaptive_avg_pooling2d",
+    "adaptive_avg_pooling2d", "reshape_like", "stop_gradient",
+    "cast_storage", "depth_to_space", "space_to_depth", "im2col", "col2im",
     "index_array", "boolean_mask",
 ):
     _register(_name, globals()[_name], wrapper=True)

@@ -253,24 +253,23 @@ def test_transforms_crop_resize_and_rotation():
     np_.testing.assert_array_equal(np_.asarray(c), img[1:6, 2:8])
     c2 = T.CropResize(2, 1, 6, 5, size=(4, 4))(img)
     assert np_.asarray(c2).shape == (4, 4, 3)
-    # content check: a 90-degree rotation moves a bright column to a row
-    sq = np_.zeros((8, 8, 1), "float32")
-    sq[:, 2, 0] = 1.0  # vertical stripe at x=2
-    rot = np_.asarray(T.RandomRotation((89.999, 90.0))(sq))[..., 0]
+    # RandomRotation is the reference's post-ToTensor CHW transform:
+    # content check — a ~90-degree rotation turns a vertical stripe
+    # (mass concentrated in one column) into a horizontal one
+    sq = np_.zeros((1, 8, 8), "float32")
+    sq[0, :, 2] = 1.0  # vertical stripe at x=2 (CHW)
+    rot = np_.asarray(T.RandomRotation((89.999, 90.0))(sq))[0]
     assert rot.shape == (8, 8)
-    # after ~90deg rotation the stripe is (near-)horizontal: some row now
-    # carries most of the mass instead of a column
     row_mass = rot.sum(axis=1).max()
     col_mass = rot.sum(axis=0).max()
     assert row_mass > 2 * col_mass
-    r = T.RandomRotation((-30, 30))(img.astype("float32"))
-    assert np_.asarray(r).shape == (10, 12, 3)
-    # uint8 input round-trips through the float32 CHW rotation path
-    r8 = T.RandomRotation((-30, 30))(img)
-    assert np_.asarray(r8).dtype == np_.uint8
+    r = T.RandomRotation((-30, 30))(sq)
+    assert np_.asarray(r).shape == (1, 8, 8)
     import pytest as _pytest
 
     from mxnet_tpu.base import MXNetError as _Err
+    with _pytest.raises(_Err, match="float32"):
+        T.RandomRotation((-30, 30))(img)  # uint8 HWC: reference raises
     with _pytest.raises(_Err, match="out of bounds"):
         T.CropResize(8, 8, 6, 5)(img)
     # rotate_with_proba=0: identity

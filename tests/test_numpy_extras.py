@@ -284,3 +284,45 @@ def test_adaptive_avg_pooling2d_torch_oracle():
     want = torch.nn.functional.adaptive_avg_pool2d(
         torch.tensor(x), (3, 2)).numpy()
     onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_npx_depth_space_im2col_family():
+    """depth_to_space (DCR order, reference matrix_op-inl.h kernel),
+    space_to_depth inverse, im2col/col2im vs torch unfold/fold,
+    reshape_like, stop_gradient, cast_storage."""
+    import torch
+
+    from mxnet_tpu import autograd, npx
+    from mxnet_tpu.ndarray.sparse import CSRNDArray
+
+    x = onp.random.RandomState(3).randn(2, 8, 4, 6).astype("float32")
+    d = npx.depth_to_space(np.array(x), 2)
+    n, c, h, w = x.shape
+    want = x.reshape(n, 2, 2, c // 4, h, w).transpose(
+        0, 3, 4, 1, 5, 2).reshape(n, c // 4, h * 2, w * 2)
+    onp.testing.assert_allclose(d.asnumpy(), want, rtol=1e-6)
+    onp.testing.assert_allclose(npx.space_to_depth(d, 2).asnumpy(), x,
+                                rtol=1e-6)
+
+    img = onp.random.RandomState(4).randn(1, 2, 5, 5).astype("float32")
+    cols = npx.im2col(np.array(img), (3, 3), pad=(1, 1))
+    wt = torch.nn.functional.unfold(torch.tensor(img), (3, 3),
+                                    padding=1).numpy()
+    onp.testing.assert_allclose(cols.asnumpy(), wt, rtol=1e-5)
+    rec = npx.col2im(cols, (5, 5), (3, 3), pad=(1, 1))
+    wr = torch.nn.functional.fold(torch.tensor(wt), (5, 5), (3, 3),
+                                  padding=1).numpy()
+    onp.testing.assert_allclose(rec.asnumpy(), wr, rtol=1e-5)
+
+    a = np.array(onp.ones((2, 6), "float32"))
+    assert npx.reshape_like(a, np.array(onp.zeros((3, 4)))).shape == (3, 4)
+
+    v = np.array(onp.ones((3,), "float32"))
+    v.attach_grad()
+    with autograd.record():
+        (npx.stop_gradient(v) * v).sum().backward()
+    onp.testing.assert_allclose(v.grad.asnumpy(), onp.ones(3), rtol=1e-6)
+
+    cs = npx.cast_storage(np.array(onp.eye(3, dtype="float32")), "csr")
+    assert isinstance(cs, CSRNDArray)
+    assert npx.cast_storage(cs, "default").shape == (3, 3)
