@@ -1,9 +1,20 @@
 """``mx.nd`` — the legacy NDArray namespace.
 
 In the reference this is a distinct API family (``python/mxnet/ndarray/``)
-with legacy op names; in 2.x it shares the NDArray type with ``mx.np``. Here
-``mx.nd`` re-exports the numpy-style ops plus the legacy-spelled aliases the
-Gluon v1 layers and old scripts use.
+whose op functions are *generated* at import by enumerating the C op
+registry (``python/mxnet/ndarray/register.py:115-265``), including the
+CamelCase layer ops (``nd.FullyConnected``, ``nd.Convolution``, …) that
+Gluon-v1-era scripts call. Here the namespace is **lazy**: module-level
+``__getattr__`` resolves each name on first touch through
+``ops.legacy.resolve`` (legacy aliases → legacy funcs → op registry →
+``mx.np``/``mx.npx``), then caches it in module globals.
+
+Lazy resolution is load-bearing, not a style choice: this module is
+imported while ``mxnet_tpu`` core is still initializing, so an eager
+"populate from mx.np" loop runs during the circular import window when
+``mxnet_tpu.numpy`` is half-built and freezes an empty namespace (the
+round-3 ``mx.nd``-is-empty bug). Deferring every lookup to first attribute
+access guarantees the numpy namespace is complete by the time it is read.
 """
 from __future__ import annotations
 
@@ -12,6 +23,7 @@ from .utils import load, save
 from . import sparse
 
 ndarray = NDArray
+waitall = None  # replaced on first access via __getattr__
 
 
 def __getattr__(name):
@@ -23,27 +35,37 @@ def __getattr__(name):
         mod = importlib.import_module(".contrib", __name__)
         globals()["contrib"] = mod
         return mod
-    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute {name!r}")
+    if name == "random":
+        from ..numpy import random as mod
+
+        globals()["random"] = mod
+        return mod
+    if name == "waitall":
+        from ..engine import wait_all
+
+        globals()["waitall"] = wait_all
+        return wait_all
+    if name.startswith("_"):
+        raise AttributeError(
+            f"module 'mxnet_tpu.ndarray' has no attribute {name!r}")
+    from ..ops import legacy
+
+    try:
+        fn = legacy.resolve(name)
+    except AttributeError:
+        raise AttributeError(
+            f"module 'mxnet_tpu.ndarray' has no attribute {name!r}. If "
+            f"this is a reference op name, it may be unimplemented — see "
+            f"mxnet_tpu/ops/legacy.py for the legacy surface") from None
+    globals()[name] = fn
+    return fn
 
 
-def _populate():
-    """Fill mx.nd with the np-style functions + legacy-name aliases."""
-    from .. import numpy as _mxnp
+def __dir__():
+    from ..ops import legacy
 
-    g = globals()
-    for name in dir(_mxnp):
-        if name.startswith("_"):
-            continue
-        if name not in g:
-            g[name] = getattr(_mxnp, name)
-
-    # legacy spellings
-    g.setdefault("waitall", __import__("mxnet_tpu.engine", fromlist=["x"]).wait_all)
-
-
-_populate()
-
-from ..numpy import random  # noqa: E402  (mx.nd.random parity)
+    return sorted(set(globals()) | set(legacy.all_names())
+                  | {"contrib", "random", "waitall"})
 
 
 def array(source_array, ctx=None, dtype=None, device=None):
@@ -64,11 +86,38 @@ def ones(shape, ctx=None, dtype=None, device=None, **kwargs):  # pylint: disable
     return _mxnp.ones(shape, dtype=dtype or "float32", ctx=ctx or device)
 
 
-def concat(*arrays, dim=1):
+def empty(shape, ctx=None, dtype=None, device=None):
+    return zeros(shape, ctx=ctx, dtype=dtype, device=device)
+
+
+def full(shape, val, ctx=None, dtype=None, device=None, **kwargs):  # pylint: disable=unused-argument
+    from .. import numpy as _mxnp
+
+    return _mxnp.full(shape, val, dtype=dtype or "float32", ctx=ctx or device)
+
+
+def concat(*arrays, dim=1, out=None):
     """Legacy ``nd.concat`` (axis kwarg spelled ``dim``)."""
     from .. import numpy as _mxnp
 
-    return _mxnp.concatenate(list(arrays), axis=dim)
+    res = _mxnp.concatenate(list(arrays), axis=dim)
+    if out is not None:
+        out._set_data_internal(res._data)
+        return out
+    return res
+
+
+def stack(*arrays, axis=0, out=None):
+    """Legacy ``nd.stack`` (varargs, unlike np.stack's sequence arg)."""
+    from .. import numpy as _mxnp
+
+    seq = arrays[0] if len(arrays) == 1 and isinstance(
+        arrays[0], (list, tuple)) else list(arrays)
+    res = _mxnp.stack(seq, axis=axis)
+    if out is not None:
+        out._set_data_internal(res._data)
+        return out
+    return res
 
 
 def elemwise_add(lhs, rhs):
