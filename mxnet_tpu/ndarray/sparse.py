@@ -267,7 +267,21 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         if transpose_b:
             raise MXNetError("dot(csr, dense, transpose_b=True) unsupported")
         if autograd.is_recording() and _tracked(lhs):
-            return NDArray(lhs._data).dot(rhs)  # dense fallback, recorded
+            # storage fallback for a TRACKED csr lhs: route through the
+            # dispatch layer with lhs itself as a primal so the tape
+            # connects (a fresh NDArray(lhs._data) would drop the leaf
+            # link); the dense view materializes here, which is the
+            # reference's FCompute fallback behavior for a csr operand
+            # requiring grad. transpose_a is applied inside the traced fn.
+            from ..ops import registry as _reg
+
+            ta = transpose_a
+
+            def _dense_fb(dl, r):
+                d = jnp.swapaxes(dl, 0, 1) if ta else dl
+                return jnp.matmul(d, r)
+
+            return _reg.apply(_dense_fb, (lhs, rhs), name="sparse_dot_fb")
         rows = _csr_row_ids(lhs)
         cols = lhs.indices._data.astype(jnp.int64)
         vals = lhs.values._data

@@ -237,18 +237,19 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
             # unmatched anchor is a negative CANDIDATE only if its best
             # IoU < negative_mining_thresh (higher-overlap unmatched
             # anchors are "too hard" and ignored); candidates are ranked
-            # by max non-background predicted probability (hardest first)
-            # and the top ratio*num_pos (>= minimum_negative_samples)
-            # train as background — every other unmatched anchor gets
-            # ignore_label.
-            neg_score = jnp.max(cpred[1:, :], axis=0)
+            # by ASCENDING softmax probability of the background class
+            # (multibox_target.cc:219-237 sorts SortElemDescend(-prob) —
+            # lowest background confidence = hardest negative first) and
+            # the top ratio*num_pos (>= minimum_negative_samples) train as
+            # background — every other unmatched anchor gets ignore_label.
+            bg_prob = jax.nn.softmax(cpred, axis=0)[0, :]
             cand = (~matched) & (best_iou < negative_mining_thresh)
             num_pos = jnp.sum(matched)
             quota = jnp.maximum(
                 (negative_mining_ratio * num_pos).astype(jnp.int32),
                 minimum_negative_samples)
             rank = jnp.argsort(jnp.argsort(
-                jnp.where(cand, -neg_score, jnp.inf)))
+                jnp.where(cand, bg_prob, jnp.inf)))
             keep_neg = cand & (rank < quota)
             cls_t = jnp.where(matched, cls_t,
                               jnp.where(keep_neg, 0.0,

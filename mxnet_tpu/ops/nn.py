@@ -438,8 +438,10 @@ def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
             var32 = jnp.maximum(
                 jnp.mean(jnp.square(x32), axis=axes) - jnp.square(mean32),
                 0.0)
-            mean = mean32.astype(xx.dtype)
-            var = var32.astype(xx.dtype)
+            # stats stay fp32: they feed the running-stat update, and the
+            # reference keeps BN aux states fp32 under AMP — only the
+            # normalization arithmetic below casts down
+            mean, var = mean32, var32
             inv_c = 1.0 / jnp.sqrt(var32 + eps)
         else:
             # fp32/fp64: keep the exact two-pass form — one-pass
@@ -453,7 +455,8 @@ def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
         shape[axis] = xx.shape[axis]
         gg = jnp.ones_like(g) if fix_gamma else g
         inv = (gg.astype(inv_c.dtype) * inv_c).astype(xx.dtype).reshape(shape)
-        out = (xx - mean.reshape(shape)) * inv + b.reshape(shape)
+        out = ((xx - mean.astype(xx.dtype).reshape(shape)) * inv
+               + b.reshape(shape))
         return out, mean, var
 
     def f_eval(xx, g, b, rm, rv):
@@ -1094,10 +1097,17 @@ def gamma(data):
         import jax.scipy.special as jsp
 
         jnp = _jnp()
-        # Γ via lgamma: |Γ(x)| = exp(lgamma(x)); gammasgn restores the
-        # alternating sign on the negative axis
+        # Γ via lgamma: |Γ(x)| = exp(lgamma(x)); the sign alternates on
+        # the negative axis: Γ(x) < 0 iff floor(x) is odd for x < 0
+        # (poles at non-positive integers are ±inf either way)
         mag = jnp.exp(jsp.gammaln(x))
-        return jsp.gammasgn(x) * mag if hasattr(jsp, "gammasgn") else mag
+        if hasattr(jsp, "gammasgn"):
+            sign = jsp.gammasgn(x)
+        else:
+            sign = jnp.where(
+                (x < 0) & (jnp.floor(x) % 2 != 0), -1.0, 1.0
+            ).astype(x.dtype)
+        return sign * mag
 
     return _apply(f, (data,), name="gamma")
 

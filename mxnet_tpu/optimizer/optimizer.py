@@ -206,11 +206,19 @@ def _zeros_like(weight):
 class SGD(Optimizer):
     """SGD with momentum (reference ``optimizer/sgd.py``)."""
 
-    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True, **kwargs):
-        # lazy_update=True is the reference default (optimizer/sgd.py):
-        # row_sparse grads update only their stored rows
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False, **kwargs):
+        # lazy_update defaults False, matching the reference 2.x
+        # (python/mxnet/optimizer/sgd.py:95): opted in, row_sparse grads
+        # update only their stored rows — skipping momentum decay and wd
+        # on untouched rows, a documented numerics divergence from the
+        # dense update
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
+        if lazy_update and kwargs.get("multi_precision"):
+            # reference sgd.py:105-107 forbids the combination: the fp32
+            # master copy would drift from the lazily-updated weight
+            raise ValueError("lazy_update is not compatible with "
+                             "multi_precision (reference sgd.py:105)")
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):

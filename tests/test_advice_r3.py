@@ -1,0 +1,115 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md r3)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np
+from mxnet_tpu.ndarray import sparse
+
+
+def test_sgd_lazy_update_defaults_false():
+    """Reference 2.x default (python/mxnet/optimizer/sgd.py:95) is
+    lazy_update=False; lazy is opt-in and incompatible with
+    multi_precision (sgd.py:105)."""
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    assert opt.lazy_update is False
+    with pytest.raises(ValueError):
+        mx.optimizer.create("sgd", learning_rate=0.1, lazy_update=True,
+                            multi_precision=True)
+
+
+def test_sparse_dot_recorded_fallback_honors_transpose_a():
+    """advisor: the recorded dense fallback for a tracked CSR lhs computed
+    lhs@rhs instead of lhs.T@rhs."""
+    import scipy.sparse as sp
+
+    a = onp.random.rand(3, 4).astype(onp.float32)
+    a[a < 0.5] = 0
+    rhs = onp.random.rand(3, 2).astype(onp.float32)
+    a_sp = sp.csr_matrix(a)
+    csr = sparse.csr_matrix(
+        (a_sp.data, a_sp.indptr.astype(onp.int64),
+         a_sp.indices.astype(onp.int64)), shape=a.shape)
+    # track the csr lhs so the dense recorded fallback runs
+    csr.attach_grad()
+    r = np.array(rhs)
+    with autograd.record():
+        out = sparse.dot(csr, r, transpose_a=True)
+        loss = out.sum()
+    assert out.shape == (4, 2)
+    onp.testing.assert_allclose(out.asnumpy(), a.T @ rhs, rtol=1e-5)
+    # the fallback must stay ON the tape: L = sum(A^T R) so
+    # dL/dA[i,j] = sum_k R[i,k] — each row of grad(A) is R's row-sum
+    loss.backward()
+    expect = onp.broadcast_to(rhs.sum(axis=1, keepdims=True), a.shape)
+    onp.testing.assert_allclose(csr.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_multibox_target_negative_mining_ranks_by_bg_prob():
+    """advisor: negatives must be mined by ASCENDING softmax background
+    probability (multibox_target.cc:219-237), not max foreground logit."""
+    from mxnet_tpu.ops import detection
+
+    # 4 anchors, no overlap with the single gt except anchor 0
+    anchors = onp.array([[[0.0, 0.0, 0.5, 0.5],
+                          [0.6, 0.6, 0.7, 0.7],
+                          [0.8, 0.8, 0.9, 0.9],
+                          [0.1, 0.6, 0.2, 0.7]]], onp.float32)
+    label = onp.array([[[0.0, 0.0, 0.0, 0.5, 0.5]]], onp.float32)
+    # logits (batch, classes=2, anchors). Candidate negatives: anchors
+    # 1,2,3. Background probs: anchor1 lowest (hardest), anchor2 highest.
+    cls_pred = onp.zeros((1, 2, 4), onp.float32)
+    cls_pred[0, 0, 1] = -5.0   # anchor1: bg logit low  -> hardest negative
+    cls_pred[0, 0, 2] = +5.0   # anchor2: bg logit high -> easiest negative
+    # quota = ratio*num_pos = 1 (with minimum_negative_samples=0) ->
+    # exactly anchor1 must be kept as negative, others ignored
+    _, _, cls_t = detection.multibox_target(
+        np.array(anchors), np.array(label), np.array(cls_pred),
+        overlap_threshold=0.5, negative_mining_ratio=1.0,
+        negative_mining_thresh=0.5, minimum_negative_samples=0,
+        ignore_label=-1)
+    got = cls_t.asnumpy()[0]
+    assert got[0] == 1.0           # matched -> class 0 + 1
+    assert got[1] == 0.0           # hardest negative trains as background
+    assert got[2] == -1.0          # easy negative ignored
+    assert got[3] == -1.0
+
+
+def test_batch_norm_training_stats_are_fp32_under_bf16():
+    """advisor: batch mean/var feed the running-stat update and must stay
+    fp32 under AMP (reference keeps BN aux states fp32)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import nn as nn_ops
+
+    x = np.array(onp.random.rand(4, 3, 2, 2).astype(onp.float32)).astype(
+        jnp.bfloat16)
+    gamma = np.ones((3,)).astype(jnp.bfloat16)
+    beta = np.zeros((3,)).astype(jnp.bfloat16)
+    rm, rv = np.zeros((3,)), np.ones((3,))
+    with autograd.train_mode():
+        out, mean, var = nn_ops.batch_norm(x, gamma, beta, rm, rv,
+                                           output_mean_var=True)
+    assert out.dtype == jnp.bfloat16          # activations stay bf16
+    assert mean.dtype == onp.float32          # stats full precision
+    assert var.dtype == onp.float32
+
+
+def test_gamma_sign_on_negative_axis():
+    """advisor: Γ(x) must carry the alternating sign for negative
+    non-integer x even without jax gammasgn."""
+    import math
+
+    from mxnet_tpu.ops import nn as nn_ops
+
+    x = onp.array([-0.5, -1.5, -2.5, 0.5, 3.0], onp.float32)
+    got = nn_ops.gamma(np.array(x)).asnumpy()
+    expect = onp.array([math.gamma(v) for v in x], onp.float32)
+    onp.testing.assert_allclose(got, expect, rtol=1e-4)
+    # the explicit floor-parity fallback agrees with gammasgn
+    import jax.numpy as jnp
+
+    sign_fallback = onp.where(
+        (x < 0) & (onp.floor(x) % 2 != 0), -1.0, 1.0)
+    onp.testing.assert_array_equal(sign_fallback, onp.sign(expect))
+    del jnp

@@ -4,7 +4,7 @@ lazy_update optimizers, row_sparse_pull — r2 verdict Next #4.
 Reference: ``src/operator/tensor/dot-inl.h`` (sparse dot),
 ``src/operator/optimizer_op.cc`` (lazy_update row kernels),
 ``include/mxnet/kvstore.h:161`` (PullRowSparse),
-``python/mxnet/optimizer/sgd.py`` (lazy_update default True).
+``python/mxnet/optimizer/sgd.py`` (lazy_update, opt-in: default False).
 
 The O(nnz) contract is asserted through ``is_materialized()``: any code
 path that touches a sparse array's dense view flips it.
@@ -91,7 +91,8 @@ def test_embedding_sparse_grad_is_row_sparse_o_nnz():
     emb = gluon.nn.Embedding(VOCAB, DIM, sparse_grad=True)
     emb.initialize()
     tr = gluon.Trainer(emb.collect_params(), "sgd",
-                       {"learning_rate": 0.5, "momentum": 0.9})
+                       {"learning_rate": 0.5, "momentum": 0.9,
+                        "lazy_update": True})
     idx = np.array(onp.array([[3, 17, 3], [99, 17, 4999]], "int64"))
     w_before = emb.weight.data().asnumpy().copy()
     with autograd.record():
@@ -123,7 +124,8 @@ def test_lazy_update_momentum_only_touched_rows():
     VOCAB, DIM = 100, 4
     w = np.array(onp.ones((VOCAB, DIM), "float32"))
     w.attach_grad()
-    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              lazy_update=True)
     state = opt.create_state_multi_precision(0, w)
     g = RowSparseNDArray(np.array(onp.ones((2, DIM), "float32")),
                          np.array(onp.array([5, 42], "int64")),
