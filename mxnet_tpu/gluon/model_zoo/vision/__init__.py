@@ -7,6 +7,7 @@ from __future__ import annotations
 
 from ....base import MXNetError
 from .alexnet import *
+from .darknet import *
 from .densenet import *
 from .inception import *
 from .mobilenet import *
@@ -15,6 +16,7 @@ from .squeezenet import *
 from .vgg import *
 
 from .alexnet import AlexNet
+from .darknet import DarknetV3, darknet53
 from .densenet import DenseNet
 from .inception import Inception3
 from .mobilenet import MobileNet, MobileNetV2
@@ -22,6 +24,7 @@ from .resnet import (BasicBlockV1, BasicBlockV2, BottleneckV1, BottleneckV2,
                      ResNetV1, ResNetV2, get_resnet)
 from .squeezenet import SqueezeNet
 from .vgg import VGG, get_vgg
+from .yolo import YOLOV3, YOLOV3Loss, yolo3_darknet53, yolo3_targets
 
 
 def get_model(name, **kwargs):
@@ -47,6 +50,8 @@ def get_model(name, **kwargs):
         "mobilenetv2_0.75": mobilenet_v2_0_75,
         "mobilenetv2_0.5": mobilenet_v2_0_5,
         "mobilenetv2_0.25": mobilenet_v2_0_25,
+        "darknet53": darknet53,
+        "yolo3_darknet53": yolo3_darknet53,
     }
     name = name.lower()
     if name not in models:

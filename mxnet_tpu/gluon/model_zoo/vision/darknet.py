@@ -1,0 +1,82 @@
+"""Darknet-53 (YOLOv3 backbone; Redmon & Farhadi, "YOLOv3: An Incremental
+Improvement", 1804.02767 Table 1).
+
+The reference repo names "GluonCV: ResNet-50 / YOLOv3" as its flagship
+detection config (BASELINE.json); the backbone lives in GluonCV
+(``gluoncv/model_zoo/yolo/darknet.py``) rather than in-tree, so this is a
+from-scratch TPU-native build of the published architecture: every conv is
+Conv-BN-LeakyReLU(0.1) which XLA fuses into one MXU pass; residual blocks
+are 1x1 (half channels) → 3x3; five stride-2 stages give the 8/16/32
+feature pyramid YOLOv3 taps.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["DarknetV3", "darknet53", "get_darknet"]
+
+
+def _conv2d(channels, kernel, padding, strides=1):
+    """conv-bn-leaky(0.1) — the only conv motif darknet uses."""
+    cell = nn.HybridSequential()
+    cell.add(nn.Conv2D(channels, kernel_size=kernel, strides=strides,
+                       padding=padding, use_bias=False))
+    cell.add(nn.BatchNorm(epsilon=1e-5, momentum=0.9))
+    cell.add(nn.LeakyReLU(0.1))
+    return cell
+
+
+class DarknetBasicBlockV3(HybridBlock):
+    """Residual: 1x1 conv (channels//2) → 3x3 conv (channels) + identity."""
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential()
+        self.body.add(_conv2d(channels // 2, 1, 0))
+        self.body.add(_conv2d(channels, 3, 1))
+
+    def forward(self, x):
+        return x + self.body(x)
+
+
+class DarknetV3(HybridBlock):
+    """Darknet-53 trunk + classifier head.
+
+    ``layers``/``channels``: residual-block counts and output channels per
+    stage; darknet53 = layers [1,2,8,8,4], channels [64,128,256,512,1024].
+    """
+
+    def __init__(self, layers, channels, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(channels), (layers, channels)
+        self.features = nn.HybridSequential()
+        # stem: 3x3 stride 1, 32 channels
+        self.features.add(_conv2d(channels[0] // 2, 3, 1))
+        for nlayer, channel in zip(layers, channels):
+            # downsample 3x3 stride 2 then nlayer residual blocks
+            self.features.add(_conv2d(channel, 3, 1, strides=2))
+            for _ in range(nlayer):
+                self.features.add(DarknetBasicBlockV3(channel))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.mean(axis=(2, 3))  # global average pool
+        return self.output(x)
+
+
+darknet_versions = {"v3": DarknetV3}
+darknet_spec = {
+    "v3": {53: ([1, 2, 8, 8, 4], [64, 128, 256, 512, 1024])},
+}
+
+
+def get_darknet(darknet_version, num_layers, **kwargs):
+    layers, channels = darknet_spec[darknet_version][num_layers]
+    return darknet_versions[darknet_version](layers, channels, **kwargs)
+
+
+def darknet53(**kwargs):
+    """Darknet-53 classifier (1804.02767 Table 1)."""
+    return get_darknet("v3", 53, **kwargs)

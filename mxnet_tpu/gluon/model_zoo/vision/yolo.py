@@ -1,0 +1,352 @@
+"""YOLOv3 on the Gluon API (Redmon & Farhadi 1804.02767).
+
+The reference names "GluonCV: ResNet-50 / YOLOv3" as its flagship
+detection pairing (BASELINE.json); the implementation lives out-of-tree in
+GluonCV (``gluoncv/model_zoo/yolo/yolo3.py``), so this is a from-scratch
+TPU-first build of the published architecture, re-using the in-tree
+detection op family (``ops/detection.py``: IoU, box_nms).
+
+TPU-first design decisions (vs the GluonCV original):
+
+- **Static shapes end to end.** Anchor/offset grids are baked per feature
+  shape as trace constants; labels are fixed-width ``(B, M, 5)`` with -1
+  padding; NMS is the static-shape ``box_nms`` (pruned rows = -1), so the
+  whole inference path jits into one XLA program.
+- **Target assignment is host-side numpy** (``yolo3_targets``): the
+  matching scatter (one cell per gt) is data-dependent — on-device it
+  would be a serialized scatter chain; in the input pipeline it
+  overlaps with device compute, the same split the reference makes by
+  running label processing in its DataIter workers.
+- **The pred-dependent "ignore" mask is on-device** in ``YOLOV3Loss``: it
+  depends on decoded predictions, so it must live in the jitted loss —
+  one (B, N, M) IoU einsum, MXU-friendly, no host sync.
+- Upsampling is nearest ``repeat`` (fuses); route convs are 1x1.
+
+Scale order everywhere is [stride 8, stride 16, stride 32].
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ....base import MXNetError
+from ....ops import detection as _det
+from ....ops import nn as _ops
+from ... import nn
+from ...block import HybridBlock
+from .darknet import _conv2d, darknet53
+
+__all__ = ["YOLOV3", "YOLOV3Loss", "yolo3_darknet53", "yolo3_targets"]
+
+# canonical COCO anchors (1804.02767 §2.3), pixels at 416 input,
+# grouped per scale [stride8, stride16, stride32]
+_DEFAULT_ANCHORS = [
+    [(10, 13), (16, 30), (33, 23)],
+    [(30, 61), (62, 45), (59, 119)],
+    [(116, 90), (156, 198), (373, 326)],
+]
+_DEFAULT_STRIDES = [8, 16, 32]
+
+
+def _upsample2x(x):
+    """Nearest 2x upsample, NCHW: two repeats XLA fuses into one copy."""
+    return x.repeat(2, axis=2).repeat(2, axis=3)
+
+
+class YOLODetectionBlockV3(HybridBlock):
+    """5 alternating 1x1(c)/3x3(2c) convs ("body") + a 3x3(2c) "tip".
+
+    The body output routes laterally (and, through a 1x1 transition, up
+    to the next-shallower scale); the tip feeds this scale's output conv.
+    """
+
+    def __init__(self, channel, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential()
+        for _ in range(2):
+            self.body.add(_conv2d(channel, 1, 0))
+            self.body.add(_conv2d(channel * 2, 3, 1))
+        self.body.add(_conv2d(channel, 1, 0))
+        self.tip = _conv2d(channel * 2, 3, 1)
+
+    def forward(self, x):
+        route = self.body(x)
+        return route, self.tip(route)
+
+
+class YOLOOutputV3(HybridBlock):
+    """Per-scale 1x1 output conv + raw-prediction unpacking/decoding."""
+
+    def __init__(self, num_class, anchors, stride, **kwargs):
+        super().__init__(**kwargs)
+        self._classes = num_class
+        self._num_pred = 4 + 1 + num_class
+        self._anchors = onp.asarray(anchors, onp.float32)  # (A, 2)
+        self._na = self._anchors.shape[0]
+        self._stride = stride
+        self.prediction = nn.Conv2D(self._na * self._num_pred, 1, padding=0)
+        self._grid_cache = {}
+
+    def _grids(self, h, w):
+        """(1, H*W*A, 2) cell offsets + tiled anchors, cached per shape —
+        trace constants under jit, uploaded once in eager mode."""
+        key = (h, w)
+        if key not in self._grid_cache:
+            from .... import np as mnp
+
+            ys, xs = onp.meshgrid(onp.arange(h), onp.arange(w),
+                                  indexing="ij")
+            off = onp.stack([xs, ys], axis=-1).astype(onp.float32)  # x,y
+            off = onp.repeat(off.reshape(h * w, 1, 2), self._na, axis=1)
+            anc = onp.tile(self._anchors[None], (h * w, 1, 1))
+            self._grid_cache[key] = (
+                mnp.array(off.reshape(1, h * w * self._na, 2)),
+                mnp.array(anc.reshape(1, h * w * self._na, 2)))
+        return self._grid_cache[key]
+
+    def forward(self, x):
+        from .... import np as mnp
+
+        pred = self.prediction(x)  # (B, A*K, H, W)
+        b = pred.shape[0]
+        h, w = pred.shape[2], pred.shape[3]
+        k = self._num_pred
+        pred = pred.reshape(b, self._na, k, h, w)
+        pred = pred.transpose(0, 3, 4, 1, 2).reshape(b, h * w * self._na, k)
+        offsets, anchors = self._grids(h, w)
+        raw_center = pred[:, :, 0:2]
+        raw_scale = pred[:, :, 2:4]
+        objness = pred[:, :, 4:5]
+        cls_pred = pred[:, :, 5:]
+        strides = mnp.full((1, h * w * self._na, 1), float(self._stride))
+        return (raw_center, raw_scale, objness, cls_pred, anchors, offsets,
+                strides)
+
+
+def _decode_boxes(raw_center, raw_scale, anchors, offsets, strides):
+    """Raw predictions -> corner boxes in input pixels (1804.02767 §2.1):
+    b_xy = (σ(t_xy) + cell) * stride ; b_wh = anchor * exp(t_wh)."""
+    from .... import np as mnp
+
+    center = (_ops.sigmoid(raw_center) + offsets) * strides
+    # clip exp input: an untrained/diverged net must not overflow fp32
+    wh = anchors * mnp.exp(mnp.clip(raw_scale, -20.0, 8.0))
+    half = wh * 0.5
+    return mnp.concatenate([center - half, center + half], axis=-1)
+
+
+class YOLOV3(HybridBlock):
+    """Full detector: backbone stages → top-down detection blocks → three
+    ``YOLOOutputV3`` heads.
+
+    ``stages``: list of 3 blocks emitting stride-8/16/32 features.
+    Training-mode forward returns the raw tensors the loss consumes;
+    predict-mode returns ``(ids, scores, boxes)`` after per-class
+    expansion + NMS, everything static-shape.
+    """
+
+    def __init__(self, stages, channels=(128, 256, 512), classes=20,
+                 anchors=None, strides=None, nms_thresh=0.45, nms_topk=100,
+                 **kwargs):
+        super().__init__(**kwargs)
+        anchors = anchors or _DEFAULT_ANCHORS
+        strides = strides or _DEFAULT_STRIDES
+        if not (len(stages) == len(anchors) == len(strides) == 3):
+            raise MXNetError("YOLOV3 wants exactly 3 stages/anchor "
+                             "groups/strides")
+        self.classes = classes
+        self.nms_thresh = nms_thresh
+        self.nms_topk = nms_topk
+        # scale-ordered [stride8, stride16, stride32] — yolo_outputs below
+        # is built deepest-FIRST, so iterating the heads reverses this;
+        # target generation must use these, not the head order
+        self.anchors = [list(map(tuple, grp)) for grp in anchors]
+        self.strides = list(strides)
+        self.stages = nn.HybridSequential()
+        for s in stages:
+            self.stages.add(s)
+        # deepest-first construction (stride 32 -> 8)
+        self.yolo_blocks = nn.HybridSequential()
+        self.yolo_outputs = nn.HybridSequential()
+        self.transitions = nn.HybridSequential()
+        for i, ch in enumerate(reversed(channels)):     # 512, 256, 128
+            scale = len(channels) - 1 - i               # 2, 1, 0
+            self.yolo_blocks.add(YOLODetectionBlockV3(ch))
+            self.yolo_outputs.add(
+                YOLOOutputV3(classes, anchors[scale], strides[scale]))
+            if i < len(channels) - 1:
+                self.transitions.add(_conv2d(ch // 2, 1, 0))
+
+    def forward(self, x):
+        from .... import autograd
+        from .... import np as mnp
+
+        feats = []
+        for stage in self.stages:
+            x = stage(x)
+            feats.append(x)
+        # top-down pass, deepest first
+        outputs = []
+        route = None
+        for i, (block, head) in enumerate(zip(self.yolo_blocks,
+                                              self.yolo_outputs)):
+            feat = feats[len(feats) - 1 - i]
+            if route is not None:
+                feat = mnp.concatenate(
+                    [_upsample2x(self.transitions[i - 1](route)), feat],
+                    axis=1)
+            route, tip = block(feat)
+            outputs.append(head(tip))
+        outputs = outputs[::-1]  # back to [stride8, stride16, stride32]
+
+        cat = [mnp.concatenate([o[j] for o in outputs], axis=1)
+               for j in range(7)]
+        (raw_center, raw_scale, objness, cls_pred, anchors, offsets,
+         strides) = cat
+        if autograd.is_training():
+            return (raw_center, raw_scale, objness, cls_pred, anchors,
+                    offsets, strides)
+
+        boxes = _decode_boxes(raw_center, raw_scale, anchors, offsets,
+                              strides)                       # (B, N, 4)
+        scores = (_ops.sigmoid(cls_pred)
+                  * _ops.sigmoid(objness))                   # (B, N, C)
+        b, n = boxes.shape[0], boxes.shape[1]
+        c = self.classes
+        # per-class rows [id, score, x1, y1, x2, y2] -> (B, N*C, 6)
+        ids = mnp.broadcast_to(
+            mnp.arange(c).reshape(1, 1, c, 1), (b, n, c, 1))
+        sc = scores.reshape(b, n, c, 1)
+        bx = mnp.broadcast_to(boxes.reshape(b, n, 1, 4), (b, n, c, 4))
+        dets = mnp.concatenate([ids, sc, bx], axis=-1).reshape(b, n * c, 6)
+        dets = _det.box_nms(dets, overlap_thresh=self.nms_thresh,
+                            valid_thresh=0.01, topk=self.nms_topk,
+                            coord_start=2, score_index=1, id_index=0)
+        return dets[:, :, 0:1], dets[:, :, 1:2], dets[:, :, 2:6]
+
+
+def yolo3_targets(labels, input_size, num_class, anchors=None,
+                  strides=None):
+    """Host-side static target assignment (the GluonCV
+    ``YOLOV3PrefetchTargetGenerator`` role, run in the data pipeline).
+
+    ``labels``: (B, M, 5) numpy, rows [cls, x1, y1, x2, y2] normalized to
+    [0, 1], padded with -1. Each valid gt matches the ONE anchor (of 9)
+    whose shape-IoU at the origin is highest (1804.02767 §2.2), landing in
+    that anchor's scale at the gt center's cell.
+
+    Returns numpy arrays over the concatenated anchor axis N:
+    ``objness (B,N,1)``, ``center_t (B,N,2)``, ``scale_t (B,N,2)``,
+    ``weight (B,N,2)`` (2 - w*h box-size weighting, zero on unmatched),
+    ``cls_t (B,N,C)`` one-hot, ``gt_boxes (B,M,4)`` in pixels for the
+    loss's dynamic ignore mask.
+    """
+    anchors = onp.asarray(anchors or _DEFAULT_ANCHORS,
+                          onp.float32)            # (3, A, 2)
+    strides = onp.asarray(strides or _DEFAULT_STRIDES, onp.int64)
+    labels = onp.asarray(labels, onp.float32)
+    b, m, _ = labels.shape
+    na = anchors.shape[1]
+    sizes = [int(input_size // s) for s in strides]
+    n_per = [h * h * na for h in sizes]
+    n = sum(n_per)
+    starts = onp.cumsum([0] + n_per[:-1])
+
+    objness = onp.zeros((b, n, 1), onp.float32)
+    center_t = onp.zeros((b, n, 2), onp.float32)
+    scale_t = onp.zeros((b, n, 2), onp.float32)
+    weight = onp.zeros((b, n, 2), onp.float32)
+    cls_t = onp.zeros((b, n, num_class), onp.float32)
+    gt_boxes = onp.full((b, m, 4), -1.0, onp.float32)
+
+    flat_anchors = anchors.reshape(-1, 2)         # (9, 2)
+    for bi in range(b):
+        for mi in range(m):
+            cls, x1, y1, x2, y2 = labels[bi, mi]
+            if cls < 0:
+                continue
+            px1, py1, px2, py2 = (v * input_size for v in (x1, y1, x2, y2))
+            gt_boxes[bi, mi] = [px1, py1, px2, py2]
+            gw, gh = max(px2 - px1, 1e-6), max(py2 - py1, 1e-6)
+            # shape-only IoU at origin vs all 9 anchors
+            iw = onp.minimum(flat_anchors[:, 0], gw)
+            ih = onp.minimum(flat_anchors[:, 1], gh)
+            inter = iw * ih
+            iou = inter / (flat_anchors[:, 0] * flat_anchors[:, 1]
+                           + gw * gh - inter)
+            best = int(onp.argmax(iou))
+            scale_i, anchor_i = best // na, best % na
+            grid = sizes[scale_i]
+            cx = (px1 + px2) / 2 / strides[scale_i]
+            cy = (py1 + py2) / 2 / strides[scale_i]
+            ci = min(int(cx), grid - 1)
+            cj = min(int(cy), grid - 1)
+            idx = starts[scale_i] + (cj * grid + ci) * na + anchor_i
+            objness[bi, idx, 0] = 1.0
+            center_t[bi, idx] = [cx - ci, cy - cj]
+            scale_t[bi, idx] = [
+                onp.log(gw / flat_anchors[best, 0]),
+                onp.log(gh / flat_anchors[best, 1])]
+            weight[bi, idx] = 2.0 - gw * gh / input_size / input_size
+            cls_t[bi, idx, int(cls)] = 1.0
+    return objness, center_t, scale_t, weight, cls_t, gt_boxes
+
+
+def _sigmoid_bce(logits, targets, weight=None):
+    """Numerically stable elementwise sigmoid cross-entropy."""
+    from .... import np as mnp
+
+    loss = (mnp.maximum(logits, 0.0) - logits * targets
+            + mnp.log1p(mnp.exp(-mnp.abs(logits))))
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+class YOLOV3Loss(HybridBlock):
+    """Four-part YOLOv3 loss (GluonCV ``YOLOV3Loss`` semantics):
+    objectness BCE (with the dynamic IoU ignore mask), center BCE, scale
+    L2 (in t-space), class BCE — each normalized by batch positives."""
+
+    def __init__(self, ignore_iou_thresh=0.7, **kwargs):
+        super().__init__(**kwargs)
+        self._ignore = ignore_iou_thresh
+
+    def forward(self, raw_center, raw_scale, objness, cls_pred, anchors,
+                offsets, strides, obj_t, center_t, scale_t, weight, cls_t,
+                gt_boxes):
+        from .... import np as mnp
+
+        npos = mnp.maximum(obj_t.sum(), 1.0)
+
+        # dynamic part: decoded predictions overlapping ANY gt above the
+        # threshold are exempt from the negative-objectness loss
+        pred_boxes = _decode_boxes(raw_center, raw_scale, anchors, offsets,
+                                   strides)                    # (B,N,4)
+        iou = _det.box_iou(pred_boxes, gt_boxes,
+                           fmt="corner")                       # (B,N,M)
+        best_iou = iou.max(axis=-1, keepdims=True)             # (B,N,1)
+        obj_mask = obj_t + (1.0 - obj_t) * (best_iou < self._ignore)
+
+        obj_loss = _sigmoid_bce(objness, obj_t, obj_mask).sum() / npos
+        ctr_loss = _sigmoid_bce(raw_center, center_t,
+                                weight * obj_t).sum() / npos
+        diff = (raw_scale - scale_t)
+        scl_loss = (0.5 * diff * diff * weight * obj_t).sum() / npos
+        cls_loss = _sigmoid_bce(cls_pred, cls_t, obj_t).sum() / npos
+        return obj_loss + ctr_loss + scl_loss + cls_loss
+
+
+def yolo3_darknet53(classes=20, pretrained_base=False, **kwargs):
+    """YOLOv3 with a Darknet-53 backbone (the BASELINE.json flagship
+    detection config). ``classes`` excludes background (YOLO has none)."""
+    if pretrained_base:
+        raise MXNetError("no pretrained weight store in this environment; "
+                         "train from scratch or load_parameters")
+    base = darknet53()
+    feats = base.features
+    # stage split: stem+s1+s2+s3 = stride 8 (256ch) | s4 = stride 16
+    # (512ch) | s5 = stride 32 (1024ch); block counts per DarknetV3:
+    # 1 + (1+1) + (1+2) + (1+8) = 15, then 1+8 = 9, then 1+4 = 5
+    stages = [feats[:15], feats[15:24], feats[24:29]]
+    return YOLOV3(stages, channels=(128, 256, 512), classes=classes,
+                  **kwargs)

@@ -12,6 +12,7 @@ from ..ops.nn import *  # noqa: F401,F403
 from ..ops import nn as _nn
 from ..ops.control_flow import cond, foreach, while_loop  # noqa: F401
 from ..ops.detection import (  # noqa: F401
+    box_iou,
     box_nms,
     multibox_detection,
     multibox_prior,
@@ -108,5 +109,6 @@ __all__ = [n for n in dir(_nn) if not n.startswith("_")] + [
     "to_dlpack_for_write", "bernoulli", "normal_n", "uniform_n",
     "grid_generator", "bilinear_sampler", "spatial_transformer",
     "multibox_prior", "multibox_target", "multibox_detection", "box_nms",
-    "roi_align", "roi_pooling", "correlation", "deformable_convolution",
+    "box_iou", "roi_align", "roi_pooling", "correlation",
+    "deformable_convolution",
 ]

@@ -482,7 +482,27 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
     return _apply(f, (data, rois), name="roi_align")
 
 
+def box_iou(lhs, rhs, fmt="corner"):
+    """Batched pairwise IoU (reference ``bounding_box.cc``
+    ``_contrib_box_iou``/``_npx_box_iou``): lhs (..., N, 4) × rhs
+    (..., M, 4) → (..., N, M). Invalid boxes (non-positive extent, e.g.
+    the -1 padding convention) score 0 against everything."""
+    import jax
+
+    def f(a, b):
+        jnp = _jnp()
+        if fmt == "center":
+            a, b = _center_to_corner(a), _center_to_corner(b)
+        batch = a.shape[:-2]
+        fa = a.reshape((-1,) + a.shape[-2:])
+        fb = b.reshape((-1,) + b.shape[-2:])
+        out = jax.vmap(_iou_corner)(fa, fb)
+        return out.reshape(batch + out.shape[-2:])
+
+    return _apply(f, (lhs, rhs), name="box_iou")
+
+
 # registry entries: list_ops parity + mx.sym.<op> symbol constructors
 for _name in ("multibox_prior", "multibox_target", "multibox_detection",
-              "box_nms", "roi_align", "roi_pooling"):
+              "box_nms", "box_iou", "roi_align", "roi_pooling"):
     _register(_name, globals()[_name], wrapper=True)

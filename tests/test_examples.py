@@ -40,3 +40,9 @@ def test_bert_finetune_tiny():
 def test_ssd_detection_tiny():
     out = _run("ssd_detection.py", "--steps", "10", "--batch", "8")
     assert "top detections" in out
+
+
+def test_yolo3_detection_tiny():
+    out = _run("yolo3_detection.py", "--tiny", "--steps", "12", "--batch",
+               "4", "--size", "96")
+    assert "top detections" in out

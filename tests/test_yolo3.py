@@ -1,0 +1,197 @@
+"""YOLOv3 family tests (VERDICT r3 item 2): darknet53 backbone, target
+assignment oracle, loss finite + decreasing, hybridized inference, zoo
+exposure. Architecture per 1804.02767; reference flagship config naming
+per BASELINE.json ("GluonCV: ResNet-50 / YOLOv3")."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np as mnp
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.gluon.model_zoo.vision.darknet import _conv2d
+from mxnet_tpu.gluon.model_zoo.vision.yolo import (
+    _DEFAULT_ANCHORS, YOLOV3, YOLOV3Loss, yolo3_targets)
+
+
+def _tiny(classes=3):
+    def stage(ch, n_down):
+        s = nn.HybridSequential()
+        for _ in range(n_down):
+            s.add(_conv2d(ch, 3, 1, strides=2))
+        return s
+
+    anchors = [[(16, 16), (32, 24), (24, 32)],
+               [(48, 48), (64, 48), (48, 64)],
+               [(96, 96), (128, 96), (96, 128)]]
+    net = YOLOV3([stage(8, 3), stage(16, 1), stage(32, 1)],
+                 channels=(8, 16, 32), classes=classes, anchors=anchors)
+    net.initialize(init=mx.init.Xavier())
+    return net, anchors
+
+
+def test_net_anchors_are_scale_ordered():
+    """net.anchors must stay [stride8, 16, 32] even though the heads are
+    built deepest-first (the example reads it for target generation)."""
+    net, anchors = _tiny()
+    assert net.anchors == [list(map(tuple, g)) for g in anchors]
+    assert net.strides == [8, 16, 32]
+    # the deepest-first head order is the reverse
+    head_anchors = [tuple(map(tuple, h._anchors)) for h in net.yolo_outputs]
+    assert list(head_anchors) == [tuple(map(tuple, g))
+                                  for g in reversed(anchors)]
+
+
+def test_zoo_exposes_yolo3_and_darknet():
+    net = vision.get_model("yolo3_darknet53", classes=5)
+    assert isinstance(net, YOLOV3)
+    assert len(net.yolo_outputs) == 3
+    clf = vision.get_model("darknet53", classes=7)
+    # darknet53 trunk: 29 feature blocks (stem + 5 stages)
+    assert len(clf.features) == 29
+
+
+def test_darknet53_stage_strides_and_channels():
+    """The yolo3_darknet53 stage split must tap strides 8/16/32 with
+    channels 256/512/1024 (1804.02767 Table 1)."""
+    net = vision.get_model("yolo3_darknet53", classes=2)
+    net.initialize(init=mx.init.Xavier())
+    x = mnp.array(onp.random.rand(1, 3, 64, 64).astype("float32"))
+    with autograd.predict_mode():
+        feats = []
+        for stage in net.stages:
+            x = stage(x)
+            feats.append(x.shape)
+    assert feats == [(1, 256, 8, 8), (1, 512, 4, 4), (1, 1024, 2, 2)]
+
+
+def test_train_output_shapes():
+    net, _ = _tiny()
+    x = mnp.array(onp.random.rand(2, 3, 64, 64).astype("float32"))
+    with autograd.train_mode():
+        (raw_c, raw_s, obj, cls, anc, off, strd) = net(x)
+    n = (8 * 8 + 4 * 4 + 2 * 2) * 3
+    assert raw_c.shape == (2, n, 2)
+    assert raw_s.shape == (2, n, 2)
+    assert obj.shape == (2, n, 1)
+    assert cls.shape == (2, n, 3)
+    assert anc.shape == (1, n, 2)
+    assert off.shape == (1, n, 2)
+    assert strd.shape == (1, n, 1)
+
+
+def test_target_assignment_oracle():
+    """A gt box whose shape equals anchor (30, 61) of scale 1 must land at
+    exactly that scale/cell/anchor slot with the documented encodings."""
+    size = 128
+    labels = onp.full((1, 2, 5), -1.0, "float32")
+    # gt: 30x61px box centered at (70, 50) -> stride-16 cell (4, 3)
+    cx, cy, gw, gh = 70.0, 50.0, 30.0, 61.0
+    labels[0, 0] = [2, (cx - gw / 2) / size, (cy - gh / 2) / size,
+                    (cx + gw / 2) / size, (cy + gh / 2) / size]
+    obj, ctr, scl, wgt, cls, gtb = yolo3_targets(labels, size, 4)
+    n8, n16 = 16 * 16 * 3, 8 * 8 * 3
+    pos = onp.flatnonzero(obj[0, :, 0])
+    assert len(pos) == 1
+    idx = pos[0]
+    # scale 1 (stride 16), cell ci=4, cj=3, anchor 0 of that scale
+    ci, cj = int(cx / 16), int(cy / 16)
+    assert idx == n8 + (cj * 8 + ci) * 3 + 0
+    onp.testing.assert_allclose(ctr[0, idx], [cx / 16 - ci, cy / 16 - cj],
+                                atol=1e-5)
+    onp.testing.assert_allclose(scl[0, idx], [0.0, 0.0], atol=1e-5)
+    assert cls[0, idx].tolist() == [0.0, 0.0, 1.0, 0.0]
+    onp.testing.assert_allclose(wgt[0, idx],
+                                [2.0 - gw * gh / size / size] * 2,
+                                rtol=1e-5)
+    onp.testing.assert_allclose(
+        gtb[0, 0], [cx - gw / 2, cy - gh / 2, cx + gw / 2, cy + gh / 2])
+    # padded row stays invalid
+    onp.testing.assert_array_equal(gtb[0, 1], [-1, -1, -1, -1])
+    del n16
+
+
+def test_target_best_anchor_selection():
+    """gt shaped exactly like the largest default anchor must pick scale 2."""
+    size = 416
+    a_w, a_h = _DEFAULT_ANCHORS[2][2]  # (373, 326)
+    labels = onp.full((1, 1, 5), -1.0, "float32")
+    labels[0, 0] = [0, 0.5 - a_w / size / 2, 0.5 - a_h / size / 2,
+                    0.5 + a_w / size / 2, 0.5 + a_h / size / 2]
+    obj, _, scl, _, _, _ = yolo3_targets(labels, size, 1)
+    n8 = 52 * 52 * 3
+    n16 = 26 * 26 * 3
+    pos = onp.flatnonzero(obj[0, :, 0])
+    assert len(pos) == 1
+    assert pos[0] >= n8 + n16, "largest gt must land on the stride-32 head"
+    onp.testing.assert_allclose(scl[0, pos[0]], [0.0, 0.0], atol=1e-5)
+
+
+def test_loss_finite_and_decreases():
+    rng = onp.random.RandomState(3)
+    net, anchors = _tiny(classes=2)
+    loss_fn = YOLOV3Loss()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 2e-3})
+    size, batch = 64, 4
+    imgs = rng.rand(batch, 3, size, size).astype("float32")
+    labels = onp.full((batch, 1, 5), -1.0, "float32")
+    for i in range(batch):
+        labels[i, 0] = [i % 2, 0.25, 0.25, 0.75, 0.75]
+    targets = [mnp.array(t)
+               for t in yolo3_targets(labels, size, 2,
+                                      anchors=anchors)]
+    x = mnp.array(imgs)
+    losses = []
+    for _ in range(6):
+        with autograd.record():
+            loss = loss_fn(*net(x), *targets)
+        loss.backward()
+        tr.step(batch)
+        v = float(loss.asnumpy())
+        assert onp.isfinite(v)
+        losses.append(v)
+    assert losses[-1] < losses[0], losses
+
+
+def test_hybrid_matches_eager_train_outputs():
+    net, _ = _tiny()
+    x = mnp.array(onp.random.rand(1, 3, 64, 64).astype("float32"))
+    with autograd.train_mode():
+        eager = [o.asnumpy() for o in net(x)]
+    net.hybridize()
+    with autograd.train_mode():
+        hybrid = [o.asnumpy() for o in net(x)]
+    for e, h in zip(eager, hybrid):
+        onp.testing.assert_allclose(e, h, rtol=2e-5, atol=2e-5)
+
+
+def test_inference_shapes_and_nms_contract():
+    net, _ = _tiny(classes=3)
+    net.hybridize()
+    x = mnp.array(onp.random.rand(2, 3, 64, 64).astype("float32"))
+    with autograd.predict_mode():
+        ids, scores, boxes = net(x)
+    n = (8 * 8 + 4 * 4 + 2 * 2) * 3 * 3  # anchors × classes
+    assert ids.shape == (2, n, 1)
+    assert scores.shape == (2, n, 1)
+    assert boxes.shape == (2, n, 4)
+    s = scores.asnumpy()[:, :, 0]
+    # box_nms contract: rows sorted by descending score, pruned rows -1
+    valid = s >= 0
+    for b in range(2):
+        sv = s[b][valid[b]]
+        assert (onp.diff(sv) <= 1e-6).all()
+
+
+def test_box_iou_oracle():
+    from mxnet_tpu import npx
+
+    a = onp.array([[[0, 0, 2, 2], [1, 1, 3, 3]]], "float32")
+    b = onp.array([[[0, 0, 2, 2], [2, 2, 4, 4], [-1, -1, -1, -1]]],
+                  "float32")
+    got = npx.box_iou(mnp.array(a), mnp.array(b)).asnumpy()
+    assert got.shape == (1, 2, 3)
+    onp.testing.assert_allclose(got[0, 0], [1.0, 0.0, 0.0], atol=1e-6)
+    onp.testing.assert_allclose(got[0, 1], [1 / 7, 1 / 7, 0.0], rtol=1e-5)
