@@ -1,0 +1,2 @@
+"""Experiment harnesses (perf probes, memory proofs) — importable so the
+driver dryrun and tests share one config definition per experiment."""

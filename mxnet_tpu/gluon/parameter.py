@@ -115,6 +115,17 @@ class Parameter:
 
     # -- initialization ---------------------------------------------------
     def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        if getattr(self, "_abstract_placeholder", False):
+            # placeholder installed by functionalize_abstract (compile-only
+            # proofs): silently "already initialized" would leave 0-element
+            # weights in play — a real init must be explicit
+            if not force_reinit:
+                raise MXNetError(
+                    f"Parameter {self._name} holds an abstract (compile-only)"
+                    " placeholder from functionalize_abstract; pass "
+                    "force_reinit=True to materialize real weights")
+            self._abstract_placeholder = False
+            self._data = None
         if self._data is not None and not force_reinit:
             return
         if ctx is None:
@@ -192,6 +203,17 @@ class Parameter:
                 f"it lives on {list(self._data)}")
 
     def data(self, ctx=None):
+        if getattr(self, "_abstract_placeholder", False):
+            from ..cachedop import in_trace
+
+            # inside a functionalized trace the slot is rebound to the
+            # trace's tracer (that is its whole job); anywhere else the
+            # 0-element placeholder must not masquerade as weights
+            if not in_trace():
+                raise MXNetError(
+                    f"Parameter {self._name} belongs to an abstract "
+                    "(compile-only) functionalization and has no real "
+                    "data; re-initialize with force_reinit=True to train")
         self._check_initialized(ctx)
         if ctx is None:
             return next(iter(self._data.values()))

@@ -66,10 +66,17 @@ class LlamaAttention(HybridBlock):
         self._head_dim = units // num_heads
         self._theta = theta
         kv_units = self._head_dim * num_kv_heads
-        self.q_proj = nn.Dense(units, flatten=False, use_bias=False)
-        self.k_proj = nn.Dense(kv_units, flatten=False, use_bias=False)
-        self.v_proj = nn.Dense(kv_units, flatten=False, use_bias=False)
-        self.o_proj = nn.Dense(units, flatten=False, use_bias=False)
+        # explicit in_units: static shapes at construction, required by
+        # the abstract (compile-only) functionalize path used for the 8B
+        # AOT memory proof (parallel/functional.functionalize_abstract)
+        self.q_proj = nn.Dense(units, flatten=False, use_bias=False,
+                               in_units=units)
+        self.k_proj = nn.Dense(kv_units, flatten=False, use_bias=False,
+                               in_units=units)
+        self.v_proj = nn.Dense(kv_units, flatten=False, use_bias=False,
+                               in_units=units)
+        self.o_proj = nn.Dense(units, flatten=False, use_bias=False,
+                               in_units=units)
 
     def _heads_split(self, x, n):
         b, t, _ = x.shape
@@ -101,9 +108,12 @@ class LlamaFFN(HybridBlock):
 
     def __init__(self, units, hidden_size, **kwargs):
         super().__init__(**kwargs)
-        self.gate_proj = nn.Dense(hidden_size, flatten=False, use_bias=False)
-        self.up_proj = nn.Dense(hidden_size, flatten=False, use_bias=False)
-        self.down_proj = nn.Dense(units, flatten=False, use_bias=False)
+        self.gate_proj = nn.Dense(hidden_size, flatten=False,
+                                  use_bias=False, in_units=units)
+        self.up_proj = nn.Dense(hidden_size, flatten=False, use_bias=False,
+                                in_units=units)
+        self.down_proj = nn.Dense(units, flatten=False, use_bias=False,
+                                  in_units=hidden_size)
 
     def forward(self, x):
         g = _ops.activation(self.gate_proj(x), "silu")
@@ -114,9 +124,9 @@ class LlamaBlock(HybridBlock):
     def __init__(self, units, hidden_size, num_heads, num_kv_heads,
                  norm_eps=1e-5, **kwargs):
         super().__init__(**kwargs)
-        self.attn_norm = nn.RMSNorm(epsilon=norm_eps)
+        self.attn_norm = nn.RMSNorm(epsilon=norm_eps, in_channels=units)
         self.attention = LlamaAttention(units, num_heads, num_kv_heads)
-        self.ffn_norm = nn.RMSNorm(epsilon=norm_eps)
+        self.ffn_norm = nn.RMSNorm(epsilon=norm_eps, in_channels=units)
         self.ffn = LlamaFFN(units, hidden_size)
 
     def forward(self, x):
@@ -128,12 +138,21 @@ class LlamaBlock(HybridBlock):
 class LlamaModel(HybridBlock):
     """Decoder-only LM; forward returns logits (B, T, vocab)."""
 
+    # ShardedTrainer protocol: the model casts params to the AMP dtype
+    # inside its own remat boundary (cast-at-use; see forward) instead of
+    # the trainer pre-casting the whole tree
+    supports_inner_amp = True
+
     def __init__(self, vocab_size=32000, units=4096, hidden_size=11008,
                  num_layers=32, num_heads=32, num_kv_heads=None,
-                 norm_eps=1e-5, tie_embeddings=False, **kwargs):
+                 norm_eps=1e-5, tie_embeddings=False, remat=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._tie = tie_embeddings
+        # remat: re-compute each decoder layer in backward instead of
+        # saving its activations (jax.checkpoint) — HBM-for-FLOPs trade
+        # that makes 8B training fit a v5e's 16 GB (exp/llama8b_aot.py)
+        self._remat = remat
         self.embed = nn.Embedding(vocab_size, units)
         self._blocks = []
         for i in range(num_layers):
@@ -141,15 +160,60 @@ class LlamaModel(HybridBlock):
                              norm_eps)
             self._blocks.append(blk)
             self.register_child(blk, f"layer{i}")
-        self.norm = nn.RMSNorm(epsilon=norm_eps)
+        self.norm = nn.RMSNorm(epsilon=norm_eps, in_channels=units)
         if not tie_embeddings:
             self.lm_head = nn.Dense(vocab_size, flatten=False,
-                                    use_bias=False)
+                                    use_bias=False, in_units=units)
 
     def forward(self, input_ids):
         x = self.embed(input_ids)
-        for blk in self._blocks:
-            x = blk(x)
+        from ..cachedop import in_trace
+
+        if self._remat and in_trace():
+            # only under a functionalized trace (ShardedTrainer/CachedOp):
+            # the eager tape records per-op and cannot see through
+            # jax.checkpoint, so eager mode keeps the plain loop
+            import jax
+            import jax.numpy as jnp
+
+            from ..cachedop import _ParamBinding
+            from ..ndarray.ndarray import NDArray
+
+            # inner AMP (see ShardedTrainer supports_inner_amp): cast
+            # params to the compute dtype INSIDE the checkpointed layer,
+            # with the fp32 masters as the closed-over residuals — the
+            # bf16 copies are transient and re-materialize in backward,
+            # so AMP costs zero extra live parameter bytes (a pre-cast
+            # outside the checkpoint keeps a full bf16 param copy alive
+            # through the whole step; measured 3.5 GiB/device on the 8B
+            # proof, exp/llama8b_aot.py)
+            amp = getattr(self, "_amp_dtype", None)
+            if amp is not None:
+                x = x.astype(amp)
+
+            for blk in self._blocks:
+                # params enter as closed-over tracers (functionalize's
+                # _ParamBinding); jax.checkpoint differentiates through
+                # the closure, so grads still flow to every weight
+                def layer_fn(xd, _blk=blk):
+                    if amp is None:
+                        return _blk(NDArray(xd))._data
+                    ps = list(_blk.collect_params().values())
+                    arrays = [p.data() for p in ps]
+                    casts = [
+                        a._data.astype(amp)
+                        if jnp.issubdtype(a._data.dtype, jnp.floating)
+                        else a._data for a in arrays]
+                    with _ParamBinding(arrays, casts):
+                        return _blk(NDArray(xd))._data
+
+                x = NDArray(jax.checkpoint(layer_fn)(x._data))
+            if amp is not None:
+                # final norm + lm_head + loss run at master precision
+                x = x.astype(jnp.float32)
+        else:
+            for blk in self._blocks:
+                x = blk(x)
         x = self.norm(x)
         if self._tie:
             w = self.embed.weight.data()

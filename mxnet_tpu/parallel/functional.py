@@ -95,6 +95,66 @@ def functionalize(block, train_mode=False):
     return apply_fn, params
 
 
+def functionalize_abstract(block):
+    """``functionalize`` for compile-only flows: parameters are NEVER
+    materialized. Returns ``(apply_fn, {name: jax.ShapeDtypeStruct})``.
+
+    Every uninitialized Parameter must carry a complete static shape (the
+    model must be built with explicit ``in_units``/``in_channels``) — it
+    gets a 0-element placeholder slot whose only job is identity for the
+    trace-time rebinding (``_ParamBinding`` swaps ``_data`` for the
+    tracer, so the placeholder's shape is never read). This is what makes
+    an 8B-parameter AOT memory proof possible on a laptop-sized host
+    (VERDICT r3 item 5): nothing but ShapeDtypeStructs ever exists.
+    """
+    import jax
+    from collections import OrderedDict
+
+    import numpy as _np
+
+    from ..device import cpu
+    from ..ndarray.ndarray import NDArray
+
+    params_od = block.collect_params()
+    structs = {}
+    placeholders = []
+    for n, p in params_od.items():
+        if p._data is None:
+            if not _param_shape_complete(p.shape):
+                raise MXNetError(
+                    f"functionalize_abstract: parameter {n!r} has "
+                    f"incomplete shape {p.shape}; build the model with "
+                    "explicit in_units/in_channels so shapes are static")
+            import jax.numpy as jnp
+
+            slot = NDArray(jnp.zeros((0,), p.dtype or _np.float32))
+            p._data = OrderedDict({cpu(): slot})
+            placeholders.append(p)
+        structs[n] = jax.ShapeDtypeStruct(
+            tuple(p.shape), p.dtype or _np.float32)
+    apply_fn, _ = functionalize(block, train_mode=True)
+    # poison AFTER functionalize captured the slots: the placeholder must
+    # never leak into eager use — Parameter.data()/initialize() raise on
+    # it outside a trace (inside a trace the slot is rebound to a tracer)
+    for p in placeholders:
+        p._abstract_placeholder = True
+    return apply_fn, structs
+
+
+def _param_shape_complete(shape):
+    return shape is not None and all(
+        isinstance(s, int) and s > 0 for s in shape)
+
+
+def _cost_analysis_of(compiled):
+    """Normalize jax Compiled.cost_analysis() across jax versions (older
+    ones return a one-element list)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca or {}
+
+
 def _collect_aux_losses(block):
     """Sum `aux_loss` values the forward just set on any sub-block (MoE
     router load-balance terms). Values are tracers from THIS trace — read
@@ -194,7 +254,8 @@ class ShardedTrainer:
 
     def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
                  mesh=None, rules: Optional[ShardingRules] = None,
-                 batch_spec=None, dtype=None, aux_loss_weight=0.01):
+                 batch_spec=None, dtype=None, aux_loss_weight=0.01,
+                 abstract=False):
         import jax
         from jax.sharding import NamedSharding
 
@@ -202,6 +263,7 @@ class ShardedTrainer:
         from . import mesh as mesh_mod
 
         self.block = block
+        self._abstract = bool(abstract)
         self.loss_fn = loss_fn
         if isinstance(optimizer, str):
             self.optimizer = opt_mod.create(optimizer,
@@ -255,7 +317,14 @@ class ShardedTrainer:
                 if n in params_od}
         else:
             self._frozen_names = set()
-            self._apply_fn, params = functionalize(block, train_mode=True)
+            if self._abstract:
+                # compile-only mode (VERDICT r3 item 5): params are
+                # ShapeDtypeStructs, never materialized — aot_lower() is
+                # the only runnable surface
+                self._apply_fn, params = functionalize_abstract(block)
+            else:
+                self._apply_fn, params = functionalize(block,
+                                                       train_mode=True)
             params_od = block.collect_params()
             self._train_names = [n for n in params
                                  if params_od[n].grad_req != "null"]
@@ -268,8 +337,18 @@ class ShardedTrainer:
             self.optimizer.param_dict = {
                 i: params_od[n] for i, n in enumerate(self._train_names)}
         # placement: params + optimizer state onto the mesh by rule
-        self.params = self.rules.shard(params, self.mesh)
-        self._opt_states = self._init_opt_states()
+        if self._abstract:
+            self.params = {
+                n: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype,
+                    sharding=NamedSharding(
+                        self.mesh,
+                        self.rules.spec_for(n, s.shape, self.mesh)))
+                for n, s in params.items()}
+            self._opt_states = self._init_opt_states_abstract()
+        else:
+            self.params = self.rules.shard(params, self.mesh)
+            self._opt_states = self._init_opt_states()
         self._step_jit = None
         self._compiled = {}   # batch-signature -> AOT executable
         self._last_compiled = None
@@ -304,6 +383,66 @@ class ShardedTrainer:
             states[n] = tuple(placed)
         return states
 
+    def _init_opt_states_abstract(self):
+        """Optimizer-state ShapeDtypeStructs via ``jax.eval_shape`` over
+        ``create_state_multi_precision`` — same shapes/dtypes the real
+        path materializes, zero bytes allocated."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        from ..gluon.trainer import _flatten_state
+        from ..ndarray.ndarray import NDArray
+
+        P = _P()
+        states = {}
+        for i, n in enumerate(self._train_names):
+            w_struct = self.params[n]
+
+            def mk(i=i, w_struct=w_struct):
+                import jax.numpy as jnp
+
+                w = NDArray(jnp.zeros(w_struct.shape, w_struct.dtype))
+                st = self.optimizer.create_state_multi_precision(i, w)
+                return tuple(s._data for s in _flatten_state(st))
+
+            flat = jax.eval_shape(mk)
+            spec = self.rules.spec_for(n, w_struct.shape, self.mesh)
+            states[n] = tuple(
+                jax.ShapeDtypeStruct(
+                    s.shape, s.dtype,
+                    sharding=NamedSharding(
+                        self.mesh,
+                        spec if tuple(s.shape) == tuple(w_struct.shape)
+                        else P()))
+                for s in flat)
+        return states
+
+    def aot_lower(self, batch_struct, labels_struct):
+        """AOT-compile ONE SPMD training step from ShapeDtypeStructs —
+        the compile/memory-plan-only proof path for configs too big to
+        materialize on the host (``abstract=True`` trainers; Llama-3-8B
+        on a virtual v5e-8 mesh). Returns the jax ``Compiled`` object:
+        ``.memory_analysis()`` has the per-device argument/temp bytes the
+        fit assertion reads, ``.as_text()`` the HLO.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if self._step_jit is None:
+            self._build_step()
+        n_train = len(self._train_names)
+        lrs = tuple(self.optimizer._get_lr(i) for i in range(n_train))
+        wds = tuple(self.optimizer._get_wd(i) for i in range(n_train))
+        key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        train = {n: self.params[n] for n in self._train_names}
+        state = {n: self.params[n] for n in self._state_names}
+        args = (train, state, self._opt_states, batch_struct, labels_struct,
+                key_struct, lrs, wds, 1)
+        compiled = self._step_jit.lower(*args).compile()
+        self._last_compiled = compiled
+        self._step_flops = _cost_analysis_of(compiled).get("flops")
+        return compiled
+
     # -- the compiled step ------------------------------------------------
     def _build_step(self):
         import jax
@@ -317,6 +456,17 @@ class ShardedTrainer:
         has_state = bool(state_names)
 
         amp_dtype = self._dtype
+        # inner-AMP protocol: the block casts params at use inside its own
+        # remat boundary (LlamaModel.supports_inner_amp) — the trainer
+        # must NOT pre-cast the tree, or a full extra low-precision param
+        # copy stays live across the step
+        inner_amp = (amp_dtype is not None
+                     and getattr(self.block, "supports_inner_amp", False)
+                     and getattr(self.block, "_remat", False))
+        if getattr(self.block, "supports_inner_amp", False):
+            # unconditional assignment: a later fp32 trainer on the same
+            # block must clear a previous trainer's bf16 setting
+            self.block._amp_dtype = amp_dtype if inner_amp else None
 
         def cast_amp(x):
             if amp_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
@@ -326,10 +476,12 @@ class ShardedTrainer:
         def loss_of(train_params, state_params, batch, labels, key):
             params = dict(train_params)
             params.update(state_params)
-            if amp_dtype is not None:
+            if amp_dtype is not None and not inner_amp:
                 # cast-for-compute: autodiff through the cast hands back
                 # fp32 grads against the fp32 master params
                 params = {n: cast_amp(a) for n, a in params.items()}
+                batch = jax.tree_util.tree_map(cast_amp, batch)
+            elif inner_amp:
                 batch = jax.tree_util.tree_map(cast_amp, batch)
             batch = batch if isinstance(batch, tuple) else (batch,)
             r = apply_fn(params, *batch, rng_key=key)
@@ -469,10 +621,7 @@ class ShardedTrainer:
         bench.py reads (flops/bytes = arithmetic intensity)."""
         if self._last_compiled is None:
             return {}
-        ca = self._last_compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        return ca or {}
+        return _cost_analysis_of(self._last_compiled)
 
     def device_memory_bytes(self):
         """Per-device bytes held by params + optimizer state (shard 0):
@@ -515,13 +664,16 @@ class ShardedTrainer:
         matching and exposes XLA's cost analysis — the exact per-step
         FLOPs source for MFU reporting. Returns the executable's outputs;
         updates params/opt state from the first three."""
+        if self._abstract:
+            raise MXNetError(
+                "this ShardedTrainer was built with abstract=True "
+                "(compile-only): params were never materialized — use "
+                "aot_lower() for the memory proof, or rebuild without "
+                "abstract to train")
         hit = self._compiled.get(sig)
         if hit is None:
             compiled = jit_fn.lower(*args).compile()
-            ca = compiled.cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0] if ca else {}
-            flops = (ca or {}).get("flops")
+            flops = _cost_analysis_of(compiled).get("flops")
             self._compiled[sig] = (compiled, flops)
         else:
             compiled, flops = hit

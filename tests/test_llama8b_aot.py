@@ -1,0 +1,158 @@
+"""Llama-3-8B AOT sharding/memory proof + remat/inner-AMP correctness
+(VERDICT r3 item 5). The 8B config is NEVER materialized: the abstract
+trainer lowers from ShapeDtypeStructs (parallel/functional.py
+``functionalize_abstract`` / ``ShardedTrainer(abstract=True)``)."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models.llama import get_llama, llama_sharding_rules
+from mxnet_tpu.parallel.functional import ShardedTrainer, ShardingRules
+
+
+def _mesh8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    return Mesh(onp.array(devs[:8]).reshape(1, 8), ("dp", "tp"))
+
+
+def _loss_fn(out, labels):
+    from mxnet_tpu.gluon import loss as gl
+
+    return gl.SoftmaxCrossEntropyLoss(sparse_label=True)(out, labels)
+
+
+def _tiny_trainer(mesh, remat, amp, seed=0, optimizer="sgd"):
+    m = get_llama("llama_tiny_test", remat=remat)
+    m.initialize(init=mx.init.Xavier(), force_reinit=True)
+    onp.random.seed(seed)
+    for _, p in sorted(m.collect_params().items()):
+        p.set_data(mnp.array(
+            onp.random.randn(*p.shape).astype("float32") * 0.02))
+    return ShardedTrainer(m, _loss_fn, optimizer, {"learning_rate": 0.1},
+                          mesh=mesh, rules=ShardingRules(
+                              llama_sharding_rules()),
+                          batch_spec=P("dp"), dtype=amp)
+
+
+def test_llama8b_aot_fits_v5e():
+    """THE proof: 8.03B params, tp=8 fp32 Adam, remat, B=1 T=1024 —
+    per-device args+temp from XLA's buffer assignment < 16 GiB."""
+    mesh = _mesh8()
+    model = get_llama("llama3_8b", remat=True)
+    tr = ShardedTrainer(model, _loss_fn, "adam", {"learning_rate": 1e-4},
+                        mesh=mesh,
+                        rules=ShardingRules(llama_sharding_rules()),
+                        batch_spec=P("dp"), abstract=True)
+    n_params = sum(int(onp.prod(s.shape)) for s in tr.params.values())
+    assert abs(n_params / 1e9 - 8.03) < 0.01
+    # fp32 Adam arithmetic: 8.03e9 * 12 bytes / 8 devices = 11.22 GiB
+    args_expect = n_params * 12 / 8 / 2**30
+    compiled = tr.aot_lower(jax.ShapeDtypeStruct((1, 1024), jnp.int32),
+                            jax.ShapeDtypeStruct((1, 1024), jnp.int32))
+    ma = compiled.memory_analysis()
+    args_gib = ma.argument_size_in_bytes / 2**30
+    assert abs(args_gib - args_expect) < 0.2, (args_gib, args_expect)
+    peak = args_gib + ma.temp_size_in_bytes / 2**30
+    assert peak < 16.0, f"peak {peak:.2f} GiB exceeds v5e HBM"
+    # Megatron TP must communicate: partial-sum activations all-reduce
+    assert compiled.as_text().count("all-reduce") > 0
+
+
+def test_abstract_trainer_refuses_to_run():
+    mesh = _mesh8()
+    model = get_llama("llama_tiny_test")
+    tr = ShardedTrainer(model, _loss_fn, "sgd", {"learning_rate": 0.1},
+                        mesh=mesh,
+                        rules=ShardingRules(llama_sharding_rules()),
+                        batch_spec=P("dp"), abstract=True)
+    ids = onp.zeros((1, 16), "int32")
+    with pytest.raises(MXNetError):
+        tr.step(ids, ids)
+
+
+def test_remat_step_matches_plain_step():
+    """jax.checkpoint per decoder layer must not change the math."""
+    mesh = _mesh8()
+    ids = (onp.arange(32).reshape(1, 32) % 256).astype("int32")
+    results = []
+    for remat in (False, True):
+        tr = _tiny_trainer(mesh, remat=remat, amp=None)
+        loss = float(tr.step(ids, ids).asnumpy())
+        w = onp.asarray(tr.params[sorted(tr.params)[0]])
+        results.append((loss, w))
+    (l0, w0), (l1, w1) = results
+    assert abs(l0 - l1) < 1e-5
+    onp.testing.assert_allclose(w0, w1, atol=1e-7)
+
+
+def test_inner_amp_matches_outer_amp():
+    """Cast-at-use inside the remat boundary (supports_inner_amp) must
+    agree with the trainer's whole-tree pre-cast to bf16 tolerance."""
+    mesh = _mesh8()
+    ids = (onp.arange(32).reshape(1, 32) % 256).astype("int32")
+    results = []
+    for remat in (False, True):  # False -> outer pre-cast; True -> inner
+        tr = _tiny_trainer(mesh, remat=remat, amp=jnp.bfloat16)
+        loss = float(tr.step(ids, ids).asnumpy())
+        w = onp.asarray(tr.params[sorted(tr.params)[0]])
+        results.append((loss, w))
+    (l0, w0), (l1, w1) = results
+    assert abs(l0 - l1) < 1e-3
+    onp.testing.assert_allclose(w0, w1, atol=1e-4)
+
+
+def test_abstract_placeholders_are_poisoned():
+    """After an abstract functionalization, eager param access and silent
+    re-initialize must fail loudly; force_reinit recovers the block."""
+    from mxnet_tpu.parallel.functional import functionalize_abstract
+
+    m = get_llama("llama_tiny_test")
+    functionalize_abstract(m)
+    p = m.collect_params()[sorted(m.collect_params())[0]]
+    with pytest.raises(MXNetError):
+        p.data()
+    with pytest.raises(MXNetError):
+        m.initialize()
+    m.initialize(force_reinit=True)
+    out = m(mnp.array(onp.zeros((1, 8), dtype="int32")))
+    assert out.shape == (1, 8, 256)
+
+
+def test_amp_dtype_does_not_leak_across_trainers():
+    """A bf16 trainer followed by an fp32 trainer on the SAME block must
+    not leave the block casting to bf16 (review finding r4)."""
+    mesh = _mesh8()
+    m = get_llama("llama_tiny_test", remat=True)
+    m.initialize(init=mx.init.Xavier())
+    ShardedTrainer(m, _loss_fn, "sgd", {"learning_rate": 0.1}, mesh=mesh,
+                   rules=ShardingRules(llama_sharding_rules()),
+                   batch_spec=P("dp"), dtype=jnp.bfloat16)._build_step()
+    assert m._amp_dtype == jnp.bfloat16
+    ShardedTrainer(m, _loss_fn, "sgd", {"learning_rate": 0.1}, mesh=mesh,
+                   rules=ShardingRules(llama_sharding_rules()),
+                   batch_spec=P("dp"), dtype=None)._build_step()
+    assert m._amp_dtype is None
+
+
+def test_functionalize_abstract_requires_static_shapes():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel.functional import functionalize_abstract
+
+    net = gluon.nn.Dense(4)  # deferred in_units
+    with pytest.raises(MXNetError):
+        functionalize_abstract(net)
+
+
+def test_llama_static_shapes_at_construction():
+    """All llama params must be statically shaped (the abstract path's
+    precondition) — pins the explicit in_units wiring."""
+    m = get_llama("llama_tiny_test")
+    for n, p in m.collect_params().items():
+        assert p.shape is not None and all(s > 0 for s in p.shape), (n, p.shape)
