@@ -247,6 +247,8 @@ class Parameter:
         """Overwrite the value on every context (reference ``set_data``)."""
         import jax
 
+        # real data cures an abstract (compile-only) placeholder
+        self._abstract_placeholder = False
         self.shape = tuple(data.shape)
         if self._data is None:
             if self._deferred_init is not None:

@@ -22,6 +22,12 @@ from ..ops.nn import (  # noqa: F401
     index_array,
     index_copy,
 )
+from ..ops.contrib_misc import (  # noqa: F401
+    count_sketch,
+    gradientmultiplier,
+    hawkes_ll,
+    quadratic,
+)
 from ..ops.spatial import (  # noqa: F401
     bilinear_sampler,
     correlation,
@@ -29,6 +35,23 @@ from ..ops.spatial import (  # noqa: F401
     grid_generator,
     spatial_transformer,
 )
+
+hawkesll = hawkes_ll  # reference registry spelling (_contrib_hawkesll)
+
+
+def __getattr__(name):
+    """Closed contrib surface: every remaining reference ``_contrib_*``
+    registry name resolves to a deliberate refusal with guidance (the
+    Horovod-stub pattern) rather than silently not existing. Only the
+    contrib-family refusal table is consulted — plain-nd names must NOT
+    appear here (feature-detection via hasattr stays truthful)."""
+    from ..ops import legacy
+
+    why = legacy.CONTRIB_NOT_SUPPORTED.get(name)
+    if why is not None:
+        return legacy._refusal(name, why)
+    raise AttributeError(
+        f"module 'mxnet_tpu.ndarray.contrib' has no attribute {name!r}")
 
 # reference CamelCase aliases (the C-registry names the generated
 # nd.contrib module exposed)

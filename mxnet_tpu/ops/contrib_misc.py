@@ -1,0 +1,158 @@
+"""Contrib tail ops (VERDICT r3 item 6): the reference's niche
+``_contrib_*`` kernels, implemented where they map cleanly onto XLA and
+refused-with-guidance where they don't.
+
+Implemented here:
+
+* ``quadratic`` — the reference's tutorial op
+  (``src/operator/contrib/quadratic_op-inl.h``): a·x² + b·x + c.
+* ``gradientmultiplier`` — identity forward, grad × scalar backward
+  (``src/operator/contrib/gradient_multiplier_op.cc``); the
+  gradient-reversal-layer building block (scalar = -λ).
+* ``count_sketch`` — random-projection sketch
+  (``src/operator/contrib/count_sketch-inl.h``): one scatter-add, which
+  is XLA-native; backward (a gather) comes from autodiff instead of the
+  hand-written CUDA backward.
+* ``hawkes_ll`` — marked-Hawkes-process log-likelihood
+  (``src/operator/contrib/hawkes_ll-inl.h``): the per-event recurrence
+  becomes a ``lax.scan`` over the sequence with one-hot mark updates
+  (K marks live in registers; no serialized scatter), vmapped over the
+  batch; the reference's hand-written backward kernel is replaced by
+  autodiff through the scan.
+
+Refused (see ``NOT_SUPPORTED`` in ``ops/legacy.py`` + ``nd.contrib``):
+DGL graph-sampling family (data-dependent output shapes — host-side
+graph preprocessing is the TPU-correct place), intgemm (x86 VNNI
+intrinsics; the TPU int8 path is ``contrib/quantization``).
+"""
+from __future__ import annotations
+
+from .registry import apply as _apply
+from .registry import register as _register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a·data² + b·data + c (reference ``_contrib_quadratic``)."""
+
+    def f(x):
+        return a * x * x + b * x + c
+
+    return _apply(f, (data,), name="quadratic")
+
+
+_GRADMULT_FNS = {}  # scalar -> custom_vjp fn (stable identity for the
+                    # eager jit cache; a fresh closure per call would key
+                    # -miss forever and pin dead callables)
+
+
+def gradientmultiplier(data, scalar=1.0):
+    """Forward identity; backward multiplies the gradient by ``scalar``
+    (reference ``_contrib_gradientmultiplier``). ``scalar=-1`` is the
+    gradient reversal layer of domain-adversarial training."""
+    import jax
+
+    key = float(scalar)
+    f = _GRADMULT_FNS.get(key)
+    if f is None:
+        @jax.custom_vjp
+        def f(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, ct):
+            return (ct * key,)
+
+        f.defvjp(fwd, bwd)
+        _GRADMULT_FNS[key] = f
+    return _apply(f, (data,), name="gradientmultiplier")
+
+
+def count_sketch(data, h, s, out_dim, processing_batch_size=32):  # pylint: disable=unused-argument
+    """Count sketch projection (reference ``_contrib_count_sketch``):
+    ``out[n, h[i]] += s[i] * data[n, i]`` over the flattened-to-2D input.
+    ``processing_batch_size`` is accepted for API parity (a CUDA-kernel
+    chunking knob; XLA owns scheduling here)."""
+
+    def f(x, hh, ss):
+        jnp = _jnp()
+        x2 = x.reshape(x.shape[0], -1)
+        idx = hh.reshape(-1).astype(jnp.int32)
+        sign = ss.reshape(-1).astype(x2.dtype)
+        out = jnp.zeros((x2.shape[0], int(out_dim)), x2.dtype)
+        return out.at[:, idx].add(sign[None, :] * x2)
+
+    return _apply(f, (data, h, s), name="count_sketch")
+
+
+def hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Log-likelihood of a marked self-exciting Hawkes process
+    (reference ``_contrib_hawkesll``; kernel math in
+    ``hawkes_ll-inl.h:113-189``).
+
+    Shapes: mu (N,K), alpha (K,), beta (K,), state (N,K), lags (N,T),
+    marks (N,T) int32, valid_length (N,), max_time (N,).
+    Returns ``(loglike (N,), out_state (N,K))`` — the state advanced to
+    ``max_time`` for minibatched long sequences, exactly the reference's
+    two-output contract.
+    """
+    import jax
+
+    def f(mu_, alpha_, beta_, state_, lags_, marks_, vl_, mt_):
+        jnp = _jnp()
+        k = mu_.shape[1]
+
+        def one(mu_i, state_i, lags_i, marks_i, vl_i, mt_i):
+            def step(carry, inp):
+                ll, t, last, st = carry
+                lag, mark, valid = inp
+                onehot = jax.nn.one_hot(mark, k, dtype=mu_i.dtype)
+                t_new = t + lag
+                d = t_new - (last * onehot).sum()
+                ed = jnp.exp(-(beta_ * onehot).sum() * d)
+                a_m = (alpha_ * onehot).sum()
+                b_m = (beta_ * onehot).sum()
+                mu_m = (mu_i * onehot).sum()
+                s_m = (st * onehot).sum()
+                lda = mu_m + a_m * b_m * s_m * ed
+                comp = mu_m * d + a_m * s_m * (1 - ed)
+                # padding steps: mask lda to 1 so log() stays finite even
+                # when mu is 0 on an unused mark (0 * -inf would NaN)
+                lda = jnp.where(valid > 0, lda, 1.0)
+                ll_new = ll + valid * (jnp.log(lda) - comp)
+                st_new = jnp.where(valid * onehot > 0, 1 + st * ed, st)
+                last_new = jnp.where(valid * onehot > 0, t_new, last)
+                t_new = jnp.where(valid > 0, t_new, t)
+                return (ll_new, t_new, last_new, st_new), None
+
+            t0 = jnp.zeros((), mu_i.dtype)
+            last0 = jnp.zeros((k,), mu_i.dtype)
+            ll0 = jnp.zeros((), mu_i.dtype)
+            valid = (jnp.arange(lags_i.shape[0]) < vl_i).astype(mu_i.dtype)
+            (ll, _, last, st), _ = jax.lax.scan(
+                step, (ll0, t0, last0, state_i),
+                (lags_i, marks_i, valid))
+            # remaining compensator to max_time + state decay
+            # (hawkesll_forward_compensator)
+            d = mt_i - last
+            ed = jnp.exp(-beta_ * d)
+            rem = mu_i * d + alpha_ * st * (1 - ed)
+            return ll - rem.sum(), ed * st
+
+        return jax.vmap(one)(mu_, state_, lags_, marks_, vl_, mt_)
+
+    return _apply(f, (mu, alpha, beta, state, lags, marks, valid_length,
+                      max_time), name="hawkes_ll")
+
+
+for _name in ("quadratic", "gradientmultiplier", "count_sketch",
+              "hawkes_ll"):
+    _register(_name, globals()[_name], wrapper=True)
+_register("hawkesll", hawkes_ll, wrapper=True)  # reference spelling

@@ -686,6 +686,28 @@ NOT_SUPPORTED = {
     "scatter_set_nd": "alias of scatter_nd with write-inplace; use "
                       "scatter_nd / index_copy",
 }
+# Refusals that live under nd.contrib / sym.contrib ONLY (they were
+# _contrib_* registry names in the reference, never plain-nd names):
+CONTRIB_NOT_SUPPORTED = {}
+# DGL graph-sampling family (src/operator/contrib/dgl_graph.cc):
+# data-dependent output shapes (sampled neighborhoods, compacted graphs)
+# have no efficient XLA lowering — graph preprocessing belongs on the
+# host, feeding static-shape batches to the device
+for _n in ("dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+           "dgl_adjacency", "dgl_graph_compact", "edge_id"):
+    CONTRIB_NOT_SUPPORTED[_n] = (
+        "DGL graph sampling produces data-dependent shapes; run graph "
+        "sampling on the host (e.g. with scipy.sparse) and feed "
+        "static-shape index batches to the device ops (take/gather_nd)")
+# intgemm (src/operator/contrib/intgemm/): x86 VNNI/AVX512 intrinsics
+for _n in ("intgemm_fully_connected", "intgemm_maxabsolute",
+           "intgemm_prepare_data", "intgemm_prepare_weight",
+           "intgemm_take_weight"):
+    CONTRIB_NOT_SUPPORTED[_n] = (
+        "intgemm is an x86 SIMD int8 GEMM; the TPU int8 path is "
+        "mxnet_tpu.contrib.quantization (native int8 MXU convolutions "
+        "and quantized FC)")
 for _n in ("multi_sgd_update", "multi_sgd_mom_update", "multi_mp_sgd_update",
            "multi_mp_sgd_mom_update", "preloaded_multi_sgd_update",
            "preloaded_multi_sgd_mom_update", "preloaded_multi_mp_sgd_update",

@@ -119,7 +119,12 @@ def functionalize_abstract(block):
     structs = {}
     placeholders = []
     for n, p in params_od.items():
-        if p._data is None:
+        if getattr(p, "_abstract_placeholder", False):
+            # idempotent re-functionalization (second abstract trainer on
+            # the same block): lift the poison while we re-capture slots
+            p._abstract_placeholder = False
+            placeholders.append(p)
+        elif p._data is None:
             if not _param_shape_complete(p.shape):
                 raise MXNetError(
                     f"functionalize_abstract: parameter {n!r} has "
@@ -463,10 +468,7 @@ class ShardedTrainer:
         inner_amp = (amp_dtype is not None
                      and getattr(self.block, "supports_inner_amp", False)
                      and getattr(self.block, "_remat", False))
-        if getattr(self.block, "supports_inner_amp", False):
-            # unconditional assignment: a later fp32 trainer on the same
-            # block must clear a previous trainer's bf16 setting
-            self.block._amp_dtype = amp_dtype if inner_amp else None
+        inner_protocol = getattr(self.block, "supports_inner_amp", False)
 
         def cast_amp(x):
             if amp_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
@@ -484,7 +486,18 @@ class ShardedTrainer:
             elif inner_amp:
                 batch = jax.tree_util.tree_map(cast_amp, batch)
             batch = batch if isinstance(batch, tuple) else (batch,)
-            r = apply_fn(params, *batch, rng_key=key)
+            if inner_protocol:
+                # set for THIS trace only (block.forward reads it at
+                # trace time) and restore after: a persistent write
+                # would leak this trainer's dtype into a sibling
+                # trainer's later re-trace on the same block
+                prev_amp = getattr(self.block, "_amp_dtype", None)
+                self.block._amp_dtype = amp_dtype if inner_amp else None
+            try:
+                r = apply_fn(params, *batch, rng_key=key)
+            finally:
+                if inner_protocol:
+                    self.block._amp_dtype = prev_amp
             if has_state:
                 out, new_state = r
             else:

@@ -315,9 +315,14 @@ class _ContribNamespace:
     }
 
     def __getattr__(self, name):
-        from .ops import detection, spatial  # noqa: F401  (registration)
+        from .ops import contrib_misc, detection, legacy, spatial  # noqa: F401  (registration)
 
         target = self._ALIASES.get(name, name)
+        why = legacy.CONTRIB_NOT_SUPPORTED.get(target)
+        if why is not None:
+            # refusal resolves (closed surface) but raises with guidance
+            # at graph-construction time
+            return legacy._refusal(name, why)
         try:
             _resolve_op(target)
         except MXNetError:

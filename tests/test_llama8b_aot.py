@@ -126,19 +126,30 @@ def test_abstract_placeholders_are_poisoned():
 
 
 def test_amp_dtype_does_not_leak_across_trainers():
-    """A bf16 trainer followed by an fp32 trainer on the SAME block must
-    not leave the block casting to bf16 (review finding r4)."""
+    """Two trainers with different AMP dtypes on the SAME block: each
+    trainer's RE-trace (new batch signature) must keep ITS dtype — the
+    inner-AMP attribute is trace-scoped, not persistent block state."""
     mesh = _mesh8()
     m = get_llama("llama_tiny_test", remat=True)
     m.initialize(init=mx.init.Xavier())
-    ShardedTrainer(m, _loss_fn, "sgd", {"learning_rate": 0.1}, mesh=mesh,
-                   rules=ShardingRules(llama_sharding_rules()),
-                   batch_spec=P("dp"), dtype=jnp.bfloat16)._build_step()
-    assert m._amp_dtype == jnp.bfloat16
-    ShardedTrainer(m, _loss_fn, "sgd", {"learning_rate": 0.1}, mesh=mesh,
-                   rules=ShardingRules(llama_sharding_rules()),
-                   batch_spec=P("dp"), dtype=None)._build_step()
-    assert m._amp_dtype is None
+    tr_bf16 = ShardedTrainer(m, _loss_fn, "sgd", {"learning_rate": 0.1},
+                             mesh=mesh,
+                             rules=ShardingRules(llama_sharding_rules()),
+                             batch_spec=P("dp"), dtype=jnp.bfloat16)
+    tr_fp32 = ShardedTrainer(m, _loss_fn, "sgd", {"learning_rate": 0.1},
+                             mesh=mesh,
+                             rules=ShardingRules(llama_sharding_rules()),
+                             batch_spec=P("dp"), dtype=None)
+    ids16 = (onp.arange(16).reshape(1, 16) % 256).astype("int32")
+    ids32 = (onp.arange(32).reshape(1, 32) % 256).astype("int32")
+    tr_bf16.step(ids16, ids16)
+    tr_fp32.step(ids16, ids16)   # would have clobbered a persistent attr
+    tr_bf16.step(ids32, ids32)   # fresh signature -> fresh trace
+    assert "bf16" in tr_bf16._last_compiled.as_text()
+    tr_fp32.step(ids32, ids32)
+    assert "bf16" not in tr_fp32._last_compiled.as_text()
+    # the attribute itself is restored after every trace
+    assert getattr(m, "_amp_dtype", None) is None
 
 
 def test_functionalize_abstract_requires_static_shapes():
