@@ -349,6 +349,20 @@ class HybridBlock(Block):
         return Block.__call__(self, *args, **kwargs)
 
 
+def _register_param_arrays(block, param_arrays):
+    """Bind a name->NDArray dict as initialized Parameters p0..pN on a
+    block (shared by SymbolBlock and _LegacySymbolBlock)."""
+    out = {}
+    for i, (name, arr) in enumerate(param_arrays.items()):
+        p = Parameter(name=name, shape=arr.shape, dtype=arr.dtype)
+        p.initialize(init="zeros", ctx=getattr(arr, "ctx", None))
+        p.set_data(arr)
+        block._reg_params[f"p{i}"] = p
+        object.__setattr__(block, f"p{i}", p)
+        out[name] = p
+    return out
+
+
 class SymbolBlock(Block):
     """Runs a previously exported compiled graph (reference SymbolBlock)."""
 
@@ -356,12 +370,7 @@ class SymbolBlock(Block):
         super().__init__()
         self._exported = exported
         self._param_names = list(param_arrays)
-        for i, (name, arr) in enumerate(param_arrays.items()):
-            p = Parameter(name=name, shape=arr.shape, dtype=arr.dtype)
-            p.initialize(init="zeros", ctx=arr.ctx)
-            p.set_data(arr)
-            self._reg_params[f"p{i}"] = p
-            object.__setattr__(self, f"p{i}", p)
+        _register_param_arrays(self, param_arrays)
         self._input_sig = input_sig
 
     @staticmethod
@@ -370,6 +379,14 @@ class SymbolBlock(Block):
         import jax.export as jexport
 
         from ..ndarray.utils import load
+
+        if str(symbol_file).endswith(".json"):
+            # REFERENCE artifact pair (model-symbol.json +
+            # model-0000.params): replay the nnvm graph through the
+            # legacy Symbol DAG (symbol.fromjson upgrade path) with the
+            # arg:/aux:-prefixed reference checkpoint bound as params
+            return _LegacySymbolBlock.imports(symbol_file, input_names,
+                                              param_file)
 
         with open(symbol_file, "rb") as f:
             exported = jexport.deserialize(f.read())
@@ -388,3 +405,50 @@ class SymbolBlock(Block):
         outs = self._exported.call(datas, *arg_datas)
         wrapped = [NDArray(o) for o in outs]
         return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+
+
+class _LegacySymbolBlock(Block):
+    """SymbolBlock over a REFERENCE model-symbol.json: replays the nnvm
+    graph through the legacy Symbol DAG. The reference loads such pairs
+    via ``SymbolBlock.imports`` (gluon/block.py:1500 there); this is the
+    same user contract on the TPU build's replay executor."""
+
+    def __init__(self, sym, params, input_names):
+        super().__init__()
+        self._sym = sym
+        self._input_names = list(input_names)
+        self._sym_params = _register_param_arrays(self, params)
+
+    @staticmethod
+    def imports(symbol_file, input_names=None, param_file=None):
+        from .. import symbol as sym_mod
+        from ..ndarray.utils import load
+
+        sym = sym_mod.load(symbol_file)
+        raw = load(param_file) if param_file else {}
+        if isinstance(raw, list):
+            raise MXNetError(
+                "reference param file has no names; save with keys "
+                "(arg:<name>/aux:<name>) to bind into a SymbolBlock")
+        # reference checkpoints prefix arg:/aux: (ndarray.cc Save via
+        # mx.model save_checkpoint); strip to the graph's variable names
+        params = {k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                  else k: v for k, v in raw.items()}
+        if input_names is None:
+            input_names = ["data"]
+        input_names = [str(n) for n in (
+            input_names if isinstance(input_names, (list, tuple))
+            else [input_names])]
+        free = [n for n in sym.list_arguments()
+                if n not in params and n not in input_names]
+        if free:
+            raise MXNetError(
+                f"symbol arguments {free} have no parameter in "
+                f"{param_file!r} and are not inputs {input_names}")
+        return _LegacySymbolBlock(sym, params, input_names)
+
+    def forward(self, *args):
+        bindings = {n: p.data() for n, p in self._sym_params.items()}
+        for name, arr in zip(self._input_names, args):
+            bindings[name] = arr
+        return self._sym._eval_with(bindings)

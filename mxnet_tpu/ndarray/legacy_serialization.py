@@ -1,0 +1,240 @@
+"""Reference MXNet NDArray binary format — reader/writer.
+
+Byte-level transcription of ``/root/reference/src/ndarray/ndarray.cc``:
+
+List container (``NDArray::Save(fo, data, names)``, ndarray.cc:1937-1945)::
+
+    uint64  0x112 (kMXAPINDArrayListMagic)     uint64  0 (reserved)
+    uint64  n_arrays    then per array: NDArray::Save payload
+    uint64  n_names     then per name:  uint64 len + bytes
+
+Per-array payload (``NDArray::Save``, ndarray.cc:1702-1776)::
+
+    uint32  magic: 0xF993fac9 (V2) | 0xF993faca (V3, np-shape semantics)
+    int32   storage type (0 dense / 1 row_sparse / 2 csr, ndarray.h:61-66)
+    [sparse only] storage_shape  TShape = int32 ndim + int64[ndim]
+    TShape  shape
+    int32   dev_type, int32 dev_id          (Context::Save, base.h:145)
+    int32   type_flag (mshadow/base.h:339: 0 f32, 1 f64, 2 f16, 3 u8,
+                       4 i32, 5 i8, 6 i64, 7 bool)
+    [sparse only, per aux] int32 aux_type + TShape aux_shape
+    raw data bytes (values for sparse), then aux arrays' bytes
+
+Pre-V1 "legacy" payload (``NDArray::LegacyLoad`` + ``LegacyTShapeLoad``,
+ndarray.cc:1778-1823): the magic word IS the ndim, followed by
+uint32[ndim] dims, context, type_flag, data — files written by 0.x-era
+MXNet. The V1 magic (0xF993fac8) then carries a modern TShape.
+
+``mx.nd.load`` sniffs the list magic and dispatches here, so a genuine
+reference ``.params``/``.nd`` artifact loads with no flags; ``mx.nd.save
+(..., fmt='reference')`` writes V2 bytes the reference can read back.
+"""
+from __future__ import annotations
+
+import io
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+
+LIST_MAGIC = 0x112
+V1_MAGIC = 0xF993FAC8
+V2_MAGIC = 0xF993FAC9
+V3_MAGIC = 0xF993FACA
+
+# mshadow/base.h:339-346
+_FLAG_TO_DTYPE = {
+    0: _np.float32, 1: _np.float64, 2: _np.float16, 3: _np.uint8,
+    4: _np.int32, 5: _np.int8, 6: _np.int64, 7: _np.bool_,
+}
+_DTYPE_TO_FLAG = {_np.dtype(v): k for k, v in _FLAG_TO_DTYPE.items()}
+
+_STYPE_DEFAULT, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+_NUM_AUX = {_STYPE_DEFAULT: 0, _STYPE_ROW_SPARSE: 1, _STYPE_CSR: 2}
+
+
+class _Reader:
+    def __init__(self, raw):
+        self._b = memoryview(raw)
+        self._pos = 0
+
+    def read(self, n):
+        if self._pos + n > len(self._b):
+            raise MXNetError("reference NDArray file truncated")
+        out = self._b[self._pos:self._pos + n]
+        self._pos += n
+        return out
+
+    def u32(self):
+        return struct.unpack("<I", self.read(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.read(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self.read(8))[0]
+
+
+def _read_tshape(r):
+    ndim = r.i32()
+    if ndim < 0:  # unknown shape (np semantics none-array)
+        return None
+    return tuple(struct.unpack(f"<{ndim}q", r.read(8 * ndim)))
+
+
+def _read_array(r):
+    from .ndarray import NDArray
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    magic = r.u32()
+    if magic not in (V2_MAGIC, V3_MAGIC):
+        # ndarray.cc:1793-1823 LegacyLoad: V1 carries a TShape; anything
+        # else IS the ndim followed by uint32 dims
+        if magic == V1_MAGIC:
+            shape = _read_tshape(r)
+        else:
+            ndim = magic
+            if ndim > 8:
+                raise MXNetError(
+                    f"unrecognized NDArray magic 0x{magic:x}")
+            shape = tuple(struct.unpack(f"<{ndim}I", r.read(4 * ndim)))
+        r.i32()  # dev_type
+        r.i32()  # dev_id
+        flag = r.i32()
+        dtype = _np.dtype(_FLAG_TO_DTYPE[flag])
+        n = int(_np.prod(shape, dtype=_np.int64)) if shape else 1
+        host = _np.frombuffer(r.read(dtype.itemsize * n),
+                              dtype=dtype).reshape(shape)
+        return NDArray(host.copy())
+
+    stype = r.i32()
+    nad = _NUM_AUX.get(stype)
+    if nad is None:
+        raise MXNetError(f"unknown storage type {stype} in NDArray file")
+    sshape = _read_tshape(r) if nad else None
+    shape = _read_tshape(r)
+    if shape is None:
+        raise MXNetError("none-shape NDArray entries are not supported")
+    r.i32()  # dev_type (always loaded to cpu here)
+    r.i32()  # dev_id
+    flag = r.i32()
+    dtype = _np.dtype(_FLAG_TO_DTYPE[flag])
+    aux = []
+    for _ in range(nad):
+        aflag = r.i32()
+        ashape = _read_tshape(r)
+        aux.append((_np.dtype(_FLAG_TO_DTYPE[aflag]), ashape))
+    data_shape = sshape if nad else shape
+    n = int(_np.prod(data_shape, dtype=_np.int64))
+    data = _np.frombuffer(r.read(dtype.itemsize * n),
+                          dtype=dtype).reshape(data_shape).copy()
+    aux_arrays = []
+    for adtype, ashape in aux:
+        an = int(_np.prod(ashape, dtype=_np.int64))
+        aux_arrays.append(_np.frombuffer(
+            r.read(adtype.itemsize * an), dtype=adtype).reshape(ashape).copy())
+
+    if stype == _STYPE_DEFAULT:
+        return NDArray(data)
+    if stype == _STYPE_ROW_SPARSE:
+        # values carry the storage shape (nnz, cols...); aux0 = row ids
+        return RowSparseNDArray(NDArray(data), NDArray(aux_arrays[0]),
+                                tuple(shape))
+    # csr: aux0 = indptr, aux1 = column indices, values 1-D (nnz,)
+    return CSRNDArray(NDArray(data), NDArray(aux_arrays[0]),
+                      NDArray(aux_arrays[1]), tuple(shape))
+
+
+def is_reference_file(head: bytes) -> bool:
+    return len(head) >= 8 and \
+        struct.unpack("<Q", head[:8])[0] == LIST_MAGIC
+
+
+def load_reference(raw):
+    """Parse a reference list file; returns list (unnamed) or dict."""
+    r = _Reader(raw)
+    if r.u64() != LIST_MAGIC:
+        raise MXNetError("not a reference NDArray list file")
+    r.u64()  # reserved
+    arrays = [_read_array(r) for _ in range(r.u64())]
+    names = []
+    for _ in range(r.u64()):
+        ln = r.u64()
+        names.append(bytes(r.read(ln)).decode())
+    if not names:
+        return arrays
+    if len(names) != len(arrays):
+        raise MXNetError("corrupt reference file: name/array count mismatch")
+    return dict(zip(names, arrays))
+
+
+# ---------------------------------------------------------------------------
+# writer (V2 bytes the reference's NDArray::Load accepts)
+# ---------------------------------------------------------------------------
+
+
+def _write_tshape(w, shape):
+    w.write(struct.pack("<i", len(shape)))
+    w.write(struct.pack(f"<{len(shape)}q", *shape))
+
+
+def _write_array(w, arr):
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    w.write(struct.pack("<I", V2_MAGIC))
+    if isinstance(arr, RowSparseNDArray):
+        vals = arr.values.asnumpy()
+        idx = arr.indices.asnumpy().astype(_np.int64)
+        w.write(struct.pack("<i", _STYPE_ROW_SPARSE))
+        _write_tshape(w, vals.shape)
+        _write_tshape(w, arr.shape)
+        w.write(struct.pack("<ii", 1, 0))  # cpu ctx
+        w.write(struct.pack("<i", _DTYPE_TO_FLAG[vals.dtype]))
+        w.write(struct.pack("<i", _DTYPE_TO_FLAG[_np.dtype(_np.int64)]))
+        _write_tshape(w, idx.shape)
+        w.write(_np.ascontiguousarray(vals).tobytes())
+        w.write(_np.ascontiguousarray(idx).tobytes())
+        return
+    if isinstance(arr, CSRNDArray):
+        vals = arr.values.asnumpy()
+        indptr = arr.indptr.asnumpy().astype(_np.int64)
+        idx = arr.indices.asnumpy().astype(_np.int64)
+        w.write(struct.pack("<i", _STYPE_CSR))
+        _write_tshape(w, vals.shape)
+        _write_tshape(w, arr.shape)
+        w.write(struct.pack("<ii", 1, 0))
+        w.write(struct.pack("<i", _DTYPE_TO_FLAG[vals.dtype]))
+        for a in (indptr, idx):
+            w.write(struct.pack("<i", _DTYPE_TO_FLAG[_np.dtype(_np.int64)]))
+            _write_tshape(w, a.shape)
+        w.write(_np.ascontiguousarray(vals).tobytes())
+        w.write(_np.ascontiguousarray(indptr).tobytes())
+        w.write(_np.ascontiguousarray(idx).tobytes())
+        return
+    host = arr.asnumpy()
+    if host.dtype not in _DTYPE_TO_FLAG:
+        raise MXNetError(
+            f"dtype {host.dtype} has no reference type_flag (bf16 arrays "
+            "must be cast to float32 before fmt='reference' save)")
+    w.write(struct.pack("<i", _STYPE_DEFAULT))
+    _write_tshape(w, host.shape)
+    w.write(struct.pack("<ii", 1, 0))
+    w.write(struct.pack("<i", _DTYPE_TO_FLAG[host.dtype]))
+    w.write(_np.ascontiguousarray(host).tobytes())
+
+
+def save_reference(items, names=None) -> bytes:
+    """Serialize arrays to reference V2 list bytes."""
+    w = io.BytesIO()
+    w.write(struct.pack("<QQ", LIST_MAGIC, 0))
+    w.write(struct.pack("<Q", len(items)))
+    for a in items:
+        _write_array(w, a)
+    names = names or []
+    w.write(struct.pack("<Q", len(names)))
+    for n in names:
+        enc = n.encode()
+        w.write(struct.pack("<Q", len(enc)))
+        w.write(enc)
+    return w.getvalue()

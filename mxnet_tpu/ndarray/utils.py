@@ -19,8 +19,13 @@ from ..base import MXNetError
 _MAGIC = "MXTPU_NDARRAY_V1"
 
 
-def save(fname, data):
-    """Save a list or str->NDArray dict of arrays to ``fname``."""
+def save(fname, data, fmt="tpu"):
+    """Save a list or str->NDArray dict of arrays to ``fname``.
+
+    ``fmt='reference'`` writes the reference's magic-tagged binary
+    (``src/ndarray/ndarray.cc`` V2 format) so artifacts round-trip into a
+    real MXNet install; the default TPU container is a zip readable
+    without the framework."""
     from .ndarray import NDArray
 
     if isinstance(data, NDArray):
@@ -33,6 +38,20 @@ def save(fname, data):
         keyed = True
     else:
         raise MXNetError("save expects NDArray, list, or dict of NDArrays")
+
+    if fmt == "reference":
+        from .legacy_serialization import save_reference
+
+        raw = save_reference([a for _, a in items],
+                             [n for n, _ in items] if keyed else None)
+        if hasattr(fname, "write"):
+            fname.write(raw)
+        else:
+            with open(fname, "wb") as f:
+                f.write(raw)
+        return
+    if fmt != "tpu":
+        raise MXNetError(f"unknown save format {fmt!r} (tpu|reference)")
 
     manifest = {"magic": _MAGIC, "keyed": keyed, "tensors": []}
     with zipfile.ZipFile(fname, "w", zipfile.ZIP_STORED) as zf:
@@ -47,8 +66,24 @@ def save(fname, data):
 
 
 def load(fname):
-    """Load arrays saved by :func:`save`; returns list or dict as saved."""
+    """Load arrays saved by :func:`save` — OR a genuine reference-format
+    artifact (``.params``/``.nd`` written by real MXNet; sniffed by the
+    0x112 list magic, ``src/ndarray/ndarray.cc:1935``). Returns list or
+    dict as saved."""
+    from .legacy_serialization import is_reference_file, load_reference
     from .ndarray import NDArray
+
+    if hasattr(fname, "read"):
+        head = fname.read(8)
+        fname.seek(0)
+        if is_reference_file(head):
+            return load_reference(fname.read())
+    else:
+        with open(fname, "rb") as f:
+            head = f.read(8)
+        if is_reference_file(head):
+            with open(fname, "rb") as f:
+                return load_reference(f.read())
 
     with zipfile.ZipFile(fname, "r") as zf:
         manifest = json.loads(zf.read("manifest.json"))

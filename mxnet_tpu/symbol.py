@@ -245,19 +245,98 @@ def var(name, shape=None, dtype=None, **kwargs):  # pylint: disable=unused-argum
 Variable = var
 
 
+# Attr keys the legacy JSON upgrade hides/moves instead of parsing
+# (src/nnvm/legacy_json_util.cc kHiddenKeys handling): optimizer/placement
+# hints, not graph math — dropped on replay.
+_HIDDEN_ATTR_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                     "mirror_stage")
+
+
+def _literal(v):
+    import ast
+
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def fromjson(text):
+    """Build a Symbol from REFERENCE nnvm graph JSON (the format
+    ``Symbol.tojson``/``HybridBlock.export`` wrote in real MXNet), with
+    the legacy upgrade semantics of
+    ``src/nnvm/legacy_json_util.cc``: pre-1.0 ``"attr"``/``"param"``
+    dicts normalize to ``"attrs"``, hidden optimizer/placement keys
+    (``lr_mult``, ``ctx_group``, …) and ``__shape__``-style variable
+    annotations are dropped, and op names resolve through the shared
+    legacy surface (CamelCase + snake_case, ops/legacy.py)."""
+    data = json.loads(text) if isinstance(text, str) else text
+    if "nodes" not in data:
+        raise MXNetError("not a symbol JSON (no 'nodes')")
+    built = []
+    for node in data["nodes"]:
+        op = node.get("op", "null")
+        name = node.get("name")
+        # legacy_json_util.cc upgrade: attrs lived under "param" (pre-0.9)
+        # or "attr" (pre-1.0) before settling on "attrs"
+        attrs = dict(node.get("attrs") or node.get("attr")
+                     or node.get("param") or {})
+        for k in list(attrs):
+            if k in _HIDDEN_ATTR_KEYS or any(
+                    k.endswith("_" + h) for h in _HIDDEN_ATTR_KEYS) \
+                    or k.startswith("__"):
+                del attrs[k]
+        if op == "null":
+            var_sym = Symbol(None, (), {}, name=name)
+            # stored names are authoritative: bypass the NameManager so a
+            # surrounding name.Prefix scope cannot rename loaded nodes
+            # (parameter binding depends on exact names)
+            if name:
+                var_sym.name = name
+            built.append(var_sym)
+            continue
+        args = []
+        for ent in node.get("inputs", []):
+            src, out_idx = ent[0], ent[1] if len(ent) > 1 else 0
+            if out_idx != 0:
+                raise MXNetError(
+                    f"node {name!r} consumes output {out_idx} of a "
+                    "multi-output op; only single-output graphs replay in "
+                    "the TPU build — re-export the model via "
+                    "HybridBlock.export")
+            args.append(built[src])
+        kwargs = {k: _literal(v) for k, v in attrs.items()}
+        op_sym = Symbol(op, tuple(args), kwargs, name=name)
+        if name:
+            op_sym.name = name
+        built.append(op_sym)
+    heads = data.get("heads", [[len(built) - 1, 0, 0]])
+    if len(heads) != 1:
+        raise MXNetError(
+            "multi-head legacy symbols are not supported; export heads "
+            "separately or use HybridBlock.export")
+    if len(heads[0]) > 1 and heads[0][1] != 0:
+        raise MXNetError(
+            f"symbol head selects output {heads[0][1]} of a multi-output "
+            "op; only single-output graphs replay in the TPU build")
+    return built[heads[0][0]]
+
+
 def load(fname):
-    """Reload a Symbol saved by :meth:`Symbol.save`. Legacy nnvm JSON is
-    rejected with guidance (no nnvm runtime in the TPU build; use
-    HybridBlock.export / SymbolBlock.imports for models)."""
+    """Reload a Symbol saved by :meth:`Symbol.save` — or a REFERENCE
+    model-symbol.json (nnvm graph JSON incl. the pre-1.0 legacy layouts,
+    upgraded per ``src/nnvm/legacy_json_util.cc``; see :func:`fromjson`)."""
     import ast
 
     with open(fname) as f:
         data = json.load(f)
     if "mxnet_tpu_symbol" not in data:
+        if "arg_nodes" in data or "heads" in data:
+            return fromjson(data)
         raise MXNetError(
-            "legacy symbol JSON cannot be re-executed in the TPU build (no "
-            "nnvm runtime); export models with HybridBlock.export "
-            "(StableHLO) and reload with SymbolBlock.imports")
+            "unrecognized symbol JSON (neither mxnet_tpu_symbol nor nnvm "
+            "graph format); export models with HybridBlock.export and "
+            "reload with SymbolBlock.imports")
 
     def literal(r):
         try:

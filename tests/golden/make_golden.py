@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Generate golden reference-format artifacts for the compat tests.
+
+This is a deliberate, INDEPENDENT byte-level transcription of the
+reference writers — it shares no code with
+``mxnet_tpu/ndarray/legacy_serialization.py`` (the library reader under
+test), so a bug in the library's understanding of the format cannot
+cancel out in the tests. Sources transcribed:
+
+* list container + per-array payload: ``/root/reference/src/ndarray/
+  ndarray.cc:1693-1776, 1935-1945`` (NDArray::Save, V2 magic 0xF993fac9,
+  list magic 0x112), TShape = int32 ndim + int64 dims
+  (``include/mxnet/tuple.h:731``), Context = int32 dev_type + int32
+  dev_id (``include/mxnet/base.h:145``), mshadow type flags
+  (``3rdparty/mshadow/mshadow/base.h:339``)
+* the pre-V1 payload where the magic word IS the ndim followed by
+  uint32 dims (``ndarray.cc:1778-1800`` LegacyTShapeLoad default case)
+* 1.x-era symbol JSON with attrs under ``"param"`` and ``"attr"``
+  (upgraded by ``src/nnvm/legacy_json_util.cc``)
+
+Deterministic: all values are arange-derived literals. Re-running must
+reproduce the committed files byte-for-byte (asserted by the test).
+"""
+import json
+import os
+import struct
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+# optional output dir (tests regenerate into a tmp dir to compare hashes
+# without touching the committed artifacts)
+OUT = sys.argv[1] if len(sys.argv) > 1 else HERE
+
+LIST_MAGIC = 0x112
+V2_MAGIC = 0xF993FAC9
+F32, I64 = 0, 6  # mshadow type flags
+
+
+def tshape(shape):
+    return struct.pack("<i", len(shape)) + struct.pack(
+        f"<{len(shape)}q", *shape)
+
+
+def dense_v2(arr):
+    out = struct.pack("<I", V2_MAGIC)
+    out += struct.pack("<i", 0)                    # kDefaultStorage
+    out += tshape(arr.shape)
+    out += struct.pack("<ii", 1, 0)                # cpu:0
+    out += struct.pack("<i", F32)
+    out += np.ascontiguousarray(arr, np.float32).tobytes()
+    return out
+
+
+def dense_prev1(arr):
+    """Ancient payload: magic word IS ndim, dims are uint32."""
+    out = struct.pack("<I", arr.ndim)
+    out += struct.pack(f"<{arr.ndim}I", *arr.shape)
+    out += struct.pack("<ii", 1, 0)
+    out += struct.pack("<i", F32)
+    out += np.ascontiguousarray(arr, np.float32).tobytes()
+    return out
+
+
+def csr_v2(values, indptr, indices, shape):
+    out = struct.pack("<I", V2_MAGIC)
+    out += struct.pack("<i", 2)                    # kCSRStorage
+    out += tshape(values.shape)                    # storage shape
+    out += tshape(shape)
+    out += struct.pack("<ii", 1, 0)
+    out += struct.pack("<i", F32)
+    out += struct.pack("<i", I64) + tshape(indptr.shape)
+    out += struct.pack("<i", I64) + tshape(indices.shape)
+    out += np.ascontiguousarray(values, np.float32).tobytes()
+    out += np.ascontiguousarray(indptr, np.int64).tobytes()
+    out += np.ascontiguousarray(indices, np.int64).tobytes()
+    return out
+
+
+def row_sparse_v2(values, indices, shape):
+    out = struct.pack("<I", V2_MAGIC)
+    out += struct.pack("<i", 1)                    # kRowSparseStorage
+    out += tshape(values.shape)
+    out += tshape(shape)
+    out += struct.pack("<ii", 1, 0)
+    out += struct.pack("<i", F32)
+    out += struct.pack("<i", I64) + tshape(indices.shape)
+    out += np.ascontiguousarray(values, np.float32).tobytes()
+    out += np.ascontiguousarray(indices, np.int64).tobytes()
+    return out
+
+
+def list_file(payloads, names):
+    out = struct.pack("<QQ", LIST_MAGIC, 0)
+    out += struct.pack("<Q", len(payloads))
+    out += b"".join(payloads)
+    out += struct.pack("<Q", len(names))
+    for n in names:
+        out += struct.pack("<Q", len(n.encode())) + n.encode()
+    return out
+
+
+def mlp_params():
+    """Deterministic MLP weights (see golden-symbol.json)."""
+    w1 = (np.arange(12, dtype=np.float32).reshape(3, 4) - 5.0) / 10.0
+    b1 = np.array([0.1, -0.2, 0.3], np.float32)
+    w2 = (np.arange(6, dtype=np.float32).reshape(2, 3) - 2.0) / 5.0
+    b2 = np.array([-0.5, 0.5], np.float32)
+    return w1, b1, w2, b2
+
+
+def main():
+    w1, b1, w2, b2 = mlp_params()
+    with open(os.path.join(OUT, "golden_mlp.params"), "wb") as f:
+        f.write(list_file(
+            [dense_v2(w1), dense_v2(b1), dense_v2(w2), dense_v2(b2)],
+            ["arg:fc1_weight", "arg:fc1_bias", "arg:fc2_weight",
+             "arg:fc2_bias"]))
+
+    # unnamed list holding one modern + one pre-V1 ancient payload
+    anc = np.arange(6, dtype=np.float32).reshape(2, 3)
+    with open(os.path.join(OUT, "golden_legacy.nd"), "wb") as f:
+        f.write(list_file([dense_v2(anc * 2.0), dense_prev1(anc)], []))
+
+    # sparse pair: the 4x5 csr of [[0,1,0,2,0],[0,0,3,0,0],[0]*5,[4,0,0,0,5]]
+    vals = np.array([1, 2, 3, 4, 5], np.float32)
+    indptr = np.array([0, 2, 3, 3, 5], np.int64)
+    indices = np.array([1, 3, 2, 0, 4], np.int64)
+    rs_vals = np.array([[1, 2, 3], [4, 5, 6]], np.float32)
+    rs_idx = np.array([1, 3], np.int64)
+    with open(os.path.join(OUT, "golden_sparse.params"), "wb") as f:
+        f.write(list_file(
+            [csr_v2(vals, indptr, indices, (4, 5)),
+             row_sparse_v2(rs_vals, rs_idx, (4, 3))],
+            ["csr0", "rs0"]))
+
+    # 1.x-era symbol JSON: "param" (pre-0.9) on fc1, "attr" (pre-1.0) on
+    # the Activation, hidden keys (lr_mult) that the upgrade must drop
+    sym = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "fc1_weight", "inputs": [],
+             "attr": {"__shape__": "(3, 4)"}},
+            {"op": "null", "name": "fc1_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc1",
+             "param": {"num_hidden": "3", "lr_mult": "0.1"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+            {"op": "Activation", "name": "relu1",
+             "attr": {"act_type": "relu"}, "inputs": [[3, 0, 0]]},
+            {"op": "null", "name": "fc2_weight", "inputs": []},
+            {"op": "null", "name": "fc2_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc2",
+             "attrs": {"num_hidden": "2"},
+             "inputs": [[4, 0, 0], [5, 0, 0], [6, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2, 5, 6],
+        "node_row_ptr": list(range(9)),
+        "heads": [[7, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10700]},
+    }
+    with open(os.path.join(OUT, "golden-symbol.json"), "w") as f:
+        json.dump(sym, f, indent=2)
+    print("golden files written to", OUT)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,11 +48,21 @@ def test_symbol_json_round_trip_with_consts(tmp_path):
     assert back.list_arguments() == sym.list_arguments()
 
 
-def test_symbol_load_rejects_legacy_json(tmp_path):
+def test_symbol_load_accepts_nnvm_json_rejects_unknown(tmp_path):
+    """Round 4: genuine nnvm graph JSON now loads through the
+    legacy_json_util upgrade path (tests/test_reference_artifacts.py);
+    non-symbol JSON still gets a clear rejection."""
     p = tmp_path / "legacy.json"
-    p.write_text('{"nodes": [], "arg_nodes": [], "heads": []}')
-    with pytest.raises(MXNetError, match="nnvm"):
-        mx.sym.load(str(p))
+    p.write_text('{"nodes": [{"op": "null", "name": "x", "inputs": []},'
+                 '{"op": "exp", "name": "e", "inputs": [[0, 0, 0]]}],'
+                 '"arg_nodes": [0], "heads": [[1, 0, 0]]}')
+    s = mx.sym.load(str(p))
+    out = s.eval(x=mnp.zeros((2,)))
+    onp.testing.assert_allclose(out[0].asnumpy(), [1.0, 1.0])
+    q = tmp_path / "notasymbol.json"
+    q.write_text('{"something": 1}')
+    with pytest.raises(MXNetError):
+        mx.sym.load(str(q))
 
 
 def test_model_checkpoint_roundtrip(tmp_path):
