@@ -25,12 +25,16 @@ rows, for the driver's single-line parse.
 Round-3 findings baked into the rows (per-op device profiles via
 profiler.device_op_table):
 
-* ResNet-50 train bs256@224 is HBM-bandwidth-bound on v5e: XLA cost
-  analysis gives arithmetic intensity ~80 flops/byte vs the chip balance
-  of 240 (197 TFLOP/s / 819 GB/s), so the roofline MFU bound is ~0.33 —
-  each row carries `roofline_mfu_bound` so MFU is read against physics,
-  not against 1.0. Measured conv fusions sustain ~715 GB/s and
-  elementwise ~855 GB/s (HBM peak 819): the chip is saturated.
+* ResNet-50 train bs256@224 sits at the efficiency ceiling of XLA's
+  conv kernels for these shapes on v5e (round-4 finding,
+  exp/conv_chain_probe.py): per-shape isolated measurements put the
+  forward 3x3 stage convs at 52-87% MXU and the 1x1 bottleneck pairs at
+  22-41%, all below BOTH rooflines. The round-3 "HBM-saturated, bound
+  0.294" reading was an artifact: cost-analysis 'bytes accessed' counts
+  convolutions at ~2x their fusion-boundary traffic (elementwise: 1.0x),
+  so the step's true arithmetic intensity is ~2x the raw figure. Rows
+  carry `cost_analysis_mfu_floor` (the raw, conservative figure) and the
+  fused row names the real limiter.
 * BERT-base seq128 is MXU-bound and hits >=0.5 MFU once per-step host
   dispatch is amortized (`step_n` fused rows): matmul fusions run at ~83%
   of peak; dropout uses the rbg hardware RNG; attention at seq 128 takes
@@ -86,10 +90,19 @@ def _emit(row):
     return row
 
 
-def _timed_diff(step, fetch, k1, k2):
+_LAST_SAMPLES = None  # per-iteration seconds of the most recent _timed_diff
+
+
+def _timed_diff(step, fetch, k1, k2, repeats=3):
     """Per-iteration seconds of `step`, by the two-loop difference: run k1
     iterations + fetch, then k2, and divide the extra time by (k2-k1).
-    Cancels fetch RTT / lazy-dispatch artifacts of the tunnel runtime."""
+    Cancels fetch RTT / lazy-dispatch artifacts of the tunnel runtime.
+
+    Returns the median of ``repeats`` samples; all samples land in
+    ``_LAST_SAMPLES`` so rows can report n/spread (r3 verdict item 4:
+    a reader must be able to tell regression from tunnel weather)."""
+    global _LAST_SAMPLES
+
     def run(k):
         t0 = time.perf_counter()
         r = None
@@ -98,7 +111,7 @@ def _timed_diff(step, fetch, k1, k2):
         fetch(r)
         return time.perf_counter() - t0
     diffs = []
-    for _ in range(3):
+    for _ in range(repeats):
         d1 = run(k1)
         d2 = run(k2)
         if d2 > d1:
@@ -108,7 +121,52 @@ def _timed_diff(step, fetch, k1, k2):
             f"degenerate timing: {k2}-iter loops never exceeded {k1}-iter "
             f"loops — queue not drained before timing?")
     diffs.sort()
+    _LAST_SAMPLES = list(diffs)
     return diffs[len(diffs) // 2]
+
+
+def _spread(unit_scale=1.0, invert_for=None):
+    """n/min/max of the last timing's samples, in the row's own unit.
+    ``invert_for=X`` reports X/dt rates (min rate from max dt)."""
+    if not _LAST_SAMPLES:
+        return {}
+    s = sorted(_LAST_SAMPLES)
+    if invert_for is not None:
+        return {"n": len(s),
+                "spread": [round(invert_for / s[-1], 2),
+                           round(invert_for / s[0], 2)]}
+    return {"n": len(s), "spread": [round(s[0] * unit_scale, 4),
+                                    round(s[-1] * unit_scale, 4)]}
+
+
+_RTT_MS = None
+
+
+def _measure_rtt_ms():
+    """Median host<->device fetch round-trip of a 4-byte scalar: the
+    dispatch tax every single-dispatch row pays per step on the tunnel
+    runtime. Reported once per bench run on dispatch-bound rows so their
+    variance can be attributed (r3 verdict item 4)."""
+    global _RTT_MS
+    if _RTT_MS is not None:
+        return _RTT_MS
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as onp
+
+        x = jnp.zeros(())
+        x.block_until_ready()
+        ts = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            onp.asarray(x + 1.0)
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        _RTT_MS = round(ts[len(ts) // 2] * 1e3, 2)
+    except Exception:
+        _RTT_MS = None
+    return _RTT_MS
 
 
 def _infer_rate_fused(net, x_host, n_fuse=16):
@@ -158,6 +216,8 @@ def _infer_rate_fused(net, x_host, n_fuse=16):
     if not diffs:
         raise RuntimeError("degenerate fused-inference timing")
     diffs.sort()
+    global _LAST_SAMPLES
+    _LAST_SAMPLES = list(diffs)
     return diffs[len(diffs) // 2]
 
 
@@ -199,6 +259,8 @@ def bench_resnet_infer():
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASE_INFER_IMG_S, 3),
+        "rtt_ms": _measure_rtt_ms(),
+        **_spread(invert_for=BATCH),
     })
     # fused probe AFTER the stable row is out, and non-fatal: a
     # fused-timing flake must not cost the protocol metric
@@ -212,6 +274,7 @@ def bench_resnet_infer():
             "value": round(BATCH / dt_fused, 2),
             "unit": "img/s",
             "vs_baseline": round(BATCH / dt_fused / BASE_INFER_IMG_S, 3),
+            **_spread(invert_for=BATCH),
         })
     except Exception as e:
         print(f"# fp32 fused probe failed: {e}", file=sys.stderr)
@@ -267,9 +330,13 @@ def bench_resnet_infer_int8():
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / 2085.51, 3),
+        "rtt_ms": _measure_rtt_ms(),
+        **_spread(invert_for=BATCH),
     })
     with autograd.predict_mode():
         dt_fused = _infer_rate_fused(net, x._data)
+    int8_spread = _spread(invert_for=BATCH)  # snapshot BEFORE any fp32
+    # fallback probe below overwrites _LAST_SAMPLES (review finding r4)
     # the perf contract int8 exists for: >=1.5x the fp32 rate measured the
     # same (fused, dispatch-amortized) way — a slower int8 path FAILS the
     # bench rather than shipping a number that quietly lost to fp32. If
@@ -294,6 +361,7 @@ def bench_resnet_infer_int8():
         "unit": "img/s",
         "vs_baseline": round(BATCH / dt_fused / 2085.51, 3),
         "speedup_vs_fp32": round(speedup, 3) if speedup else None,
+        **int8_spread,
     })
     if speedup is not None and speedup < 1.5:
         raise RuntimeError(
@@ -353,17 +421,18 @@ def _train_bench(net, loss_fn, optimizer, opt_params, data, labels,
 
 
 def _roofline(trainer):
-    """HBM-roofline MFU bound of the compiled step, from XLA's own cost
-    analysis: arithmetic intensity (flops / bytes accessed) divided by the
-    machine balance (peak bf16 flops / HBM bandwidth). A program whose
-    measured MFU approaches this bound is bandwidth-bound, not idle.
-
-    ResNet-50 train bs256@224 measures AI ~ 80 flops/byte vs the v5e
-    balance of 197e12/819e9 = 240 -> bound ~ 0.33: the per-op device
-    profile (profiler.device_op_table) confirms conv fusions sustain
-    ~715 GB/s and elementwise ~855 GB/s against the 819 GB/s HBM peak,
-    i.e. the chip is saturated by memory traffic, and >=50% MFU is not
-    reachable for this workload on this chip at any step time.
+    """MFU bound from XLA cost-analysis arithmetic intensity — WITH the
+    round-4 correction (exp/conv_chain_probe.py): 'bytes accessed'
+    counts convolutions at ~2x their fusion-boundary traffic (measured:
+    conv+relu reports 392 MiB for 196 MiB of boundary bytes, while
+    elementwise fusions count exactly 1.0x), so the RAW cost-analysis AI
+    UNDERSTATES conv-dominated programs and the r3 'bound 0.294, chip
+    HBM-saturated' reading was wrong. The r4 per-shape probe shows the
+    actual limiter is XLA conv-kernel efficiency at these shapes
+    (fwd 3x3: 52-87% MXU; 1x1 pairs: 22-41%; stem: 7% — all well below
+    BOTH rooflines in isolation). The raw figure is still emitted, as
+    `cost_analysis_mfu_floor`: a conservative floor on the HBM bound,
+    not a ceiling the program has hit.
     """
     try:
         ca = trainer.step_cost_analysis
@@ -419,7 +488,9 @@ def bench_resnet_train(dtype=None):
         "unit": "img/s",
         "vs_baseline": round(img_s / BASE_TRAIN_IMG_S, 3),
         "mfu": round(mfu, 4) if mfu else None,
-        "roofline_mfu_bound": _roofline(trainer),
+        "cost_analysis_mfu_floor": _roofline(trainer),
+        "rtt_ms": _measure_rtt_ms(),
+        **_spread(invert_for=BATCH),
     })
 
 
@@ -451,7 +522,12 @@ def bench_resnet_train_fused(n_fuse=8):
         "unit": "img/s",
         "vs_baseline": round(img_s / BASE_TRAIN_IMG_S, 3),
         "mfu": round(mfu, 4) if mfu else None,
-        "roofline_mfu_bound": _roofline(trainer),
+        "cost_analysis_mfu_floor": _roofline(trainer),
+        "limiter": "xla-conv-kernel-efficiency at these shapes, NOT HBM "
+                   "saturation (exp/conv_chain_probe.json; the r3 "
+                   "roofline_mfu_bound read cost-analysis bytes that "
+                   "double-count convs)",
+        **_spread(invert_for=n_fuse * BATCH),
     })
 
 
@@ -524,6 +600,8 @@ def bench_bert_train():
         "vs_baseline": None,
         "vs_mfu_target": round(mfu / 0.5, 3) if mfu else None,
         "mfu": round(mfu, 4) if mfu else None,
+        "rtt_ms": _measure_rtt_ms(),
+        **_spread(invert_for=BATCH),
     })
 
 
@@ -545,6 +623,7 @@ def bench_bert_train_fused(n_fuse=8):
         "vs_baseline": None,
         "vs_mfu_target": round(mfu / 0.5, 3) if mfu else None,
         "mfu": round(mfu, 4) if mfu else None,
+        **_spread(invert_for=n_fuse * BATCH),
     })
 
 
@@ -620,6 +699,8 @@ def bench_lenet_eager():
         "unit": "img/s",
         "vs_baseline": None,
         "uncached_img_s": round(rates[False], 2),
+        "rtt_ms": _measure_rtt_ms(),
+        **_spread(invert_for=BATCH),
     })
 
 
@@ -673,7 +754,18 @@ def main():
         try:
             rows[name] = fn()
         except Exception as e:  # keep the suite alive; report what ran
-            failures[name] = f"{type(e).__name__}: {e}"
+            msg = f"{type(e).__name__}: {e}"
+            # tunnel-transport drops (remote_compile connection resets)
+            # are transient — one retry before recording a failure
+            if "remote_compile" in str(e) or "INTERNAL" in str(e):
+                print(f"# bench {name}: tunnel error, retrying once: {msg}",
+                      file=sys.stderr)
+                try:
+                    rows[name] = fn()
+                    continue
+                except Exception as e2:
+                    msg = f"{type(e2).__name__}: {e2}"
+            failures[name] = msg
             print(f"# bench {name} failed: {failures[name]}", file=sys.stderr)
     head = rows.get("resnet_train_fused") or rows.get("resnet_train_bf16") \
         or rows.get("bert_fused") or rows.get("bert") or rows.get("infer")

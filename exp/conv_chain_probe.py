@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Independent conv-chain probe at the dominant ResNet-50 layer shapes
+(VERDICT r3 item 3a: make the roofline claim adversarially verifiable).
+
+The round-3 ResNet MFU bound (0.294-0.302) was computed from XLA
+cost_analysis of the shipped train step — self-referential. This probe
+measures the SAME conv shapes in isolation, with bytes and FLOPs counted
+from first principles (tensor-size arithmetic, independent of XLA's
+accounting):
+
+* each shape runs as an on-device `lax.scan` chain (iteration i's input
+  is iteration i-1's output, so XLA cannot elide or parallelize
+  iterations), sized to >= ~0.3 s of device time;
+* achieved GB/s = analytic bytes / measured time; achieved TF/s =
+  analytic FLOPs / time;
+* XLA's own cost_analysis bytes for the same compiled chain are reported
+  next to the analytic count, so a reader can check the two agree.
+
+If the per-shape achieved bandwidth sits at the HBM roof while MXU
+utilization sits far below the compute roof, the ResNet-50 bound is
+hardware behavior for these shapes — not an artifact of the end-to-end
+program. Writes exp/conv_chain_probe.json; summarized in PERF.md.
+
+    python exp/conv_chain_probe.py             # on the real chip
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+# dominant ResNet-50 bs256 layers. Each spec is a CYCLE of convs whose
+# composition is channel-stable (so a lax.scan can chain it): the 3x3
+# stage convs cycle alone; the bottleneck 1x1s cycle as the
+# expand/reduce pair they form in the real network. Together these
+# shapes carry ~85% of the train-step FLOPs (cost_analysis
+# decomposition, exp/decomp.py). Entries: (Cin, Cout, k).
+SHAPES = [
+    ("stage1_3x3", 256, 56, [(64, 64, 3)]),
+    ("stage2_3x3", 256, 28, [(128, 128, 3)]),
+    ("stage3_3x3", 256, 14, [(256, 256, 3)]),
+    ("stage1_1x1_pair", 256, 56, [(64, 256, 1), (256, 64, 1)]),
+    ("stage2_1x1_pair", 256, 28, [(512, 128, 1), (128, 512, 1)]),
+]
+
+BF16 = jnp.bfloat16
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def chain(x, ws, n):
+    """n iterations of the conv cycle (NCHW, stride 1, same padding),
+    relu after every conv — the real ResNet motif, and load-bearing for
+    the measurement twice over: (1) relu + the He-scaled weights keep
+    magnitudes stable with NO extra memory sweep (a max-abs
+    normalization costs 3 activation sweeps and triples the body's
+    traffic — measured, first probe revision); (2) the nonlinearity
+    stops XLA from algebraically collapsing a 1x1 expand/reduce pair
+    into one composed matmul (measured: the un-relu'd pair read
+    1540 "GB/s", i.e. the 256-channel intermediate never left VMEM)."""
+    def body(carry, _):
+        y = carry
+        for w in ws:
+            y = jax.lax.conv_general_dilated(
+                y, w, (1, 1), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                preferred_element_type=BF16)
+            y = jax.nn.relu(y)
+        return y, None
+
+    out, _ = jax.lax.scan(body, x, None, length=n)
+    return jnp.sum(out.astype(jnp.float32))
+
+
+def probe_one(name, b, h, convs, target_s=0.4):
+    rng = onp.random.RandomState(0)
+    cin0 = convs[0][0]
+    x = jnp.asarray(rng.randn(b, cin0, h, h).astype("float32") * 0.1,
+                    dtype=BF16)
+    ws = tuple(
+        jnp.asarray(rng.randn(cout, cin, k, k).astype("float32")
+                    * (2.0 / (cin * k * k)) ** 0.5, dtype=BF16)
+        for cin, cout, k in convs)
+
+    flops = sum(2.0 * b * h * h * cout * cin * k * k
+                for cin, cout, k in convs)
+    bytes_analytic = sum(
+        2.0 * (b * cin * h * h              # read activation
+               + b * cout * h * h           # write activation
+               + cout * cin * k * k)        # weights (resident)
+        for cin, cout, k in convs)
+
+    # size the chain from a short calibration run
+    n0 = 8
+    onp.asarray(chain(x, ws, n0))  # compile + drain
+    t0 = time.perf_counter()
+    onp.asarray(chain(x, ws, n0))
+    dt0 = time.perf_counter() - t0
+    per = max(dt0 / n0, 1e-5)
+    n = max(n0, int(target_s / per))
+
+    def run(m):
+        t1 = time.perf_counter()
+        onp.asarray(chain(x, ws, m))
+        return time.perf_counter() - t1
+
+    onp.asarray(chain(x, ws, n))      # compile the big sizes
+    onp.asarray(chain(x, ws, 2 * n))
+    diffs = []
+    for _ in range(5):
+        d1, d2 = run(n), run(2 * n)
+        if d2 > d1:
+            diffs.append((d2 - d1) / n)
+    if not diffs:
+        raise RuntimeError(f"degenerate timing for {name}")
+    diffs.sort()
+    per_cycle = diffs[len(diffs) // 2]
+
+    ca = chain.lower(x, ws, n).compile().cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    # XLA counts a scan body ONCE regardless of trip count, so its total
+    # is directly the per-cycle figure; the ratio vs the analytic count
+    # checks the two byte accountings against each other
+    xla_bytes_per = (ca or {}).get("bytes accessed", 0)
+
+    return {
+        "shape": name,
+        "cycle": [f"{cin}->{cout} k{k}" for cin, cout, k in convs],
+        "input": f"B{b} {cin0}x{h}x{h} bf16",
+        "ms_per_cycle": round(per_cycle * 1e3, 3),
+        "analytic_gbs": round(bytes_analytic / per_cycle / 1e9, 1),
+        "xla_bytes_ratio": round(xla_bytes_per / bytes_analytic, 2)
+        if bytes_analytic else None,
+        "achieved_tfs": round(flops / per_cycle / 1e12, 1),
+        "mxu_util": round(flops / per_cycle / 197e12, 3),
+        "hbm_util": round(bytes_analytic / per_cycle / 819e9, 3),
+        "n_chain": n,
+        "n_samples": len(diffs),
+        "spread_ms": [round(diffs[0] * 1e3, 3), round(diffs[-1] * 1e3, 3)],
+    }
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# device: {dev.device_kind}", file=sys.stderr)
+    rows = []
+    for spec in SHAPES:
+        row = probe_one(*spec)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "conv_chain_probe.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=2)
+    print(f"# wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

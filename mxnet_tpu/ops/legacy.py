@@ -92,6 +92,15 @@ ALIASES = {
     "ElementWiseSum": "add_n",
     "elemwise_sub": "elemwise_sub",
     "elemwise_div": "elemwise_div",
+    # aliases the C registry declares via .add_alias
+    "choose_element_0index": "pick",
+    "max_axis": "max",
+    "min_axis": "min",
+    "sum_axis": "sum",
+    "negative_binomial": "random_negative_binomial",
+    "generalized_negative_binomial":
+        "random_generalized_negative_binomial",
+    "shuffle": "shuffle_legacy",
 }
 
 # broadcast_* binary family -> mx.np binary op (reference:
@@ -581,6 +590,313 @@ def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
     return _write_out(res, out if out is not None else weight)
 
 
+# ---------------------------------------------------------------------------
+# linalg_* family (reference src/operator/tensor/la_op.cc: batched LAPACK
+# ops over (..., m, n) operands; jnp.linalg/lax lower them onto the MXU
+# and the TPU's QR/cholesky expansions)
+# ---------------------------------------------------------------------------
+
+
+def _op_t(a, transpose):
+    jnp = __import__("jax.numpy", fromlist=["x"])
+    return jnp.swapaxes(a, -1, -2) if transpose else a
+
+
+def linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, **kwargs):
+    def f(aa, bb, cc):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        return alpha * jnp.matmul(_op_t(aa, transpose_a),
+                                  _op_t(bb, transpose_b)) + beta * cc
+
+    return _registry().apply(f, (a, b, c), name="linalg_gemm")
+
+
+def linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0,
+                 **kwargs):
+    def f(aa, bb):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        return alpha * jnp.matmul(_op_t(aa, transpose_a),
+                                  _op_t(bb, transpose_b))
+
+    return _registry().apply(f, (a, b), name="linalg_gemm2")
+
+
+def linalg_syrk(a, transpose=False, alpha=1.0, **kwargs):
+    def f(aa):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        at = jnp.swapaxes(aa, -1, -2)
+        return alpha * (jnp.matmul(at, aa) if transpose
+                        else jnp.matmul(aa, at))
+
+    return _registry().apply(f, (a,), name="linalg_syrk")
+
+
+def linalg_potrf(a, **kwargs):
+    def f(aa):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        return jnp.linalg.cholesky(aa)
+
+    return _registry().apply(f, (a,), name="linalg_potrf")
+
+
+def linalg_potri(l, **kwargs):  # noqa: E741
+    """Inverse of A from its Cholesky factor L (A = L L^T) — the LAPACK
+    *potri* contract the reference documents."""
+    def f(ll):
+        import jax
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        eye = jnp.broadcast_to(jnp.eye(ll.shape[-1], dtype=ll.dtype),
+                               ll.shape)
+        y = jax.scipy.linalg.solve_triangular(ll, eye, lower=True)
+        return jnp.matmul(jnp.swapaxes(y, -1, -2), y)
+
+    return _registry().apply(f, (l,), name="linalg_potri")
+
+
+def linalg_trmm(a, b, transpose=False, rightside=False, lower=True,
+                alpha=1.0, **kwargs):
+    def f(aa, bb):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        tri = jnp.tril(aa) if lower else jnp.triu(aa)
+        tri = _op_t(tri, transpose)
+        out = jnp.matmul(bb, tri) if rightside else jnp.matmul(tri, bb)
+        return alpha * out
+
+    return _registry().apply(f, (a, b), name="linalg_trmm")
+
+
+def linalg_trsm(a, b, transpose=False, rightside=False, lower=True,
+                alpha=1.0, **kwargs):
+    """Solve op(A) X = alpha B (X op(A) = alpha B when rightside)."""
+    def f(aa, bb):
+        import jax
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        tri = jnp.tril(aa) if lower else jnp.triu(aa)
+        if rightside:
+            # X op(A) = aB  <=>  op(A)^T X^T = a B^T ; op(A)^T is the
+            # opposite-triangle system, solved by flipping trans
+            xt = jax.scipy.linalg.solve_triangular(
+                tri, jnp.swapaxes(alpha * bb, -1, -2), lower=lower,
+                trans=0 if transpose else 1)
+            return jnp.swapaxes(xt, -1, -2)
+        return jax.scipy.linalg.solve_triangular(
+            tri, alpha * bb, lower=lower, trans=1 if transpose else 0)
+
+    return _registry().apply(f, (a, b), name="linalg_trsm")
+
+
+def linalg_gelqf(a, **kwargs):
+    """LQ factorization A = L @ Q for (x, y) with x <= y; returns
+    [Q, L] (la_op.cc: 'Q, L = gelqf(A)'). Via QR of A^T."""
+    def f(aa):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        q_r, r = jnp.linalg.qr(jnp.swapaxes(aa, -1, -2), mode="reduced")
+        # fix signs so L has positive diagonal (LAPACK convention)
+        d = jnp.sign(jnp.diagonal(r, axis1=-2, axis2=-1))
+        d = jnp.where(d == 0, 1.0, d).astype(aa.dtype)
+        q_r = q_r * d[..., None, :]
+        r = r * d[..., :, None]
+        return jnp.swapaxes(q_r, -1, -2), jnp.swapaxes(r, -1, -2)
+
+    out = _registry().apply(f, (a,), name="linalg_gelqf")
+    return list(out)
+
+
+def linalg_det(a, **kwargs):
+    def f(aa):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        return jnp.linalg.det(aa)
+
+    return _registry().apply(f, (a,), name="linalg_det")
+
+
+def linalg_slogdet(a, **kwargs):
+    def f(aa):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        sign, logdet = jnp.linalg.slogdet(aa)
+        return sign, logdet
+
+    return list(_registry().apply(f, (a,), name="linalg_slogdet"))
+
+
+def linalg_inverse(a, **kwargs):
+    def f(aa):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        return jnp.linalg.inv(aa)
+
+    return _registry().apply(f, (a,), name="linalg_inverse")
+
+
+def linalg_sumlogdiag(a, **kwargs):
+    def f(aa):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        return jnp.sum(jnp.log(jnp.diagonal(aa, axis1=-2, axis2=-1)),
+                       axis=-1)
+
+    return _registry().apply(f, (a,), name="linalg_sumlogdiag")
+
+
+def linalg_extractdiag(a, offset=0, **kwargs):
+    def f(aa):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        return jnp.diagonal(aa, offset=offset, axis1=-2, axis2=-1)
+
+    return _registry().apply(f, (a,), name="linalg_extractdiag")
+
+
+def linalg_makediag(v, offset=0, **kwargs):
+    def f(vv):
+        import jax
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        mk = lambda x: jnp.diag(x, k=offset)  # noqa: E731
+        for _ in range(vv.ndim - 1):
+            mk = jax.vmap(mk)
+        return mk(vv)
+
+    return _registry().apply(f, (v,), name="linalg_makediag")
+
+
+def _trian_indices(n, offset, lower):
+    import numpy as onp
+
+    if offset > 0:
+        lower = False
+    elif offset < 0:
+        lower = True
+    rows, cols = (onp.tril_indices(n, offset) if lower
+                  else onp.triu_indices(n, offset))
+    return rows, cols, lower
+
+
+def linalg_extracttrian(a, offset=0, lower=True, **kwargs):
+    """Packed triangle, row-major (la_op.cc extracttrian packing)."""
+    n = a.shape[-1]
+    rows, cols, _ = _trian_indices(n, offset, lower)
+
+    def f(aa):
+        return aa[..., rows, cols]
+
+    return _registry().apply(f, (a,), name="linalg_extracttrian")
+
+
+def linalg_maketrian(v, offset=0, lower=True, **kwargs):
+    k = v.shape[-1]
+    n = None
+    for cand in range(1, 4096):  # matrix size from packed length
+        r, _, _ = _trian_indices(cand, offset, lower)
+        if len(r) == k:
+            n = cand
+            break
+        if len(r) > k:
+            break
+    if n is None:
+        raise MXNetError(f"maketrian: no matrix size fits {k} packed "
+                         f"entries at offset {offset}")
+    rows, cols, _ = _trian_indices(n, offset, lower)
+
+    def f(vv):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        out = jnp.zeros(vv.shape[:-1] + (n, n), vv.dtype)
+        return out.at[..., rows, cols].set(vv)
+
+    return _registry().apply(f, (v,), name="linalg_maketrian")
+
+
+# samplers absent from np.random
+def random_negative_binomial(k=1, p=0.5, shape=None, dtype=None, ctx=None,
+                             out=None, **kwargs):
+    """NB(k, p) failure counts via the Gamma-Poisson mixture
+    (src/operator/random/sample_op.cc semantics)."""
+    import jax
+
+    from .. import random as rng_mod
+
+    shp = (shape,) if isinstance(shape, int) else tuple(shape or ())
+    key1, key2 = jax.random.split(rng_mod.as_threefry(rng_mod.next_key()))
+    lam = jax.random.gamma(key1, k, shape=shp) * ((1 - p) / p)
+    data = jax.random.poisson(key2, lam).astype("float32")
+    res = _np().array(data)
+    return _write_out(res, out)
+
+
+def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                         dtype=None, ctx=None, out=None,
+                                         **kwargs):
+    import jax
+
+    from .. import random as rng_mod
+
+    shp = (shape,) if isinstance(shape, int) else tuple(shape or ())
+    key1, key2 = jax.random.split(rng_mod.as_threefry(rng_mod.next_key()))
+    if alpha == 0:
+        # degenerate: GNB(mu, 0) IS Poisson(mu) (variance mu + alpha mu^2)
+        import jax.numpy as jnp
+
+        lam = jnp.full(shp, float(mu))
+    else:
+        lam = jax.random.gamma(key1, 1.0 / alpha, shape=shp) * (mu * alpha)
+    data = jax.random.poisson(key2, lam).astype("float32")
+    res = _np().array(data)
+    return _write_out(res, out)
+
+
+def shuffle_legacy(data, **kwargs):
+    """Shuffle along the first axis (reference ``_shuffle``), returning a
+    new array (np.random.shuffle mutates in place; legacy nd.shuffle
+    returns)."""
+    out = _np().array(data._data)
+    _np().random.shuffle(out)
+    return out
+
+
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
+                       **kwargs):
+    """Draw class indices from probability rows (reference
+    _sample_multinomial): data (..., K) probs -> (..., [shape]) ints."""
+    import jax
+
+    from .. import random as rng_mod
+
+    if shape is None:
+        draw_shape = (1,)
+    elif isinstance(shape, int):
+        draw_shape = (shape,)
+    else:
+        draw_shape = tuple(int(s) for s in shape)
+    n = 1
+    for s in draw_shape:
+        n *= s
+    key = rng_mod.next_key()
+
+    def f(d):
+        jnp = __import__("jax.numpy", fromlist=["x"])
+        logits = jnp.log(jnp.maximum(d, 1e-30))
+        out = jax.random.categorical(
+            key, logits[..., None, :], axis=-1,
+            shape=logits.shape[:-1] + (n,))
+        out = out.reshape(logits.shape[:-1] + draw_shape).astype(dtype)
+        return out[..., 0] if shape is None else out
+
+    res = _registry().apply(f, (data,), name="sample_multinomial",
+                            cacheable=False)
+    if get_prob:
+        def g(d, idx):
+            jnp = __import__("jax.numpy", fromlist=["x"])
+            p = jnp.take_along_axis(d[..., None, :],
+                                    idx[..., :, None].astype(jnp.int32),
+                                    axis=-1)[..., 0]
+            return jnp.log(jnp.maximum(p, 1e-30))
+
+        logp = _registry().apply(
+            g, (data, res if shape is not None else
+                _np().expand_dims(res, -1)), name="sample_multinomial_logp")
+        if shape is None:
+            logp = _np().squeeze(logp, axis=-1)
+        return [res, logp]
+    return res
+
+
 FUNCS = {
     "flatten": flatten,
     "cast": cast,
@@ -626,6 +942,27 @@ FUNCS = {
     "random_exponential": random_exponential,
     "random_poisson": random_poisson,
     "random_randint": random_randint,
+    "linalg_gemm": linalg_gemm,
+    "linalg_gemm2": linalg_gemm2,
+    "linalg_syrk": linalg_syrk,
+    "linalg_potrf": linalg_potrf,
+    "linalg_potri": linalg_potri,
+    "linalg_trmm": linalg_trmm,
+    "linalg_trsm": linalg_trsm,
+    "linalg_gelqf": linalg_gelqf,
+    "linalg_det": linalg_det,
+    "linalg_slogdet": linalg_slogdet,
+    "linalg_inverse": linalg_inverse,
+    "linalg_sumlogdiag": linalg_sumlogdiag,
+    "linalg_extractdiag": linalg_extractdiag,
+    "linalg_makediag": linalg_makediag,
+    "linalg_extracttrian": linalg_extracttrian,
+    "linalg_maketrian": linalg_maketrian,
+    "random_negative_binomial": random_negative_binomial,
+    "random_generalized_negative_binomial":
+        random_generalized_negative_binomial,
+    "sample_multinomial": sample_multinomial,
+    "shuffle_legacy": shuffle_legacy,
     "sgd_update": sgd_update,
     "sgd_mom_update": sgd_mom_update,
     "adam_update": adam_update,

@@ -41,14 +41,17 @@ def test_golden_files_are_reproducible(tmp_path):
                         str(tmp_path)],
                        capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
+    checked = 0
     for f in os.listdir(GOLD):
-        if f.endswith(".py"):
+        if f.endswith((".py", ".txt")):  # generator + data files
             continue
+        checked += 1
         committed = hashlib.sha256(
             open(os.path.join(GOLD, f), "rb").read()).hexdigest()
         fresh = hashlib.sha256(
             open(os.path.join(tmp_path, f), "rb").read()).hexdigest()
         assert committed == fresh, f"{f} diverged from its generator"
+    assert checked >= 4
 
 
 def test_load_reference_named_params():

