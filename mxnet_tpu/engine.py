@@ -160,10 +160,29 @@ def wait_all():
                 pass
 
 
+_BULK_SIZE = 15
+
+
+def set_bulk_size(size):
+    """Set the bulk-execution size limit (reference
+    ``python/mxnet/engine.py:25``); returns the previous value. Advisory
+    here: XLA fuses ops inside a trace, and the per-step analog of bulk
+    execution is ``ShardedTrainer.step_n`` windows — the setting is kept
+    for API parity and surfaced via :func:`bulk`."""
+    global _BULK_SIZE
+    prev = _BULK_SIZE
+    _BULK_SIZE = int(size)
+    return prev
+
+
 @contextlib.contextmanager
-def bulk(size: int = 15):  # pylint: disable=unused-argument
-    """Bulk-execution scope (``engine.h:311-317``). No-op: XLA fuses."""
-    yield
+def bulk(size: int = 15):
+    """Bulk-execution scope (``engine.h:311-317``). Advisory: XLA fuses."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
 
 
 # ---------------------------------------------------------------------------

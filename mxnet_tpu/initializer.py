@@ -226,6 +226,42 @@ class LSTMBias(Initializer):
 
 
 @register
+class Mixed(Initializer):
+    """Dispatch to one of several initializers by parameter-name regex
+    (reference ``initializer.py`` Mixed): first matching pattern wins.
+
+    >>> init = mx.init.Mixed(['bias', '.*'],
+    ...                      [mx.init.Zero(), mx.init.Uniform(0.1)])
+    """
+
+    def __init__(self, patterns, initializers, **kwargs):
+        import re
+
+        super().__init__(patterns=patterns, initializers=initializers,
+                         **kwargs)
+        if len(patterns) != len(initializers):
+            raise MXNetError("Mixed: len(patterns) != len(initializers)")
+        self.map = [(re.compile(p), init) for p, init in
+                    zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.search(name or ""):
+                # the matched initializer's own fill applies — NOT the
+                # base class's role-suffix shortcuts (which would, e.g.,
+                # zero a bias the user explicitly matched to Constant)
+                init._init_weight(name, arr)
+                return
+        raise MXNetError(
+            f"Parameter {name!r} matched no Mixed pattern; add '.*' as the "
+            "last pattern for a default")
+
+    # Mixed dispatches whole-name; the role-suffix shortcuts of the base
+    # class must not pre-empt the user's patterns
+    init_weight = __call__
+
+
+@register
 class InitDesc(str):  # pragma: no cover - reference API surface
     pass
 

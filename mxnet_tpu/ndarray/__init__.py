@@ -23,7 +23,10 @@ from .utils import load, save
 from . import sparse
 
 ndarray = NDArray
-waitall = None  # replaced on first access via __getattr__
+# NOTE: no module-level `waitall = None` placeholder — a binding that
+# EXISTS (even as None) pre-empts module __getattr__, which is exactly
+# how round 4's nd.waitall-is-None bug happened; __getattr__ installs
+# the real function on first access
 
 
 def __getattr__(name):

@@ -67,6 +67,10 @@ class Symbol:
         return out
 
     def list_outputs(self):
+        # derived, not stored: survives tojson/load round-trips (the op
+        # name "_group" is what persists)
+        if self._op == "_group":
+            return [o for a in self._args for o in a.list_outputs()]
         return [f"{self.name}_output"]
 
     def infer_shape(self, **shapes):
@@ -100,6 +104,10 @@ class Symbol:
         def ev(s):
             if id(s) in memo:
                 return memo[id(s)]
+            if s._op == "_group":
+                v = [ev(a) for a in s._args]
+                memo[id(s)] = v
+                return v
             if s._op is None:
                 try:
                     v = bindings[s.name]
@@ -118,6 +126,9 @@ class Symbol:
 
         out = ev(self)
         if raw:
+            if isinstance(out, list):  # _group: unwrap every member
+                return [o._data if isinstance(o, NDArray) else o
+                        for o in out]
             return out._data if isinstance(out, NDArray) else out
         return out
 
@@ -243,6 +254,21 @@ def var(name, shape=None, dtype=None, **kwargs):  # pylint: disable=unused-argum
 
 
 Variable = var
+
+
+def Group(symbols):  # noqa: N802  (reference spelling)
+    """Multi-output symbol (reference ``mx.sym.Group``): evaluating it
+    yields one output per grouped symbol, in order. Nested groups
+    flatten, so ``list_outputs()`` and ``eval()`` lengths always agree."""
+    flat = []
+    for s in symbols:
+        if isinstance(s, Symbol) and s._op == "_group":
+            flat.extend(s._args)
+        else:
+            flat.append(s)
+    if not flat:
+        raise MXNetError("Group needs at least one symbol")
+    return Symbol("_group", tuple(flat), {})
 
 
 # Attr keys the legacy JSON upgrade hides/moves instead of parsing

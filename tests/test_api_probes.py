@@ -1,0 +1,98 @@
+"""Regression tests from the round-4 fresh-process idiom sweep: user-facing
+API points the reference documents that broke or were missing here. Each
+probe is the exact user spelling, several in fresh subprocesses (the
+round-3 lesson: warm imports hide init-order bugs)."""
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu import np as mnp
+from mxnet_tpu.base import MXNetError
+
+
+def test_nd_waitall_is_callable_fresh_process():
+    """Round-4 bug: a module-level `waitall = None` placeholder pre-empted
+    __getattr__, so nd.waitall() raised TypeError in every process."""
+    code = ("import mxnet_tpu as mx\n"
+            "mx.nd.waitall()\n"
+            "assert callable(mx.nd.waitall)\n"
+            "print('WAITALL_OK')\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "WAITALL_OK" in r.stdout
+
+
+def test_sym_group_multi_output():
+    a, b = mx.sym.var("a"), mx.sym.var("b")
+    g = mx.sym.Group([a.exp(), (a + b).tanh()])
+    outs = g.eval(a=mnp.zeros((2,)), b=mnp.ones((2,)))
+    assert len(outs) == 2
+    onp.testing.assert_allclose(outs[0].asnumpy(), [1.0, 1.0])
+    onp.testing.assert_allclose(outs[1].asnumpy(),
+                                onp.tanh([1.0, 1.0]), rtol=1e-6)
+    assert len(g.list_outputs()) == 2
+    with pytest.raises(MXNetError):
+        mx.sym.Group([])
+    # infer_shape through a group (review finding r4)
+    _, out_shapes, _ = g.infer_shape(a=(2,), b=(2,))
+    assert out_shapes == [(2,), (2,)]
+    # nested groups flatten: list_outputs length == eval length
+    g2 = mx.sym.Group([g, a])
+    assert len(g2.list_outputs()) == 3
+    assert len(g2.eval(a=mnp.zeros((2,)), b=mnp.ones((2,)))) == 3
+    # save/load round-trip keeps the multi-output contract
+    import os
+    import tempfile
+
+    f = tempfile.mktemp(suffix=".json")
+    g.save(f)
+    g3 = mx.sym.load(f)
+    os.unlink(f)
+    assert len(g3.list_outputs()) == 2
+    outs3 = g3.eval(a=mnp.zeros((2,)), b=mnp.ones((2,)))
+    onp.testing.assert_allclose(outs3[0].asnumpy(), [1.0, 1.0])
+    # initdesc registration survives (review finding r4: the decorator
+    # must not be stolen by a class inserted above it)
+    from mxnet_tpu.initializer import _REGISTRY
+
+    assert "initdesc" in _REGISTRY and "mixed" in _REGISTRY
+
+
+def test_init_mixed_dispatches_by_pattern():
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    init = mx.init.Mixed(["bias", ".*"],
+                         [mx.init.Constant(7.0), mx.init.Zero()])
+    a = NDArray(onp.empty((4,), onp.float32))
+    init("fc1_bias", a)
+    onp.testing.assert_allclose(a.asnumpy(), [7.0] * 4)
+    b = NDArray(onp.empty((4, 3), onp.float32))
+    init("fc1_weight", b)
+    onp.testing.assert_allclose(b.asnumpy(), onp.zeros((4, 3)))
+    # first matching pattern wins, and the matched initializer's own
+    # fill applies (no base-class role-suffix shortcut)
+    with pytest.raises(MXNetError):
+        mx.init.Mixed(["bias"], [mx.init.Zero()])("fc1_weight", b)
+
+    # gluon precedence unchanged: a layer-level bias_initializer still
+    # beats the block-level Mixed (reference semantics); Mixed governs
+    # params without their own init — the weight here
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize(init=mx.init.Mixed(
+        ["weight", ".*"], [mx.init.Constant(3.0), mx.init.Zero()]))
+    onp.testing.assert_allclose(net.weight.data().asnumpy(),
+                                onp.full((4, 3), 3.0))
+    onp.testing.assert_allclose(net.bias.data().asnumpy(), onp.zeros(4))
+
+
+def test_engine_bulk_api():
+    prev = mx.engine.set_bulk_size(32)
+    assert mx.engine.set_bulk_size(prev) == 32
+    with mx.engine.bulk(10):
+        x = nd.zeros((2,)) + 1
+    assert x.asnumpy().tolist() == [1.0, 1.0]
