@@ -46,3 +46,19 @@ def test_yolo3_detection_tiny():
     out = _run("yolo3_detection.py", "--tiny", "--steps", "12", "--batch",
                "4", "--size", "96")
     assert "top detections" in out
+
+
+def test_char_rnn_tiny():
+    out = _run("char_rnn.py", "--cpu", "--steps", "45", "--bptt", "16",
+               "--batch", "8")
+    assert "sample:" in out
+
+
+def test_matrix_factorization_tiny():
+    out = _run("matrix_factorization.py", "--cpu", "--steps", "120")
+    assert "sparse-grad contract held" in out
+
+
+def test_adversary_fgsm():
+    out = _run("adversary_fgsm.py", "--cpu", "--steps", "30")
+    assert "FGSM dropped accuracy" in out
