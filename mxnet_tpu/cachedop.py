@@ -212,6 +212,14 @@ class CachedOp:
             "diff_arg_idx": diff_arg_idx,
         }
 
+    def _read_param_datas(self, entry):
+        """Snapshot the raw param buffers for one call. A hook so the
+        thread-safe subclass can exclude this read from trace windows
+        (an active trace rebinds the SHARED Parameter NDArrays to
+        tracers; a concurrent reader would leak them into its own jit)."""
+        return (tuple(p.data()._data for p in entry["train_params"]),
+                tuple(p.data()._data for p in entry["state_params"]))
+
     # -- call -------------------------------------------------------------
     def __call__(self, *args):
         args = list(args)
@@ -261,8 +269,7 @@ class CachedOp:
 
         train_params = entry["train_params"]
         state_params = entry["state_params"]
-        tp_datas = tuple(p.data()._data for p in train_params)
-        st_datas = tuple(p.data()._data for p in state_params)
+        tp_datas, st_datas = self._read_param_datas(entry)
         rng_key = _rng.next_key()
 
         out_datas, new_states, vjp = entry["fwd"](tp_datas, st_datas, rng_key,
@@ -329,7 +336,45 @@ class CachedOpThreadSafe(CachedOp):
             if entry is None:
                 entry = super()._lookup_or_build(
                     key, grad_mode, args_tracked, static_args)
+                self._guard_first_call(entry)
             return entry
+
+    def _guard_first_call(self, entry):
+        """jax.jit traces on FIRST INVOCATION PER JAX SIGNATURE, and the
+        trace rebinds the shared Parameter NDArrays to tracers
+        (_ParamBinding); a concurrent p.data() read would leak them (the
+        round-4 cold-start probe: 4 unwarmed threads ->
+        UnexpectedTracerError). Any call whose jax-level signature —
+        shape/dtype AND weak_type, which the CachedOp cache key does NOT
+        capture (jnp scalars are weak) — hasn't completed yet holds the
+        op lock; known-signature calls run lock-free."""
+        import jax
+
+        raw = entry["fwd"]
+        seen = set()
+
+        def sig_of(args):
+            return tuple(
+                (getattr(x, "shape", None), str(getattr(x, "dtype", type(x))),
+                 bool(getattr(x, "weak_type", False)))
+                for x in jax.tree_util.tree_leaves(args))
+
+        def guarded(*a):
+            s = sig_of(a)
+            if s in seen:
+                return raw(*a)
+            with self._lock:
+                out = raw(*a)
+                seen.add(s)
+                return out
+
+        entry["fwd"] = guarded
+
+    def _read_param_datas(self, entry):
+        # excluded from trace windows: the lock is held by any in-flight
+        # first-call trace (see _guard_first_call)
+        with self._lock:
+            return super()._read_param_datas(entry)
 
     def _write_back_state(self, state_params, new_states):
         if not state_params:

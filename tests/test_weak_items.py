@@ -56,6 +56,37 @@ def test_waitall_bounded_and_correct():
                                 rtol=1e-6)
 
 
+def test_cachedop_threadsafe_cold_start_race():
+    """Round-4 probe finding: with NO warmup call, concurrent first calls
+    raced the jit trace — _ParamBinding rebinds the shared Parameter
+    NDArrays to tracers, and a concurrent p.data() read leaked them
+    (UnexpectedTracerError). First-call-per-entry now holds the op lock."""
+    from mxnet_tpu.cachedop import CachedOpThreadSafe
+
+    for _ in range(3):
+        net = gluon.nn.Dense(2, in_units=2)
+        net.initialize()
+        op = CachedOpThreadSafe(net)
+        outs, errors = [], []
+
+        def worker(op=op, outs=outs, errors=errors):
+            try:
+                with autograd.predict_mode():
+                    outs.append(op(np.array(onp.ones((1, 2),
+                                            "float32"))).asnumpy())
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[0]
+        for o in outs[1:]:
+            onp.testing.assert_allclose(o, outs[0], rtol=1e-6)
+
+
 def test_cachedop_threadsafe_concurrent_inference():
     from mxnet_tpu.cachedop import CachedOpThreadSafe
 
