@@ -329,7 +329,41 @@ class HybridBlock(Block):
         return f"{path}-symbol.mxir", f"{path}-{epoch:04d}.params"
 
     def forward(self, *args):
-        raise NotImplementedError
+        # Gluon-v1 compatibility (reference block.py:574 "v1 style"):
+        # subclasses that define hybrid_forward(self, F, x, <param>...)
+        # get it called with F = the legacy nd op namespace (which works
+        # identically eager and under trace — tracing lives inside
+        # NDArray) and this block's registered Parameters passed by name,
+        # the reference's weight-forwarding convention.
+        hf = getattr(type(self), "hybrid_forward", None)
+        if hf is not None:
+            from ..gluon.parameter import DeferredInitializationError
+            from .. import ndarray as F
+
+            try:
+                params = {n: p.data() for n, p in self._reg_params.items()}
+            except DeferredInitializationError:
+                # deferred-shape params: the reference 2.x contract
+                # (gluon/block.py _deferred_infer_shape) — the block's
+                # infer_shape(*args) sets param shapes from the inputs,
+                # then init completes and the forward retries
+                infer = getattr(type(self), "infer_shape", None)
+                if infer is None or infer is HybridBlock.infer_shape:
+                    # the base infer_shape runs a paused forward — for a
+                    # hybrid_forward block that recurses right back here
+                    raise MXNetError(
+                        f"{type(self).__name__} has deferred-shape "
+                        "parameters; implement infer_shape(self, *args) "
+                        "to derive them from the inputs, or construct "
+                        "the Parameters with complete shapes") from None
+                infer(self, *args)
+                for p in self._reg_params.values():
+                    p._finish_deferred_init()
+                params = {n: p.data() for n, p in self._reg_params.items()}
+            return hf(self, F, *args, **params)
+        raise NotImplementedError(
+            f"{type(self).__name__} defines neither forward() nor the "
+            "legacy hybrid_forward()")
 
     def __call__(self, *args, **kwargs):  # noqa: F811 - final definition above
         # remember example args for export

@@ -43,6 +43,16 @@ def __getattr__(name):
 
         globals()["random"] = mod
         return mod
+    if name in ("np", "npx"):
+        # F.np / F.npx — the dual-dispatch idiom of v1-style gluon layers
+        # (reference basic_layers.py: `F.npx.fully_connected if
+        # is_np_array() else F.FullyConnected`)
+        import importlib
+
+        mod = importlib.import_module(
+            "mxnet_tpu.numpy" if name == "np" else "mxnet_tpu.numpy_extension")
+        globals()[name] = mod
+        return mod
     if name == "waitall":
         from ..engine import wait_all
 
@@ -68,7 +78,7 @@ def __dir__():
     from ..ops import legacy
 
     return sorted(set(globals()) | set(legacy.all_names())
-                  | {"contrib", "random", "waitall"})
+                  | {"contrib", "random", "waitall", "np", "npx"})
 
 
 def array(source_array, ctx=None, dtype=None, device=None):

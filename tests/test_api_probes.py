@@ -96,3 +96,99 @@ def test_engine_bulk_api():
     with mx.engine.bulk(10):
         x = nd.zeros((2,)) + 1
     assert x.asnumpy().tolist() == [1.0, 1.0]
+
+
+def test_v1_hybrid_forward_blocks():
+    """Gluon-v1 user blocks define hybrid_forward(self, F, x, <params>) —
+    the dominant idiom of pre-2.x scripts (reference block.py:926
+    _get_graph_v1). F is the legacy nd namespace (with F.np/F.npx for the
+    dual-dispatch idiom); registered params arrive as kwargs."""
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    class V1(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.dense = gluon.nn.Dense(4, in_units=3)
+
+        def hybrid_forward(self, F, x):
+            return F.relu(self.dense(x)) + F.ones_like(x[:, :1])
+
+    net = V1()
+    net.initialize()
+    x = mnp.array(onp.random.randn(2, 3).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    onp.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-5)
+
+    class V1Param(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.weight = Parameter("weight", shape=(4, 3))
+
+        def hybrid_forward(self, F, x, weight):
+            return F.npx.fully_connected(x, weight, None, num_hidden=4,
+                                         no_bias=True)
+
+    net2 = V1Param()
+    net2.initialize()
+    out = net2(x).asnumpy()
+    onp.testing.assert_allclose(
+        out, x.asnumpy() @ net2.weight.data().asnumpy().T, rtol=1e-5)
+    # trains: gradients flow through the kwarg-passed parameter
+    from mxnet_tpu import autograd
+
+    tr = gluon.Trainer(net2.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    with autograd.record():
+        loss = gluon.loss.L2Loss()(net2(x), mnp.ones((2, 4))).mean()
+    loss.backward()
+    g = net2.weight.grad().asnumpy()
+    assert (g != 0).any()
+    tr.step(2)
+
+    class NoForward(gluon.nn.HybridBlock):
+        pass
+
+    with pytest.raises(NotImplementedError):
+        NoForward()(x)
+
+
+def test_v1_hybrid_forward_deferred_shapes():
+    """Deferred-shape v1 params resolve through the block's infer_shape
+    (the reference 2.x _deferred_infer_shape contract); without it, the
+    error says what to implement."""
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    class Deferred(gluon.nn.HybridBlock):
+        def __init__(self, units, **kw):
+            super().__init__(**kw)
+            self._units = units
+            self.weight = Parameter("weight", shape=(units, 0))
+
+        def infer_shape(self, x):
+            self.weight.shape = (self._units, x.shape[1])
+
+        def hybrid_forward(self, F, x, weight):
+            return F.npx.fully_connected(x, weight, None,
+                                         num_hidden=self._units,
+                                         no_bias=True)
+
+    net = Deferred(4)
+    net.initialize()
+    x = mnp.array(onp.ones((2, 3), "float32"))
+    out = net(x)
+    assert out.shape == (2, 4)
+    assert net.weight.shape == (4, 3)
+
+    class NoInfer(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.weight = Parameter("weight", shape=(4, 0))
+
+        def hybrid_forward(self, F, x, weight):
+            return x
+
+    bad = NoInfer()
+    bad.initialize()
+    with pytest.raises(MXNetError, match="infer_shape"):
+        bad(x)
