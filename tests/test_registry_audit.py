@@ -170,12 +170,22 @@ def test_np_all_surface_complete(name):
     (python/mxnet/numpy/*.py, extracted to the golden list) exists here —
     the primary 2.x API surface, closed the same way as the legacy one.
     Usability, not mere presence: a None placeholder fails (the
-    nd.waitall lesson), except newaxis which IS None by definition."""
-    attr = getattr(mx.np, name)
-    if name != "newaxis":
-        assert attr is not None, name
+    nd.waitall lesson). The reference exports no None-valued names in
+    __all__ (newaxis lives outside it), so the check is unconditional."""
+    attr = getattr(mx.np, name)  # AttributeError = missing = fail
+    assert attr is not None, name
 
 
 @pytest.mark.parametrize("name", _load_golden("reference_npx_all.txt"))
 def test_npx_all_surface_complete(name):
     assert getattr(mx.npx, name) is not None, name
+
+
+@pytest.mark.parametrize("name", _load_golden("reference_np_linalg_all.txt"))
+def test_np_linalg_surface_complete(name):
+    assert getattr(mx.np.linalg, name) is not None, name
+
+
+@pytest.mark.parametrize("name", _load_golden("reference_np_random_all.txt"))
+def test_np_random_surface_complete(name):
+    assert getattr(mx.np.random, name) is not None, name
