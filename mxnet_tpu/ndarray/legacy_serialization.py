@@ -141,9 +141,10 @@ def _read_array(r):
         # values carry the storage shape (nnz, cols...); aux0 = row ids
         return RowSparseNDArray(NDArray(data), NDArray(aux_arrays[0]),
                                 tuple(shape))
-    # csr: aux0 = indptr, aux1 = column indices, values 1-D (nnz,)
-    return CSRNDArray(NDArray(data), NDArray(aux_arrays[0]),
-                      NDArray(aux_arrays[1]), tuple(shape))
+    # csr: aux0 = indptr, aux1 = column indices, values 1-D (nnz,);
+    # CSRNDArray takes (data, indices, indptr) — the scipy/reference order
+    return CSRNDArray(NDArray(data), NDArray(aux_arrays[1]),
+                      NDArray(aux_arrays[0]), tuple(shape))
 
 
 def is_reference_file(head: bytes) -> bool:

@@ -85,6 +85,18 @@ class BaseSparseNDArray(NDArray):
     def size(self):
         return int(_np.prod(self._dense_shape)) if self._dense_shape else 1
 
+    def astype(self, dtype, copy=True):
+        """Cast stored values, preserving the sparse structure (reference
+        ``BaseSparseNDArray.astype`` keeps the storage type: a zeros
+        row_sparse cast to int32 stays row_sparse)."""
+        if not copy and _np.dtype(dtype) == self.dtype:
+            return self
+        return self._with_values(
+            NDArray(self.values._data.astype(_np.dtype(dtype))))
+
+    def _with_values(self, new_vals):
+        raise NotImplementedError
+
 
 class RowSparseNDArray(BaseSparseNDArray):
     """Row-sparse: (indices[K], values[K, ...cols]) over rows of a 2D+
@@ -99,6 +111,24 @@ class RowSparseNDArray(BaseSparseNDArray):
         self.values = values if isinstance(values, NDArray) \
             else NDArray(values)
         self._init_sparse(shape, "row_sparse")
+
+    @property
+    def data(self):
+        """The data array holding stored row slices (reference
+        ``RowSparseNDArray.data``)."""
+        return self.values
+
+    def _with_values(self, new_vals):
+        # fresh handles around the (immutable) buffers: in-place writes on
+        # the result must not leak into this array's aux data
+        return RowSparseNDArray(new_vals, NDArray(self.indices._data),
+                                self._dense_shape)
+
+    def __getitem__(self, key):
+        # reference RowSparseNDArray supports only the full slice read
+        if isinstance(key, slice) and key == slice(None):
+            return self
+        raise MXNetError("RowSparseNDArray only supports [:] indexing")
 
     def _densify(self):
         dense = _jnp().zeros(self._dense_shape, self.values.dtype)
@@ -172,17 +202,92 @@ def _unique_static(idx):
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """Compressed sparse row matrix (indptr, indices, data)."""
+    """Compressed sparse row matrix.
+
+    Constructor argument order is ``(data, indices, indptr)`` — the
+    scipy/reference order (``python/mxnet/ndarray/sparse.py:871-877``:
+    column indices for row i live in ``indices[indptr[i]:indptr[i+1]]``
+    with values in ``data[indptr[i]:indptr[i+1]]``).
+    """
 
     __slots__ = ("indptr", "indices", "values")
 
-    def __init__(self, data, indptr, indices, shape):
+    def __init__(self, data, indices, indptr, shape):
         self.indptr = indptr if isinstance(indptr, NDArray) \
             else NDArray(indptr)
         self.indices = indices if isinstance(indices, NDArray) \
             else NDArray(indices)
         self.values = data if isinstance(data, NDArray) else NDArray(data)
         self._init_sparse(shape, "csr")
+
+    @property
+    def data(self):
+        """The data array holding stored values (reference
+        ``CSRNDArray.data``)."""
+        return self.values
+
+    def _with_values(self, new_vals):
+        # fresh handles around the (immutable) buffers: in-place writes on
+        # the result must not leak into this array's aux data
+        return CSRNDArray(new_vals, NDArray(self.indices._data),
+                          NDArray(self.indptr._data), self._dense_shape)
+
+    def asscipy(self):
+        """Return a ``scipy.sparse.csr_matrix`` sharing the same triple
+        (reference ``CSRNDArray.asscipy``, ``sparse.py:540-565``)."""
+        import scipy.sparse as _spsp
+
+        return _spsp.csr_matrix(
+            (self.values.asnumpy(), self.indices.asnumpy(),
+             self.indptr.asnumpy()), shape=self._dense_shape)
+
+    def __getitem__(self, key):
+        """Row slicing on the CSR buffers, O(nnz of the slice) — the
+        reference's ``a[1:2]`` / ``a[i]`` behavior (a sliced CSRNDArray,
+        keeping 2-D shape for integer keys)."""
+        if isinstance(key, int):
+            if key < 0:
+                key += self._dense_shape[0]
+            if not 0 <= key < self._dense_shape[0]:
+                raise IndexError(f"index {key} out of range")
+            key = slice(key, key + 1)
+        if not isinstance(key, slice):
+            raise MXNetError("CSRNDArray supports row-slice indexing only")
+        if key.step not in (None, 1):
+            raise MXNetError("CSRNDArray slicing requires step 1")
+        start, stop, _ = key.indices(self._dense_shape[0])
+        stop = max(stop, start)  # empty slice still needs indptr=[0]
+        ip = self.indptr.asnumpy()
+        lo, hi = int(ip[start]), int(ip[stop])
+        return CSRNDArray(
+            NDArray(self.values._data[lo:hi]),
+            NDArray(self.indices._data[lo:hi]),
+            NDArray(_np.asarray(ip[start:stop + 1] - ip[start], _np.int64)),
+            (stop - start, self._dense_shape[1]))
+
+    def __add__(self, other):
+        """csr + csr stays sparse via the host triple (reference elemwise
+        add keeps csr storage when both operands are csr); anything else
+        — including a recorded add on tracked operands, which must stay on
+        the tape — storage-falls-back dense."""
+        from .. import autograd
+        from .ndarray import _tracked
+
+        if isinstance(other, CSRNDArray) \
+                and other._dense_shape == self._dense_shape \
+                and not (autograd.is_recording()
+                         and (_tracked(self) or _tracked(other))):
+            try:
+                out = (self.asscipy() + other.asscipy()).tocsr()
+            except ImportError:
+                return NDArray.__add__(self, other)
+            out.sort_indices()
+            return CSRNDArray(
+                NDArray(_np.asarray(out.data)),
+                NDArray(_np.asarray(out.indices, _np.int64)),
+                NDArray(_np.asarray(out.indptr, _np.int64)),
+                self._dense_shape)
+        return NDArray.__add__(self, other)
 
     def _densify(self):
         jnp = _jnp()
@@ -221,18 +326,201 @@ class CSRNDArray(BaseSparseNDArray):
         raise MXNetError(f"cannot convert csr to {stype}")
 
 
-def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):  # pylint: disable=unused-argument
-    values, indices = arg1
-    values = values if isinstance(values, NDArray) else NDArray(values, dtype=dtype)
-    indices = indices if isinstance(indices, NDArray) else NDArray(indices, dtype="int64")
-    if shape is None:
-        raise MXNetError("row_sparse_array requires an explicit dense shape")
-    return RowSparseNDArray(values, indices, shape)
+def _default_dtype(src, dtype):
+    """Reference ``_prepare_default_dtype``
+    (``python/mxnet/ndarray/sparse.py:822-832``): keep the source dtype
+    for NDArray / numpy / scipy inputs, default float32 otherwise (so a
+    plain Python list of ints still yields a float32 sparse array)."""
+    if dtype is not None:
+        return dtype
+    if isinstance(src, (NDArray, _np.ndarray)):
+        return src.dtype
+    try:
+        import scipy.sparse as _spsp
+
+        if _spsp.issparse(src):
+            return src.dtype
+    except ImportError:
+        pass
+    return _np.float32
 
 
-def csr_matrix(arg1, shape=None, ctx=None, dtype=None):  # pylint: disable=unused-argument
-    data, indptr, indices = arg1
-    return CSRNDArray(NDArray(data, dtype=dtype), NDArray(indptr), NDArray(indices), shape)
+def _check_shape(s1, s2):
+    """Reference ``_check_shape`` (``sparse.py:834-837``): both given and
+    disagreeing is an error."""
+    if s1 and s2 and tuple(s1) != tuple(s2):
+        raise ValueError(
+            "Shape mismatch detected. " + str(tuple(s1)) + " v.s. " + str(tuple(s2)))
+
+
+def _prep_buffer(x, ctx, dtype):
+    """Wrap in a FRESH NDArray handle, casting when the input (NDArray
+    included) disagrees with the prepared dtype — the reference copies
+    into freshly allocated storage of that dtype either way
+    (``_csr_matrix_from_definition``, ``sparse.py:1007-1019``), so the
+    caller's later in-place writes never leak into the sparse array."""
+    out = NDArray(x._data) if isinstance(x, NDArray) \
+        else NDArray(x, ctx, dtype)
+    if dtype is not None and _np.dtype(dtype) != out.dtype:
+        out = NDArray(out._data.astype(_np.dtype(dtype)))
+    return out
+
+
+def _prep_aux(x, ctx):
+    """Fresh int64 index buffer (the reference's aux dtype,
+    ``_STORAGE_AUX_TYPES``)."""
+    return _prep_buffer(x, ctx, _np.int64)
+
+
+def _from_dense(arg1, shape, ctx, dtype, stype):
+    """Shared dense-input tail of csr_matrix / row_sparse_array."""
+    dtype = _default_dtype(arg1, dtype)
+    dns = _prep_buffer(arg1, ctx, dtype)
+    _check_shape(dns.shape, shape)
+    return dns.tostype(stype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):  # pylint: disable=unused-argument
+    """Empty sparse array of ``stype`` — the reference
+    ``mx.nd.sparse.zeros`` (``python/mxnet/ndarray/sparse.py``)."""
+    dtype = _np.float32 if dtype is None else dtype
+    shape = (shape,) if isinstance(shape, int) \
+        else tuple(int(s) for s in shape)
+    if stype == "csr":
+        if len(shape) != 2:
+            raise ValueError("invalid shape")
+        return CSRNDArray(
+            NDArray(_np.zeros((0,), dtype)),
+            NDArray(_np.zeros((0,), _np.int64)),
+            NDArray(_np.zeros((shape[0] + 1,), _np.int64)), shape)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            NDArray(_np.zeros((0,) + shape[1:], dtype)),
+            NDArray(_np.zeros((0,), _np.int64)), shape)
+    if stype == "default":
+        return NDArray(_np.zeros(shape, dtype))
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+empty = zeros  # lazy alloc is free here: both start with no stored values
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a ``RowSparseNDArray`` — all four reference forms
+    (``python/mxnet/ndarray/sparse.py:1037-1157``):
+
+    - ``row_sparse_array(D)``: from a dense array-like ``D``
+    - ``row_sparse_array(S)``: from another ``RowSparseNDArray``
+    - ``row_sparse_array((D0, D1, ..., Dn))``: empty with that shape
+    - ``row_sparse_array((data, indices))``: from the row-sparse
+      definition, ``dense[indices[i], ...] = data[i, ...]``
+    """
+    if isinstance(arg1, tuple):
+        if len(arg1) < 2:
+            raise ValueError(
+                "Unexpected length of input tuple: " + str(len(arg1)))
+        if len(arg1) > 2 or (isinstance(arg1[0], (int, _np.integer))
+                             and isinstance(arg1[1], (int, _np.integer))):
+            # empty with shape (D0, D1, ..., Dn)
+            _check_shape(arg1, shape)
+            return zeros("row_sparse", arg1, ctx=ctx, dtype=dtype)
+        data, indices = arg1
+        values = _prep_buffer(data, ctx, _default_dtype(data, dtype))
+        idx = _prep_aux(indices, ctx)
+        if values.ndim < 1 or idx.ndim != 1:
+            raise ValueError("invalid shape")
+        if shape is None:
+            if idx.shape[0] == 0:
+                raise ValueError("invalid shape")
+            nrows = int(_np.asarray(idx.asnumpy()).max()) + 1
+            shape = (nrows,) + tuple(values.shape[1:])
+        if values.shape[0] != idx.shape[0] \
+                or tuple(values.shape[1:]) != tuple(shape[1:]):
+            raise ValueError("invalid shape")
+        return RowSparseNDArray(values, idx, shape)
+    if isinstance(arg1, RowSparseNDArray):
+        _check_shape(arg1.shape, shape)
+        return arg1.astype(dtype) if dtype is not None \
+            else arg1._with_values(NDArray(arg1.values._data))
+    if isinstance(arg1, CSRNDArray):
+        raise ValueError("Unexpected input type: CSRNDArray")
+    return _from_dense(arg1, shape, ctx, dtype, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a ``CSRNDArray`` — all five reference forms
+    (``python/mxnet/ndarray/sparse.py:839-993``):
+
+    - ``csr_matrix(D)``: from a dense 2D array-like ``D``
+    - ``csr_matrix(S)``: from a ``CSRNDArray`` or scipy csr matrix
+    - ``csr_matrix((M, N))``: empty with shape ``(M, N)``
+    - ``csr_matrix((data, indices, indptr))``: from the CSR definition,
+      in that order — column indices for row i in
+      ``indices[indptr[i]:indptr[i+1]]``, values in
+      ``data[indptr[i]:indptr[i+1]]``
+    - ``csr_matrix((data, (row, col)))``: from COO triplets
+    """
+    if isinstance(arg1, tuple):
+        if len(arg1) == 2:
+            if isinstance(arg1[1], tuple) and len(arg1[1]) == 2:
+                # COO: (data, (row, col)) — route through scipy like the
+                # reference (sparse.py:949-963)
+                import scipy.sparse as _spsp
+
+                data, (row, col) = arg1
+                to_np = lambda x: x.asnumpy() if isinstance(x, NDArray) \
+                    else _np.asarray(x)
+                coo = _spsp.coo_matrix(
+                    (to_np(data), (to_np(row), to_np(col))), shape=shape)
+                return csr_matrix(coo.tocsr(), ctx=ctx, dtype=dtype)
+            # empty with shape (M, N) — ints only; a 2-tuple of arrays is
+            # not a documented form (reference raises on it too)
+            if not all(isinstance(v, (int, _np.integer)) for v in arg1):
+                raise ValueError(
+                    "Unexpected input tuple: expected (M, N) ints, "
+                    "(data, indices, indptr), or (data, (row, col))")
+            _check_shape(arg1, shape)
+            return zeros("csr", arg1, ctx=ctx, dtype=dtype)
+        if len(arg1) == 3:
+            data, indices, indptr = arg1
+            vals = _prep_buffer(data, ctx, _default_dtype(data, dtype))
+            idx = _prep_aux(indices, ctx)
+            iptr = _prep_aux(indptr, ctx)
+            if vals.ndim != 1 or idx.ndim != 1 or iptr.ndim != 1 \
+                    or iptr.shape[0] == 0:
+                raise ValueError("invalid shape")
+            if shape is None:
+                if idx.shape[0] == 0:
+                    raise ValueError("invalid shape")
+                shape = (iptr.shape[0] - 1,
+                         int(_np.asarray(idx.asnumpy()).max()) + 1)
+            if len(shape) != 2 or iptr.shape[0] != shape[0] + 1 \
+                    or vals.shape[0] != idx.shape[0]:
+                raise ValueError("invalid shape")
+            return CSRNDArray(vals, idx, iptr, shape)
+        raise ValueError(
+            "Unexpected length of input tuple: " + str(len(arg1)))
+    if isinstance(arg1, CSRNDArray):
+        _check_shape(arg1.shape, shape)
+        return arg1.astype(dtype) if dtype is not None \
+            else arg1._with_values(NDArray(arg1.values._data))
+    if isinstance(arg1, RowSparseNDArray):
+        raise ValueError("Unexpected input type: RowSparseNDArray")
+    try:
+        import scipy.sparse as _spsp
+
+        if _spsp.issparse(arg1):
+            # sorted_indices() copies — never mutate the caller's matrix
+            sp = arg1.tocsr().sorted_indices()
+            _check_shape(sp.shape, shape)
+            dtype = _default_dtype(sp, dtype)
+            return CSRNDArray(
+                NDArray(_np.asarray(sp.data, _np.dtype(dtype))),
+                NDArray(_np.asarray(sp.indices, _np.int64)),
+                NDArray(_np.asarray(sp.indptr, _np.int64)), sp.shape)
+    except ImportError:
+        pass
+    return _from_dense(arg1, shape, ctx, dtype, "csr")
 
 
 def _csr_row_ids(csr):
@@ -366,8 +654,8 @@ def dense_to_sparse(arr: NDArray, stype: str):
             indptr.append(len(indices))
         return CSRNDArray(
             NDArray(_np.asarray(data, host.dtype)),
-            NDArray(_np.asarray(indptr, _np.int64)),
             NDArray(_np.asarray(indices, _np.int64)),
+            NDArray(_np.asarray(indptr, _np.int64)),
             host.shape,
         )
     raise MXNetError(f"unknown stype {stype}")

@@ -28,8 +28,8 @@ def test_sparse_dot_recorded_fallback_honors_transpose_a():
     rhs = onp.random.rand(3, 2).astype(onp.float32)
     a_sp = sp.csr_matrix(a)
     csr = sparse.csr_matrix(
-        (a_sp.data, a_sp.indptr.astype(onp.int64),
-         a_sp.indices.astype(onp.int64)), shape=a.shape)
+        (a_sp.data, a_sp.indices.astype(onp.int64),
+         a_sp.indptr.astype(onp.int64)), shape=a.shape)
     # track the csr lhs so the dense recorded fallback runs
     csr.attach_grad()
     r = np.array(rhs)

@@ -157,8 +157,8 @@ def test_reference_roundtrip_through_save():
     m = sp.csr_matrix(host)
     from mxnet_tpu.ndarray import sparse as sp_mod
 
-    csr = sp_mod.csr_matrix((m.data, m.indptr.astype(onp.int64),
-                             m.indices.astype(onp.int64)), shape=host.shape)
+    csr = sp_mod.csr_matrix((m.data, m.indices.astype(onp.int64),
+                             m.indptr.astype(onp.int64)), shape=host.shape)
     buf = io.BytesIO()
     nd.save(buf, {"dense": a, "sparse": csr}, fmt="reference")
     buf.seek(0)

@@ -30,8 +30,8 @@ def _rand_csr(rng, m, k, nnz_per_row=2):
         vals.extend(rng.randn(nnz_per_row).tolist())
         indptr.append(len(cols))
     return sparse.csr_matrix(
-        (onp.array(vals, "float32"), onp.array(indptr, "int64"),
-         onp.array(cols, "int64")), shape=(m, k))
+        (onp.array(vals, "float32"), onp.array(cols, "int64"),
+         onp.array(indptr, "int64")), shape=(m, k))
 
 
 def test_csr_dot_dense_matches_numpy_and_stays_sparse():

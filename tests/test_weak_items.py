@@ -33,7 +33,7 @@ def test_csr_lazy_and_correct():
     data = onp.array([1.0, 2, 3], "float32")
     indptr = onp.array([0, 2, 3], "int64")
     indices = onp.array([0, 2, 1], "int64")
-    csr = mx.nd.sparse.csr_matrix((data, indptr, indices), shape=(2, 3))
+    csr = mx.nd.sparse.csr_matrix((data, indices, indptr), shape=(2, 3))
     assert not csr.is_materialized()
     want = onp.array([[1, 0, 2], [0, 3, 0]], "float32")
     onp.testing.assert_array_equal(csr.tostype("default").asnumpy(), want)
