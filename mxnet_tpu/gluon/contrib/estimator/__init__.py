@@ -1,4 +1,5 @@
 """Estimator (reference: ``python/mxnet/gluon/contrib/estimator/``)."""
+from .batch_processor import BatchProcessor
 from .estimator import Estimator
 from .event_handler import (
     BatchBegin,

@@ -1,0 +1,54 @@
+"""Batch processor: the composable batch-level fit/evaluate override
+point (reference
+``python/mxnet/gluon/contrib/estimator/batch_processor.py``, 105 LoC).
+
+Users subclass :class:`BatchProcessor` and override ``fit_batch`` /
+``evaluate_batch`` to customize what happens per minibatch (custom loss
+composition, multi-output nets, gradient surgery) without subclassing
+``Estimator`` itself.
+
+TPU redesign note: the reference's ``_get_data_and_label`` shards the
+batch across a device list with ``split_and_load``; here a batch runs on
+one logical device (data parallelism is the ShardedTrainer/pjit path, not
+the fit loop), so the hook simply unpacks — overriding it still lets a
+user reshape/cast/shard however they need.
+"""
+from __future__ import annotations
+
+__all__ = ["BatchProcessor"]
+
+
+class BatchProcessor:
+    """Plug-and-play ``fit_batch`` & ``evaluate_batch`` for Estimator."""
+
+    def _get_data_and_label(self, batch, ctx, batch_axis=0):  # pylint: disable=unused-argument
+        data, label = batch[0], batch[1]
+        return data, label
+
+    def evaluate_batch(self, estimator, val_batch, batch_axis=0):
+        """Evaluate on one validation batch.
+
+        Returns ``(data, label, pred, loss)`` like the reference
+        (``batch_processor.py:49-67``)."""
+        from .... import autograd
+
+        data, label = self._get_data_and_label(
+            val_batch, estimator.device, batch_axis)
+        with autograd.predict_mode():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label)
+        return data, label, pred, loss
+
+    def fit_batch(self, estimator, train_batch, batch_axis=0):
+        """Forward + backward on one training batch; the Estimator runs
+        the optimizer step. Returns ``(data, label, pred, loss)``
+        (reference ``batch_processor.py:69-105``)."""
+        from .... import autograd
+
+        data, label = self._get_data_and_label(
+            train_batch, estimator.device, batch_axis)
+        with autograd.record():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label).mean()
+        loss.backward()
+        return data, label, pred, loss

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from ....base import MXNetError
 from ... import Trainer, loss as gloss, metric as gmetric
+from .batch_processor import BatchProcessor
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
                             LoggingHandler, MetricHandler, StoppingHandler,
                             TrainBegin, TrainEnd, ValidationHandler)
@@ -18,10 +19,17 @@ class Estimator:
     epochs=N)`` with composable event handlers."""
 
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
-                 initializer=None, trainer=None, device=None, context=None):
+                 initializer=None, trainer=None, device=None, context=None,
+                 batch_processor=None):
         self.net = net
         self.loss = loss
         self.device = device or context
+        if batch_processor is not None \
+                and not isinstance(batch_processor, BatchProcessor):
+            raise MXNetError(
+                "batch_processor must be a BatchProcessor instance")
+        self.batch_processor = (batch_processor if batch_processor
+                                is not None else BatchProcessor())
         if initializer is not None:
             net.initialize(init=initializer, ctx=self.device,
                            force_reinit=False)
@@ -33,33 +41,21 @@ class Estimator:
         self.train_loss_metric = _LossMetric(name="train_loss")
         self.val_loss_metric = _LossMetric(name="val_loss")
 
-    def _batch_fn(self, batch):
-        from ... import utils as gutils  # noqa: F401
-
-        data, label = batch[0], batch[1]
-        return data, label
-
     def evaluate(self, val_data=None, **kwargs):
-        from .... import autograd
-
         if val_data is None:
             return
         for m in self.val_metrics:
             m.reset()
         self.val_loss_metric.reset()
         for batch in val_data:
-            data, label = self._batch_fn(batch)
-            with autograd.predict_mode():
-                pred = self.net(data)
-                l = self.loss(pred, label)
+            _data, label, pred, l = \
+                self.batch_processor.evaluate_batch(self, batch)
             for m in self.val_metrics:
                 m.update(label, pred)
             self.val_loss_metric.update(0, l)
 
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None, batch_size=None):
-        from .... import autograd
-
         if epochs is None and batches is None:
             epochs = 1
         handlers = self._init_handlers(val_data, event_handlers,
@@ -76,12 +72,8 @@ class Estimator:
             for batch in train_data:
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)
-                data, label = self._batch_fn(batch)
-                bsz = data.shape[0]
-                with autograd.record():
-                    pred = self.net(data)
-                    l = self.loss(pred, label).mean()
-                l.backward()
+                _data, label, pred, l = \
+                    self.batch_processor.fit_batch(self, batch)
                 self.trainer.step(1)
                 for h in batch_end:
                     h.batch_end(self, batch=batch, pred=pred, label=label,

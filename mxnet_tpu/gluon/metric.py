@@ -170,6 +170,97 @@ class F1(_BinaryClassificationBase):
 
 
 @register
+class Fbeta(_BinaryClassificationBase):
+    """F-beta score of a binary classification problem (reference
+    ``python/mxnet/gluon/metric.py:815-871``):
+    ``(1 + beta^2) * P * R / (beta^2 * P + R)``."""
+
+    def __init__(self, name="fbeta", beta=1, threshold=0.5, **kwargs):
+        self.beta = beta
+        self.threshold = threshold
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        self._count(labels, preds, threshold=self.threshold)
+        self.num_inst = 1
+        prec = self.tp / max(self.tp + self.fp, 1)
+        rec = self.tp / max(self.tp + self.fn, 1)
+        b2 = self.beta ** 2
+        self.sum_metric = ((1 + b2) * prec * rec
+                           / max(b2 * prec + rec, 1e-12))
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """Accuracy of a binary / multilabel problem at a confidence
+    ``threshold`` (reference ``metric.py:876-934``)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        self.threshold = threshold
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            thr = (_to_numpy(self.threshold)
+                   if isinstance(self.threshold, NDArray)
+                   else self.threshold)
+            pred = (_to_numpy(pred) > thr).astype(_onp.int64).ravel()
+            label = _to_numpy(label).astype(_onp.int64).ravel()
+            if len(label) != len(pred):
+                raise ValueError(
+                    f"shape mismatch: {len(label)} labels vs "
+                    f"{len(pred)} predictions")
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(pred)
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance between label and prediction rows
+    (reference ``metric.py:1197-1258``)."""
+
+    def __init__(self, name="mpd", p=2, **kwargs):
+        self.p = p
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).astype(_onp.float64)
+            pred = _to_numpy(pred).astype(_onp.float64)
+            label = label.reshape(label.shape[0], -1)
+            pred = pred.reshape(pred.shape[0], -1)
+            dis = (((label - pred) ** self.p).sum(axis=-1)) ** (1. / self.p)
+            self.sum_metric += float(dis.sum())
+            self.num_inst += label.shape[0]
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis
+    (reference ``metric.py:1263-1329``)."""
+
+    def __init__(self, name="cos_sim", eps=1e-8, **kwargs):
+        self.eps = eps
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_numpy(label).astype(_onp.float64)
+            pred = _to_numpy(pred).astype(_onp.float64)
+            if label.ndim == 1:
+                label = label.reshape(1, -1)
+            if pred.ndim == 1:
+                pred = pred.reshape(1, -1)
+            sim = (label * pred).sum(axis=-1)
+            n_p = _onp.linalg.norm(pred, axis=-1)
+            n_l = _onp.linalg.norm(label, axis=-1)
+            sim = sim / _onp.maximum(n_l * n_p, self.eps)
+            self.sum_metric += float(sim.sum())
+            self.num_inst += int(
+                _onp.prod(label.shape[:-1], dtype=_onp.int64))
+
+
+@register
 class MCC(_BinaryClassificationBase):
     def __init__(self, name="mcc", **kwargs):
         super().__init__(name, **kwargs)

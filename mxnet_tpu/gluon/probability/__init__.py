@@ -4,8 +4,11 @@
 Distributions operate on NDArrays through the normal dispatch layer, so
 ``log_prob`` participates in autograd and everything jits inside
 ``hybridize``. Sampling draws from the framework RNG (trace-aware keys)."""
+from . import constraint
 from . import distributions
+from . import exp_family
 from .distributions import (
+    ExponentialFamily,
     Bernoulli,
     Beta,
     Binomial,

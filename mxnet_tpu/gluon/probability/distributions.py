@@ -7,6 +7,7 @@ import math
 from ... import random as _rng
 from ...base import MXNetError
 from ...ops.registry import apply as _apply
+from . import constraint as _constraint
 
 
 def _jnp():
@@ -31,12 +32,97 @@ def _wrap(fn, *args, name="dist"):
     return _apply(fn, args, name=name)
 
 
+def _owning_init_class(t):
+    """First class in ``t``'s MRO that defines ``__init__`` — the one
+    whose (wrapped) constructor actually finishes last."""
+    for c in t.__mro__:
+        if "__init__" in c.__dict__:
+            return c
+    return None
+
+
 class Distribution:
-    """Base distribution (reference ``distribution.py``)."""
+    """Base distribution (reference ``distribution.py``).
+
+    Argument validation (reference ``distribution.py:54-66`` +
+    ``constraint.py``): each subclass declares ``arg_constraints``
+    (param name → Constraint) and ``support``; with
+    ``validate_args=True`` (or after
+    ``Distribution.set_default_validate_args(True)``) the constructor
+    checks every supplied parameter and ``log_prob`` checks its input
+    against the support, raising ``ValueError`` on violation. Validation
+    hooks are installed by ``__init_subclass__`` so the ~30 subclasses
+    stay declarative."""
 
     has_grad = True
     support = None
     arg_constraints = {}
+    _default_validate_args = False
+
+    @staticmethod
+    def set_default_validate_args(value):
+        """Process-wide default for ``validate_args`` (reference
+        ``distribution.py:48-52``)."""
+        Distribution._default_validate_args = bool(value)
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        import functools
+
+        init = cls.__dict__.get("__init__")
+        if init is not None:
+            @functools.wraps(init)
+            def wrapped_init(self, *a, __init=init, __cls=cls, **k):
+                __init(self, *a, **k)
+                # validate exactly once, after the MOST-DERIVED __init__
+                # finished (params are assigned after super().__init__
+                # here, unlike the reference)
+                if _owning_init_class(type(self)) is __cls:
+                    self._validate_params()
+
+            cls.__init__ = wrapped_init
+        lp = cls.__dict__.get("log_prob")
+        if lp is not None:
+            @functools.wraps(lp)
+            def wrapped_lp(self, value, *a, __lp=lp, **k):
+                if self._should_validate():
+                    self._validate_samples(value)
+                return __lp(self, value, *a, **k)
+
+            cls.log_prob = wrapped_lp
+
+    def _should_validate(self):
+        v = getattr(self, "_validate_args", None)
+        return Distribution._default_validate_args if v is None else v
+
+    def _validate_params(self):
+        from .constraint import is_dependent
+
+        if not self._should_validate():
+            return
+        for name, con in self.arg_constraints.items():
+            if is_dependent(con):
+                continue
+            # __dict__, not getattr: derived parameterizations (prob
+            # from logit) must not be materialized just to validate.
+            # "_<name>" covers prob/logit storage, "<name>_param" covers
+            # attributes renamed to dodge method collisions (Gamma.shape)
+            val = self.__dict__.get(
+                name, self.__dict__.get(
+                    "_" + name, self.__dict__.get(name + "_param")))
+            if val is None:
+                continue
+            con.check(val)
+
+    def _validate_samples(self, value):
+        """Check ``value`` lies in ``self.support`` (reference
+        ``distribution.py:193-198``)."""
+        from .constraint import Constraint, is_dependent
+
+        sup = self.support  # dependent_property resolves on the instance
+        if isinstance(sup, Constraint) and not is_dependent(sup):
+            sup.check(value)
+        return value
 
     def __init__(self, event_dim=0, validate_args=None):
         self.event_dim = event_dim
@@ -85,13 +171,99 @@ class Distribution:
         return tuple(size) + base
 
 
-class Normal(Distribution):
+class ExponentialFamily(Distribution):
+    r"""Base for densities ``p(x;θ) = exp(⟨t(x),θ⟩ − F(θ) + k(x))``.
+
+    Reference ``exp_family.py`` (68 LoC) declares the
+    ``_natural_params`` / ``_log_normalizer`` / ``_mean_carrier_measure``
+    interface but leaves the generic identities unimplemented; here they
+    are computed TPU-natively with jax autodiff of the log-normalizer:
+
+        H(P)    = F(θ) − ⟨θ, ∇F(θ)⟩ − E_p[k(x)]
+        KL(P‖Q) = F(θ_q) − F(θ_p) − ⟨∇F(θ_p), θ_q − θ_p⟩  (same family)
+
+    so members with natural parameters need no per-class entropy/KL math
+    (``kl_divergence`` falls back to the Bregman form for same-class
+    pairs with no registered closed form).
+    """
+
+    @property
+    def _natural_params(self):
+        """Tuple of natural-parameter NDArrays."""
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        """F(θ) on raw jax arrays (must be jax-differentiable)."""
+        raise NotImplementedError
+
+    def _mean_carrier_measure(self):
+        """E_p[k(x)] — 0 for most members; required for entropy."""
+        raise NotImplementedError
+
+    def entropy(self):
+        import jax
+
+        theta = self._natural_params
+        carrier = self._mean_carrier_measure()
+        n = len(theta)
+
+        def f(*ts):
+            grads = jax.grad(
+                lambda *args: self._log_normalizer(*args).sum(),
+                argnums=tuple(range(n)))(*ts)
+            lognorm = self._log_normalizer(*ts)
+            inner = sum(
+                (t * g).reshape(lognorm.shape + (-1,)).sum(-1)
+                for t, g in zip(ts, grads))
+            return lognorm - inner
+
+        return _wrap(f, *theta, name="expfam_entropy") - carrier
+
+    def _kl_same_family(self, other):
+        import jax
+
+        tp = self._natural_params
+        tq = other._natural_params
+        n = len(tp)
+
+        def f(*ts):
+            p, q = ts[:n], ts[n:]
+            grads = jax.grad(
+                lambda *args: self._log_normalizer(*args).sum(),
+                argnums=tuple(range(n)))(*p)
+            lognorm_p = self._log_normalizer(*p)
+            lognorm_q = self._log_normalizer(*q)
+            inner = sum(
+                (g * (qi - pi)).reshape(lognorm_p.shape + (-1,)).sum(-1)
+                for g, pi, qi in zip(grads, p, q))
+            return lognorm_q - lognorm_p - inner
+
+        return _wrap(f, *tp, *tq, name="expfam_kl")
+
+
+class Normal(ExponentialFamily):
+    arg_constraints = {"loc": _constraint.Real(),
+                       "scale": _constraint.Positive()}
+    support = _constraint.Real()
+
     def __init__(self, loc=0.0, scale=1.0, **kwargs):
         from ... import numpy as mnp
 
         super().__init__(**kwargs)
         self.loc = mnp.array(loc) if not hasattr(loc, "_data") else loc
         self.scale = mnp.array(scale) if not hasattr(scale, "_data") else scale
+
+    @property
+    def _natural_params(self):
+        return (self.loc / self.scale ** 2,
+                -0.5 / self.scale ** 2)
+
+    def _log_normalizer(self, t1, t2):
+        jnp = _jnp()
+        return -(t1 ** 2) / (4 * t2) + 0.5 * jnp.log(-math.pi / t2)
+
+    def _mean_carrier_measure(self):
+        return 0.0
 
     def log_prob(self, value):
         jnp = _jnp()
@@ -131,6 +303,9 @@ class Normal(Distribution):
 
 
 class Laplace(Distribution):
+    arg_constraints = {'loc': _constraint.Real(), 'scale': _constraint.Positive()}
+    support = _constraint.Real()
+
     def __init__(self, loc=0.0, scale=1.0, **kwargs):
         from ... import numpy as mnp
 
@@ -196,7 +371,20 @@ class _ProbLogitMixin:
                      name="logit")
 
 
-class Bernoulli(_ProbLogitMixin, Distribution):
+class Bernoulli(_ProbLogitMixin, ExponentialFamily):
+    arg_constraints = {'prob': _constraint.Interval(0, 1), 'logit': _constraint.Real()}
+    support = _constraint.Boolean()
+
+    @property
+    def _natural_params(self):
+        return (self.logit,)
+
+    def _log_normalizer(self, t):
+        return _jnp().logaddexp(0.0, t)
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
     def __init__(self, prob=None, logit=None, **kwargs):
         super().__init__(**kwargs)
         self._init_prob_logit(prob, logit)
@@ -233,6 +421,8 @@ class Bernoulli(_ProbLogitMixin, Distribution):
 
 
 class Categorical(Distribution):
+    arg_constraints = {'prob': _constraint.Simplex(), 'logit': _constraint.Real()}
+
     def __init__(self, num_events=None, prob=None, logit=None, **kwargs):
         from ... import numpy as mnp
 
@@ -244,6 +434,13 @@ class Categorical(Distribution):
         self._logit = (mnp.array(logit) if logit is not None
                        and not hasattr(logit, "_data") else logit)
         self.num_events = num_events
+    @_constraint.dependent_property
+    def support(self):
+        n = self.num_events
+        if n is None:
+            n = int(self.prob.shape[-1]) if self._prob is not None \
+                else int(self.logit.shape[-1])
+        return _constraint.IntegerInterval(0, n - 1)
 
     @property
     def logit(self):
@@ -287,12 +484,18 @@ class Categorical(Distribution):
 
 
 class Uniform(Distribution):
+    arg_constraints = {'low': _constraint.Real(), 'high': _constraint.Real()}
+
     def __init__(self, low=0.0, high=1.0, **kwargs):
         from ... import numpy as mnp
 
         super().__init__(**kwargs)
         self.low = mnp.array(low) if not hasattr(low, "_data") else low
         self.high = mnp.array(high) if not hasattr(high, "_data") else high
+
+    @_constraint.dependent_property
+    def support(self):
+        return _constraint.Interval(self.low, self.high)
 
     def log_prob(self, value):
         jnp = _jnp()
@@ -318,7 +521,20 @@ class Uniform(Distribution):
         return (self.low + self.high) / 2
 
 
-class Exponential(Distribution):
+class Exponential(ExponentialFamily):
+    arg_constraints = {'scale': _constraint.Positive()}
+    support = _constraint.NonNegative()
+
+    @property
+    def _natural_params(self):
+        return (-1.0 / self.scale,)
+
+    def _log_normalizer(self, t):
+        return -_jnp().log(-t)
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
     def __init__(self, scale=1.0, **kwargs):
         from ... import numpy as mnp
 
@@ -348,7 +564,22 @@ class Exponential(Distribution):
         return self.scale
 
 
-class Gamma(Distribution):
+class Gamma(ExponentialFamily):
+    arg_constraints = {'shape': _constraint.Positive(), 'scale': _constraint.Positive()}
+    support = _constraint.Positive()
+
+    @property
+    def _natural_params(self):
+        return (self.shape_param - 1.0, -1.0 / self.scale)
+
+    def _log_normalizer(self, t1, t2):
+        from jax.scipy.special import gammaln
+
+        return gammaln(t1 + 1) - (t1 + 1) * _jnp().log(-t2)
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
     def __init__(self, shape=1.0, scale=1.0, **kwargs):
         from ... import numpy as mnp
 
@@ -383,7 +614,22 @@ class Gamma(Distribution):
         return self.shape_param * self.scale
 
 
-class Beta(Distribution):
+class Beta(ExponentialFamily):
+    arg_constraints = {'alpha': _constraint.Positive(), 'beta': _constraint.Positive()}
+    support = _constraint.UnitInterval()
+
+    @property
+    def _natural_params(self):
+        return (self.alpha - 1.0, self.beta - 1.0)
+
+    def _log_normalizer(self, t1, t2):
+        from jax.scipy.special import gammaln
+
+        return gammaln(t1 + 1) + gammaln(t2 + 1) - gammaln(t1 + t2 + 2)
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
     def __init__(self, alpha=1.0, beta=1.0, **kwargs):
         from ... import numpy as mnp
 
@@ -413,7 +659,19 @@ class Beta(Distribution):
         return _wrap(f, self.alpha, self.beta, name="beta_sample")
 
 
-class Poisson(Distribution):
+class Poisson(ExponentialFamily):
+    arg_constraints = {'rate': _constraint.Positive()}
+    support = _constraint.NonNegativeInteger()
+
+    @property
+    def _natural_params(self):
+        from ... import numpy as mnp
+
+        return (mnp.log(self.rate),)
+
+    def _log_normalizer(self, t):
+        return _jnp().exp(t)
+
     def __init__(self, rate=1.0, **kwargs):
         from ... import numpy as mnp
 
@@ -444,7 +702,22 @@ class Poisson(Distribution):
         return self.rate
 
 
-class Dirichlet(Distribution):
+class Dirichlet(ExponentialFamily):
+    arg_constraints = {'alpha': _constraint.Positive()}
+    support = _constraint.Simplex()
+
+    @property
+    def _natural_params(self):
+        return (self.alpha - 1.0,)
+
+    def _log_normalizer(self, t):
+        from jax.scipy.special import gammaln
+
+        return gammaln(t + 1).sum(-1) - gammaln((t + 1).sum(-1))
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
     def __init__(self, alpha, **kwargs):
         from ... import numpy as mnp
 
@@ -475,6 +748,9 @@ class Dirichlet(Distribution):
 
 
 class MultivariateNormal(Distribution):
+    arg_constraints = {'loc': _constraint.Real(), 'cov': _constraint.PositiveDefinite(), 'scale_tril': _constraint.LowerCholesky()}
+    support = _constraint.Real()
+
     def __init__(self, loc, cov=None, scale_tril=None, **kwargs):
         from ... import numpy as mnp
 
@@ -545,6 +821,14 @@ def register_kl(p_cls, q_cls):
 def kl_divergence(p, q):
     fn = _KL_REGISTRY.get((type(p), type(q)))
     if fn is None:
+        # same-class exponential-family pairs fall back to the Bregman
+        # divergence of the log-normalizer (exact, via jax.grad) — no
+        # closed form needs registering
+        if type(p) is type(q) and isinstance(p, ExponentialFamily):
+            try:
+                return p._kl_same_family(q)
+            except NotImplementedError:
+                pass
         raise MXNetError(
             f"no KL registered for ({type(p).__name__}, "
             f"{type(q).__name__})")
@@ -590,6 +874,9 @@ def _kl_categorical_categorical(p, q):
 class StudentT(Distribution):
     """Student's t (reference studentT.py)."""
 
+    arg_constraints = {'df': _constraint.Positive(), 'loc': _constraint.Real(), 'scale': _constraint.Real()}
+    support = _constraint.Real()
+
     def __init__(self, df, loc=0.0, scale=1.0, **kwargs):
         from ... import numpy as mnp
 
@@ -634,6 +921,9 @@ class StudentT(Distribution):
 
 
 class Cauchy(Distribution):
+    arg_constraints = {'loc': _constraint.Real(), 'scale': _constraint.Real()}
+    support = _constraint.Real()
+
     def __init__(self, loc=0.0, scale=1.0, **kwargs):
         from ... import numpy as mnp
 
@@ -662,6 +952,9 @@ class Cauchy(Distribution):
 
 
 class HalfNormal(Distribution):
+    arg_constraints = {'scale': _constraint.Positive()}
+    support = _constraint.NonNegative()
+
     def __init__(self, scale=1.0, **kwargs):
         from ... import numpy as mnp
 
@@ -694,6 +987,9 @@ class HalfNormal(Distribution):
 
 
 class Chi2(Distribution):
+    arg_constraints = {'df': _constraint.Positive()}
+    support = _constraint.Positive()
+
     def __init__(self, df, **kwargs):
         from ... import numpy as mnp
 
@@ -734,6 +1030,9 @@ class Chi2(Distribution):
 class Geometric(Distribution):
     """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
 
+    arg_constraints = {'prob': _constraint.Interval(0, 1)}
+    support = _constraint.NonNegativeInteger()
+
     def __init__(self, prob, **kwargs):
         from ... import numpy as mnp
 
@@ -765,6 +1064,9 @@ class Geometric(Distribution):
 
 
 class Gumbel(Distribution):
+    arg_constraints = {'loc': _constraint.Real(), 'scale': _constraint.Positive()}
+    support = _constraint.Real()
+
     def __init__(self, loc=0.0, scale=1.0, **kwargs):
         from ... import numpy as mnp
 
@@ -799,12 +1101,18 @@ class Gumbel(Distribution):
 class Binomial(_ProbLogitMixin, Distribution):
     """Binomial(n, p) (reference ``distributions/binomial.py``)."""
 
+    arg_constraints = {'n': _constraint.NonNegativeInteger(), 'prob': _constraint.Interval(0, 1), 'logit': _constraint.Real()}
+
     def __init__(self, n=1, prob=None, logit=None, **kwargs):
         from ... import numpy as mnp
 
         super().__init__(**kwargs)
         self.n = mnp.array(n) if not hasattr(n, "_data") else n
         self._init_prob_logit(prob, logit)
+
+    @_constraint.dependent_property
+    def support(self):
+        return _constraint.IntegerInterval(0, self.n)
 
     def log_prob(self, value):
         jnp = _jnp()
@@ -843,6 +1151,9 @@ class Binomial(_ProbLogitMixin, Distribution):
 class NegativeBinomial(_ProbLogitMixin, Distribution):
     """Failures-before-n-successes form: P(X=k) = C(k+n-1,k)(1-p)^n p^k
     (reference ``distributions/negative_binomial.py``)."""
+
+    arg_constraints = {'n': _constraint.GreaterThanEq(0), 'prob': _constraint.Interval(0, 1), 'logit': _constraint.Real()}
+    support = _constraint.NonNegativeInteger()
 
     def __init__(self, n=1, prob=None, logit=None, **kwargs):
         from ... import numpy as mnp
@@ -892,6 +1203,8 @@ class NegativeBinomial(_ProbLogitMixin, Distribution):
 class Multinomial(Distribution):
     """Counts over ``num_events`` categories from ``total_count`` draws
     (reference ``distributions/multinomial.py``)."""
+
+    arg_constraints = {'prob': _constraint.Simplex(), 'logit': _constraint.Real()}
 
     def __init__(self, num_events=None, prob=None, logit=None,
                  total_count=1, **kwargs):
@@ -962,6 +1275,9 @@ class Multinomial(Distribution):
 class FisherSnedecor(Distribution):
     """F-distribution (reference ``distributions/fishersnedecor.py``)."""
 
+    arg_constraints = {'df1': _constraint.Positive(), 'df2': _constraint.Positive()}
+    support = _constraint.Positive()
+
     def __init__(self, df1, df2, **kwargs):
         from ... import numpy as mnp
 
@@ -1009,6 +1325,9 @@ class FisherSnedecor(Distribution):
 class HalfCauchy(Distribution):
     """|Cauchy(0, scale)| (reference ``distributions/half_cauchy.py``)."""
 
+    arg_constraints = {'scale': _constraint.Positive()}
+    support = _constraint.NonNegative()
+
     def __init__(self, scale=1.0, **kwargs):
         from ... import numpy as mnp
 
@@ -1039,6 +1358,12 @@ class HalfCauchy(Distribution):
 
 class Pareto(Distribution):
     """Pareto Type I (reference ``distributions/pareto.py``)."""
+
+    arg_constraints = {'alpha': _constraint.Positive(), 'scale': _constraint.Positive()}
+
+    @_constraint.dependent_property
+    def support(self):
+        return _constraint.GreaterThanEq(self.scale)
 
     def __init__(self, alpha, scale=1.0, **kwargs):
         from ... import numpy as mnp
@@ -1082,6 +1407,8 @@ class Pareto(Distribution):
 class OneHotCategorical(Distribution):
     """One-hot coded categorical (reference
     ``distributions/one_hot_categorical.py``)."""
+
+    arg_constraints = {'prob': _constraint.Simplex(), 'logit': _constraint.Real()}
 
     def __init__(self, num_events=None, prob=None, logit=None, **kwargs):
         super().__init__(**kwargs)
@@ -1131,6 +1458,9 @@ class RelaxedBernoulli(Distribution):
     """Concrete / Gumbel-sigmoid relaxation (reference
     ``distributions/relaxed_bernoulli.py``)."""
 
+    arg_constraints = {'prob': _constraint.Interval(0, 1), 'logit': _constraint.Real()}
+    support = _constraint.UnitInterval()
+
     def __init__(self, T=1.0, prob=None, logit=None, **kwargs):
         from ... import numpy as mnp
 
@@ -1177,6 +1507,9 @@ class RelaxedBernoulli(Distribution):
 class RelaxedOneHotCategorical(Distribution):
     """Gumbel-softmax relaxation (reference
     ``distributions/relaxed_one_hot_categorical.py``)."""
+
+    arg_constraints = {'prob': _constraint.Simplex(), 'logit': _constraint.Real()}
+    support = _constraint.Simplex()
 
     def __init__(self, T=1.0, num_events=None, prob=None, logit=None,
                  **kwargs):
@@ -1275,6 +1608,9 @@ class Independent(Distribution):
 
 
 class Weibull(Distribution):
+    arg_constraints = {'concentration': _constraint.Positive(), 'scale': _constraint.Positive()}
+    support = _constraint.Positive()
+
     def __init__(self, concentration, scale=1.0, **kwargs):
         from ... import numpy as mnp
 

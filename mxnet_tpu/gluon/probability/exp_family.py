@@ -1,0 +1,11 @@
+"""Exponential family (reference
+``python/mxnet/gluon/probability/distributions/exp_family.py``).
+
+The class itself lives in ``distributions.py`` (its members — Normal,
+Bernoulli, Exponential, Gamma, Beta, Dirichlet, Poisson — subclass it at
+definition time); this module mirrors the reference layout for imports
+like ``from ...probability.exp_family import ExponentialFamily``.
+"""
+from .distributions import ExponentialFamily
+
+__all__ = ["ExponentialFamily"]

@@ -1,0 +1,265 @@
+"""Round-5 verdict Next #5: probability constraint machinery +
+exponential family entropy/KL + the 4 missing metrics + estimator
+batch_processor.
+
+Reference semantics:
+``python/mxnet/gluon/probability/distributions/constraint.py`` (548 LoC),
+``exp_family.py`` (68), ``gluon/metric.py:815,876,1197,1263``,
+``gluon/contrib/estimator/batch_processor.py`` (105).
+"""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import probability as P
+from mxnet_tpu.gluon.probability import constraint as C
+
+
+# -- constraint classes ----------------------------------------------------
+
+def test_constraint_primitives():
+    jnp_ok = C.Real().check(onp.array([1.0, 2.0]))
+    assert jnp_ok is not None
+    with pytest.raises(ValueError):
+        C.Real().check(onp.array([1.0, onp.nan]))
+    with pytest.raises(ValueError):
+        C.Boolean().check(onp.array([0.0, 2.0]))
+    C.Boolean().check(onp.array([0.0, 1.0]))
+    C.Interval(0, 1).check(0.5)
+    with pytest.raises(ValueError):
+        C.OpenInterval(0, 1).check(0.0)
+    C.HalfOpenInterval(0, 1).check(0.0)
+    with pytest.raises(ValueError):
+        C.HalfOpenInterval(0, 1).check(1.0)
+    C.UnitInterval().check(1.0)
+    with pytest.raises(ValueError):
+        C.IntegerInterval(0, 5).check(2.5)
+    C.IntegerInterval(0, 5).check(3.0)
+    with pytest.raises(ValueError):
+        C.GreaterThan(0).check(0.0)
+    C.GreaterThanEq(0).check(0.0)
+    with pytest.raises(ValueError):
+        C.LessThan(1).check(1.0)
+    C.LessThanEq(1).check(1.0)
+    C.Positive().check(0.1)
+    with pytest.raises(ValueError):
+        C.Positive().check(-0.1)
+    C.NonNegative().check(0.0)
+    C.PositiveInteger().check(2.0)
+    with pytest.raises(ValueError):
+        C.PositiveInteger().check(0.0)
+    C.NonNegativeInteger().check(0.0)
+    with pytest.raises(ValueError):
+        C.IntegerGreaterThanEq(2).check(1.0)
+    with pytest.raises(ValueError):
+        C.IntegerLessThan(3).check(3.0)
+    C.IntegerLessThanEq(3).check(3.0)
+    with pytest.raises(ValueError):
+        C.IntegerOpenInterval(0, 2).check(2.0)
+    C.IntegerHalfOpenInterval(0, 2).check(0.0)
+
+
+def test_constraint_matrix_and_simplex():
+    C.Simplex().check(onp.array([0.2, 0.8]))
+    with pytest.raises(ValueError):
+        C.Simplex().check(onp.array([0.5, 0.6]))
+    tri = onp.array([[1.0, 0.0], [2.0, 3.0]])
+    C.LowerTriangular().check(tri)
+    with pytest.raises(ValueError):
+        C.LowerTriangular().check(onp.array([[1.0, 1.0], [0.0, 1.0]]))
+    C.LowerCholesky().check(tri)
+    with pytest.raises(ValueError):  # negative diagonal
+        C.LowerCholesky().check(onp.array([[1.0, 0.0], [1.0, -2.0]]))
+    C.PositiveDefinite().check(onp.array([[2.0, 0.5], [0.5, 1.0]]))
+    with pytest.raises(ValueError):
+        C.PositiveDefinite().check(onp.array([[1.0, 2.0], [2.0, 1.0]]))
+
+
+def test_constraint_cat_stack_dependent():
+    cat = C.Cat([C.Positive(), C.LessThan(0)], axis=0, lengths=[2, 1])
+    cat.check(onp.array([1.0, 2.0, -3.0]))
+    with pytest.raises(ValueError):
+        cat.check(onp.array([1.0, -2.0, -3.0]))
+    st = C.Stack([C.Positive(), C.NonNegative()], axis=0)
+    st.check(onp.array([[1.0], [0.0]]))
+    with pytest.raises(ValueError):
+        st.check(onp.array([[-1.0], [0.0]]))
+    assert C.is_dependent(C._Dependent())
+    with pytest.raises(ValueError):
+        C._Dependent().check(1.0)
+
+
+# -- ctor validation on distributions --------------------------------------
+
+@pytest.mark.parametrize("bad_ctor", [
+    lambda: P.Normal(0.0, -1.0, validate_args=True),
+    lambda: P.Normal(onp.nan, 1.0, validate_args=True),
+    lambda: P.Gamma(shape=-2.0, scale=1.0, validate_args=True),
+    lambda: P.Bernoulli(prob=1.5, validate_args=True),
+    lambda: P.Exponential(-1.0, validate_args=True),
+    lambda: P.Beta(0.0, 1.0, validate_args=True),
+    lambda: P.Poisson(-1.0, validate_args=True),
+    lambda: P.Dirichlet(onp.array([-1.0, 2.0]), validate_args=True),
+    lambda: P.Geometric(1.5, validate_args=True),
+    lambda: P.Weibull(-1.0, 1.0, validate_args=True),
+    lambda: P.HalfNormal(-1.0, validate_args=True),
+    lambda: P.StudentT(-1.0, validate_args=True),
+    lambda: P.Categorical(prob=onp.array([0.5, 0.9]), validate_args=True),
+])
+def test_invalid_params_raise(bad_ctor):
+    with pytest.raises(ValueError):
+        bad_ctor()
+
+
+def test_valid_params_pass_and_default_off():
+    # validation off by default: invalid params do NOT raise (reference
+    # default _validate_args = False)
+    P.Normal(0.0, -1.0)
+    # valid params + validation on: fine
+    P.Normal(0.0, 2.0, validate_args=True)
+    P.Gamma(shape=2.0, scale=1.0, validate_args=True)
+    P.Bernoulli(logit=-3.0, validate_args=True)
+    P.Uniform(0.0, 1.0, validate_args=True)
+    # process-wide default toggle
+    P.Distribution.set_default_validate_args(True)
+    try:
+        with pytest.raises(ValueError):
+            P.Exponential(-2.0)
+    finally:
+        P.Distribution.set_default_validate_args(False)
+    P.Exponential(-2.0)  # off again
+
+
+def test_support_validation_in_log_prob():
+    with pytest.raises(ValueError):
+        P.Exponential(1.0, validate_args=True).log_prob(-3.0)
+    with pytest.raises(ValueError):
+        P.Beta(2.0, 2.0, validate_args=True).log_prob(1.5)
+    # dependent support resolves on the instance (Uniform)
+    with pytest.raises(ValueError):
+        P.Uniform(0.0, 1.0, validate_args=True).log_prob(2.0)
+    P.Uniform(0.0, 1.0, validate_args=True).log_prob(0.5)
+    # without validation, no raise
+    P.Exponential(1.0).log_prob(-3.0)
+
+
+# -- exponential family ----------------------------------------------------
+
+def test_bregman_entropy_matches_closed_forms():
+    from scipy import stats
+
+    cases = [
+        (P.Normal(1.0, 2.0), 0.5 * math.log(2 * math.pi * math.e * 4.0)),
+        (P.Exponential(2.0), 1 + math.log(2.0)),
+        (P.Beta(2.0, 3.0), stats.beta(2, 3).entropy()),
+        (P.Gamma(shape=3.0, scale=2.0), stats.gamma(3, scale=2).entropy()),
+        (P.Dirichlet(onp.array([1.0, 2.0, 3.0], "float32")),
+         stats.dirichlet([1.0, 2.0, 3.0]).entropy()),
+        (P.Bernoulli(prob=0.3), stats.bernoulli(0.3).entropy()),
+    ]
+    for dist, want in cases:
+        got = float(P.ExponentialFamily.entropy(dist).asnumpy())
+        assert abs(got - float(want)) < 1e-3, (type(dist).__name__, got, want)
+
+
+def test_bregman_kl_matches_registered_closed_forms():
+    pairs = [
+        (P.Normal(0.0, 1.0), P.Normal(1.0, 2.0)),
+        (P.Gamma(shape=2.0, scale=1.5), P.Gamma(shape=3.0, scale=0.5)),
+        (P.Beta(2.0, 3.0), P.Beta(4.0, 1.5)),
+        (P.Exponential(1.0), P.Exponential(3.0)),
+        (P.Bernoulli(prob=0.3), P.Bernoulli(prob=0.7)),
+        (P.Dirichlet(onp.array([1.0, 2.0], "float32")),
+         P.Dirichlet(onp.array([3.0, 1.0], "float32"))),
+    ]
+    for p, q in pairs:
+        closed = float(P.kl_divergence(p, q).asnumpy())
+        bregman = float(p._kl_same_family(q).asnumpy())
+        assert abs(closed - bregman) < 1e-3, (type(p).__name__,
+                                              closed, bregman)
+
+
+def test_exp_family_module_reexport():
+    from mxnet_tpu.gluon.probability.exp_family import ExponentialFamily
+    assert ExponentialFamily is P.ExponentialFamily
+    assert issubclass(P.Normal, ExponentialFamily)
+    assert issubclass(P.Poisson, ExponentialFamily)
+
+
+# -- the 4 missing metrics (reference docstring oracles) -------------------
+
+def test_fbeta_reference_oracle():
+    from mxnet_tpu.gluon import metric
+
+    fbeta = metric.Fbeta(beta=2)
+    fbeta.update([mx.nd.array([0., 1., 1.])],
+                 [mx.nd.array([[0.3, 0.7], [0., 1.], [0.4, 0.6]])])
+    assert abs(fbeta.get()[1] - 0.9090909090909091) < 1e-9
+
+
+def test_binary_accuracy_reference_oracle():
+    from mxnet_tpu.gluon import metric
+
+    bacc = metric.BinaryAccuracy(threshold=0.6)
+    bacc.update([mx.nd.array([0., 1., 0.])], [mx.nd.array([0.7, 1, 0.55])])
+    assert abs(bacc.get()[1] - 2 / 3) < 1e-9
+
+
+def test_mean_pairwise_distance_reference_oracle():
+    from mxnet_tpu.gluon import metric
+
+    mpd = metric.MeanPairwiseDistance()
+    mpd.update([mx.nd.array([[1., 0.], [4., 2.]])],
+               [mx.nd.array([[1., 2.], [3., 4.]])])
+    assert abs(mpd.get()[1] - (2.0 + math.sqrt(5.0)) / 2) < 1e-6
+
+
+def test_mean_cosine_similarity_reference_oracle():
+    from mxnet_tpu.gluon import metric
+
+    mcs = metric.MeanCosineSimilarity()
+    mcs.update([mx.nd.array([[3., 4.], [2., 2.]])],
+               [mx.nd.array([[1., 0.], [1., 1.]])])
+    assert abs(mcs.get()[1] - 0.8) < 1e-6
+
+
+def test_new_metrics_registered_for_create():
+    from mxnet_tpu.gluon import metric
+
+    for name in ("fbeta", "binaryaccuracy", "meanpairwisedistance",
+                 "meancosinesimilarity"):
+        m = metric.create(name)
+        assert isinstance(m, metric.EvalMetric)
+
+
+# -- estimator batch processor ---------------------------------------------
+
+def test_estimator_custom_batch_processor():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib.estimator import BatchProcessor, Estimator
+
+    calls = {"fit": 0, "eval": 0}
+
+    class DoubledLossProcessor(BatchProcessor):
+        def fit_batch(self, estimator, train_batch, batch_axis=0):
+            calls["fit"] += 1
+            return super().fit_batch(estimator, train_batch, batch_axis)
+
+        def evaluate_batch(self, estimator, val_batch, batch_axis=0):
+            calls["eval"] += 1
+            return super().evaluate_batch(estimator, val_batch, batch_axis)
+
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    est = Estimator(net, gluon.loss.L2Loss(),
+                    train_metrics=[gluon.metric.MSE()],
+                    batch_processor=DoubledLossProcessor())
+    x = mx.np.ones((8, 3))
+    y = mx.np.ones((8, 1))
+    est.fit([(x, y)] * 3, val_data=[(x, y)], epochs=1)
+    assert calls["fit"] == 3
+    assert calls["eval"] >= 1
+    with pytest.raises(Exception):
+        Estimator(net, gluon.loss.L2Loss(), batch_processor=object())
