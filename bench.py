@@ -39,8 +39,11 @@ profiler.device_op_table):
   dispatch is amortized (`step_n` fused rows): matmul fusions run at ~83%
   of peak; dropout uses the rbg hardware RNG; attention at seq 128 takes
   the XLA path (flash kernel wins only past the ~1024-token crossover).
-* Single-dispatch rows pay the tunnel's per-execute RTT (~30 ms) that a
-  non-tunneled host would pipeline; fused rows amortize it 8x.
+* Single-dispatch rows pay the tunnel's per-execute RTT — 0.7-30 ms in
+  healthy sessions, 117 ms observed in r4 — that a non-tunneled host
+  would pipeline; fused rows amortize it 8-16x. Rows whose rtt_ms
+  exceeds WEATHER_RTT_THRESHOLD_MS are flagged `weather_dominated` and
+  must not be compared across rounds.
 """
 from __future__ import annotations
 
@@ -140,6 +143,23 @@ def _spread(unit_scale=1.0, invert_for=None):
 
 
 _RTT_MS = None
+
+# single-dispatch rows are tunnel-weather-dominated above this RTT: the
+# healthy band observed across r1-r3 was 0.7-30 ms; r4 recorded 117 ms
+# and its fp32-infer spread swung -47%. Above 10 ms the per-step
+# dispatch tax, not the chip, sets the number — such rows must not be
+# compared across rounds (PERF.md "Benchmark variance").
+WEATHER_RTT_THRESHOLD_MS = 10.0
+
+
+def _dispatch_meta():
+    """rtt_ms + weather_dominated flag for single-dispatch rows, making
+    the JSON self-interpreting (r4 verdict Next #7)."""
+    rtt = _measure_rtt_ms()
+    meta = {"rtt_ms": rtt}
+    if rtt is not None:
+        meta["weather_dominated"] = bool(rtt > WEATHER_RTT_THRESHOLD_MS)
+    return meta
 
 
 def _measure_rtt_ms():
@@ -259,7 +279,7 @@ def bench_resnet_infer():
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASE_INFER_IMG_S, 3),
-        "rtt_ms": _measure_rtt_ms(),
+        **_dispatch_meta(),
         **_spread(invert_for=BATCH),
     })
     # fused probe AFTER the stable row is out, and non-fatal: a
@@ -330,7 +350,7 @@ def bench_resnet_infer_int8():
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / 2085.51, 3),
-        "rtt_ms": _measure_rtt_ms(),
+        **_dispatch_meta(),
         **_spread(invert_for=BATCH),
     })
     with autograd.predict_mode():
@@ -489,7 +509,7 @@ def bench_resnet_train(dtype=None):
         "vs_baseline": round(img_s / BASE_TRAIN_IMG_S, 3),
         "mfu": round(mfu, 4) if mfu else None,
         "cost_analysis_mfu_floor": _roofline(trainer),
-        "rtt_ms": _measure_rtt_ms(),
+        **_dispatch_meta(),
         **_spread(invert_for=BATCH),
     })
 
@@ -600,7 +620,7 @@ def bench_bert_train():
         "vs_baseline": None,
         "vs_mfu_target": round(mfu / 0.5, 3) if mfu else None,
         "mfu": round(mfu, 4) if mfu else None,
-        "rtt_ms": _measure_rtt_ms(),
+        **_dispatch_meta(),
         **_spread(invert_for=BATCH),
     })
 
@@ -699,7 +719,7 @@ def bench_lenet_eager():
         "unit": "img/s",
         "vs_baseline": None,
         "uncached_img_s": round(rates[False], 2),
-        "rtt_ms": _measure_rtt_ms(),
+        **_dispatch_meta(),
         **_spread(invert_for=BATCH),
     })
 

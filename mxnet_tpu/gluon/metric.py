@@ -156,28 +156,16 @@ class _BinaryClassificationBase(EvalMetric):
 
 
 @register
-class F1(_BinaryClassificationBase):
-    def __init__(self, name="f1", average="macro", **kwargs):
-        self.average = average
-        super().__init__(name, **kwargs)
-
-    def update(self, labels, preds):
-        self._count(labels, preds)
-        self.num_inst = 1
-        prec = self.tp / max(self.tp + self.fp, 1)
-        rec = self.tp / max(self.tp + self.fn, 1)
-        self.sum_metric = 2 * prec * rec / max(prec + rec, 1e-12)
-
-
-@register
 class Fbeta(_BinaryClassificationBase):
     """F-beta score of a binary classification problem (reference
     ``python/mxnet/gluon/metric.py:815-871``):
     ``(1 + beta^2) * P * R / (beta^2 * P + R)``."""
 
-    def __init__(self, name="fbeta", beta=1, threshold=0.5, **kwargs):
+    def __init__(self, name="fbeta", beta=1, threshold=0.5,
+                 average="micro", **kwargs):
         self.beta = beta
         self.threshold = threshold
+        self.average = average
         super().__init__(name, **kwargs)
 
     def update(self, labels, preds):
@@ -188,6 +176,15 @@ class Fbeta(_BinaryClassificationBase):
         b2 = self.beta ** 2
         self.sum_metric = ((1 + b2) * prec * rec
                            / max(b2 * prec + rec, 1e-12))
+
+
+@register
+class F1(Fbeta):
+    """F1 is F-beta at beta=1 (the reference derives Fbeta from F1;
+    sharing one update either way)."""
+
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, beta=1, average=average, **kwargs)
 
 
 @register

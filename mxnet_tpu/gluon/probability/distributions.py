@@ -103,15 +103,35 @@ class Distribution:
         for name, con in self.arg_constraints.items():
             if is_dependent(con):
                 continue
-            # __dict__, not getattr: derived parameterizations (prob
-            # from logit) must not be materialized just to validate.
-            # "_<name>" covers prob/logit storage, "<name>_param" covers
-            # attributes renamed to dodge method collisions (Gamma.shape)
-            val = self.__dict__.get(
-                name, self.__dict__.get(
-                    "_" + name, self.__dict__.get(name + "_param")))
+            # __dict__ first, not getattr: derived parameterizations
+            # (prob from logit) must not be materialized just to
+            # validate. "_<name>" covers prob/logit storage, "<name>_param"
+            # covers attributes renamed to dodge method collisions
+            # (Gamma.shape).
+            found = False
+            val = None
+            for attr in (name, "_" + name, name + "_param"):
+                if attr in self.__dict__:
+                    found = True
+                    val = self.__dict__[attr]
+                    if val is not None:
+                        break
+            if val is None and not found:
+                # wrapper classes (OneHotCategorical→_base, MVN's
+                # cov/scale_tril pair) expose the param as a property;
+                # materializing it here is fine — validation is opt-in
+                if isinstance(getattr(type(self), name, None), property):
+                    found = True
+                    val = getattr(self, name)
+            if not found:
+                # a declared constraint that maps to NO storage is a
+                # programming error, not a pass (silently skipping is
+                # how dead validation ships)
+                raise TypeError(
+                    f"{type(self).__name__}.arg_constraints declares "
+                    f"{name!r} but no attribute or property stores it")
             if val is None:
-                continue
+                continue  # unused side of a dual parameterization
             con.check(val)
 
     def _validate_samples(self, value):

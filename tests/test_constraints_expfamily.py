@@ -145,6 +145,51 @@ def test_support_validation_in_log_prob():
     P.Exponential(1.0).log_prob(-3.0)
 
 
+def test_wrapper_class_params_are_validated():
+    """review finding: params stored behind properties/_base wrappers
+    were silently skipped — dead validation."""
+    with pytest.raises(ValueError):
+        P.OneHotCategorical(prob=onp.array([0.5, 0.9]),
+                            validate_args=True)
+    with pytest.raises(ValueError):
+        P.RelaxedBernoulli(T=0.5, prob=1.7, validate_args=True)
+    with pytest.raises(ValueError):  # negative diagonal tril
+        P.MultivariateNormal(
+            onp.zeros(2, "float32"),
+            scale_tril=onp.array([[1.0, 0.0], [1.0, -2.0]], "float32"),
+            validate_args=True)
+    # valid wrapper params pass
+    P.OneHotCategorical(prob=onp.array([0.4, 0.6], "float32"),
+                        validate_args=True)
+    P.MultivariateNormal(
+        onp.zeros(2, "float32"),
+        scale_tril=onp.array([[1.0, 0.0], [0.5, 2.0]], "float32"),
+        validate_args=True)
+
+
+def test_unmapped_constraint_raises_loudly():
+    """review finding: a declared constraint that maps to no storage
+    must be a programming error, not a silent skip."""
+    class Broken(P.Distribution):
+        arg_constraints = {"nonexistent": C.Positive()}
+
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+
+    with pytest.raises(TypeError):
+        Broken(validate_args=True)
+    Broken()  # validation off: no probe, no raise
+
+
+def test_cauchy_studentt_scale_real_matches_reference():
+    """The reference constrains Cauchy/StudentT scale with Real(), not
+    Positive() (cauchy.py:48, studentT.py:48) — parity means a negative
+    scale passes validation there too; pinned so a future 'fix' is a
+    conscious divergence."""
+    P.Cauchy(0.0, -1.0, validate_args=True)
+    P.StudentT(3.0, 0.0, -1.0, validate_args=True)
+
+
 # -- exponential family ----------------------------------------------------
 
 def test_bregman_entropy_matches_closed_forms():
