@@ -23,7 +23,7 @@ Variants:
                   NOTE round-3's exp/resnet_bound.py s2d variant was
                   wrong (4x4 s2d + stride 2 collapsed the network to
                   1/16 spatial, 1.6 GF/img); this one keeps the true
-                  FLOP count (23.9 -> 24.2 GF/img, stem kernel 8x8/49).
+                  FLOP count (22.4 -> 22.5 GF/img, stem kernel 8x8/49).
 
 MFU accounting matches bench.py: numerator = XLA cost_analysis flops of
 the compiled SINGLE step (the fused variant multiplies by the window —
@@ -286,6 +286,8 @@ def run_variant(nhwc, s2d=False, fuse=8):
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which not in ("all", "nchw", "nhwc", "s2d"):
+        sys.exit(f"unknown variant {which!r}: use all|nchw|nhwc|s2d")
     dev = jax.devices()[0]
     print(f"# device: {dev.device_kind}", file=sys.stderr)
     rows = []

@@ -1,0 +1,147 @@
+"""Fused 1x1-conv pair as a single Pallas TPU kernel (VERDICT r4 #1b).
+
+A 1x1 convolution in channels-last layout IS a matmul over the flattened
+batch*spatial rows: ``(M, C1) @ (C1, Cm)``.  ResNet-style bottlenecks
+chain two of them (expand/reduce) with a relu between — the shape
+`exp/conv_chain_probe.json` measured at 0.22-0.41 MXU utilization under
+XLA's conv lowering (`stage2_1x1_pair`: 43 TF/s of the chip's 197).
+
+This kernel computes ``relu(a1(x @ w1)) @ w2 -> relu(a2(.))`` for one
+row-tile per grid step, keeping the mid-channel intermediate ``h`` in
+VMEM — it never touches HBM, so the pair's traffic drops from
+x + h + h + y to x + y.  ``a1``/``a2`` are optional per-channel affines
+(folded BatchNorm for inference-time use).  Both matmuls land on the
+MXU with f32 accumulation.
+
+The pair's fused arithmetic intensity: per row it does 4*C1*Cm flops
+against 4*C1 bytes of x-in + y-out traffic, i.e. AI = Cm flops/byte.
+At the stage2 shape (Cm=128) that is below the v5e machine balance of
+240 (197e12/819e9) — HBM-bound: the kernel's ceiling is ~0.53 MXU, not
+1.0.  Measured verdict vs the XLA conv and XLA matmul formulations:
+`exp/pallas_1x1_probe.json`, summarized in PERF.md.
+
+Reference context: the reference's bottleneck 1x1s are cuDNN conv calls
+(`/root/reference/src/operator/nn/convolution.cc`) — there is no fused
+pair there; this is TPU-first design on the shape the probe named.
+"""
+from __future__ import annotations
+
+import functools
+
+
+def _kernel(x_ref, w1_ref, w2_ref, s1_ref, b1_ref, s2_ref, b2_ref, o_ref):
+    import jax.numpy as jnp
+
+    x = x_ref[...]
+    h = jnp.dot(x, w1_ref[...], preferred_element_type=jnp.float32)
+    h = h * s1_ref[0] + b1_ref[0]
+    h = jnp.maximum(h, 0.0).astype(x.dtype)
+    y = jnp.dot(h, w2_ref[...], preferred_element_type=jnp.float32)
+    y = y * s2_ref[0] + b2_ref[0]
+    o_ref[...] = jnp.maximum(y, 0.0).astype(x.dtype)
+
+
+def _kernel_res(x_ref, res_ref, w1_ref, w2_ref, s1_ref, b1_ref, s2_ref,
+                b2_ref, o_ref):
+    """Pair with a residual folded between the matmuls: computes
+    ``relu(a2(relu(a1(x @ w1) + res) @ w2))`` — the cross-block
+    bottleneck-boundary motif (c3 -> bn3 -> +skip -> relu -> next c1 ->
+    bn1 -> relu) in channels-last rows."""
+    import jax.numpy as jnp
+
+    x = x_ref[...]
+    h = jnp.dot(x, w1_ref[...], preferred_element_type=jnp.float32)
+    h = h * s1_ref[0] + b1_ref[0] + res_ref[...].astype(jnp.float32)
+    h = jnp.maximum(h, 0.0).astype(x.dtype)
+    y = jnp.dot(h, w2_ref[...], preferred_element_type=jnp.float32)
+    y = y * s2_ref[0] + b2_ref[0]
+    o_ref[...] = jnp.maximum(y, 0.0).astype(x.dtype)
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("block_rows", "interpret"))
+def conv1x1_pair(x, w1, w2, scale1=None, bias1=None, scale2=None,
+                 bias2=None, residual=None, *, block_rows=1024,
+                 interpret=False):
+    """relu(a2((relu(a1(x @ w1) [+ residual])) @ w2)), mid in VMEM.
+
+    x: (..., C1) channels-last; any leading shape (flattened to rows).
+    w1: (C1, Cm), w2: (Cm, C1out). scale/bias: optional (Cm,)/(C1out,)
+    per-channel affines applied before each relu (folded BN).
+    residual: optional (..., Cm) skip input added after the first
+    affine, before its relu — the bottleneck block-boundary motif.
+    Rows are zero-padded up to a block_rows multiple and sliced back.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    c1, cm = w1.shape
+    cout = w2.shape[1]
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, c1)
+    pad = (-m) % block_rows
+    if pad:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((pad, c1), x2.dtype)], axis=0)
+    mp = m + pad
+    r2 = None
+    if residual is not None:
+        r2 = residual.reshape(m, cm).astype(x.dtype)
+        if pad:
+            r2 = jnp.concatenate(
+                [r2, jnp.zeros((pad, cm), r2.dtype)], axis=0)
+
+    # per-channel affines as (1, C) 2-D — TPU VMEM blocks must be >=2-D
+    one = jnp.ones((), jnp.float32)
+    zero = jnp.zeros((), jnp.float32)
+    s1 = jnp.broadcast_to(one if scale1 is None else scale1, (1, cm)) \
+        .astype(jnp.float32)
+    b1 = jnp.broadcast_to(zero if bias1 is None else bias1, (1, cm)) \
+        .astype(jnp.float32)
+    s2 = jnp.broadcast_to(one if scale2 is None else scale2, (1, cout)) \
+        .astype(jnp.float32)
+    b2 = jnp.broadcast_to(zero if bias2 is None else bias2, (1, cout)) \
+        .astype(jnp.float32)
+
+    # index-map constants must be jnp.int32 built INSIDE the map (a bare
+    # Python 0 lowers to i64 and Mosaic rejects the mixed index tuple;
+    # a captured tracer is rejected by pallas itself)
+    full = lambda s: pl.BlockSpec(  # noqa: E731
+        s, lambda i: (jnp.int32(0),) * len(s))
+    # When the pair is channel-stable (C1 == Cout, no row padding) let
+    # the output reuse x's buffer: grid step i reads exactly the rows it
+    # writes, so aliasing is safe, and it lets XLA elide the full-array
+    # copy it otherwise inserts when the call sits in a loop carry
+    # (measured: the copy alone costs as much as the kernel at stage2).
+    # JAX still copies defensively if x is live elsewhere.
+    alias = {0: 0} if (cout == c1 and pad == 0) else {}
+    row_spec = lambda c: pl.BlockSpec(  # noqa: E731
+        (block_rows, c), lambda i: (i, jnp.int32(0)))
+    in_specs = [row_spec(c1)]
+    operands = [x2]
+    if r2 is not None:
+        in_specs.append(row_spec(cm))
+        operands.append(r2)
+    in_specs += [full((c1, cm)), full((cm, cout)), full((1, cm)),
+                 full((1, cm)), full((1, cout)), full((1, cout))]
+    operands += [w1, w2, s1, b1, s2, b2]
+    out = pl.pallas_call(
+        _kernel if r2 is None else _kernel_res,
+        grid=(mp // block_rows,),
+        input_output_aliases=alias,
+        in_specs=in_specs,
+        out_specs=row_spec(cout),
+        out_shape=jax.ShapeDtypeStruct((mp, cout), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(*operands)
+    if pad:
+        out = out[:m]
+    return out.reshape(*lead, cout)

@@ -1,0 +1,84 @@
+"""Pallas fused 1x1-conv-pair kernel vs a numpy oracle.
+
+Runs the real TPU kernel through the Pallas interpreter on CPU
+(reference test style: numpy-oracle per-op checks). The kernel's
+on-chip verdict lives in exp/pallas_1x1_probe.json (stage2 pair:
+1.87x over the XLA conv formulation).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+from mxnet_tpu.ops.pallas.conv1x1 import conv1x1_pair
+
+
+def _oracle(x, w1, w2, s1, b1, s2, b2, res=None):
+    h = x.astype("float32") @ w1.astype("float32")
+    h = h * s1 + b1
+    if res is not None:
+        h = h + res.astype("float32")
+    h = onp.maximum(h, 0.0)
+    y = h @ w2.astype("float32")
+    y = y * s2 + b2
+    return onp.maximum(y, 0.0)
+
+
+CASES = [
+    # lead, c1, cm, cout, block_rows, affine, residual
+    ((256,), 512, 128, 512, 64, False, False),   # stage2 pair shape
+    ((64,), 64, 256, 64, 64, False, False),      # stage1 pair shape
+    ((4, 49,), 512, 128, 512, 64, True, False),  # folded-BN affines
+    ((200,), 128, 512, 128, 64, True, True),     # boundary motif + skip
+    ((33,), 256, 128, 192, 32, True, False),     # cout != c1, pad rows
+]
+
+
+@pytest.mark.parametrize("lead,c1,cm,cout,br,affine,residual", CASES)
+def test_conv1x1_pair_matches_oracle(lead, c1, cm, cout, br, affine,
+                                     residual):
+    rng = onp.random.RandomState(0)
+    x = rng.randn(*lead, c1).astype("float32") * 0.5
+    w1 = (rng.randn(c1, cm) * (2.0 / c1) ** 0.5).astype("float32")
+    w2 = (rng.randn(cm, cout) * (2.0 / cm) ** 0.5).astype("float32")
+    if affine:
+        s1 = (rng.rand(cm) + 0.5).astype("float32")
+        b1 = (rng.randn(cm) * 0.1).astype("float32")
+        s2 = (rng.rand(cout) + 0.5).astype("float32")
+        b2 = (rng.randn(cout) * 0.1).astype("float32")
+    else:
+        s1 = b1 = s2 = b2 = None
+    res = (rng.randn(*lead, cm).astype("float32") * 0.5
+           if residual else None)
+
+    with jax.default_matmul_precision("highest"):
+        got = conv1x1_pair(
+            jnp.asarray(x), jnp.asarray(w1), jnp.asarray(w2),
+            None if s1 is None else jnp.asarray(s1),
+            None if b1 is None else jnp.asarray(b1),
+            None if s2 is None else jnp.asarray(s2),
+            None if b2 is None else jnp.asarray(b2),
+            None if res is None else jnp.asarray(res),
+            block_rows=br, interpret=True)
+    want = _oracle(
+        x.reshape(-1, c1), w1, w2,
+        1.0 if s1 is None else s1, 0.0 if b1 is None else b1,
+        1.0 if s2 is None else s2, 0.0 if b2 is None else b2,
+        None if res is None else res.reshape(-1, cm))
+    assert got.shape == (*lead, cout)
+    onp.testing.assert_allclose(
+        onp.asarray(got, "float32").reshape(-1, cout), want,
+        rtol=2e-5, atol=2e-5)
+
+
+def test_conv1x1_pair_bf16():
+    rng = onp.random.RandomState(1)
+    x = jnp.asarray(rng.randn(96, 512) * 0.5, dtype=jnp.bfloat16)
+    w1 = jnp.asarray(rng.randn(512, 128) * 0.06, dtype=jnp.bfloat16)
+    w2 = jnp.asarray(rng.randn(128, 512) * 0.12, dtype=jnp.bfloat16)
+    got = conv1x1_pair(x, w1, w2, block_rows=32, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = _oracle(onp.asarray(x, "float32"), onp.asarray(w1, "float32"),
+                   onp.asarray(w2, "float32"), 1.0, 0.0, 1.0, 0.0)
+    err = onp.abs(onp.asarray(got, "float32") - want)
+    assert err.max() / (onp.abs(want).max() + 1e-9) < 0.05
