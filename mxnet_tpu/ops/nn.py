@@ -834,8 +834,14 @@ def attention(query, key, value, mask=None, causal=False, scale=None,
     from .pallas import flash_attention as fa
 
     n_extra = (mask is not None, valid_length is not None)
+    # routing globals must live in f's CLOSURE: the eager jit cache keys
+    # ops on (code, closure values), and a cached executable replays its
+    # traced path — without these cells a force_path()/use_interpret()
+    # flip would silently keep serving the previously-traced kernel
+    routing = (fa._FORCE_PATH, fa._INTERPRET)
 
     def f(q, k, v, *extra):
+        assert routing == (fa._FORCE_PATH, fa._INTERPRET)
         it = iter(extra)
         m = next(it) if n_extra[0] else None
         vl = next(it) if n_extra[1] else None

@@ -49,6 +49,20 @@ def use_interpret(flag: bool) -> None:
     _INTERPRET = bool(flag)
 
 
+# bench/test override of the empirical crossover routing: None (measured
+# routing), "xla" (force fallback — the bench ablation arm), or "pallas"
+# (force the kernel where it supports the shape).
+_FORCE_PATH = None
+
+
+def force_path(path) -> None:
+    """Override attention path selection: None | 'xla' | 'pallas'."""
+    global _FORCE_PATH
+    if path not in (None, "xla", "pallas"):
+        raise ValueError(f"force_path: {path!r} not in (None,'xla','pallas')")
+    _FORCE_PATH = path
+
+
 def last_path():
     return _LAST_PATH
 
@@ -563,7 +577,11 @@ def attention(q, k, v, mask=None, causal=False, scale=None, use_flash=True,
     mask, broadcastable against (B, H, Tq, Tk); forces the XLA path.
     """
     global _LAST_PATH
-    if mask is None and use_flash and _supports_pallas(q, k):
+    want_flash = use_flash and _FORCE_PATH != "xla" and (
+        _supports_pallas(q, k)
+        or (_FORCE_PATH == "pallas" and q.ndim == 4
+            and q.shape[-1] <= 256))
+    if mask is None and want_flash:
         _LAST_PATH = "pallas"
         return _flash_core(q, k, v, valid_length, causal, scale)
     _LAST_PATH = "xla"

@@ -146,3 +146,35 @@ def test_models_use_flash_path_under_interpret():
         loss = out.sum()
     loss.backward()
     assert fa.last_path() == "pallas"
+
+
+def test_force_path_invalidates_eager_op_cache():
+    """force_path() must actually flip the traced path even when the
+    attention op was already compiled into the eager jit cache at the
+    same shapes (r5 bench-ablation bug: the cache keys on (code,
+    closure), so the routing globals must live in the closure — a stale
+    hit would silently replay the previously-traced kernel)."""
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.ops import nn as ops_nn
+
+    q = mnp.array(_rand((1, 1, 128, 64)))
+    ops_nn.attention(q, q, q, causal=True)
+    assert fa.last_path() == "pallas"
+    fa.force_path("xla")
+    try:
+        ops_nn.attention(q, q, q, causal=True)
+        assert fa.last_path() == "xla"
+    finally:
+        fa.force_path(None)
+    # restored routing picks pallas again on a FRESH trace (new shape —
+    # last_path() reports trace-time decisions; a cache-hit replay of
+    # the original shape correctly executes pallas but does not re-run
+    # the Python that records it)
+    q2 = mnp.array(_rand((1, 1, 256, 64)))
+    ops_nn.attention(q2, q2, q2, causal=True)
+    assert fa.last_path() == "pallas"
+
+
+def test_force_path_rejects_unknown():
+    with pytest.raises(ValueError):
+        fa.force_path("cuda")
