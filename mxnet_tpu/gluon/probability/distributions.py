@@ -117,9 +117,25 @@ class Distribution:
                     if val is not None:
                         break
             if val is None and not found:
-                # wrapper classes (OneHotCategorical→_base, MVN's
-                # cov/scale_tril pair) expose the param as a property;
-                # materializing it here is fine — validation is opt-in
+                # wrapper classes (OneHotCategorical→Categorical) store
+                # the duals on a _base distribution: look there BEFORE
+                # the property fallback, so the unused side of a dual
+                # parameterization is skipped instead of materialized
+                # (softmax'ing logits just to re-check Simplex both
+                # wastes a device launch and can spuriously reject valid
+                # logits at float32 summation tolerance)
+                base = self.__dict__.get("_base")
+                if base is not None:
+                    for attr in (name, "_" + name, name + "_param"):
+                        if attr in base.__dict__:
+                            found = True
+                            val = base.__dict__[attr]
+                            if val is not None:
+                                break
+            if val is None and not found:
+                # a param only ever exposed as a property (no dual
+                # storage anywhere): materializing it is the only way
+                # to validate — validation is opt-in
                 if isinstance(getattr(type(self), name, None), property):
                     found = True
                     val = getattr(self, name)
@@ -780,13 +796,13 @@ class MultivariateNormal(Distribution):
         self.loc = mnp.array(loc) if not hasattr(loc, "_data") else loc
         self._cov = mnp.array(cov) if cov is not None \
             and not hasattr(cov, "_data") else cov
-        self._tril = mnp.array(scale_tril) if scale_tril is not None \
+        self._scale_tril = mnp.array(scale_tril) if scale_tril is not None \
             and not hasattr(scale_tril, "_data") else scale_tril
 
     @property
     def scale_tril(self):
-        if self._tril is not None:
-            return self._tril
+        if self._scale_tril is not None:
+            return self._scale_tril
         jnp = _jnp()
         return _wrap(lambda c: jnp.linalg.cholesky(c), self._cov,
                      name="cholesky")

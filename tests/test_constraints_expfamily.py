@@ -308,3 +308,29 @@ def test_estimator_custom_batch_processor():
     assert calls["eval"] >= 1
     with pytest.raises(Exception):
         Estimator(net, gluon.loss.L2Loss(), batch_processor=object())
+
+
+def test_unused_dual_side_is_not_materialized():
+    """r5 review finding: the property fallback must not materialize
+    DERIVED parameters (softmax of logits, Cholesky of cov) just to
+    re-validate them — the unused side of a dual parameterization is
+    skipped via its _base/self storage, mirroring direct classes.
+    float32 softmax over many classes can miss Simplex's 1e-6 sum
+    tolerance on perfectly valid logits."""
+    rng = onp.random.RandomState(0)
+    # 4096-class logits: softmax sum error is O(1e-6) in float32 — a
+    # materialize-and-check would flake; the skip must make it exact
+    logits = (rng.randn(4096) * 4).astype("float32")
+    P.OneHotCategorical(logit=onp.asarray(logits), validate_args=True)
+    P.Categorical(logit=onp.asarray(logits), validate_args=True)
+    # MVN given cov: validates cov (PositiveDefinite), must NOT take a
+    # Cholesky for a tautological LowerCholesky check
+    cov = onp.array([[2.0, 0.3], [0.3, 1.0]], "float32")
+    mvn = P.MultivariateNormal(onp.zeros(2, "float32"), cov=cov,
+                               validate_args=True)
+    assert mvn._scale_tril is None  # construction left the dual unset
+    with pytest.raises(ValueError):  # non-PD cov still rejected
+        P.MultivariateNormal(
+            onp.zeros(2, "float32"),
+            cov=onp.array([[1.0, 2.0], [2.0, 1.0]], "float32"),
+            validate_args=True)
