@@ -394,6 +394,75 @@ def bench_resnet_infer_int8():
     return row
 
 
+def bench_resnet_infer_pallas_fused(n_fuse=16):
+    """ResNet-50 bf16 inference through contrib.pallas_fuse (NHWC
+    trunk, folded BN) — the transform is the headline (13.7k+ img/s vs
+    5.9k plain fp32); the conv1x1_pair-kernel boundary arm
+    (use_pallas=True) is re-measured as `pallas_kernel_img_s` each
+    round with its measured in-graph verdict: the kernel wins 2.52x on
+    the isolated probe shape but LOSES end-to-end because a custom-call
+    is a fusion barrier (PERF.md round-5). Scan-chained dispatch (same
+    n_fuse protocol as the int8 row)."""
+    import functools
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.contrib.pallas_fuse import fuse_resnet_v1
+
+    BATCH, SIZE = 32, 224
+    net = _make_resnet()  # initialized + shapes materialized
+    x = jnp.asarray(onp.random.uniform(
+        -1, 1, (BATCH, 3, SIZE, SIZE)).astype("float32"))
+
+    def rate(fused):
+        @functools.partial(jax.jit, static_argnums=1)
+        def run(xd, m):
+            def body(carry, _):
+                logits = fused._forward(xd + carry)
+                return jnp.mean(logits).astype(xd.dtype) * 1e-12, None
+
+            c, _ = jax.lax.scan(body, jnp.zeros((), xd.dtype), None,
+                                length=m)
+            return c
+
+        onp.asarray(run(x, n_fuse))
+        onp.asarray(run(x, 4 * n_fuse))
+        diffs = []
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            onp.asarray(run(x, n_fuse))
+            d1 = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            onp.asarray(run(x, 4 * n_fuse))
+            d2 = _time.perf_counter() - t0
+            if d2 > d1:
+                diffs.append((d2 - d1) / (3 * n_fuse))
+        if not diffs:
+            raise RuntimeError("degenerate fused-pair timing")
+        diffs.sort()
+        global _LAST_SAMPLES
+        _LAST_SAMPLES = list(diffs)
+        return diffs[len(diffs) // 2]
+
+    dt_pal = rate(fuse_resnet_v1(net, use_pallas=True))
+    pal_spread = _spread(invert_for=BATCH)
+    dt_xla = rate(fuse_resnet_v1(net))  # default: XLA boundaries
+    return _emit({
+        "metric": f"resnet50_v1_infer_bs32_bf16_fusedpairs{n_fuse}",
+        "value": round(BATCH / dt_xla, 2),
+        "unit": "img/s",
+        "vs_baseline": round(BATCH / dt_xla / BASE_INFER_IMG_S, 3),
+        "pallas_kernel_img_s": round(BATCH / dt_pal, 2),
+        "pallas_kernel_ratio": round(dt_xla / dt_pal, 3),
+        "pallas_kernel_spread": pal_spread.get("spread"),
+        **_spread(invert_for=BATCH),
+    })
+
+
 def _train_bench(net, loss_fn, optimizer, opt_params, data, labels,
                  rules=None, dtype=None, k1=3, k2=15, fuse=None):
     """Shared training-step timer: ShardedTrainer (SPMD step over the device
@@ -867,6 +936,7 @@ def main():
     failures = {}
     for name, fn in [("infer", bench_resnet_infer),
                      ("infer_int8", bench_resnet_infer_int8),
+                     ("infer_pallas_fused", bench_resnet_infer_pallas_fused),
                      ("bandwidth", bench_bandwidth),
                      ("lenet_eager", bench_lenet_eager),
                      ("bert", bench_bert_train),

@@ -1,0 +1,243 @@
+"""Pallas pair-fusion transform for bottleneck ResNets (inference).
+
+The graph rewrite the `exp/pallas_1x1_probe.py` win pays off with:
+``fuse_resnet_v1(net)`` takes a trained model-zoo ``ResNetV1``
+(bottleneck blocks) and returns an inference callable that
+
+* runs the whole trunk channels-last (NHWC — the TPU-native layout, so
+  the 1x1 convs are literal matmuls on (B·H·W, C) rows);
+* folds every BatchNorm into per-channel affines (inference-mode BN is
+  ``y = x*s + b`` with ``s = gamma/sqrt(var+eps)``);
+* optionally (``use_pallas=True``) fuses every block-boundary pair —
+  ``c3 -> bn3 -> +skip -> relu -> next c1 -> bn1 -> relu`` — into ONE
+  Pallas kernel (`ops/pallas/conv1x1.conv1x1_pair(residual=...,
+  return_mid=True)`), the shape the conv-chain probe measured at
+  0.22 MXU under XLA while the kernel runs it at 0.55;
+* leaves the 3x3s, the strided block entries, and the stem to XLA.
+
+The transform itself (NHWC + folded BN) is the win: 13.7-14.2k img/s
+bf16 at bs32 on v5e vs 5.9k on the plain fp32 path. The kernel arm is
+kept behind its flag with a measured LOSS verdict in-graph — see
+:func:`fuse_resnet_v1` — and `bench.py` re-measures both arms every
+round.
+
+This is the TPU analog of the reference's operator-fusion subgraph
+backends (``src/operator/subgraph/``): an opt-in post-training graph
+transform on the user-facing model, in the same spirit as
+``contrib.quantization.quantize_net``.
+
+Training is NOT rewritten: training-mode BN computes batch statistics
+between the convs, which breaks the single-pass fusion (documented
+design bound, PERF.md).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+_INTERPRET = False
+
+
+def use_interpret(flag: bool) -> None:
+    """Route the fused kernels through the Pallas interpreter (CPU CI)."""
+    global _INTERPRET
+    _INTERPRET = bool(flag)
+
+
+def _np(x):
+    return x.data().asnumpy()
+
+
+def _fold_bn(bn):
+    """Inference BN as (scale, bias): y = x*scale + bias."""
+    import numpy as onp
+
+    gamma = _np(bn.gamma)
+    beta = _np(bn.beta)
+    mean = _np(bn.running_mean)
+    var = _np(bn.running_var)
+    s = gamma / onp.sqrt(var + bn._eps)
+    return s.astype("float32"), (beta - mean * s).astype("float32")
+
+
+def _conv_w(conv):
+    """Conv2D weight (O, I, kh, kw) -> HWIO for NHWC lax convs."""
+    return _np(conv.weight).transpose(2, 3, 1, 0)
+
+
+def _extract_bottleneck(blk):
+    """Pull (weights, affines, stride, downsample) out of a BottleneckV1."""
+    body = blk.body
+    p = {
+        "w1": _conv_w(body[0])[0, 0],          # (I, mid) 1x1
+        "a1": _fold_bn(body[1]),
+        "w2": _conv_w(body[3]),                # (3, 3, mid, mid)
+        "a2": _fold_bn(body[4]),
+        "w3": _conv_w(body[6])[0, 0],          # (mid, O) 1x1
+        "a3": _fold_bn(body[7]),
+        "stride": body[0]._strides[0],
+    }
+    if blk.downsample is not None:
+        p["wd"] = _conv_w(blk.downsample[0])[0, 0]
+        p["ad"] = _fold_bn(blk.downsample[1])
+    return p
+
+
+class FusedResNetV1:
+    """Callable inference model produced by :func:`fuse_resnet_v1`.
+
+    Holds jnp weights; ``__call__`` takes an NDArray / array NCHW image
+    batch and returns logits as an NDArray. The whole forward is one
+    jitted program per input shape.
+    """
+
+    def __init__(self, stem, stages, head, dtype, block_rows,
+                 use_pallas=True):
+        import jax
+        import jax.numpy as jnp
+
+        self._dtype = jnp.dtype(dtype)
+        self._block_rows = block_rows
+        self._use_pallas = use_pallas
+        cast = lambda a: jnp.asarray(a, self._dtype)  # noqa: E731
+
+        def cast_tree(obj):
+            if isinstance(obj, dict):
+                return {k: cast_tree(v) for k, v in obj.items()}
+            if isinstance(obj, tuple):
+                return tuple(cast_tree(v) for v in obj)
+            if isinstance(obj, list):
+                return [cast_tree(v) for v in obj]
+            if isinstance(obj, int):
+                return obj
+            return cast(obj)
+
+        self._stem = cast_tree(stem)
+        self._stages = cast_tree(stages)
+        self._head = cast_tree(head)
+        self._jit = jax.jit(self._forward)
+
+    # -- pure-jax forward -------------------------------------------------
+
+    def _affine_relu(self, x, a, relu=True):
+        import jax.numpy as jnp
+
+        s, b = a
+        y = x * s + b
+        return jnp.maximum(y, 0.0).astype(x.dtype) if relu \
+            else y.astype(x.dtype)
+
+    def _conv(self, x, w, stride=1, pad=None):
+        import jax
+
+        k = w.shape[0]
+        if pad is None:
+            pad = (k - 1) // 2
+        return jax.lax.conv_general_dilated(
+            x, w, (stride, stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=x.dtype)
+
+    def _stage(self, x, blocks):
+        """x NHWC; bottleneck stage with pair-fused block boundaries."""
+        from ..ops.pallas.conv1x1 import conv1x1_pair
+
+        b0 = blocks[0]
+        st = b0["stride"]
+        xs = x[:, ::st, ::st, :] if st > 1 else x
+        if "wd" in b0:
+            res = self._affine_relu(xs @ b0["wd"], b0["ad"], relu=False)
+        else:
+            res = x
+        h = self._affine_relu(xs @ b0["w1"], b0["a1"])
+        h = self._affine_relu(self._conv(h, b0["w2"]), b0["a2"])
+        for i, blk in enumerate(blocks):
+            s3, b3 = blk["a3"]
+            if i + 1 < len(blocks):
+                nxt = blocks[i + 1]
+                s1n, b1n = nxt["a1"]
+                if self._use_pallas:
+                    # boundary pair in ONE kernel; mid = this block's
+                    # output = the next boundary's residual
+                    h2, res2 = conv1x1_pair(
+                        h, blk["w3"], nxt["w1"], s3, b3, s1n, b1n,
+                        residual=res, return_mid=True,
+                        block_rows=self._block_rows,
+                        interpret=_INTERPRET)
+                else:
+                    # ablation arm: identical folded NHWC graph, the
+                    # boundary left to XLA (isolates the kernel's win)
+                    import jax.numpy as jnp
+
+                    y = self._affine_relu(h @ blk["w3"], (s3, b3),
+                                          relu=False)
+                    res2 = jnp.maximum(y + res, 0.0).astype(h.dtype)
+                    h2 = self._affine_relu(res2 @ nxt["w1"],
+                                           (s1n, b1n))
+                res = res2
+                h = self._affine_relu(self._conv(h2, nxt["w2"]),
+                                      nxt["a2"])
+            else:
+                import jax.numpy as jnp
+
+                y = self._affine_relu(h @ blk["w3"], (s3, b3),
+                                      relu=False)
+                h = jnp.maximum(y + res, 0.0).astype(x.dtype)
+        return h
+
+    def _forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        x = x.astype(self._dtype).transpose(0, 2, 3, 1)  # NCHW -> NHWC
+        x = self._conv(x, self._stem["w"], stride=2, pad=3)
+        x = self._affine_relu(x, self._stem["a"])
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            [(0, 0), (1, 1), (1, 1), (0, 0)])
+        for blocks in self._stages:
+            x = self._stage(x, blocks)
+        x = jnp.mean(x, axis=(1, 2)).astype(self._dtype)
+        return (x @ self._head["w"] + self._head["b"]).astype(jnp.float32)
+
+    def __call__(self, x):
+        from ..ndarray.ndarray import NDArray
+
+        data = x._data if isinstance(x, NDArray) else x
+        return NDArray(self._jit(data))
+
+
+def fuse_resnet_v1(net, dtype="bfloat16", block_rows=512,
+                   use_pallas=False):
+    """Fuse a trained bottleneck ``ResNetV1`` for TPU inference.
+
+    Requires the v1 deep-stem layout (7x7 stem; ``BottleneckV1``
+    stages). Raises MXNetError for basic-block or v2 models — the pair
+    motif this fuses only exists in bottleneck nets.
+
+    ``use_pallas=True`` routes every block boundary through the
+    conv1x1_pair kernel. The measured verdict (PERF.md round-5) is that
+    this LOSES end-to-end (0.65-0.82x) despite the kernel's 2.52x win
+    on the isolated shape: a pallas custom-call is a fusion barrier, so
+    XLA can no longer fuse the boundary into its neighbors and inserts
+    relayout copies at every kernel edge (36 copies / 111 fusions vs
+    8 / 166 in the compiled bs32 forward). The default therefore keeps
+    the boundaries in XLA; the flag preserves the measured alternative
+    and the bench re-checks the ratio every round.
+    """
+    feats = list(net.features)
+    if len(feats) != 9:
+        raise MXNetError(
+            "fuse_resnet_v1 expects the model-zoo ResNetV1 bottleneck "
+            f"layout (9 feature blocks, got {len(feats)}); thumbnail "
+            "and v2 variants are not fusable")
+    stem = {"w": _conv_w(feats[0]), "a": _fold_bn(feats[1])}
+    stages = []
+    for stage in feats[4:8]:
+        blocks = list(stage)
+        if not hasattr(blocks[0], "body") or len(list(blocks[0].body)) != 8:
+            raise MXNetError(
+                "fuse_resnet_v1 supports BottleneckV1 stages only")
+        stages.append([_extract_bottleneck(b) for b in blocks])
+    head = {"w": _np(net.output.weight).T, "b": _np(net.output.bias)}
+    return FusedResNetV1(stem, stages, head, dtype, block_rows,
+                         use_pallas=use_pallas)

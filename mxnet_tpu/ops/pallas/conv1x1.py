@@ -58,12 +58,28 @@ def _kernel_res(x_ref, res_ref, w1_ref, w2_ref, s1_ref, b1_ref, s2_ref,
     o_ref[...] = jnp.maximum(y, 0.0).astype(x.dtype)
 
 
+def _kernel_res2(x_ref, res_ref, w1_ref, w2_ref, s1_ref, b1_ref, s2_ref,
+                 b2_ref, o_mid_ref, o_ref):
+    """_kernel_res that ALSO writes the mid value ``relu(a1(x@w1)+res)``
+    — a fused ResNet stage needs it as the NEXT block's residual."""
+    import jax.numpy as jnp
+
+    x = x_ref[...]
+    h = jnp.dot(x, w1_ref[...], preferred_element_type=jnp.float32)
+    h = h * s1_ref[0] + b1_ref[0] + res_ref[...].astype(jnp.float32)
+    h = jnp.maximum(h, 0.0).astype(x.dtype)
+    o_mid_ref[...] = h
+    y = jnp.dot(h, w2_ref[...], preferred_element_type=jnp.float32)
+    y = y * s2_ref[0] + b2_ref[0]
+    o_ref[...] = jnp.maximum(y, 0.0).astype(x.dtype)
+
+
 @functools.partial(
     __import__("jax").jit,
-    static_argnames=("block_rows", "interpret"))
+    static_argnames=("block_rows", "return_mid", "interpret"))
 def conv1x1_pair(x, w1, w2, scale1=None, bias1=None, scale2=None,
                  bias2=None, residual=None, *, block_rows=1024,
-                 interpret=False):
+                 return_mid=False, interpret=False):
     """relu(a2((relu(a1(x @ w1) [+ residual])) @ w2)), mid in VMEM.
 
     x: (..., C1) channels-last; any leading shape (flattened to rows).
@@ -71,6 +87,9 @@ def conv1x1_pair(x, w1, w2, scale1=None, bias1=None, scale2=None,
     per-channel affines applied before each relu (folded BN).
     residual: optional (..., Cm) skip input added after the first
     affine, before its relu — the bottleneck block-boundary motif.
+    return_mid (requires residual): also return the post-residual mid
+    ``relu(a1(x@w1)+res)`` — (out, mid); a fused ResNet stage feeds
+    mid forward as the next boundary's residual.
     Rows are zero-padded up to a block_rows multiple and sliced back.
     """
     import jax
@@ -131,17 +150,34 @@ def conv1x1_pair(x, w1, w2, scale1=None, bias1=None, scale2=None,
     in_specs += [full((c1, cm)), full((cm, cout)), full((1, cm)),
                  full((1, cm)), full((1, cout)), full((1, cout))]
     operands += [w1, w2, s1, b1, s2, b2]
+    if return_mid:
+        if r2 is None:
+            raise ValueError("return_mid requires residual")
+        kern = _kernel_res2
+        out_specs = [row_spec(cm), row_spec(cout)]
+        out_shape = [jax.ShapeDtypeStruct((mp, cm), x.dtype),
+                     jax.ShapeDtypeStruct((mp, cout), x.dtype)]
+        alias = {}  # mid output shares no buffer with x
+    else:
+        kern = _kernel if r2 is None else _kernel_res
+        out_specs = row_spec(cout)
+        out_shape = jax.ShapeDtypeStruct((mp, cout), x.dtype)
     out = pl.pallas_call(
-        _kernel if r2 is None else _kernel_res,
+        kern,
         grid=(mp // block_rows,),
         input_output_aliases=alias,
         in_specs=in_specs,
-        out_specs=row_spec(cout),
-        out_shape=jax.ShapeDtypeStruct((mp, cout), x.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*operands)
+    if return_mid:
+        mid, y = out
+        if pad:
+            mid, y = mid[:m], y[:m]
+        return (y.reshape(*lead, cout), mid.reshape(*lead, cm))
     if pad:
         out = out[:m]
     return out.reshape(*lead, cout)
