@@ -193,6 +193,37 @@ def _measure_rtt_ms():
     return _RTT_MS
 
 
+def _chain_diff(run, n_fuse, repeats=3):
+    """Two-loop differential timing of a scan-chained dispatch: ``run(m)``
+    must execute m chained device iterations and block on a host fetch.
+    Times n_fuse- vs 4*n_fuse-iteration dispatches and divides the
+    difference — fetch RTT and dispatch tails cancel. Returns
+    per-iteration seconds (median of ``repeats``); samples land in
+    ``_LAST_SAMPLES`` for the row's n/spread. ONE definition: three bench
+    rows share this protocol, and a prior review round caught a bug born
+    of it being copy-pasted."""
+    import time
+
+    run(n_fuse)          # compile + drain both static signatures
+    run(4 * n_fuse)
+    diffs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run(n_fuse)
+        d1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(4 * n_fuse)
+        d2 = time.perf_counter() - t0
+        if d2 > d1:
+            diffs.append((d2 - d1) / (3 * n_fuse))
+    if not diffs:
+        raise RuntimeError("degenerate chained timing")
+    diffs.sort()
+    global _LAST_SAMPLES
+    _LAST_SAMPLES = list(diffs)
+    return diffs[len(diffs) // 2]
+
+
 def _infer_rate_fused(net, x_host, n_fuse=16):
     """Per-inference seconds with n_fuse forwards fused into ONE dispatch
     (lax.scan on device). Single-dispatch inference at bs32 is tunnel-RTT
@@ -201,7 +232,6 @@ def _infer_rate_fused(net, x_host, n_fuse=16):
     negligible function of the previous logits so XLA cannot elide or
     reorder the iterations."""
     import functools
-    import time
 
     import jax
     import jax.numpy as jnp
@@ -223,26 +253,7 @@ def _infer_rate_fused(net, x_host, n_fuse=16):
         return c
 
     x = jnp.asarray(x_host)
-    onp.asarray(run(params, x, n_fuse))
-    onp.asarray(run(params, x, 4 * n_fuse))
-
-    def t(m):
-        t0 = time.perf_counter()
-        r = run(params, x, m)
-        onp.asarray(r)
-        return time.perf_counter() - t0
-
-    diffs = []
-    for _ in range(3):
-        d1, d2 = t(n_fuse), t(4 * n_fuse)
-        if d2 > d1:
-            diffs.append((d2 - d1) / (3 * n_fuse))
-    if not diffs:
-        raise RuntimeError("degenerate fused-inference timing")
-    diffs.sort()
-    global _LAST_SAMPLES
-    _LAST_SAMPLES = list(diffs)
-    return diffs[len(diffs) // 2]
+    return _chain_diff(lambda m: onp.asarray(run(params, x, m)), n_fuse)
 
 
 def bench_resnet_infer():
@@ -404,13 +415,11 @@ def bench_resnet_infer_pallas_fused(n_fuse=16):
     is a fusion barrier (PERF.md round-5). Scan-chained dispatch (same
     n_fuse protocol as the int8 row)."""
     import functools
-    import time as _time
 
     import jax
     import jax.numpy as jnp
     import numpy as onp
 
-    from mxnet_tpu import gluon
     from mxnet_tpu.contrib.pallas_fuse import fuse_resnet_v1
 
     BATCH, SIZE = 32, 224
@@ -429,24 +438,7 @@ def bench_resnet_infer_pallas_fused(n_fuse=16):
                                 length=m)
             return c
 
-        onp.asarray(run(x, n_fuse))
-        onp.asarray(run(x, 4 * n_fuse))
-        diffs = []
-        for _ in range(3):
-            t0 = _time.perf_counter()
-            onp.asarray(run(x, n_fuse))
-            d1 = _time.perf_counter() - t0
-            t0 = _time.perf_counter()
-            onp.asarray(run(x, 4 * n_fuse))
-            d2 = _time.perf_counter() - t0
-            if d2 > d1:
-                diffs.append((d2 - d1) / (3 * n_fuse))
-        if not diffs:
-            raise RuntimeError("degenerate fused-pair timing")
-        diffs.sort()
-        global _LAST_SAMPLES
-        _LAST_SAMPLES = list(diffs)
-        return diffs[len(diffs) // 2]
+        return _chain_diff(lambda m: onp.asarray(run(x, m)), n_fuse)
 
     dt_pal = rate(fuse_resnet_v1(net, use_pallas=True))
     pal_spread = _spread(invert_for=BATCH)

@@ -145,10 +145,27 @@ class LlamaModel(HybridBlock):
 
     def __init__(self, vocab_size=32000, units=4096, hidden_size=11008,
                  num_layers=32, num_heads=32, num_kv_heads=None,
-                 norm_eps=1e-5, tie_embeddings=False, remat=False, **kwargs):
+                 norm_eps=1e-5, tie_embeddings=False, remat=False,
+                 layer_barrier=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._tie = tie_embeddings
+        # layer_barrier: thread each layer's params through an
+        # optimization_barrier with the incoming activation, so a
+        # layer's weight all-gathers (fsdp/ZeRO sharding) and AMP casts
+        # cannot be scheduled before the previous layer finishes.
+        # Without it the heap simulator hoists EVERY layer's gather to
+        # the front of the step (measured: full 32 GiB unsharded param
+        # set live at once on the fsdp8 8B lowering, exp/llama8b_aot).
+        # Trade-off: also forbids one-layer-ahead gather prefetch, so
+        # leave it off for tp-sharded runs where nothing is gathered.
+        if layer_barrier and not remat:
+            # the barrier is threaded inside the per-layer checkpoint;
+            # without remat it would silently never exist
+            raise MXNetError(
+                "layer_barrier=True requires remat=True (the barrier "
+                "lives inside the per-layer jax.checkpoint trace)")
+        self._layer_barrier = layer_barrier
         # remat: re-compute each decoder layer in backward instead of
         # saving its activations (jax.checkpoint) — HBM-for-FLOPs trade
         # that makes 8B training fit a v5e's 16 GB (exp/llama8b_aot.py)
@@ -191,20 +208,26 @@ class LlamaModel(HybridBlock):
             if amp is not None:
                 x = x.astype(amp)
 
+            barrier = self._layer_barrier
             for blk in self._blocks:
                 # params enter as closed-over tracers (functionalize's
                 # _ParamBinding); jax.checkpoint differentiates through
                 # the closure, so grads still flow to every weight
                 def layer_fn(xd, _blk=blk):
-                    if amp is None:
+                    if amp is None and not barrier:
                         return _blk(NDArray(xd))._data
                     ps = list(_blk.collect_params().values())
                     arrays = [p.data() for p in ps]
-                    casts = [
-                        a._data.astype(amp)
-                        if jnp.issubdtype(a._data.dtype, jnp.floating)
-                        else a._data for a in arrays]
-                    with _ParamBinding(arrays, casts):
+                    datas = [a._data for a in arrays]
+                    if barrier:
+                        xd, *datas = jax.lax.optimization_barrier(
+                            (xd, *datas))
+                    if amp is not None:
+                        datas = [
+                            d.astype(amp)
+                            if jnp.issubdtype(d.dtype, jnp.floating)
+                            else d for d in datas]
+                    with _ParamBinding(arrays, datas):
                         return _blk(NDArray(xd))._data
 
                 x = NDArray(jax.checkpoint(layer_fn)(x._data))

@@ -422,14 +422,12 @@ class ShardedTrainer:
                 for s in flat)
         return states
 
-    def aot_lower(self, batch_struct, labels_struct):
-        """AOT-compile ONE SPMD training step from ShapeDtypeStructs —
-        the compile/memory-plan-only proof path for configs too big to
-        materialize on the host (``abstract=True`` trainers; Llama-3-8B
-        on a virtual v5e-8 mesh). Returns the jax ``Compiled`` object:
-        ``.memory_analysis()`` has the per-device argument/temp bytes the
-        fit assertion reads, ``.as_text()`` the HLO.
-        """
+    def aot_lowered(self, batch_struct, labels_struct):
+        """Lowered-but-NOT-compiled step (StableHLO) from
+        ShapeDtypeStructs — pre-optimization inspection (tests check
+        e.g. that ``layer_barrier`` threaded its optimization_barriers
+        into the trace; backends may fold them after scheduling, so the
+        compiled text cannot pin them)."""
         import jax
         import jax.numpy as jnp
 
@@ -443,7 +441,17 @@ class ShardedTrainer:
         state = {n: self.params[n] for n in self._state_names}
         args = (train, state, self._opt_states, batch_struct, labels_struct,
                 key_struct, lrs, wds, 1)
-        compiled = self._step_jit.lower(*args).compile()
+        return self._step_jit.lower(*args)
+
+    def aot_lower(self, batch_struct, labels_struct):
+        """AOT-compile ONE SPMD training step from ShapeDtypeStructs —
+        the compile/memory-plan-only proof path for configs too big to
+        materialize on the host (``abstract=True`` trainers; Llama-3-8B
+        on a virtual v5e-8 mesh). Returns the jax ``Compiled`` object:
+        ``.memory_analysis()`` has the per-device argument/temp bytes the
+        fit assertion reads, ``.as_text()`` the HLO.
+        """
+        compiled = self.aot_lowered(batch_struct, labels_struct).compile()
         self._last_compiled = compiled
         self._step_flops = _cost_analysis_of(compiled).get("flops")
         return compiled

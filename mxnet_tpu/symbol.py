@@ -149,7 +149,17 @@ class Symbol:
         return Executor(self, ctx, args, None, grad_req)
 
     # -- serialization ----------------------------------------------------
-    def tojson(self):
+    def tojson(self, fmt="tpu"):
+        """Serialize the graph. ``fmt='tpu'`` (default) writes this
+        build's v2 container; ``fmt='nnvm'`` writes the REFERENCE's
+        nnvm graph JSON (``nodes``/``arg_nodes``/``heads``, string
+        attrs — the layout real MXNet's Symbol.tojson emitted,
+        ``src/nnvm/`` graph JSON), so artifacts written here load in a
+        reference install AND replay through :func:`fromjson`."""
+        if fmt == "nnvm":
+            return self._tojson_nnvm()
+        if fmt != "tpu":
+            raise MXNetError(f"unknown symbol json format {fmt!r}")
         nodes = []
         memo = {}  # id(sym) -> node index; shared subexpressions emit once
 
@@ -173,9 +183,57 @@ class Symbol:
         walk(self)
         return json.dumps({"nodes": nodes, "mxnet_tpu_symbol": 2}, indent=2)
 
-    def save(self, fname):
+    def _tojson_nnvm(self):
+        nodes = []
+        arg_nodes = []
+        memo = {}
+
+        if self._op == "_group":
+            # the reference format expects one heads entry per output;
+            # a "_group" op node would not load in a real install —
+            # mirror fromjson's single-head contract and refuse loudly
+            raise MXNetError(
+                "nnvm JSON export of a multi-output Group is not "
+                "supported; save each output symbol separately")
+
+        def walk(s):
+            if id(s) in memo:
+                return memo[id(s)]
+            if s._op is None:
+                idx = len(nodes)
+                nodes.append({"op": "null", "name": s.name, "inputs": []})
+                arg_nodes.append(idx)
+                memo[id(s)] = idx
+                return idx
+            inputs = []
+            for a in s._args:
+                if not isinstance(a, Symbol):
+                    raise MXNetError(
+                        f"node {s.name!r} holds a literal positional "
+                        f"argument ({a!r}); the nnvm JSON format has no "
+                        "encoding for it — rebuild the graph passing "
+                        "scalars as keyword attrs")
+                inputs.append([walk(a), 0, 0])
+            entry = {"op": s._op, "name": s.name, "inputs": inputs}
+            if s._kwargs:
+                # nnvm attrs are strings; fromjson (and the reference's
+                # parameter parsers) literal-eval them back
+                entry["attrs"] = {k: str(v) for k, v in s._kwargs.items()}
+            idx = len(nodes)
+            nodes.append(entry)
+            memo[id(s)] = idx
+            return idx
+
+        root = walk(self)
+        return json.dumps(
+            {"nodes": nodes, "arg_nodes": arg_nodes,
+             "node_row_ptr": list(range(len(nodes) + 1)),
+             "heads": [[root, 0, 0]],
+             "attrs": {"mxnet_version": ["int", 10700]}}, indent=2)
+
+    def save(self, fname, fmt="tpu"):
         with open(fname, "w") as f:
-            f.write(self.tojson())
+            f.write(self.tojson(fmt))
 
     # -- composition ------------------------------------------------------
     def _binop(self, other, op):

@@ -167,3 +167,73 @@ def test_llama_static_shapes_at_construction():
     m = get_llama("llama_tiny_test")
     for n, p in m.collect_params().items():
         assert p.shape is not None and all(s > 0 for s in p.shape), (n, p.shape)
+
+
+def test_zero_dp8_sharding_lowers_with_gathers():
+    """ZeRO-3-style lowering (r5): params + Adam moments sharded over
+    the SAME 8-way axis the batch is data-parallel over. The compiled
+    step must gather params (all-gather) and reduce gradients
+    (reduce-scatter or all-reduce) — pins that the fsdp default rules
+    actually shard instead of replicating. Fit is NOT asserted here:
+    the CPU heap sim schedules every layer's gather up front (measured
+    34 GiB artifact); the real TPU compiler's plan is 13.8 GiB
+    (exp/llama8b_aot.json, memory_backend=tpu-aot)."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(onp.array(devs[:8]).reshape(8), ("fsdp",))
+    model = get_llama("llama_tiny_test", remat=True)
+    tr = ShardedTrainer(model, _loss_fn, "adam", {"learning_rate": 1e-4},
+                        mesh=mesh,
+                        rules=ShardingRules((), default_axis="fsdp"),
+                        batch_spec=P("fsdp"), abstract=True)
+    compiled = tr.aot_lower(
+        jax.ShapeDtypeStruct((8, 64), jnp.int32),
+        jax.ShapeDtypeStruct((8, 64), jnp.int32))
+    txt = compiled.as_text()
+    assert txt.count("all-gather") > 0, "ZeRO lowering gathered nothing"
+    assert txt.count("reduce-scatter") + txt.count("all-reduce") > 0
+
+
+def test_layer_barrier_is_threaded_into_the_trace():
+    """layer_barrier=True must put one optimization_barrier per decoder
+    layer into the lowered module (visible in StableHLO; backends may
+    fold it after scheduling)."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(onp.array(devs[:8]).reshape(8), ("fsdp",))
+    model = get_llama("llama_tiny_test", remat=True, layer_barrier=True)
+    tr = ShardedTrainer(model, _loss_fn, "sgd", {"learning_rate": 0.1},
+                        mesh=mesh,
+                        rules=ShardingRules((), default_axis="fsdp"),
+                        batch_spec=P("fsdp"), abstract=True)
+    lowered = tr.aot_lowered(
+        jax.ShapeDtypeStruct((8, 32), jnp.int32),
+        jax.ShapeDtypeStruct((8, 32), jnp.int32))
+    n = lowered.as_text().count("optimization_barrier")
+    assert n >= 2, n  # one per decoder layer (tiny config: 2 layers)
+
+
+def test_bf16_master_cast_halves_argument_bytes():
+    """Block.cast('bfloat16') -> 6 B/param (bf16 masters + 2 Adam
+    moments) vs fp32's 12 B/param, visible in the abstract lowering's
+    argument size."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(onp.array(devs[:8]).reshape(1, 8), ("dp", "tp"))
+    sizes = {}
+    for cast in (False, True):
+        model = get_llama("llama_tiny_test", remat=True)
+        if cast:
+            model.cast("bfloat16")
+        tr = ShardedTrainer(model, _loss_fn, "adam",
+                            {"learning_rate": 1e-4}, mesh=mesh,
+                            rules=ShardingRules(llama_sharding_rules()),
+                            batch_spec=P("dp"), abstract=True)
+        c = tr.aot_lower(jax.ShapeDtypeStruct((1, 64), jnp.int32),
+                         jax.ShapeDtypeStruct((1, 64), jnp.int32))
+        sizes[cast] = c.memory_analysis().argument_size_in_bytes
+    ratio = sizes[True] / sizes[False]
+    assert 0.45 < ratio < 0.58, ratio

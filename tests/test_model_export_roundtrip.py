@@ -1,0 +1,142 @@
+"""Model-scale reference-artifact round-trip (VERDICT r4 Next #8).
+
+Builds a conv-net as a legacy Symbol graph, writes BOTH halves of a
+reference checkpoint pair with this repo's OWN writers —
+``model-symbol.json`` in the reference's nnvm graph JSON
+(``Symbol.save(fmt='nnvm')``) and ``model-0000.params`` in the
+reference's magic-tagged V2 binary with ``arg:``/``aux:`` keys
+(``ndarray.utils.save(fmt='reference')``) — then loads the pair back
+through ``SymbolBlock.imports`` (the reference-format reader path that
+also loads ``tests/golden/``'s genuine artifacts) and checks inference
+parity at model scale, not tensor scale.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu import np as mnp
+from mxnet_tpu import sym
+from mxnet_tpu.base import MXNetError
+
+
+def _convnet():
+    """LeNet-scale conv-net WITH BatchNorm (exercises aux: states)."""
+    data = sym.var("data")
+    w = {}
+
+    def v(name):
+        w[name] = None
+        return sym.var(name)
+
+    x = sym.Convolution(data, v("conv0_weight"), v("conv0_bias"),
+                        kernel=(5, 5), num_filter=32, name="conv0")
+    x = sym.BatchNorm(x, v("bn0_gamma"), v("bn0_beta"),
+                      v("bn0_moving_mean"), v("bn0_moving_var"),
+                      fix_gamma=False, name="bn0")
+    x = sym.Activation(x, act_type="relu", name="relu0")
+    x = sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool0")
+    x = sym.Convolution(x, v("conv1_weight"), v("conv1_bias"),
+                        kernel=(3, 3), num_filter=64, name="conv1")
+    x = sym.Activation(x, act_type="relu", name="relu1")
+    x = sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool1")
+    x = sym.Flatten(x, name="flat")
+    x = sym.FullyConnected(x, v("fc0_weight"), v("fc0_bias"),
+                           num_hidden=128, name="fc0")
+    x = sym.Activation(x, act_type="relu", name="relu2")
+    x = sym.FullyConnected(x, v("fc1_weight"), v("fc1_bias"),
+                           num_hidden=10, name="fc1")
+    return x, list(w)
+
+
+def _init_params(rng):
+    shapes = {
+        "conv0_weight": (32, 1, 5, 5), "conv0_bias": (32,),
+        "bn0_gamma": (32,), "bn0_beta": (32,),
+        "bn0_moving_mean": (32,), "bn0_moving_var": (32,),
+        "conv1_weight": (64, 32, 3, 3), "conv1_bias": (64,),
+        "fc0_weight": (128, 64 * 5 * 5), "fc0_bias": (128,),
+        "fc1_weight": (10, 128), "fc1_bias": (10,),
+    }
+    out = {}
+    for n, s in shapes.items():
+        if n.endswith("moving_var"):
+            a = onp.abs(rng.randn(*s)).astype("float32") + 0.5
+        elif n.endswith(("gamma",)):
+            a = onp.abs(rng.randn(*s)).astype("float32") * 0.3 + 0.8
+        else:
+            a = (rng.randn(*s) * 0.1).astype("float32")
+        out[n] = mnp.array(a)
+    return out
+
+
+def test_nnvm_export_reference_params_roundtrip(tmp_path):
+    net_sym, names = _convnet()
+    rng = onp.random.RandomState(0)
+    params = _init_params(rng)
+    x = mnp.array(rng.uniform(-1, 1, (4, 1, 28, 28)).astype("float32"))
+
+    # in-memory oracle: executor forward on the original graph
+    exe = net_sym.bind(args={"data": x, **params})
+    ref = exe.forward(is_train=False)[0].asnumpy()
+    assert ref.shape == (4, 10)
+
+    # write the checkpoint pair with the repo's own writers, in the
+    # REFERENCE formats (nnvm graph JSON; V2 params, arg:/aux: keys)
+    sym_file = os.path.join(tmp_path, "model-symbol.json")
+    par_file = os.path.join(tmp_path, "model-0000.params")
+    net_sym.save(sym_file, fmt="nnvm")
+    keyed = {}
+    for n, a in params.items():
+        prefix = "aux:" if "moving_" in n else "arg:"
+        keyed[prefix + n] = a
+    from mxnet_tpu.ndarray.utils import save
+
+    save(par_file, keyed, fmt="reference")
+
+    # sanity: both artifacts really are reference-format bytes
+    import json as _json
+
+    with open(sym_file) as f:
+        doc = _json.load(f)
+    assert "arg_nodes" in doc and "heads" in doc
+    assert "mxnet_tpu_symbol" not in doc
+    with open(par_file, "rb") as f:
+        magic = f.read(8)
+    assert magic[:4] == b"\x12\x01\x00\x00"  # NDArray list magic 0x112
+
+    # reload THROUGH the reference-artifact reader path and run
+    net = gluon.SymbolBlock.imports(sym_file, ["data"], par_file)
+    got = net(x).asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_nnvm_writer_rejects_literal_positional_args(tmp_path):
+    s = sym.var("a") * 2.0  # scalar binop holds a literal positional arg
+    with pytest.raises(MXNetError):
+        s.tojson(fmt="nnvm")
+    with pytest.raises(MXNetError):
+        sym.var("a").tojson(fmt="bogus")
+    # a multi-output Group has no single-head nnvm encoding: refuse
+    # loudly rather than write a '_group' node no reference install
+    # could load (review finding r5)
+    g = sym.Group([sym.var("a"), sym.var("b")])
+    with pytest.raises(MXNetError):
+        g.tojson(fmt="nnvm")
+
+
+def test_nnvm_json_loads_in_fresh_symbol_module(tmp_path):
+    """The written JSON replays through symbol.load's nnvm branch (the
+    same code path the golden reference artifact uses)."""
+    net_sym, _ = _convnet()
+    f = os.path.join(tmp_path, "m-symbol.json")
+    net_sym.save(f, fmt="nnvm")
+    from mxnet_tpu import symbol as sym_mod
+
+    loaded = sym_mod.load(f)
+    assert sorted(loaded.list_arguments()) == \
+        sorted(net_sym.list_arguments())
