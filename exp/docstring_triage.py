@@ -1,0 +1,49 @@
+"""Triage runner: execute every reference docstring block in a file and
+summarize pass/fail, so the conformance tests' skip-lists are built from
+evidence. Usage: python exp/docstring_triage.py numpy/multiarray.py [-v]
+"""
+import os
+import sys
+import traceback
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from docstring_harness import collect_blocks, default_globs, run_block, \
+    ExampleFailure  # noqa: E402
+
+
+def main(relpath, verbose=False, legacy=False):
+    if legacy:
+        import mxnet_tpu as mx
+        mx.util.set_np(array=False)
+    blocks = collect_blocks(relpath)
+    ok, fails = [], []
+    for qn, exs in blocks:
+        globs = default_globs()
+        try:
+            run_block(exs, globs)
+            ok.append(qn)
+        except ExampleFailure as e:
+            fails.append((qn, str(e)))
+        except Exception:
+            fails.append((qn, "HARNESS ERROR\n" + traceback.format_exc()))
+    print(f"{relpath}: {len(ok)} blocks pass, {len(fails)} fail "
+          f"(of {len(blocks)})")
+    for qn, msg in fails:
+        first = msg if verbose else msg.split("\n")[0]
+        print(f"  FAIL {qn}: {first}")
+        if verbose:
+            print()
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    sys.exit(main(args[0], verbose="-v" in sys.argv,
+                  legacy="--legacy" in sys.argv))

@@ -40,6 +40,11 @@ class Context:
     _default = threading.local()
 
     def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Context):
+            # copy-ctor form of the reference (``context.py:70-77``):
+            # ``Context(mx.gpu(2))`` clones type and id
+            device_type, device_id = device_type.device_type, \
+                device_type.device_id
         if device_type not in self.devtype2id:
             raise MXNetError(f"unknown device type {device_type!r}")
         self.device_type = device_type
@@ -95,6 +100,18 @@ class Context:
     def real_device_type(self) -> str:
         """'tpu' | 'gpu' | 'cpu' of the underlying jax device platform."""
         return self.jax_device().platform
+
+    def empty_cache(self):
+        """Release unreferenced device memory (reference ``context.py:120-136``).
+
+        The reference drains its per-device storage pool via
+        ``MXStorageEmptyCache``.  Here XLA's allocator owns the pool and
+        returns a buffer the moment its last ``jax.Array`` reference dies,
+        so the equivalent user-visible action is collecting dropped Python
+        references (cycles included) that still pin device buffers.
+        """
+        import gc
+        gc.collect()
 
     # -- default-context management --------------------------------------
     def __enter__(self):

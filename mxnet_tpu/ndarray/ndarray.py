@@ -80,7 +80,8 @@ _SIG_CACHE = {}
 class NDArray:
     """Mutable array handle; also serves as ``mx.np.ndarray``."""
 
-    __slots__ = ("_data", "_tape", "_leaf", "_version", "_stype", "__weakref__")
+    __slots__ = ("_buf", "_tape", "_leaf", "_version", "_stype",
+                 "_view_parent", "_view_key", "_view_pver", "__weakref__")
 
     # make NumPy defer binary-op dispatch to us (ndarray.py reference sets
     # __array_priority__ on mx.nd.NDArray similarly)
@@ -89,6 +90,10 @@ class NDArray:
     def __init__(self, data, ctx: Context = None, dtype=None, stype="default"):
         import jax
 
+        # view linkage must exist before the first _data property access
+        self._view_parent = None
+        self._view_key = None
+        self._view_pver = 0
         if isinstance(data, jax.Array):
             if dtype is not None and data.dtype != _np.dtype(dtype):
                 data = data.astype(dtype)
@@ -109,6 +114,31 @@ class NDArray:
         """Let jax/jnp functions consume NDArray directly (no autograd)."""
         return self._data
 
+    # -- buffer / view core -----------------------------------------------
+    # Reference basic contiguous slicing and reshape return VIEWS that
+    # share memory with the parent (``ndarray.py`` ``__getitem__``
+    # "contiguous" examples, ``MXNDArrayReshape64``): writes through a
+    # view appear in the parent and vice versa.  jax buffers are
+    # immutable, so views are modeled as (parent, key) linkage with lazy
+    # resync: reads refresh from the parent when its version moved, and
+    # rebinds push the updated region back up the parent chain.
+    @property
+    def _data(self):
+        p = getattr(self, "_view_parent", None)
+        if p is not None:
+            src = p._data  # refresh the whole parent chain first
+            if self._view_pver != p._version:
+                key = self._view_key
+                self._buf = src.reshape(self._buf.shape) if key is None \
+                    else src[key]
+                self._view_pver = p._version
+                self._version += 1  # children of this view refresh too
+        return self._buf
+
+    @_data.setter
+    def _data(self, v):
+        self._buf = v
+
     # -- mutation core ----------------------------------------------------
     def _set_data_internal(self, new_data, keep_tape=False):
         """Rebind the buffer (engine Var version bump analog)."""
@@ -116,6 +146,15 @@ class NDArray:
         self._version += 1
         if not keep_tape:
             self._tape = None
+        p = getattr(self, "_view_parent", None)
+        if p is not None:
+            key = self._view_key
+            if key is None:  # reshape view: write the whole array back
+                newp = new_data.reshape(p.shape).astype(p.dtype)
+            else:
+                newp = p._data.at[key].set(new_data.astype(p.dtype))
+            p._set_data_internal(newp, keep_tape=keep_tape)
+            self._view_pver = p._version  # buffer already current
 
     # -- basic properties -------------------------------------------------
     @property
@@ -193,7 +232,23 @@ class NDArray:
     def asscalar(self):
         if self.size != 1:
             raise MXNetError("the array is not a scalar")
-        return self.item()
+        # reference returns self.asnumpy()[0]: a NUMPY scalar whose type
+        # carries the array dtype (``type(x.asscalar()) -> numpy.float32``)
+        return self.asnumpy().reshape(())[()]
+
+    def slice_assign_scalar(self, value, begin, end, step):
+        """Assign ``value`` into the cropped region; mutates and returns
+        self (reference ``ndarray.py slice_assign_scalar``)."""
+        key = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+        self[key] = value
+        return self
+
+    def slice_assign(self, rhs, begin, end, step):
+        """Assign ``rhs`` into the cropped region; mutates and returns
+        self (reference ``ndarray.py slice_assign``)."""
+        key = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+        self[key] = rhs
+        return self
 
     def tolist(self):
         return self.asnumpy().tolist()
@@ -268,17 +323,61 @@ class NDArray:
     # -- indexing ---------------------------------------------------------
     @staticmethod
     def _prep_index(key):
-        """Unwrap NDArray indices to jax arrays; pass through the rest."""
+        """Unwrap NDArray indices to jax arrays; pass through the rest.
+        Python lists become integer/bool index arrays (the reference's
+        advanced-indexing contract; jax itself rejects raw sequences)."""
         def conv(k):
-            return k._data if isinstance(k, NDArray) else k
+            if isinstance(k, NDArray):
+                return k._data
+            if isinstance(k, list):
+                return _np.asarray(k)
+            return k
 
         if isinstance(key, tuple):
             return tuple(conv(k) for k in key)
         return conv(key)
 
+    @staticmethod
+    def _is_contiguous_basic(key, shape):
+        """True when ``key`` selects a row-major-contiguous region the
+        reference would hand out as a shared-memory view
+        (``ndarray.py _basic_indexing`` contiguity check): leading
+        integer indexes, then at most one partial step-1 slice, then
+        only full slices.  Conservative — advanced keys never view."""
+        if not isinstance(key, tuple):
+            key = (key,)
+        if any(k is None or k is Ellipsis for k in key):
+            return False
+        state = "ints"  # -> "tail" after the first (partial) slice
+        for k, dim in zip(key, shape):
+            if isinstance(k, (bool, _np.bool_)):
+                return False  # bool scalar keys are ADVANCED indexing
+            if isinstance(k, (int, _np.integer)):
+                if state != "ints":
+                    return False
+            elif isinstance(k, slice):
+                if k.step not in (None, 1):
+                    return False
+                if state == "tail":
+                    start = k.start or 0
+                    full = start == 0 and (k.stop is None or k.stop >= dim)
+                    if not full:
+                        return False
+                else:
+                    state = "tail"
+            else:
+                return False  # array/bool index: advanced indexing
+        return True
+
     def __getitem__(self, key):
         jkey = self._prep_index(key)
-        return _apply(lambda x: x[jkey], (self,), name="getitem")
+        res = _apply(lambda x: x[jkey], (self,), name="getitem")
+        if type(self) is NDArray and not autograd.is_recording() \
+                and self._is_contiguous_basic(jkey, self.shape):
+            res._view_parent = self
+            res._view_key = jkey
+            res._view_pver = self._version
+        return res
 
     def __setitem__(self, key, value):
         jkey = self._prep_index(key)
@@ -497,11 +596,17 @@ class NDArray:
     # integer/bool outputs get zero cotangents anyway — skip recording)
     def _cmp(self, other, fn, name):
         from ..ops.registry import apply
+        from ..util import is_np_array
 
         if not (isinstance(other, NDArray) or _np.isscalar(other)
                 or isinstance(other, (_np.ndarray, list, tuple))):
             return NotImplemented
-        return apply(fn, (self, other), name=name, record=False)
+        res = apply(fn, (self, other), name=name, record=False)
+        if not is_np_array() and str(res.dtype) == "bool":
+            # legacy NDArray comparisons return input-dtype 0/1 values,
+            # not bool (reference ndarray.py ``equal`` docstring)
+            res = res.astype(self.dtype)
+        return res
 
     def __eq__(self, o):
         return self._cmp(o, _jnp().equal, "equal")
@@ -522,10 +627,43 @@ class NDArray:
         return self._cmp(o, _jnp().greater_equal, "greater_equal")
 
     # -- shape ops --------------------------------------------------------
-    def reshape(self, *shape, **kwargs):  # pylint: disable=unused-argument
+    def _link_reshape_view(self, res):
+        """Reference reshape/flatten/expand_dims share memory with the
+        source (``MXNDArrayReshape64``); link as a whole-array view."""
+        if type(self) is NDArray and not autograd.is_recording():
+            res._view_parent = self
+            res._view_key = None
+            res._view_pver = self._version
+        return res
+
+    def reshape(self, *shape, **kwargs):
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
-        return _apply(lambda x: x.reshape(shape), (self,), name="reshape")
+        elif not shape:
+            shape = kwargs.get("shape")
+            if not shape:
+                raise ValueError("Shape must be provided.")
+        bad = [k for k in kwargs if k not in ("shape", "reverse", "order")]
+        if bad:
+            raise TypeError(f"Got unknown keywords in reshape: {bad}. "
+                            "Accepted keyword arguments are 'shape', "
+                            "'reverse' and 'order'.")
+        if kwargs.get("order", "C") != "C":
+            raise NotImplementedError(
+                "reshape(order='F') is not supported on this build; "
+                "transpose first for Fortran-order traversal")
+        from ..util import is_np_array
+        if any(int(s) < -1 for s in shape) or kwargs.get("reverse", False) \
+                or (not is_np_array() and any(int(s) == 0 for s in shape)):
+            # the reference's special values 0/-2/-3/-4 (+ reverse) are
+            # legacy-only; in numpy mode 0 is a genuine zero-size dim
+            # (values < -1 are invalid in numpy, so always legacy)
+            from ..ops.legacy import infer_reshape_shape
+            shape = infer_reshape_shape(shape, self.shape,
+                                        kwargs.get("reverse", False))
+        res = _apply(lambda x: x.reshape(tuple(shape)), (self,),
+                     name="reshape")
+        return self._link_reshape_view(res)
 
     def reshape_like(self, other):
         return self.reshape(other.shape)
@@ -539,14 +677,37 @@ class NDArray:
     def swapaxes(self, a, b):
         return _apply(lambda x: _jnp().swapaxes(x, a, b), (self,), name="swapaxes")
 
-    def flatten(self):
-        return self.reshape((-1,))
+    def flatten(self, order="C", inplace=False):
+        """numpy semantics (1-D copy) under ``is_np_array()``; the legacy
+        2-D collapse ``(d1, d2*...*dk)`` under ``set_np(array=False)`` or
+        whenever the legacy-only ``inplace`` flag is passed (reference
+        ``ndarray.py flatten``: ``op.flatten`` / ``reshape((0, -1))``)."""
+        from ..util import is_np_array
+        if inplace or not is_np_array():
+            # reference Flatten: (d0, prod(rest)) — 1-D gives (d, 1)
+            res = self.reshape((self.shape[0], -1)) if self.ndim >= 1 \
+                else self.reshape((1, 1))
+            if not inplace:  # reference op.flatten copies; only the
+                res._view_parent = None  # inplace form is a view
+            return res
+        src = self
+        if order == "F":
+            src = self.transpose(*reversed(range(self.ndim)))
+        elif order != "C":
+            raise ValueError(f"order must be 'C' or 'F', got {order!r}")
+        res = src.reshape((-1,))
+        res._view_parent = None  # numpy .flatten() contract is a copy
+        return res
 
     def squeeze(self, axis=None):
         return _apply(lambda x: _jnp().squeeze(x, axis), (self,), name="squeeze")
 
-    def expand_dims(self, axis):
-        return _apply(lambda x: _jnp().expand_dims(x, axis), (self,), name="expand_dims")
+    def expand_dims(self, axis, inplace=False):
+        res = _apply(lambda x: _jnp().expand_dims(x, axis), (self,),
+                     name="expand_dims")
+        if inplace:
+            res = self._link_reshape_view(res)
+        return res
 
     def broadcast_to(self, shape):
         return _apply(lambda x: _jnp().broadcast_to(x, shape), (self,), name="broadcast_to")
@@ -677,4 +838,35 @@ class NDArray:
 
 
 # ``mx.np.ndarray`` is this class
+def indexing_key_expand_implicit_axes(key, shape):
+    """Make implicit axes explicit (``slice(None)`` fill), expand
+    ``Ellipsis``, and convert boolean index arrays to integer arrays via
+    ``nonzero`` (reference ``ndarray/ndarray.py
+    indexing_key_expand_implicit_axes``)."""
+    if not isinstance(key, tuple):
+        key = (key,)
+    ell_idx = None
+    nonell = []
+    for idx in key:
+        if idx is Ellipsis:
+            if ell_idx is not None:
+                raise IndexError(
+                    "Cannot use more than one ellipsis (`...`) for indexing")
+            ell_idx = len(nonell)
+            continue
+        if isinstance(idx, NDArray):
+            idx = idx.asnumpy()
+        if isinstance(idx, _np.ndarray) and idx.dtype == _np.bool_:
+            nonell.extend(_np.nonzero(idx))
+        else:
+            nonell.append(idx)
+    consumed = sum(1 for k in nonell if k is not None)
+    pad = [slice(None)] * (len(shape) - consumed)
+    if ell_idx is None:
+        expanded = nonell + pad
+    else:
+        expanded = nonell[:ell_idx] + pad + nonell[ell_idx:]
+    return tuple(expanded)
+
+
 ndarray = NDArray

@@ -58,6 +58,10 @@ e = _onp.e
 inf = _onp.inf
 nan = _onp.nan
 newaxis = None
+# host-side index-expression builders (numpy public API; keys feed
+# NDArray.__getitem__ unchanged)
+s_ = _onp.s_
+index_exp = _onp.index_exp
 euler_gamma = _onp.euler_gamma
 
 _dtype = _onp.dtype

@@ -78,6 +78,7 @@ ALIASES = {
     # numpy-spelling drift
     "stop_gradient": "stop_gradient",
     "identity": "copy",
+    "modulo": "mod",
     "lesser": "less",
     "lesser_equal": "less_equal",
     "split": "slice_channel",   # legacy nd.split == SliceChannel semantics
@@ -169,6 +170,85 @@ def flatten(data, **kwargs):
     import numpy as onp
 
     return _np().reshape(data, (data.shape[0], int(onp.prod(data.shape[1:], dtype=onp.int64))))
+
+
+def infer_reshape_shape(spec, src_shape, reverse=False):
+    """The reference's reshape special values (``matrix_op-inl.h``
+    ``InferReshapeShape``): 0 = copy input dim, -1 = infer one dim,
+    -2 = copy all remaining input dims, -3 = merge two consecutive input
+    dims, -4 d1 d2 = split one input dim (either may be -1).
+    ``reverse=True`` runs the algorithm right-to-left."""
+    spec = list(spec)
+    src = list(src_shape)
+    if reverse:
+        spec.reverse()
+        src.reverse()
+    out, src_idx, inf_idx, i = [], 0, -1, 0
+    while i < len(spec):
+        v = spec[i]
+        if v == 0:
+            if src_idx >= len(src):
+                raise ValueError(f"reshape spec {tuple(spec)} runs past "
+                                 f"input shape {tuple(src_shape)}")
+            out.append(src[src_idx]); src_idx += 1
+        elif v == -1:
+            if inf_idx >= 0:
+                raise ValueError("One and only one dim can be inferred")
+            inf_idx = len(out)
+            out.append(1); src_idx += 1
+        elif v == -2:
+            out.extend(src[src_idx:]); src_idx = len(src)
+        elif v == -3:
+            if src_idx + 1 >= len(src):
+                raise ValueError(f"-3 needs two input dims at position "
+                                 f"{src_idx} of {tuple(src_shape)}")
+            out.append(src[src_idx] * src[src_idx + 1]); src_idx += 2
+        elif v == -4:
+            if i + 2 >= len(spec) or src_idx >= len(src):
+                raise ValueError("-4 must be followed by two split dims")
+            d0 = src[src_idx]; src_idx += 1
+            d1, d2 = spec[i + 1], spec[i + 2]; i += 2
+            if d1 == -1 and d2 == -1:
+                raise ValueError("Split dims cannot both be -1.")
+            if d1 == -1:
+                d1 = d0 // d2
+            if d2 == -1:
+                d2 = d0 // d1
+            if d1 * d2 != d0:
+                raise ValueError(f"Split dims {d1}, {d2} do not divide "
+                                 f"original dim {d0}")
+            out.extend([d1, d2])
+        else:
+            out.append(v); src_idx += 1
+        i += 1
+    if inf_idx >= 0:
+        import numpy as onp
+        known = int(onp.prod(out, dtype=onp.int64))
+        total = int(onp.prod(src, dtype=onp.int64))
+        out[inf_idx] = total // known
+    if reverse:
+        out.reverse()
+    return tuple(out)
+
+
+def reshape(data, shape=None, reverse=False, out=None, **kwargs):
+    """Legacy ``nd.reshape`` incl. special values 0/-1/-2/-3/-4 and
+    ``reverse`` (reference ``Reshape``, src/operator/tensor/matrix_op.cc)."""
+    new_shape = infer_reshape_shape(shape, data.shape, reverse)
+    return _write_out(_np().reshape(data, new_shape), out)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32",
+           infer_range=None, out=None, **kwargs):
+    """Legacy ``nd.arange``: float32 default dtype and element-wise
+    ``repeat`` (reference ndarray.py ``arange`` docstring:
+    ``arange(2, 6, step=1.5, repeat=2) -> [2, 2, 3.5, 3.5, 5, 5]``)."""
+    if stop is None:
+        start, stop = 0, start
+    res = _np().arange(start, stop, step, dtype=dtype, ctx=ctx)
+    if repeat != 1:
+        res = res.repeat(repeat)
+    return _write_out(res, out)
 
 
 def cast(data, dtype, **kwargs):
@@ -899,6 +979,8 @@ def sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
 
 FUNCS = {
     "flatten": flatten,
+    "reshape": reshape,
+    "arange": arange,
     "cast": cast,
     "slice_legacy": slice_legacy,
     "slice_axis": slice_axis,
@@ -972,9 +1054,21 @@ FUNCS = {
     "rmsprop_update": rmsprop_update,
     "ftrl_update": ftrl_update,
 }
+def _legacy_cmp_dtype(lhs, rhs):
+    dt = getattr(lhs, "dtype", None) or getattr(rhs, "dtype", None)
+    return dt if dt is not None else "float32"
+
+
 def _make_broadcast(tgt):
     def fn(lhs, rhs, out=None, **kwargs):
-        return _write_out(getattr(_np(), tgt)(lhs, rhs), out)
+        res = getattr(_np(), tgt)(lhs, rhs)
+        if str(res.dtype) == "bool":
+            # the legacy surface returns input-dtype 0/1 floats, not bool
+            # (reference broadcast_equal docstring, ndarray/ndarray.py:
+            # "array([[ 1.,  1.,  1.], ...], dtype=float32)"); mx.np keeps
+            # numpy bool semantics — the cast is legacy-only
+            res = res.astype(_legacy_cmp_dtype(lhs, rhs))
+        return _write_out(res, out)
 
     fn.__name__ = tgt
     fn.__doc__ = f"Legacy broadcast op delegating to mx.np.{tgt}"
@@ -983,6 +1077,15 @@ def _make_broadcast(tgt):
 
 FUNCS.update({name: _make_broadcast(tgt)
               for name, tgt in _BROADCAST_BINARY.items()})
+
+# the elemwise comparison family shares the float-not-bool legacy contract
+# (reference ndarray.py ``equal``/``greater``/... docstrings)
+FUNCS.update({name: _make_broadcast(tgt) for name, tgt in {
+    "equal": "equal", "not_equal": "not_equal",
+    "greater": "greater", "greater_equal": "greater_equal",
+    "less": "less", "less_equal": "less_equal",
+    "logical_and": "logical_and", "logical_or": "logical_or",
+    "logical_xor": "logical_xor"}.items()})
 
 
 def custom(*inputs, op_type=None, **params):

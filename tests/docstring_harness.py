@@ -1,0 +1,252 @@
+"""Execute the reference's own docstring examples against mxnet_tpu.
+
+Round-4 verdict, Next #3: the registry audit pins op *names*; the sparse
+ctor bug (`csr_matrix` triple in the wrong order) showed that names are
+not enough — the *signatures and semantics* documented in the reference's
+docstrings must execute verbatim.  This harness generalizes the lesson
+beyond sparse: it extracts every ``>>>`` example from a reference source
+file (``/root/reference/python/mxnet/...``), executes it with ``mx`` bound
+to :mod:`mxnet_tpu`, and compares outputs numerically.
+
+Comparison model (``run_block``):
+
+- Examples inside one docstring share a namespace (reference examples
+  build on earlier assignments).
+- An example whose *want* starts with ``Traceback`` must raise.
+- A *want* carrying numeric tokens is compared by parsed-number sequence
+  (device tags, ``dtype=`` annotations and ``<NDArray ...>`` repr tails
+  are stripped first) with a print-truncation tolerance — this makes the
+  check robust to pure formatting drift (``1.`` vs ``1.0``) while still
+  catching wrong values, wrong order, and wrong shape (count mismatch).
+- A numberless *want* is compared as normalized text after mapping
+  ``mxnet_tpu`` spellings back to ``mxnet`` ones.
+- Sources that are nondeterministic (unseeded RNG) or wants carrying
+  doctest ellipsis run in smoke mode: they must execute, output unchecked.
+
+Known, justified divergences are declared per-file in the test modules
+via ``skip`` dicts mapping ``qualname`` (or ``(qualname, index)``) to a
+reason string — the skip list IS the documented divergence surface.
+"""
+import ast
+import contextlib
+import doctest
+import io
+import re
+
+REF_ROOT = "/root/reference/python/mxnet"
+
+_PARSER = doctest.DocTestParser()
+
+
+def collect_blocks(relpath):
+    """Return [(qualname, [doctest.Example, ...]), ...] for a reference file."""
+    with open(f"{REF_ROOT}/{relpath}", encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src)
+    blocks = []
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qn = prefix + child.name
+                ds = ast.get_docstring(child)
+                if ds:
+                    try:
+                        exs = _PARSER.get_examples(ds)
+                    except ValueError:
+                        exs = []
+                    if exs:
+                        blocks.append((qn, exs))
+                visit(child, qn + ".")
+
+    visit(tree, "")
+    return blocks
+
+
+# --- want/got comparison -------------------------------------------------
+
+_STRIP = [
+    # repr tails and device tags carry no semantics on this build
+    re.compile(r"<(?:NDArray|CSRNDArray|RowSparseNDArray|BaseSparseNDArray)"
+               r"[^>]*>"),
+    re.compile(r"@?(?:cpu|gpu|cpu_pinned|cpu_shared)\(\d*\)"),
+    re.compile(r"dtype=[\w.\'\"<>]+"),
+    re.compile(r"ctx=[^,)\s]+"),
+    re.compile(r"0x[0-9a-fA-F]+"),  # memory addresses
+    # dtype words would otherwise leak their width into the number stream
+    # (``np.int64(30)`` must parse as [30], not [64, 30])
+    re.compile(r"\b(?:u?int|float|complex)\d+\b|\bbool_\b"),
+]
+_NUM = re.compile(r"-?(?:inf\b|nan\b|\d+\.?\d*(?:e[+-]?\d+)?|\.\d+(?:e[+-]?\d+)?)",
+                  re.IGNORECASE)
+
+_NONDET = re.compile(
+    r"\b(?:random|randn|randint|rand\b|normal|uniform|shuffle|sample|poisson|"
+    r"gamma\(|exponential|multinomial|bernoulli|dropout|choice)\b")
+
+
+def _numbers(s):
+    for rx in _STRIP:
+        s = rx.sub(" ", s)
+    out = []
+    for tok in _NUM.findall(s):
+        t = tok.lower()
+        out.append(float("nan") if t == "nan" else float(t))
+    return out
+
+
+def _norm_text(s):
+    s = s.replace("mxnet_tpu", "mxnet")
+    s = s.replace("<type '", "<class '")  # py2-era reference docstrings
+    # mxnet.context is an alias module of mxnet.device in this build
+    s = s.replace("mxnet.device.", "mxnet.context.")
+    for rx in _STRIP:
+        s = rx.sub(" ", s)
+    return " ".join(s.split())
+
+
+def _truncated(want):
+    """True when the want's brackets don't balance: the reference
+    docstring had a literal blank line inside an array repr (no
+    ``<BLANKLINE>``), so doctest cut the expected output short."""
+    return want.count("[") != want.count("]")
+
+
+_SHAPE_TAIL = re.compile(
+    r"<(?:NDArray|CSRNDArray|RowSparseNDArray)\s+([\dx]+)\s*@")
+
+
+def _want_shape(want):
+    """Shape pinned by a bare ``<NDArray 2x3 @...>`` repr-tail want."""
+    m = _SHAPE_TAIL.search(want)
+    if not m:
+        return None
+    return tuple(int(t) for t in m.group(1).split("x"))
+
+
+def _close(a, b):
+    import math
+    if math.isnan(a) and math.isnan(b):
+        return True
+    # print-truncation tolerance: reference docstrings round float32 reprs
+    return abs(a - b) <= 1e-4 + 1e-3 * max(abs(a), abs(b))
+
+
+class ExampleFailure(AssertionError):
+    pass
+
+
+_GPU_CALL = re.compile(r"\bmx\.gpu\((\d*)\)")
+_IMPORT_MX = re.compile(r"\b(import|from)\s+mxnet\b")
+
+
+def _gpu_to_cpu(m):
+    # map gpu(N) to the DISTINCT device cpu(N+1) so cross-device copies in
+    # examples stay real copies (conftest provisions an 8-CPU virtual mesh)
+    n = int(m.group(1) or 0)
+    return f"mx.cpu({min(n + 1, 7)})"
+
+
+def _rewrite(source):
+    source = _GPU_CALL.sub(_gpu_to_cpu, source)
+    # examples written as ``import mxnet`` / ``from mxnet import nd``:
+    # a bare ``import mxnet_tpu`` must still bind the name ``mxnet``
+    source = _IMPORT_MX.sub(lambda m: f"{m.group(1)} mxnet_tpu", source)
+    source = re.sub(r"^(\s*)import mxnet_tpu$", r"\1import mxnet_tpu as mxnet",
+                    source, flags=re.MULTILINE)
+    return source
+
+
+def run_example(source, want, globs):
+    """Execute one example; raise ExampleFailure on divergence."""
+    source = _rewrite(source)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        # reference docstrings contain a few malformed doctests (array
+        # literals continued without '...' markers)
+        raise ExampleFailure(
+            f"unparseable example (malformed doctest in reference): {e}\n"
+            f"  source: {source!r}")
+    last_value = _SENTINEL
+    stdout = io.StringIO()
+    expect_raise = want.lstrip().startswith("Traceback")
+    try:
+        with contextlib.redirect_stdout(stdout):
+            if tree.body and isinstance(tree.body[-1], ast.Expr):
+                head = ast.Module(body=tree.body[:-1], type_ignores=[])
+                exec(compile(head, "<doctest>", "exec"), globs)
+                last_value = eval(
+                    compile(ast.Expression(body=tree.body[-1].value),
+                            "<doctest>", "eval"), globs)
+            else:
+                exec(compile(tree, "<doctest>", "exec"), globs)
+    except Exception as e:  # noqa: BLE001 - doctest semantics
+        if expect_raise:
+            return
+        raise ExampleFailure(
+            f"example raised {type(e).__name__}: {e}\n  source: {source!r}")
+    if expect_raise:
+        raise ExampleFailure(
+            f"expected an exception, none raised\n  source: {source!r}")
+    if not want.strip():
+        return
+    got = stdout.getvalue()
+    if last_value is not _SENTINEL and last_value is not None:
+        got += repr(last_value)
+    if "..." in want or _NONDET.search(source):
+        return  # smoke: executed fine, output explicitly unpinned
+    want_nums = _numbers(want)
+    if not want_nums and not _norm_text(want):
+        # the want is a bare repr tail (``<NDArray 2x3 @gpu(0)>``): the
+        # only semantic content is the shape — pin that
+        shp = _want_shape(want)
+        if shp is not None and last_value is not _SENTINEL:
+            got_shape = tuple(getattr(last_value, "shape", ()))
+            if got_shape != shp and tuple(s for s in shp if s != 1) != \
+                    tuple(s for s in got_shape if s != 1):
+                raise ExampleFailure(
+                    f"shape mismatch\n  source: {source!r}\n"
+                    f"  want: {shp}\n  got:  {got_shape}")
+        return
+    if want_nums:
+        got_nums = _numbers(got)
+        if _truncated(want):
+            got_nums = got_nums[:len(want_nums)]  # prefix-compare
+        if len(got_nums) != len(want_nums) or not all(
+                _close(a, b) for a, b in zip(want_nums, got_nums)):
+            raise ExampleFailure(
+                f"numeric mismatch\n  source: {source!r}\n"
+                f"  want: {want_nums}\n  got:  {got_nums}\n"
+                f"  raw got: {got!r}")
+        return
+    if _norm_text(want) != _norm_text(got):
+        raise ExampleFailure(
+            f"text mismatch\n  source: {source!r}\n"
+            f"  want: {_norm_text(want)!r}\n  got:  {_norm_text(got)!r}")
+
+
+_SENTINEL = object()
+
+
+def run_block(examples, globs, skip_idx=()):
+    """Run one docstring's examples under a shared namespace.
+    ``skip_idx``: example indices excused by a documented skip."""
+    for i, ex in enumerate(examples):
+        if ex.options.get(doctest.SKIP) or i in skip_idx:
+            continue
+        try:
+            run_example(ex.source, ex.want, globs)
+        except ExampleFailure as e:
+            raise ExampleFailure(f"[example {i}] {e}") from None
+
+
+def default_globs():
+    import numpy
+    import mxnet_tpu as mx
+    return {
+        "mx": mx, "mxnet": mx, "np": mx.np, "npx": mx.npx,
+        "nd": mx.nd, "numpy": numpy, "onp": numpy, "_np": numpy,
+        "gluon": mx.gluon, "autograd": mx.autograd,
+    }

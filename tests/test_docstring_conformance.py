@@ -1,0 +1,87 @@
+"""Reference docstring conformance: the reference's OWN ``>>>`` examples,
+executed verbatim against this build's public surfaces.
+
+Round-4 verdict, Next #3 generalized: the registry audit pins op *names*
+and ``test_sparse_ctor_conformance`` pins the sparse ctor docstrings; this
+suite sweeps whole reference source files through
+:mod:`docstring_harness`, so *signatures and semantics* documented in the
+reference are executed, not just resolvable.  Each parametrized case is
+one docstring (examples inside a docstring share state).
+
+``SKIPS`` is the documented divergence surface: every entry is either a
+reference-side doctest defect (typos, missing ``...`` continuations, py2
+reprs the comparator cannot normalize) or a justified redesign with its
+rationale stated inline.  An entry may be a ``qualname`` (whole block) or
+``(qualname, example_idx)``.
+
+Legacy files run under ``mx.util.set_np(array=False)``, the reference's
+default mode for the ``mx.nd`` era (this build defaults to numpy mode).
+"""
+import pytest
+
+import mxnet_tpu as mx
+from docstring_harness import (ExampleFailure, collect_blocks,
+                               default_globs, run_block)
+
+
+def _ndarray_extra_globs():
+    from mxnet_tpu.ndarray.ndarray import indexing_key_expand_implicit_axes
+    return {"indexing_key_expand_implicit_axes":
+            indexing_key_expand_implicit_axes}
+
+
+FILES = {
+    "context.py": dict(legacy=True, skips={}, extra=None),
+    "ndarray/ndarray.py": dict(
+        legacy=True,
+        extra=_ndarray_extra_globs,
+        skips={
+            "NDArray._sync_copyfrom":
+                "reference docstring typo: the output line is prefixed "
+                "'>> ' so doctest attaches the want to the assignment",
+            "NDArray.dtype":
+                "legacy .dtype returns the np.dtype instance, not the "
+                "numpy scalar class; == comparisons with either spelling "
+                "behave identically",
+            "NDArray.astype": "same np.dtype-instance repr as NDArray.dtype",
+            "NDArray.to_dlpack_for_read":
+                "returns a live __dlpack__ exporter (keeps the buffer "
+                "alive across consumers) instead of a consumed-once "
+                "PyCapsule — documented redesign, mxnet_tpu/dlpack.py",
+            "NDArray.to_dlpack_for_write": "same exporter redesign",
+            ("indexing_key_expand_implicit_axes", 5):
+                "malformed doctest in the reference: array literal "
+                "continued without '...' markers",
+            ("indexing_key_expand_implicit_axes", 6):
+                "depends on the malformed example above",
+        }),
+}
+
+
+def _cases():
+    for relpath, cfg in FILES.items():
+        for qn, exs in collect_blocks(relpath):
+            yield pytest.param(relpath, qn, exs, cfg,
+                               id=f"{relpath}::{qn}")
+
+
+@pytest.mark.parametrize("relpath,qualname,examples,cfg", _cases())
+def test_reference_docstring(relpath, qualname, examples, cfg):
+    skips = cfg["skips"]
+    if qualname in skips:
+        pytest.skip(skips[qualname])
+    skip_idx = {idx for (qn, idx) in
+                [k for k in skips if isinstance(k, tuple)] if qn == qualname}
+    globs = default_globs()
+    if cfg["extra"] is not None:
+        globs.update(cfg["extra"]())
+    prev = None
+    if cfg["legacy"]:
+        prev = mx.util.set_np(array=False)
+    try:
+        run_block(examples, globs, skip_idx=skip_idx)
+    except ExampleFailure as e:
+        pytest.fail(f"{relpath}::{qualname}: {e}")
+    finally:
+        if cfg["legacy"]:
+            mx.util.set_np(array=prev)
