@@ -15,16 +15,14 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 from docstring_harness import collect_blocks, default_globs, run_block, \
-    ExampleFailure  # noqa: E402
+    reset_mode, ExampleFailure  # noqa: E402
 
 
 def main(relpath, verbose=False, legacy=False):
-    if legacy:
-        import mxnet_tpu as mx
-        mx.util.set_np(array=False)
     blocks = collect_blocks(relpath)
     ok, fails = [], []
     for qn, exs in blocks:
+        reset_mode(legacy)
         globs = default_globs()
         try:
             run_block(exs, globs)

@@ -127,7 +127,9 @@ def __getattr__(name):
             mod = importlib.import_module("." + target, __name__)
         globals()[name] = mod
         return mod
-    if name in ("set_np", "set_np_shape", "is_np_array", "is_np_shape", "use_np"):
+    if name in ("set_np", "set_np_shape", "is_np_array", "is_np_shape",
+                "use_np", "is_np_default_dtype", "set_np_default_dtype",
+                "reset_np"):
         from . import util
 
         return getattr(util, name)

@@ -68,6 +68,9 @@ class _ThreadLocalState(threading.local):
         # compat; the TPU build is numpy-semantics-native so both default on.
         self.np_shape = True
         self.np_array = True
+        # reference set_np(dtype=...): True = numpy default dtype
+        # (float64), False = MXNet classic float32 defaults
+        self.np_dtype = False
 
 
 _thread_state = _ThreadLocalState()

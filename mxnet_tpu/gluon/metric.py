@@ -10,6 +10,17 @@ from ..ndarray.ndarray import NDArray
 
 _METRIC_REGISTRY = {}
 
+# short names the reference registers via @alias (gluon/metric.py:238,
+# 368, 441, 1333, 1492) so ``metric.create('acc')``-era scripts resolve
+_ALIASES = {
+    "composite": "compositeevalmetric",
+    "acc": "accuracy",
+    "top_k_accuracy": "topkaccuracy",
+    "top_k_acc": "topkaccuracy",
+    "ce": "crossentropy",
+    "pearsonr": "pearsoncorrelation",
+}
+
 
 def register(cls):
     _METRIC_REGISTRY[cls.__name__.lower()] = cls
@@ -26,8 +37,10 @@ def create(metric, *args, **kwargs):
         return composite
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
+    name = metric.lower()
+    name = _ALIASES.get(name, name)
     try:
-        return _METRIC_REGISTRY[metric.lower()](*args, **kwargs)
+        return _METRIC_REGISTRY[name](*args, **kwargs)
     except KeyError:
         raise MXNetError(f"unknown metric {metric!r}") from None
 
@@ -469,3 +482,14 @@ def np_metric(name=None, allow_extra_outputs=False):
         return CustomMetric(f, name or f.__name__, allow_extra_outputs)
 
     return deco
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):  # pylint: disable=invalid-name
+    """Create a CustomMetric from a ``feval(label, pred)`` numpy function
+    (reference ``gluon/metric.py:1824``; numpy itself is ``_onp`` in this
+    module, so the reference's unfortunate name is safe to mirror)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = getattr(numpy_feval, "__name__", "feval")
+    return CustomMetric(feval, name or feval.__name__, allow_extra_outputs)

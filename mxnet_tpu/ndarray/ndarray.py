@@ -67,7 +67,9 @@ def _to_jax(value, dtype=None, ctx: Context = None):
         dtype = _np.float32 if isinstance(value, float) else None
     host = _np.asarray(value, dtype=dtype)
     if host.dtype == _np.float64 and dtype is None:
-        host = host.astype(_np.float32)  # MXNet default dtype is float32
+        from ..base import _thread_state
+        if not _thread_state.np_dtype:  # set_np(dtype=True) keeps float64
+            host = host.astype(_np.float32)  # MXNet default is float32
     dev = (ctx or current_context()).jax_device()
     return jax.device_put(host, dev)
 
@@ -129,8 +131,13 @@ class NDArray:
             src = p._data  # refresh the whole parent chain first
             if self._view_pver != p._version:
                 key = self._view_key
-                self._buf = src.reshape(self._buf.shape) if key is None \
-                    else src[key]
+                if key is None:
+                    self._buf = src.reshape(self._buf.shape)
+                elif isinstance(key, tuple) and len(key) == 2 \
+                        and key[0] == "flip":
+                    self._buf = _jnp().flip(src, key[1])
+                else:
+                    self._buf = src[key]
                 self._view_pver = p._version
                 self._version += 1  # children of this view refresh too
         return self._buf
@@ -151,6 +158,9 @@ class NDArray:
             key = self._view_key
             if key is None:  # reshape view: write the whole array back
                 newp = new_data.reshape(p.shape).astype(p.dtype)
+            elif isinstance(key, tuple) and len(key) == 2 \
+                    and key[0] == "flip":  # self-inverse transform
+                newp = _jnp().flip(new_data, key[1]).astype(p.dtype)
             else:
                 newp = p._data.at[key].set(new_data.astype(p.dtype))
             p._set_data_internal(newp, keep_tape=keep_tape)
@@ -698,6 +708,19 @@ class NDArray:
         res = src.reshape((-1,))
         res._view_parent = None  # numpy .flatten() contract is a copy
         return res
+
+    def nonzero(self):
+        """Indices of nonzero elements, one array per dimension (numpy
+        method contract)."""
+        host = _np.nonzero(self.asnumpy())
+        return tuple(NDArray(h) for h in host)
+
+    def ravel(self, order="C"):
+        """1-D view of the array (numpy contract; the reshape view links
+        back to the parent like ``reshape``)."""
+        if order == "F":
+            return self.transpose(*reversed(range(self.ndim))).reshape((-1,))
+        return self.reshape((-1,))
 
     def squeeze(self, axis=None):
         return _apply(lambda x: _jnp().squeeze(x, axis), (self,), name="squeeze")

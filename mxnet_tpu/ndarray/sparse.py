@@ -659,3 +659,70 @@ def dense_to_sparse(arr: NDArray, stype: str):
             host.shape,
         )
     raise MXNetError(f"unknown stype {stype}")
+
+
+# ---------------------------------------------------------------------------
+# Module-level elementwise arithmetic (reference ``ndarray/sparse.py``
+# :1210-1516 ``add``/``subtract``/``multiply``/``divide``): the result
+# keeps the operands' sparse storage where the reference contract says the
+# output stays sparse (same-stype operands, or scalar multiply/divide),
+# and falls back to dense otherwise.
+# ---------------------------------------------------------------------------
+def _elemwise_binary(name, jfn, lhs, rhs):
+    import numbers
+
+    res = NDArray(jfn(
+        lhs._data if isinstance(lhs, NDArray) else lhs,
+        rhs._data if isinstance(rhs, NDArray) else rhs))
+    l_st = getattr(lhs, "stype", "default")
+    r_st = getattr(rhs, "stype", "default")
+    if isinstance(rhs, numbers.Number):
+        if l_st in ("csr", "row_sparse") and name in ("multiply", "divide"):
+            return res.tostype(l_st)  # scalar mul/div preserves sparsity
+        return res
+    if l_st == r_st and l_st in ("csr", "row_sparse"):
+        return res.tostype(l_st)
+    return res
+
+
+def add(lhs, rhs):
+    """csr+csr / rsp+rsp stay sparse; mixed or scalar adds densify
+    (reference sparse.py:1210-1281)."""
+    return _elemwise_binary("add", _jnp_fn("add"), lhs, rhs)
+
+
+def subtract(lhs, rhs):
+    return _elemwise_binary("subtract", _jnp_fn("subtract"), lhs, rhs)
+
+
+def multiply(lhs, rhs):
+    return _elemwise_binary("multiply", _jnp_fn("multiply"), lhs, rhs)
+
+
+def divide(lhs, rhs):
+    return _elemwise_binary("divide", _jnp_fn("divide"), lhs, rhs)
+
+
+def _jnp_fn(name):
+    import jax.numpy as jnp
+
+    return getattr(jnp, name)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create a sparse array from a sparse source (scipy csr or another
+    sparse NDArray); dense sources belong to ``mx.nd.array``
+    (reference sparse.py:1596-1655)."""
+    try:
+        import scipy.sparse as spsp
+    except ImportError:
+        spsp = None
+    if spsp is not None and isinstance(source_array, spsp.spmatrix):
+        return csr_matrix(source_array, ctx=ctx, dtype=dtype)
+    if isinstance(source_array, BaseSparseNDArray):
+        # a genuine copy (reference array() copies), onto ctx if given
+        dense = NDArray(source_array._data, ctx=ctx,
+                        dtype=dtype or source_array.dtype)
+        return dense.tostype(source_array.stype)
+    raise ValueError("Unexpected source_array type: use mx.nd.array for "
+                     "dense inputs and mx.nd.sparse.array for sparse ones")

@@ -45,6 +45,16 @@ uint16 = _onp.uint16
 uint32 = _onp.uint32
 uint64 = _onp.uint64
 bool_ = _onp.bool_
+# word-size aliases (numpy public names used in reference docstrings)
+uint = _onp.uint
+int_ = _onp.int_
+intp = _onp.intp
+uintp = _onp.uintp
+float_ = _onp.float64
+bool = _onp.bool_  # pylint: disable=redefined-builtin
+half = _onp.float16
+single = _onp.float32
+double = _onp.float64
 
 
 def _bfloat16():
@@ -80,7 +90,8 @@ def _pop_ctx(kwargs):
 
 
 def array(object, dtype=None, ctx=None, device=None, copy=True):  # pylint: disable=redefined-builtin,unused-argument
-    return NDArray(_to_jax(object, dtype=dtype, ctx=ctx or device))
+    dtype, ctx = _ctx_in_dtype_slot(dtype, ctx or device)
+    return NDArray(_to_jax(object, dtype=dtype, ctx=ctx))
 
 
 def _creation(fn_name):
@@ -90,26 +101,44 @@ def _creation(fn_name):
 
         jfn = getattr(_jnp(), fn_name)
         out = jfn(*args, **kwargs)
-        if ctx is not None:
-            out = jax.device_put(out, ctx.jax_device())
-        else:
-            out = jax.device_put(out, current_context().jax_device())
-        return NDArray(out)
+        dev = (ctx or current_context()).jax_device()
+        if isinstance(out, tuple):  # e.g. linspace(..., retstep=True)
+            return tuple(
+                NDArray(jax.device_put(o, dev)) if hasattr(o, "shape")
+                else o for o in out)
+        return NDArray(jax.device_put(out, dev))
 
     f.__name__ = fn_name
     return f
 
 
-def zeros(shape, dtype=float32, order="C", ctx=None, device=None):  # pylint: disable=unused-argument
-    return _eager_create(_jnp().zeros, shape, dtype or float32, ctx or device)
+def _default_float():
+    from ..util import is_np_default_dtype
+
+    return float64 if is_np_default_dtype() else float32
 
 
-def ones(shape, dtype=float32, order="C", ctx=None, device=None):  # pylint: disable=unused-argument
-    return _eager_create(_jnp().ones, shape, dtype or float32, ctx or device)
+def _ctx_in_dtype_slot(dtype, ctx):
+    """Reference docstrings call ``np.zeros((2,3), npx.gpu(0))`` — a
+    Context landing in the dtype position; shift it over."""
+    if isinstance(dtype, Context):
+        return None, dtype
+    return dtype, ctx
 
 
-def empty(shape, dtype=float32, order="C", ctx=None, device=None):  # pylint: disable=unused-argument
-    return _eager_create(_jnp().zeros, shape, dtype or float32, ctx or device)
+def zeros(shape, dtype=None, order="C", ctx=None, device=None):  # pylint: disable=unused-argument
+    dtype, ctx = _ctx_in_dtype_slot(dtype, ctx or device)
+    return _eager_create(_jnp().zeros, shape, dtype or _default_float(), ctx)
+
+
+def ones(shape, dtype=None, order="C", ctx=None, device=None):  # pylint: disable=unused-argument
+    dtype, ctx = _ctx_in_dtype_slot(dtype, ctx or device)
+    return _eager_create(_jnp().ones, shape, dtype or _default_float(), ctx)
+
+
+def empty(shape, dtype=None, order="C", ctx=None, device=None):  # pylint: disable=unused-argument
+    dtype, ctx = _ctx_in_dtype_slot(dtype, ctx or device)
+    return _eager_create(_jnp().zeros, shape, dtype or _default_float(), ctx)
 
 
 def _eager_create(jfn, shape, dt, ctx):
@@ -187,16 +216,74 @@ def indices(dimensions, dtype=int64, ctx=None, device=None):  # pylint: disable=
 # ---------------------------------------------------------------------------
 
 
+_ARRAYLIKE_REJECT = None
+
+
+def _convert_rejected_arg(args, exc):
+    """jax.numpy refuses raw python sequences in DATA positions (config
+    lists like ``tile`` reps are fine and never raise).  The reference
+    mx.np accepts array-likes everywhere, so on that specific TypeError
+    convert exactly the offending argument and retry."""
+    global _ARRAYLIKE_REJECT
+    import re as _re
+    if _ARRAYLIKE_REJECT is None:
+        _ARRAYLIKE_REJECT = _re.compile(
+            r"requires ndarray or scalar arguments, got <class "
+            r"'(?:list|tuple)'> at position (\d+)")
+    m = _ARRAYLIKE_REJECT.search(str(exc))
+    if not m:
+        return None
+    p = int(m.group(1))
+    if p >= len(args) or not isinstance(args[p], (list, tuple)):
+        return None
+    return args[:p] + (_onp.asarray(args[p]),) + args[p + 1:]
+
+
 def _wrap(jfn, name, record=True):
     """Wrap a jax.numpy function into an NDArray-aware, autograd-aware op."""
 
     def f(*args, **kwargs):
+        for _ in range(len(args) + 1):
+            try:
+                return _invoke(args, kwargs)
+            except TypeError as e:
+                converted = _convert_rejected_arg(args, e)
+                if converted is not None:
+                    args = converted
+                    continue
+                # reference ufuncs take ``out`` positionally
+                # (``np.cos(x, out1)``); jax.numpy signatures do not
+                if ("positional argument" in str(e) and len(args) >= 2
+                        and isinstance(args[-1], NDArray)
+                        and "out" not in kwargs):
+                    kwargs = dict(kwargs, out=args[-1])
+                    args = args[:-1]
+                    continue
+                raise
+            except NotImplementedError as e:
+                # jnp.isposinf/isneginf ACCEPT out positionally then refuse
+                # it themselves; route it through our out= path instead
+                if ("'out' argument" in str(e) and len(args) >= 2
+                        and isinstance(args[-1], NDArray)
+                        and "out" not in kwargs):
+                    kwargs = dict(kwargs, out=args[-1])
+                    args = args[:-1]
+                    continue
+                raise
+        raise AssertionError("unreachable")
+
+    def _invoke(args, kwargs):
         import jax
 
+        kwargs = dict(kwargs)
         out = kwargs.pop("out", None)
         where = kwargs.pop("where", None)
         if where is not None:
-            kwargs["where"] = where._data if isinstance(where, NDArray) else where
+            if isinstance(where, NDArray):
+                where = where._data
+            elif isinstance(where, (list, tuple)):
+                where = _onp.asarray(where)  # jnp rejects raw sequences
+            kwargs["where"] = where
         leaves, treedef = jax.tree_util.tree_flatten(
             (args, kwargs), is_leaf=lambda x: isinstance(x, NDArray)
         )
@@ -307,6 +394,135 @@ _install(globals(), _NONDIFF_OPS, record=False)
 # jnp.fix is deprecated (alias of trunc); keep the numpy-parity name alive
 fix = _wrap(lambda x: _jnp().trunc(x), "fix", record=True)
 
+
+def _clipped_split(fn_name, axis_of):
+    """numpy split semantics allow out-of-range index points (they clamp
+    to the axis length and produce empty sections); jax.numpy rejects
+    them, so clamp before delegating."""
+    def split_fn(ary, indices_or_sections, axis=None):
+        if isinstance(ary, (list, tuple)):
+            ary = array(ary)
+        ax = axis_of if axis is None else axis
+        if fn_name == "hsplit" and ary.ndim == 1:
+            ax = 0  # numpy: hsplit of 1-D splits axis 0
+        ios = indices_or_sections
+        if not isinstance(ios, int):
+            host = _onp.asarray(
+                ios._data if isinstance(ios, NDArray) else ios)
+            dim = ary.shape[ax if ax >= 0 else ary.ndim + ax]
+            host = _onp.where(host < 0, host + dim, host)  # from-end points
+            ios = _onp.clip(host, 0, dim).tolist()
+        jfn = getattr(_jnp(), fn_name)
+        if fn_name in ("hsplit", "vsplit", "dsplit"):
+            return _base_wrap_call(jfn, fn_name, ary, ios)
+        return _base_wrap_call(jfn, fn_name, ary, ios, axis=ax)
+
+    split_fn.__name__ = fn_name
+    return split_fn
+
+
+def _base_wrap_call(jfn, name, *args, **kwargs):
+    return _wrap(jfn, name)(*args, **kwargs)
+
+
+split = _clipped_split("split", 0)
+array_split = _clipped_split("array_split", 0)
+hsplit = _clipped_split("hsplit", 1)
+vsplit = _clipped_split("vsplit", 0)
+dsplit = _clipped_split("dsplit", 2)
+
+# host-side integer formatting (numpy public API)
+binary_repr = _onp.binary_repr
+base_repr = _onp.base_repr
+
+def _flip_view(name, axis_fn):
+    """numpy's flips are stride VIEWS: writes through ``np.fliplr(a)``
+    land in ``a`` (the reference anti-diagonal fill_diagonal idiom).
+    Link the result as a self-inverse 'flip' view of the source."""
+    table_fn = _wrap(getattr(_jnp(), name), name)
+
+    def f(m, *args, **kwargs):
+        from .. import autograd as _ag
+        res = table_fn(m, *args, **kwargs)
+        if isinstance(m, NDArray) and type(m) is NDArray \
+                and not _ag.is_recording():
+            res._view_parent = m
+            res._view_key = ("flip", axis_fn(m, *args, **kwargs))
+            res._view_pver = m._version
+        return res
+
+    f.__name__ = name
+    return f
+
+
+flipud = _flip_view("flipud", lambda m: 0)
+fliplr = _flip_view("fliplr", lambda m: 1)
+flip = _flip_view(
+    "flip", lambda m, axis=None: tuple(range(m.ndim)) if axis is None
+    else axis)
+
+def _nan_to_num_table():
+    global _n2n_wrapped
+    if _n2n_wrapped is None:
+        _n2n_wrapped = _wrap(_jnp().nan_to_num, "nan_to_num")
+    return _n2n_wrapped
+
+
+_n2n_wrapped = None
+
+
+def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    """numpy.nan_to_num incl. the in-place ``copy=False`` form (mutation
+    = rebind; views of ``x`` observe the update)."""
+    res = _nan_to_num_table()(x, nan=nan, posinf=posinf, neginf=neginf)
+    if not copy and isinstance(x, NDArray):
+        x._set_data_internal(res._data)
+        return x
+    return res
+
+
+# alias identity: numpy exposes these as the SAME object and reference
+# docstrings assert it (``np.bitwise_not is np.invert``)
+bitwise_not = invert  # noqa: F821
+absolute = abs  # noqa: F821
+conjugate = conj  # noqa: F821
+remainder = mod  # noqa: F821
+
+def _around_table():
+    global _around_wrapped
+    if _around_wrapped is None:
+        _around_wrapped = _wrap(_jnp().round, "around")
+    return _around_wrapped
+
+
+_around_wrapped = None
+
+
+def around(a, decimals=0, out=None):
+    """numpy.around incl. negative ``decimals`` on integer arrays, which
+    jax.numpy refuses (reference example: around([1, 2, 3, 11], -1))."""
+    if isinstance(a, (list, tuple)):
+        a = array(a)
+    if decimals < 0:
+        scale = 10 ** (-decimals)
+        res = _wrap(lambda x: (_jnp().round(x / scale) * scale)
+                    .astype(x.dtype), "around_negdec")(a)
+        return _write_to_out(res, out)
+    if out is not None:
+        return _around_table()(a, decimals, out=out)
+    return _around_table()(a, decimals)
+
+
+def _write_to_out(res, out):
+    if out is None:
+        return res
+    out._set_data_internal(res._data)
+    return out
+
+
+round = around  # pylint: disable=redefined-builtin
+round_ = around
+
 # functional form: JAX arrays are immutable, so this RETURNS the result
 put_along_axis = _wrap(
     lambda arr, indices, values, axis: _jnp().put_along_axis(
@@ -378,6 +594,8 @@ def triu_indices(n, k=0, m=None):
 
 def unravel_index(indices_, shape):
     idx = indices_._data if isinstance(indices_, NDArray) else indices_
+    if isinstance(idx, (list, tuple)):
+        idx = _onp.asarray(idx)
     return tuple(NDArray(x) for x in _jnp().unravel_index(idx, shape))
 
 

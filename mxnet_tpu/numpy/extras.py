@@ -245,11 +245,28 @@ def require(a, dtype=None, requirements=None):
 
 
 def fill_diagonal(a, val, wrap=False):
-    """In-place diagonal fill (numpy mutation semantics via rebind)."""
+    """In-place diagonal fill (numpy mutation semantics via rebind).
+    ``wrap=True`` (tall matrices restart the diagonal after a full
+    period) is unsupported by jax.numpy, so it takes the index path."""
     import jax.numpy as jnp
 
     val_ = _d(val) if isinstance(val, NDArray) else val
-    out = jnp.fill_diagonal(_d(a), val_, wrap=wrap, inplace=False)
+    if isinstance(val_, (list, tuple)):
+        import numpy as onp
+        val_ = onp.asarray(val_)
+    if wrap and a.ndim == 2 and a.shape[0] > a.shape[1]:
+        import numpy as onp
+        n_rows, n_cols = a.shape
+        # numpy semantics: a.flat[::ncols+1] = val over the WHOLE flat
+        # array — the one-row gap after each full diagonal block emerges
+        # from the stride arithmetic; array vals repeat cyclically
+        flat = onp.arange(0, n_rows * n_cols, n_cols + 1)
+        if getattr(val_, "ndim", 0):
+            reps = -(-len(flat) // len(val_))  # ceil
+            val_ = jnp.tile(val_, reps)[:len(flat)]
+        out = _d(a).at[flat // n_cols, flat % n_cols].set(val_)
+    else:
+        out = jnp.fill_diagonal(_d(a), val_, wrap=False, inplace=False)
     a._set_data_internal(out)
     return None
 

@@ -28,6 +28,27 @@ from ..ops.spatial import (  # noqa: F401
     spatial_transformer,
 )
 from ..util import is_np_array, is_np_shape, set_np, reset_np  # noqa: F401
+# device helpers the reference's npx re-exports (numpy_extension/__init__.py
+# pulls in mxnet.context): npx.cpu()/npx.gpu() appear throughout the
+# reference's mx.np docstrings
+from ..device import (  # noqa: F401
+    Context,
+    cpu,
+    cpu_pinned,
+    current_context,
+    gpu,
+    num_gpus,
+    tpu,
+)
+
+
+def set_np_float64(default_float64=True):
+    """Switch creation-default dtype to float64 (the reference documents
+    this npx helper in its own mx.np docstrings, e.g. multiarray.py:1320,
+    though it never shipped it; equivalent to ``set_np_default_dtype``)."""
+    from ..util import set_np_default_dtype
+
+    return set_np_default_dtype(default_float64)
 
 
 def seed(s):

@@ -27,15 +27,29 @@ def set_np_shape(active: bool) -> bool:
     return prev
 
 
-def set_np(shape=True, array=True):
+def is_np_default_dtype() -> bool:
+    """True when ``set_np(dtype=True)`` selected numpy's float64 creation
+    defaults over MXNet's classic float32 (reference ``util.py
+    set_np``/``is_np_default_dtype``)."""
+    return _thread_state.np_dtype
+
+
+def set_np_default_dtype(is_np_dtype=True) -> bool:
+    prev = _thread_state.np_dtype
+    _thread_state.np_dtype = bool(is_np_dtype)
+    return prev
+
+
+def set_np(shape=True, array=True, dtype=False):
     set_np_shape(shape)
+    set_np_default_dtype(dtype)
     prev = _thread_state.np_array
     _thread_state.np_array = bool(array)
     return prev
 
 
 def reset_np():
-    set_np(True, True)
+    set_np(True, True, False)
 
 
 class _NumpyShapeScope:
