@@ -68,6 +68,14 @@ class Initializer:
     def _init_weight(self, name, arr):
         raise NotImplementedError
 
+    def dumps(self):
+        """JSON ``'["<name>", {<kwargs>}]'`` form consumed by
+        update-on-kvstore optimizer shipping (reference
+        ``initializer.py:99-118``)."""
+        import json
+
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
     def __repr__(self):
         return f"{type(self).__name__}({self._kwargs})"
 

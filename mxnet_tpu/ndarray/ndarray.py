@@ -136,6 +136,9 @@ class NDArray:
                 elif isinstance(key, tuple) and len(key) == 2 \
                         and key[0] == "flip":
                     self._buf = _jnp().flip(src, key[1])
+                elif isinstance(key, tuple) and len(key) == 3 \
+                        and key[0] == "sliceshape":
+                    self._buf = src[key[1]].reshape(self._buf.shape)
                 else:
                     self._buf = src[key]
                 self._view_pver = p._version
@@ -161,6 +164,10 @@ class NDArray:
             elif isinstance(key, tuple) and len(key) == 2 \
                     and key[0] == "flip":  # self-inverse transform
                 newp = _jnp().flip(new_data, key[1]).astype(p.dtype)
+            elif isinstance(key, tuple) and len(key) == 3 \
+                    and key[0] == "sliceshape":  # reshaped slice view
+                newp = p._data.at[key[1]].set(
+                    new_data.reshape(key[2]).astype(p.dtype))
             else:
                 newp = p._data.at[key].set(new_data.astype(p.dtype))
             p._set_data_internal(newp, keep_tape=keep_tape)
@@ -170,6 +177,33 @@ class NDArray:
     @property
     def shape(self):
         return tuple(self._data.shape)
+
+    @shape.setter
+    def shape(self, new_shape):
+        # numpy in-place reshape (``a.shape = (8, 3)``): same id, new view
+        # of the same data
+        if autograd.is_recording() and _tracked(self):
+            # keep the tape connected: record a real reshape op, then
+            # rebind (mirrors the recording branch of __setitem__)
+            res = _apply(lambda x: x.reshape(new_shape), (self,),
+                         name="reshape")
+            self._set_data_internal(res._data, keep_tape=True)
+            self._tape = res._tape
+            return
+        key = None if getattr(self, "_view_parent", None) is None \
+            else self._view_key
+        if isinstance(key, tuple) and key and key[0] == "flip":
+            # reshaping a flip alias: materialize and detach (rare)
+            self._buf = self._data
+            self._view_parent = None
+        elif key is not None and not (isinstance(key, tuple) and
+                                      key and key[0] == "sliceshape"):
+            # slice view: remember the slice's own shape so write-backs
+            # can un-reshape into the parent slot
+            self._view_key = ("sliceshape", key, self.shape)
+        old = self._data
+        self._buf = old.reshape(new_shape)
+        self._version += 1
 
     @property
     def dtype(self):

@@ -39,7 +39,15 @@ def _wrap(name, record=True):
 
 
 norm = _wrap("norm")
-svd = _wrap("svd")
+_svd_full = _wrap("svd")
+
+
+def svd(a, full_matrices=False):
+    """Reference ``mx.np.linalg.svd`` contract: the REDUCED triple
+    ``(ut, l, v)`` with ``ut (..,M,M)``, ``l (..,M)``, ``v (..,M,N)``
+    (reference numpy/linalg.py:283-316 — it has no full_matrices notion);
+    pass ``full_matrices=True`` explicitly for numpy's full semantics."""
+    return _svd_full(a, full_matrices=full_matrices)
 cholesky = _wrap("cholesky")
 qr = _wrap("qr")
 inv = _wrap("inv")

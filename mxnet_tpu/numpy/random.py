@@ -80,6 +80,17 @@ def rand(*size, dtype=None, ctx=None):
     return uniform(0.0, 1.0, size=size, dtype=dtype, ctx=ctx)
 
 
+def random_sample(size=None, ctx=None):
+    """Uniform [0, 1) floats (numpy's ``random_sample``; ``random`` and
+    ``ranf`` are its aliases)."""
+    return uniform(0.0, 1.0, size=size, ctx=ctx)
+
+
+random = random_sample
+ranf = random_sample
+sample = random_sample
+
+
 def randint(low, high=None, size=None, dtype=None, ctx=None, device=None):
     if high is None:
         low, high = 0, low
@@ -92,7 +103,11 @@ def choice(a, size=None, replace=True, p=None, ctx=None, device=None):
     a_ = a._data if isinstance(a, NDArray) else a
     if isinstance(a_, int):
         a_ = _jnp().arange(a_)
+    elif isinstance(a_, (list, tuple)):
+        a_ = _jnp().asarray(_onp.asarray(a_))
     p_ = p._data if isinstance(p, NDArray) else p
+    if isinstance(p_, (list, tuple)):
+        p_ = _onp.asarray(p_)
     data = _jr().choice(_rng.next_key(), a_, _size(size), replace=replace, p=p_)
     return _place(data, ctx or device or current_context())
 
@@ -140,8 +155,9 @@ def poisson(lam=1.0, size=None, ctx=None, device=None):
 def multinomial(n, pvals, size=None):
     pv = pvals._data if isinstance(pvals, NDArray) else _jnp().asarray(pvals)
     shape = _size(size)
+    # jax.random.multinomial wants the FULL result shape incl. categories
     counts = _jr().multinomial(_rng.next_key(), n, pv,
-                               shape=shape + pv.shape[:-1] if shape else None)
+                               shape=shape + pv.shape if shape else None)
     return NDArray(counts)
 
 
@@ -213,7 +229,7 @@ def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None,  # pyl
     mean_ = mean._data if isinstance(mean, NDArray) else _jnp().asarray(mean)
     cov_ = cov._data if isinstance(cov, NDArray) else _jnp().asarray(cov)
     data = _jr().multivariate_normal(_rng.next_key(), mean_, cov_,
-                                     _size(size))
+                                     _size(size) or None)
     return _place(data, ctx or device or current_context())
 
 
