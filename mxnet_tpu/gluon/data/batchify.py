@@ -1,0 +1,130 @@
+"""Composable batchify functions for DataLoader (reference
+``python/mxnet/gluon/data/batchify.py``): ``Stack`` (dense stacking),
+``Pad`` (ragged samples padded to the longest then stacked), ``Append``
+(no batching — each sample kept, optionally expanded), ``Group`` (one
+function per tuple element), ``AsList`` (passthrough nesting).
+
+TPU note: padding happens host-side with numpy (one device transfer for
+the final batch) — the reference issues the same warning when handed
+device NDArrays sample-by-sample.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as _onp
+
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["Stack", "Pad", "Append", "Group", "AsList"]
+
+
+def _to_host(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _onp.asarray(x)
+
+
+class Stack:
+    """Stack samples along a new batch axis (reference batchify.Stack)."""
+
+    def __call__(self, data):
+        return NDArray(_onp.stack([_to_host(d) for d in data]))
+
+    def __repr__(self):
+        return "Stack()"
+
+
+class Pad:
+    """Pad ragged samples to the longest along each axis with ``val``,
+    then stack; ``round_to`` rounds the padded length up to a multiple
+    (static-shape friendliness — one compiled bucket per rounded length
+    instead of one per raw length)."""
+
+    def __init__(self, val=None, dtype=None, round_to=None,
+                 use_shared_mem=False):  # pylint: disable=unused-argument
+        self._pad_val = 0 if val is None else val
+        self._dtype = dtype
+        self._round_to = round_to
+        self._warned = False
+
+    def __call__(self, data):
+        if isinstance(data[0], NDArray) and not self._warned:
+            self._warned = True
+            warnings.warn(
+                "Using Pad with NDArrays is discouraged for speed reasons. "
+                "Pad while the data is still a list/numpy array.")
+        if not isinstance(data[0], (NDArray, _onp.ndarray, list)):
+            raise NotImplementedError(
+                "Pad() does not support multiple items, use "
+                "Group(Pad(), Pad(), ...) instead")
+        arrs = [_to_host(d) for d in data]
+        dims = max(a.ndim for a in arrs)
+        arrs = [a.reshape(a.shape + (1,) * (dims - a.ndim)) for a in arrs]
+        max_shape = [max(a.shape[i] for a in arrs) for i in range(dims)]
+        if self._round_to is not None:
+            max_shape = [-(-s // self._round_to) * self._round_to
+                         for s in max_shape]
+        dtype = self._dtype or arrs[0].dtype
+        out = _onp.full((len(arrs),) + tuple(max_shape), self._pad_val,
+                        dtype=dtype)
+        for i, a in enumerate(arrs):
+            out[(i,) + tuple(slice(0, s) for s in a.shape)] = a
+        return NDArray(out)
+
+    def __repr__(self):
+        return f"Pad(val={self._pad_val})"
+
+
+class Append:
+    """Keep samples as a list of arrays (no stacking); ``expand`` adds a
+    leading batch axis of 1 to each (reference batchify.Append)."""
+
+    def __init__(self, expand=True, batch_axis=0, use_shared_mem=False):  # pylint: disable=unused-argument
+        self._expand = expand
+        self._batch_axis = batch_axis
+
+    def __call__(self, data):
+        out = []
+        for d in data:
+            h = _to_host(d)
+            if self._expand:
+                h = _onp.expand_dims(h, self._batch_axis)
+            out.append(NDArray(h))
+        return out
+
+    def __repr__(self):
+        return "Append()"
+
+
+class Group:
+    """Apply one batchify function per element of the sample tuple
+    (reference batchify.Group: ``Group(Stack(), Pad())`` for
+    (data, ragged-label) pairs)."""
+
+    def __init__(self, *fn):
+        if len(fn) == 1 and isinstance(fn[0], (list, tuple)):
+            fn = tuple(fn[0])
+        self._fn = fn
+
+    def __call__(self, data):
+        if len(data[0]) != len(self._fn):
+            raise ValueError(
+                f"the number of attributes in each data sample should "
+                f"contain {len(self._fn)} elements, got {len(data[0])}")
+        return tuple(f(list(items))
+                     for f, items in zip(self._fn, zip(*data)))
+
+    def __repr__(self):
+        return f"Group({', '.join(repr(f) for f in self._fn)})"
+
+
+class AsList:
+    """Return the unchanged list of samples (reference batchify.AsList,
+    for string fields and other non-tensor payloads)."""
+
+    def __call__(self, data):
+        return list(data)
+
+    def __repr__(self):
+        return "AsList()"

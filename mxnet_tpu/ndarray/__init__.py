@@ -30,17 +30,18 @@ ndarray = NDArray
 
 
 def __getattr__(name):
+    import importlib
+
     # lazy: nd.contrib pulls in the quantization/detection modules, which
     # must not load during core-array import
     if name == "contrib":
-        import importlib
-
         mod = importlib.import_module(".contrib", __name__)
         globals()["contrib"] = mod
         return mod
     if name == "random":
-        from ..numpy import random as mod
-
+        # the LEGACY sampler signatures (shape=, float32, index-sampling
+        # multinomial) — mx.np.random keeps numpy semantics
+        mod = importlib.import_module(".random", __name__)
         globals()["random"] = mod
         return mod
     if name in ("np", "npx"):

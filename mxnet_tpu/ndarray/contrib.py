@@ -87,6 +87,96 @@ def _bilinear_resize2d(data, height=None, width=None, scale_height=None,
 BilinearResize2D = _bilinear_resize2d
 bilinear_resize_2d = _bilinear_resize2d
 
+from ..ops.control_flow import foreach  # noqa: F401
+
+
+def _pred_value(x):
+    from .ndarray import NDArray
+
+    return bool(x.asnumpy().item()) if isinstance(x, NDArray) else bool(x)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):  # pylint: disable=redefined-outer-name
+    """Eager reference contract (``ndarray/contrib.py:233``): ``func``
+    returns ``(step_outputs, new_loop_vars)``; the result is
+    ``(outputs stacked over the steps actually run, final loop_vars)``.
+    The compiled fixed-shape variant lives at ``npx.while_loop``."""
+    from .ndarray import NDArray
+
+    multi = isinstance(loop_vars, (list, tuple))
+    vars_ = list(loop_vars) if multi else [loop_vars]
+    outputs = None
+    steps = 0
+    while (max_iterations is None or steps < max_iterations) \
+            and _pred_value(cond(*vars_)):
+        out, new_vars = func(*vars_)
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        if outputs is None:
+            outputs = [[] for _ in out]
+        for buf, o in zip(outputs, out):
+            buf.append(o)
+        new_vars = list(new_vars) if isinstance(new_vars, (list, tuple)) \
+            else [new_vars]
+        vars_ = new_vars
+        steps += 1
+    if outputs is None:
+        raise ValueError("while_loop ran zero steps: nothing to stack")
+    import numpy as onp
+    stacked = [NDArray(onp.stack([o.asnumpy() for o in buf]))
+               for buf in outputs]
+    return stacked, (vars_ if multi else vars_[0])
+
+
+def cond(pred, then_func, else_func):
+    """Eager reference contract (``ndarray/contrib.py:401``): the branch
+    functions take no arguments (closures). The compiled variant is
+    ``npx.cond``."""
+    return then_func() if _pred_value(pred) else else_func()
+
+
+def isnan(data):
+    """Contrib spelling of the predicate (reference contrib.py)."""
+    from .. import numpy as mnp
+
+    return mnp.isnan(data)
+
+
+def isinf(data):
+    from .. import numpy as mnp
+
+    return mnp.isinf(data)
+
+
+def isfinite(data):
+    from .. import numpy as mnp
+
+    return mnp.isfinite(data)
+
+
+def rand_zipfian(true_classes, num_sampled, range_max, ctx=None):
+    """Log-uniform (Zipfian) candidate sampler: P(k) = (log(k+2) -
+    log(k+1)) / log(range_max+1); returns (samples int64,
+    expected_count_true, expected_count_sample) like the reference
+    (``ndarray/contrib.py rand_zipfian``)."""
+    import math
+
+    from . import random as legacy_random
+    from .ndarray import NDArray
+
+    log_range = math.log(range_max + 1)
+    rand = legacy_random.uniform(0, log_range, shape=(num_sampled,),
+                                 dtype="float64", ctx=ctx)
+    sampled = (rand.exp() - 1).astype("int64") % range_max
+
+    true_cls = true_classes.astype("float64")
+    exp_true = ((true_cls + 2.0) / (true_cls + 1.0)).log() \
+        / log_range * num_sampled
+    sampled_f = sampled.astype("float64")
+    exp_sampled = ((sampled_f + 2.0) / (sampled_f + 1.0)).log() \
+        / log_range * num_sampled
+    return sampled, exp_true, exp_sampled
+
+
 __all__ = [
     "quantize", "dequantize", "requantize", "box_nms", "multibox_prior",
     "multibox_target", "multibox_detection", "roi_align", "roi_pooling",
@@ -96,5 +186,6 @@ __all__ = [
     "MultiBoxTarget", "MultiBoxDetection", "ROIAlign", "ROIPooling",
     "DeformableConvolution", "Correlation", "SpatialTransformer",
     "BilinearResize2D", "bilinear_resize_2d", "AdaptiveAvgPooling2D",
-    "adaptive_avg_pooling2d",
+    "adaptive_avg_pooling2d", "foreach", "while_loop", "cond",
+    "isnan", "isinf", "isfinite", "rand_zipfian",
 ]

@@ -633,6 +633,13 @@ class NDArray:
     def __itruediv__(self, o):
         return self._inplace(o, _jnp().true_divide, "true_divide")
 
+    # py2-era spellings the reference still defines on NDArray
+    def __div__(self, o):
+        return self.__truediv__(o)
+
+    def __rdiv__(self, o):
+        return self.__rtruediv__(o)
+
     def __imod__(self, o):
         return self._inplace(o, _jnp().mod, "mod")
 
@@ -742,6 +749,26 @@ class NDArray:
         res = src.reshape((-1,))
         res._view_parent = None  # numpy .flatten() contract is a copy
         return res
+
+    def __getattr__(self, name):
+        """Reference codegen parity: the registry's op surface is exposed
+        as bound NDArray methods (``x.exp()``, ``x.log_softmax()``,
+        ``x.topk()`` — reference ``ndarray/register.py`` synthesizes these
+        from the C op registry at import).  Resolution goes through the
+        same table serving ``mx.nd.*``/``mx.sym.*``."""
+        if name.startswith("_"):
+            raise AttributeError(name)
+        from ..ops import legacy
+        try:
+            fn = legacy.resolve(name)
+        except AttributeError:
+            raise AttributeError(
+                f"'NDArray' object has no attribute {name!r}") from None
+        if not callable(fn):
+            raise AttributeError(
+                f"'NDArray' object has no attribute {name!r}")
+        import functools
+        return functools.partial(fn, self)
 
     def nonzero(self):
         """Indices of nonzero elements, one array per dimension (numpy

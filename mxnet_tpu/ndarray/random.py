@@ -1,0 +1,192 @@
+"""Legacy ``mx.nd.random``: the reference-era sampler signatures
+(``shape=`` kwarg, float32 defaults) over the shared RNG stream.
+
+Reference: ``python/mxnet/ndarray/random.py`` — every sampler takes
+``shape`` (not numpy's ``size``), returns float32 by default, and
+``multinomial`` SAMPLES INDEX VALUES from rows of a probability array
+(unlike ``np.random.multinomial``'s draw-count semantics).  The numpy
+namespace keeps numpy semantics in :mod:`mxnet_tpu.numpy.random`; this
+module exists so reference legacy scripts run unchanged.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .. import random as _rng
+from ..device import current_context
+from .ndarray import NDArray
+
+
+def _jr():
+    import jax.random as jr
+
+    return jr
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _place(data, ctx, out=None):
+    import jax
+
+    dev = (ctx or current_context()).jax_device()
+    res = NDArray(jax.device_put(data, dev))
+    if out is not None:
+        out._set_data_internal(res._data)
+        return out
+    return res
+
+
+def _params(shape, *params):
+    """Legacy NDArray-parameter semantics (reference sample_* ops): the
+    result shape is ``broadcast(param shapes) + shape`` and each param
+    broadcasts over the trailing per-param draw axes."""
+    ps = [p._data if isinstance(p, NDArray) else p for p in params]
+    pshapes = [tuple(getattr(p, "shape", ())) for p in ps]
+    batch = _onp.broadcast_shapes(*pshapes) if any(pshapes) else ()
+    tail = _shape(shape)
+    expanded = [
+        p.reshape(tuple(p.shape) + (1,) * len(tail))
+        if hasattr(p, "shape") and p.shape else p
+        for p in ps
+    ]
+    return tuple(batch) + tail, expanded
+
+
+def uniform(low=0, high=1, shape=None, dtype=None, ctx=None, out=None,
+            **kwargs):
+    dtype = _onp.dtype(dtype or _onp.float32)
+    total, (lo, hi) = _params(shape, low, high)
+    std = _jr().uniform(_rng.next_key(), total, dtype)
+    return _place(lo + std * (hi - lo), ctx, out)
+
+
+def normal(loc=0, scale=1, shape=None, dtype=None, ctx=None, out=None,
+           **kwargs):
+    dtype = _onp.dtype(dtype or _onp.float32)
+    total, (loc_, scale_) = _params(shape, loc, scale)
+    std = _jr().normal(_rng.next_key(), total, dtype)
+    return _place(loc_ + std * scale_, ctx, out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None, out=None,
+          **kwargs):
+    return normal(loc, scale, shape or None, dtype, ctx, out)
+
+
+def poisson(lam=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    dtype = _onp.dtype(dtype or _onp.float32)
+    total, (lam_,) = _params(shape, lam)
+    # jax implements poisson only for threefry keys; derive one from the
+    # active stream (mxnet_tpu.random.as_threefry)
+    data = _jr().poisson(_rng.as_threefry(_rng.next_key()), lam_,
+                         total).astype(dtype)
+    return _place(data, ctx, out)
+
+
+def exponential(scale=1, shape=None, dtype=None, ctx=None, out=None,
+                **kwargs):
+    dtype = _onp.dtype(dtype or _onp.float32)
+    total, (scale_,) = _params(shape, scale)
+    data = _jr().exponential(_rng.next_key(), total, dtype) * scale_
+    return _place(data, ctx, out)
+
+
+def gamma(alpha=1, beta=1, shape=None, dtype=None, ctx=None, out=None,
+          **kwargs):
+    dtype = _onp.dtype(dtype or _onp.float32)
+    total, (alpha_, beta_) = _params(shape, alpha, beta)
+    data = _jr().gamma(_rng.next_key(),
+                       _jnp().broadcast_to(alpha_, total), total,
+                       dtype) * beta_
+    return _place(data, ctx, out)
+
+
+def negative_binomial(k=1, p=1, shape=None, dtype=None, ctx=None, out=None,
+                      **kwargs):
+    """Counts of failures before ``k`` successes (success prob ``p``):
+    gamma-poisson mixture (reference ``sample_negative_binomial``)."""
+    import jax
+
+    dtype = _onp.dtype(dtype or _onp.float32)
+    total, (k_, p_) = _params(shape, k, p)
+    k1, k2 = jax.random.split(_rng.next_key())
+    rate = _jr().gamma(k1, _jnp().broadcast_to(_jnp().asarray(k_, float),
+                                               total), total) \
+        * (1.0 - p_) / p_
+    data = _jr().poisson(_rng.as_threefry(k2), rate).astype(dtype)
+    return _place(data, ctx, out)
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=None, dtype=None,
+                                  ctx=None, out=None, **kwargs):
+    """Mean/dispersion parameterization (reference
+    ``sample_generalized_negative_binomial``)."""
+    import jax
+
+    dtype = _onp.dtype(dtype or _onp.float32)
+    total, (mu_, alpha_) = _params(shape, mu, alpha)
+    k1, k2 = jax.random.split(_rng.next_key())
+    r = 1.0 / alpha_
+    rate = _jr().gamma(k1, _jnp().broadcast_to(_jnp().asarray(r, float),
+                                               total), total) \
+        * (mu_ * alpha_)
+    data = _jr().poisson(_rng.as_threefry(k2), rate).astype(dtype)
+    return _place(data, ctx, out)
+
+
+def multinomial(data, shape=None, get_prob=False, replace=True,
+                dtype="int32", **kwargs):
+    """Sample category INDICES from probability rows — the legacy
+    semantics (reference ndarray/random.py ``multinomial``), not
+    numpy's draw-count histogram."""
+    probs = data._data if isinstance(data, NDArray) else _jnp().asarray(data)
+    n = int(_onp.prod(_shape(shape))) if shape is not None else 1
+    logits = _jnp().log(_jnp().clip(probs, 1e-38, None))
+    draws = _jr().categorical(_rng.next_key(), logits, axis=-1,
+                              shape=(n,) + probs.shape[:-1])
+    if probs.ndim == 1:
+        out_shape = _shape(shape) if shape is not None else ()
+        draws = draws.reshape(out_shape)
+    else:
+        draws = _jnp().moveaxis(draws, 0, -1)
+        out_shape = probs.shape[:-1] + (_shape(shape) if shape is not None
+                                        else ())
+        draws = draws.reshape(out_shape)
+    draws = draws.astype(_onp.dtype(dtype))
+    if get_prob:
+        logp = _jnp().take_along_axis(
+            logits, draws.astype(_onp.int64).reshape(
+                probs.shape[:-1] + (-1,)), axis=-1).reshape(draws.shape)
+        return [NDArray(draws), NDArray(logp)]
+    return NDArray(draws)
+
+
+def randint(low, high=None, shape=None, dtype=None, ctx=None, out=None,
+            **kwargs):
+    if high is None:
+        low, high = 0, low
+    dtype = _onp.dtype(dtype or _onp.int32)
+    data = _jr().randint(_rng.next_key(), _shape(shape), low, high,
+                         dtype=dtype)
+    return _place(data, ctx, out)
+
+
+def shuffle(data, **kwargs):
+    d = data._data if isinstance(data, NDArray) else _jnp().asarray(data)
+    return NDArray(_jr().permutation(_rng.next_key(), d, axis=0))
+
+
+def seed(seed_state, ctx="all"):  # pylint: disable=unused-argument
+    _rng.seed(seed_state)

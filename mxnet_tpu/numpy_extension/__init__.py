@@ -27,7 +27,14 @@ from ..ops.spatial import (  # noqa: F401
     grid_generator,
     spatial_transformer,
 )
-from ..util import is_np_array, is_np_shape, set_np, reset_np  # noqa: F401
+from ..util import (  # noqa: F401
+    is_np_array,
+    is_np_default_dtype,
+    is_np_shape,
+    reset_np,
+    set_np,
+    set_np_default_dtype,
+)
 # device helpers the reference's npx re-exports (numpy_extension/__init__.py
 # pulls in mxnet.context): npx.cpu()/npx.gpu() appear throughout the
 # reference's mx.np docstrings

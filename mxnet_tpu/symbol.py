@@ -36,6 +36,25 @@ def _resolve_op(name):
     return fn
 
 
+# canonical spellings for the shape-rule table (snake_case ops map onto
+# their CamelCase layer twins)
+ALIAS_CANON = {
+    "fully_connected": "FullyConnected",
+    "convolution": "Convolution",
+    "batch_norm": "BatchNorm",
+    "embedding": "Embedding",
+}
+
+
+class _AttrDict(dict):
+    """Symbol attribute store that is BOTH the reference's dict surface
+    (``s.attr['group']`` via AttrScope tests) and its method surface
+    (``s.attr('mood')`` per ``Symbol.attr`` docstring)."""
+
+    def __call__(self, key):
+        return self.get(key)
+
+
 class Symbol:
     """A lazy expression node."""
 
@@ -43,21 +62,37 @@ class Symbol:
         from . import attribute, name as name_mod
 
         self._op = op          # None for variables
-        self._args = args
-        self._kwargs = kwargs or {}
+        # normalize Symbol-valued KEYWORD inputs (the reference idiom
+        # ``sym.FullyConnected(data=x, weight=w, num_hidden=128)``) into
+        # trailing positional args so every graph walk — list_arguments,
+        # eval, tojson — sees one edge list; ``_kw_names`` remembers the
+        # keywords for the op call at replay time
+        kw = dict(kwargs or {})
+        sym_kw = [(k, v) for k, v in kw.items() if isinstance(v, Symbol)]
+        for k, _ in sym_kw:
+            del kw[k]
+        self._args = tuple(args) + tuple(v for _, v in sym_kw)
+        self._kw_names = tuple(k for k, _ in sym_kw)
+        self._kwargs = kw
         hint = op if isinstance(op, str) else "var"
         self.name = name_mod.current().get(name, hint)
-        self.attr = attribute.current().get(attr)
+        self.attr = _AttrDict(attribute.current().get(attr))
 
     # -- graph introspection ---------------------------------------------
-    def list_arguments(self):
+    def _walk_vars(self, pred):
+        """Unique variable names matching ``pred``, graph order; node
+        visits are memoized so shared subexpressions stay linear."""
         out = []
-        seen = set()
+        seen_names = set()
+        seen_nodes = set()
 
         def walk(s):
+            if id(s) in seen_nodes:
+                return
+            seen_nodes.add(id(s))
             if s._op is None:
-                if s.name not in seen:
-                    seen.add(s.name)
+                if s.name not in seen_names and pred(s):
+                    seen_names.add(s.name)
                     out.append(s.name)
                 return
             for a in s._args:
@@ -67,33 +102,354 @@ class Symbol:
         walk(self)
         return out
 
+    def list_arguments(self):
+        return self._walk_vars(lambda s: not s.attr("__aux__"))
+
+    def list_inputs(self):
+        """All input names: arguments then auxiliary states (reference
+        ``Symbol.list_inputs``)."""
+        return self.list_arguments() + self.list_auxiliary_states()
+
+    def list_auxiliary_states(self):
+        """Names of auxiliary-state variables (BatchNorm moving stats —
+        reference ``Symbol.list_auxiliary_states``)."""
+        return self._walk_vars(lambda s: bool(s.attr("__aux__")))
+
+    # -- attribute access (reference Symbol.attr/list_attr/attr_dict) -----
+    def list_attr(self, recursive=False):  # pylint: disable=unused-argument
+        return dict(self.attr)
+
+    def attr_dict(self):
+        """Attributes of every node keyed by name — op params included,
+        stringified, like the reference's recursive attr dump."""
+        out = {}
+        seen = set()
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            merged = {**{k: str(v) for k, v in s._kwargs.items()},
+                      **s.attr}
+            if merged:
+                out[s.name] = merged
+            for a in s._args:
+                if isinstance(a, Symbol):
+                    walk(a)
+
+        walk(self)
+        return out
+
+    # -- composition / output selection ------------------------------------
+    def _substituted(self, mapping):
+        """Rebuild the graph with named variables replaced (compose)."""
+        memo = {}
+
+        def sub(s):
+            if id(s) in memo:
+                return memo[id(s)]
+            if s._op is None:
+                r = mapping.get(s.name, s)
+            elif not any(isinstance(a, Symbol) for a in s._args):
+                r = s
+            else:
+                r = object.__new__(Symbol)
+                r._op = s._op
+                r._args = tuple(sub(a) if isinstance(a, Symbol) else a
+                                for a in s._args)
+                r._kw_names = s._kw_names
+                r._kwargs = dict(s._kwargs)
+                r.name = s.name
+                r.attr = _AttrDict(s.attr)
+            memo[id(s)] = r
+            return r
+
+        return sub(self)
+
+    def __call__(self, *args, **kwargs):
+        """Compose: bind this symbol's free variables to other symbols
+        (reference ``Symbol.__call__``/``_compose``; ``net2(fc3_data=net1)``
+        grafts net1 into net2's ``fc3_data`` input)."""
+        name = kwargs.pop("name", None)
+        mapping = {}
+        if args:
+            arg_names = self.list_arguments()
+            if len(args) > len(arg_names):
+                raise TypeError("compose got more positional inputs than "
+                                "free variables")
+            mapping.update(zip(arg_names, args))
+        mapping.update(kwargs)
+        unknown = set(mapping) - set(self.list_arguments())
+        if unknown:
+            raise ValueError(f"compose: {sorted(unknown)} are not free "
+                             f"variables of this symbol")
+        res = self._substituted(mapping)
+        if res is self:
+            # nothing replaced: return a distinct head so a rename does
+            # not mutate the original (vars and arg-less nodes included)
+            res = object.__new__(Symbol)
+            res._op = self._op
+            res._args = self._args
+            res._kw_names = self._kw_names
+            res._kwargs = dict(self._kwargs)
+            res.name = self.name
+            res.attr = _AttrDict(self.attr)
+        if name is not None:
+            res.name = name
+        return res
+
+    def _compose(self, *args, **kwargs):
+        """In-place compose (reference mutating spelling)."""
+        name = kwargs.pop("name", None)
+        new = self.__call__(*args, **kwargs)
+        self._op, self._args = new._op, new._args
+        self._kwargs, self._kw_names = new._kwargs, new._kw_names
+        if name is not None:
+            self.name = name
+        return None
+
+    def __getitem__(self, index):
+        outs = self._output_syms()
+        if isinstance(index, slice):
+            return Group(outs[index])
+        if isinstance(index, str):
+            names = self.list_outputs()
+            matches = [i for i, n in enumerate(names) if n == index]
+            if not matches:
+                raise ValueError(f"There is no output named {index!r}")
+            if len(matches) > 1:
+                raise ValueError(f"There are multiple outputs named "
+                                 f"{index!r}")
+            index = matches[0]
+        if not isinstance(index, int):
+            raise TypeError(f"Symbol index must be int/str/slice, got "
+                            f"{type(index)}")
+        if index >= len(outs):
+            raise IndexError("index out of range")
+        return outs[index]
+
+    def _output_syms(self):
+        return list(self._args) if self._op == "_group" else [self]
+
+    def __len__(self):
+        return len(self._output_syms())
+
+    def __iter__(self):
+        return iter(self._output_syms())
+
+    def get_inputs(self):
+        """Group of this graph's free variables (reference
+        ``Symbol.get_inputs``)."""
+        seen, nodes, out = set(), set(), []
+
+        def walk(s):
+            if id(s) in nodes:
+                return
+            nodes.add(id(s))
+            if s._op is None:
+                if s.name not in seen:
+                    seen.add(s.name)
+                    out.append(s)
+                return
+            for a in s._args:
+                if isinstance(a, Symbol):
+                    walk(a)
+
+        walk(self)
+        return Group(out)
+
+    def get_internals(self):
+        """Group over every node's output, topo-ordered — the
+        ``net.get_internals()['fc1_output']`` idiom (reference
+        ``Symbol.get_internals``)."""
+        seen, out = set(), []
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for a in s._args:
+                if isinstance(a, Symbol):
+                    walk(a)
+            out.append(s)
+
+        walk(self)
+        return Group(out)
+
+    def get_children(self):
+        """Group of the head node(s)' direct inputs, or None for leaves
+        (reference ``Symbol.get_children``; on a Group the members'
+        children concatenate)."""
+        kids = []
+        for s in self._output_syms():
+            kids.extend(a for a in s._args if isinstance(a, Symbol))
+        if not kids:
+            return None
+        return Group(kids)
+
     def list_outputs(self):
         # derived, not stored: survives tojson/load round-trips (the op
         # name "_group" is what persists)
         if self._op == "_group":
             return [o for a in self._args for o in a.list_outputs()]
+        if self._op is None:
+            return [self.name]  # variables output under their own name
         return [f"{self.name}_output"]
 
+    # elementwise ops through which unknown sibling shapes back-propagate
+    # (the reference's bidirectional nnvm inference, limited to the
+    # same-shape family — enough for ``c = a + b; c.infer_shape(a=...)``)
+    # ops whose operands share ONE shape — safe for sibling backfill;
+    # broadcast_* is deliberately excluded (a (1,3) bias row would be
+    # confidently mis-inferred as the sibling's (2,3))
+    _SAME_SHAPE = frozenset({
+        "add", "subtract", "multiply", "divide", "mod", "power", "maximum",
+        "minimum", "hypot", "elemwise_add", "elemwise_sub", "elemwise_mul",
+        "elemwise_div"})
+    # forward passthrough may still ride broadcast ops (output shape =
+    # the known input's shape is right when the other side broadcasts up)
+    _ELEMWISE = _SAME_SHAPE | frozenset({
+        "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div"})
+
+    def _backfill_shapes(self, shapes):
+        """Give unknown vars the shape of a known sibling in an
+        elementwise op, to a fixpoint."""
+        known = dict(shapes)
+        changed = True
+        while changed:
+            changed = False
+
+            seen = set()
+
+            def walk(s):
+                nonlocal changed
+                if id(s) in seen:
+                    return
+                seen.add(id(s))
+                if s._op in self._SAME_SHAPE:
+                    var_args = [a for a in s._args
+                                if isinstance(a, Symbol) and a._op is None]
+                    got = [known[a.name] for a in var_args
+                           if a.name in known]
+                    if got:
+                        for a in var_args:
+                            if a.name not in known:
+                                known[a.name] = got[0]
+                                changed = True
+                for a in s._args:
+                    if isinstance(a, Symbol):
+                        walk(a)
+
+            walk(self)
+        return known
+
     def infer_shape(self, **shapes):
-        """Infer by tracing with ShapeDtypeStructs (XLA shape inference)."""
+        """Infer by tracing with ShapeDtypeStructs (XLA shape inference).
+        Unknown variables tied to known ones through elementwise ops are
+        back-filled first (see ``_backfill_shapes``)."""
         import jax
         import numpy as onp
 
         names = self.list_arguments()
-        missing = [n for n in names if n not in shapes]
+        from .util import is_np_shape
+        if not is_np_shape() and any(
+                0 in tuple(s) for s in shapes.values()):
+            # legacy shape semantics: 0 = unknown dimension, inference
+            # abstains (reference docstring: "returns None")
+            return (None, None, None)
+        aux_names = self.list_auxiliary_states()
+        if any(n not in shapes for n in names + aux_names):
+            shapes = self._backfill_shapes(shapes)
+            self._infer_missing_arg_shapes(shapes)  # layer param rules
+        all_names = names + aux_names
+        missing = [n for n in all_names if n not in shapes]
         if missing:
-            raise MXNetError(f"infer_shape missing {missing}")
+            # reference contract: underdetermined inference abstains with
+            # the None triple (symbol.py infer_shape, partial=False path)
+            return (None, None, None)
 
         def f(*arrs):
-            return self._eval_with({n: a for n, a in zip(names, arrs)},
+            return self._eval_with({n: a for n, a in zip(all_names, arrs)},
                                    raw=True)
 
         avals = [jax.ShapeDtypeStruct(tuple(shapes[n]), onp.float32)
-                 for n in names]
+                 for n in all_names]
         out = jax.eval_shape(f, *avals)
         outs = out if isinstance(out, (list, tuple)) else [out]
         return ([tuple(shapes[n]) for n in names],
-                [tuple(o.shape) for o in outs], [])
+                [tuple(o.shape) for o in outs],
+                [tuple(shapes[n]) for n in aux_names])
+
+    def infer_shape_partial(self, **shapes):
+        """Partial inference (reference ``infer_shape_partial``): forward
+        layer-param rules fill what they can; unknown arguments come back
+        as ``()``, and outputs propagate through any branch whose shape
+        is known."""
+        res = self.infer_shape(**shapes)
+        if res[0] is not None:
+            return res
+        names = self.list_arguments()
+        aux = self.list_auxiliary_states()
+        filled = dict(shapes)
+        _, outs = self._infer_missing_arg_shapes(filled)
+        return ([tuple(filled.get(n, ())) for n in names],
+                [tuple(o) if o is not None else () for o in outs],
+                [tuple(filled.get(n, ())) for n in aux])
+
+    def infer_type(self, **types):
+        """Type inference via abstract evaluation on unit shapes
+        (reference ``Symbol.infer_type``); unspecified args default
+        float32."""
+        import jax
+        import numpy as onp
+
+        names = self.list_arguments()
+        if types and any(n not in types for n in names):
+            # elementwise siblings share a dtype (the _backfill walk is
+            # value-agnostic); still-unknown args abstain
+            types = self._backfill_shapes(types)
+        if types and any(n not in types for n in names):
+            return (None, None, None)
+
+        aux = self.list_auxiliary_states()
+        all_names = names + aux
+
+        def f_all(*arrs):
+            return self._eval_with(dict(zip(all_names, arrs)), raw=True)
+
+        avals = [jax.ShapeDtypeStruct((1,),
+                                      onp.dtype(types.get(n, onp.float32)))
+                 for n in all_names]
+        in_types = [onp.dtype(types.get(n, onp.float32)).type
+                    for n in names]
+        try:
+            out = jax.eval_shape(f_all, *avals)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            out_types = [onp.dtype(o.dtype).type for o in outs]
+        except Exception:
+            # unit-shape tracing can trip shape-carrying ops (FC/conv);
+            # with a single input dtype, propagation is the identity
+            uniq = set(in_types)
+            if len(uniq) != 1:
+                return (None, None, None)
+            out_types = [next(iter(uniq))] * len(self.list_outputs())
+        return (in_types, out_types,
+                [onp.dtype(types.get(n, onp.float32)).type for n in aux])
+
+    def infer_type_partial(self, **types):
+        """Partial type inference (reference contract: unknown args come
+        back None; outputs take the unique known input dtype)."""
+        import numpy as onp
+
+        names = self.list_arguments()
+        known = {n: onp.dtype(t).type for n, t in types.items()}
+        if all(n in known for n in names):
+            return self.infer_type(**types)
+        uniq = set(known.values())
+        out_t = next(iter(uniq)) if len(uniq) == 1 else None
+        return ([known.get(n) for n in names],
+                [out_t for _ in self.list_outputs()],
+                [out_t for _ in self.list_auxiliary_states()])
 
     # -- evaluation -------------------------------------------------------
     def _eval_with(self, bindings, raw=False, memo=None):
@@ -121,7 +477,11 @@ class Symbol:
                 op = _resolve_op(s._op)
                 wrapped = [NDArray(a) if not isinstance(a, NDArray)
                            else a for a in args]
-                v = op(*wrapped, **s._kwargs)
+                n_kw = len(s._kw_names)
+                pos, kwvals = (wrapped, []) if not n_kw else \
+                    (wrapped[:-n_kw], wrapped[-n_kw:])
+                v = op(*pos, **{**s._kwargs,
+                                **dict(zip(s._kw_names, kwvals))})
             memo[id(s)] = v
             return v
 
@@ -141,12 +501,145 @@ class Symbol:
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write"):
         return Executor(self, ctx, args or {}, args_grad, grad_req)
 
+    # 2.x renamed the executor entry points with a leading underscore
+    # (reference symbol.py ``_bind``/``_simple_bind``); keep both spellings
+    _bind = bind
+
+    def _infer_missing_arg_shapes(self, shapes):
+        """Module-era ``simple_bind`` contract: parameter shapes of the
+        layer ops are derived from the data shapes (the role nnvm's
+        per-op InferShape played; here a small rule table over the
+        auto-input layer ops plus shape-preserving passthrough)."""
+        import numpy as onp
+
+        def record(sym_arg, shp, opname):
+            if not (isinstance(sym_arg, Symbol) and sym_arg._op is None):
+                return
+            shp = tuple(int(x) for x in shp)
+            prev = shapes.get(sym_arg.name)
+            if prev is None:
+                shapes[sym_arg.name] = shp
+            elif tuple(prev) != shp:
+                # reference error contract (infer_shape docstring):
+                # "Error in operator fc1: Shape inconsistent, ..."
+                def fmt(t):
+                    return "(" + ",".join(str(x) for x in t) + ")"
+                raise MXNetError(
+                    f"Error in operator {opname}: Shape inconsistent, "
+                    f"Provided={fmt(prev)}, inferred shape={fmt(shp)}")
+
+        memo = {}
+
+        def shape_of(s):
+            if id(s) in memo:
+                return memo[id(s)]
+            memo[id(s)] = None  # cycle guard
+            if s._op is None:
+                r = shapes.get(s.name)
+            else:
+                ins = [shape_of(a) for a in s._args
+                       if isinstance(a, Symbol)]
+                d = ins[0] if ins else None
+                kw = s._kwargs
+                op = ALIAS_CANON.get(s._op, s._op)
+                r = None
+                if d is not None:
+                    if op == "FullyConnected":
+                        nh = int(kw["num_hidden"])
+                        flat = int(onp.prod(d[1:]))
+                        record(s._args[1], (nh, flat), s.name)
+                        if len(s._args) > 2:
+                            record(s._args[2], (nh,), s.name)
+                        r = (d[0], nh)
+                    elif op == "Convolution":
+                        nf = int(kw["num_filter"])
+                        kshape = tuple(kw.get("kernel", ()))
+                        stride = tuple(kw.get("stride",
+                                              (1,) * len(kshape)))
+                        padding = tuple(kw.get("pad",
+                                               (0,) * len(kshape)))
+                        record(s._args[1], (nf, d[1]) + kshape, s.name)
+                        if len(s._args) > 2:
+                            record(s._args[2], (nf,), s.name)
+                        sp = tuple(
+                            (d[2 + i] + 2 * padding[i] - kshape[i])
+                            // stride[i] + 1
+                            for i in range(len(kshape)))
+                        r = (d[0], nf) + sp
+                    elif op == "BatchNorm":
+                        c = d[int(kw.get("axis", 1))]
+                        for a in s._args[1:]:
+                            record(a, (c,), s.name)
+                        r = d
+                    elif op == "Embedding":
+                        record(s._args[1], (int(kw["input_dim"]),
+                                            int(kw["output_dim"])), s.name)
+                        r = tuple(d) + (int(kw["output_dim"]),)
+                    elif op in ("Flatten", "flatten"):
+                        r = (d[0], int(onp.prod(d[1:])))
+                    elif op in ("Activation", "relu", "sigmoid", "tanh",
+                                "softmax", "log_softmax", "LeakyReLU",
+                                "Dropout", "identity", "negative", "copy"):
+                        r = d
+                if r is None and op in self._ELEMWISE:
+                    # broadcast of the KNOWN inputs (partial graphs: a
+                    # (1,3) bias sibling must not shrink the output)
+                    got = [i for i in ins if i is not None]
+                    if got:
+                        try:
+                            r = tuple(onp.broadcast_shapes(*got))
+                        except ValueError:
+                            r = None
+            memo[id(s)] = r
+            return r
+
+        outs = [shape_of(o) for o in self._output_syms()]
+        return shapes, outs
+
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
         from . import numpy as mnp
 
-        args = {n: mnp.zeros(tuple(shapes[n]))
-                for n in self.list_arguments() if n in shapes}
+        shapes = {k: tuple(v) for k, v in shapes.items()}
+        self._infer_missing_arg_shapes(shapes)
+        names = self.list_arguments() + self.list_auxiliary_states()
+        missing = [n for n in names if n not in shapes]
+        if missing:
+            raise MXNetError(
+                f"simple_bind could not infer shapes for {missing}; "
+                f"pass them explicitly")
+        args = {n: mnp.zeros(tuple(shapes[n])) for n in names}
         return Executor(self, ctx, args, None, grad_req)
+
+    _simple_bind = simple_bind
+
+    def debug_str(self):
+        """Human-readable graph dump (reference ``Symbol.debug_str`` —
+        the exact text layout is this build's own)."""
+        lines = [f"Symbol Outputs:\n\toutput[0]={self.name}(0)"]
+        seen = set()
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for a in s._args:
+                if isinstance(a, Symbol):
+                    walk(a)
+            if s._op is None:
+                lines.append(f"Variable:{s.name}")
+            else:
+                ins = ", ".join(
+                    f"arg[{i}]={a.name}(0)" if isinstance(a, Symbol)
+                    else f"arg[{i}]={a!r}"
+                    for i, a in enumerate(s._args))
+                attrs = "".join(f"\n\t{k}={v}"
+                                for k, v in s._kwargs.items())
+                lines.append("-" * 40 +
+                             f"\nOp:{s._op}, Name={s.name}{attrs}\n"
+                             f"Inputs:\n\t{ins}")
+
+        walk(self)
+        return "\n".join(lines) + "\n"
 
     # -- serialization ----------------------------------------------------
     def tojson(self, fmt="tpu"):
@@ -176,6 +669,10 @@ class Symbol:
                 else {"const": repr(a)} for a in s._args]
             entry["inputs"] = [a["node"] for a in entry["args"]
                                if "node" in a]
+            if s._kw_names:
+                entry["kw_names"] = list(s._kw_names)
+            if s.attr:  # symbol-level attrs (incl. the __aux__ marker)
+                entry["sym_attr"] = dict(s.attr)
             nodes.append(entry)
             memo[id(s)] = len(nodes) - 1
             return memo[id(s)]
@@ -242,14 +739,42 @@ class Symbol:
     def __add__(self, other):
         return self._binop(other, "add")
 
+    def __radd__(self, other):
+        return Symbol("add", (other, self), {})
+
     def __sub__(self, other):
         return self._binop(other, "subtract")
+
+    def __rsub__(self, other):
+        return Symbol("subtract", (other, self), {})
 
     def __mul__(self, other):
         return self._binop(other, "multiply")
 
+    def __rmul__(self, other):
+        return Symbol("multiply", (other, self), {})
+
     def __truediv__(self, other):
         return self._binop(other, "divide")
+
+    def __rtruediv__(self, other):
+        return Symbol("divide", (other, self), {})
+
+    # py2-era spellings the reference still defines (symbol.py __rdiv__)
+    def __div__(self, other):
+        return self._binop(other, "divide")
+
+    def __rdiv__(self, other):
+        return Symbol("divide", (other, self), {})
+
+    def __pow__(self, other):
+        return self._binop(other, "power")
+
+    def __rpow__(self, other):
+        return Symbol("power", (other, self), {})
+
+    def __mod__(self, other):
+        return self._binop(other, "mod")
 
     def __neg__(self):
         return Symbol("negative", (self,), {})
@@ -300,19 +825,68 @@ class Executor:
         if not self.outputs:
             raise MXNetError("run forward(is_train=True) before backward")
         from . import autograd
+        from .ndarray.ndarray import NDArray
 
+        if isinstance(out_grads, NDArray):
+            out_grads = [out_grads]  # one head grad per output
         autograd.backward(self.outputs, head_grads=out_grads)
         for name, arr in self.arg_dict.items():
             if arr.grad is not None:
                 self.grad_dict[name] = arr.grad
 
+    # list views in declaration order (reference Executor surface)
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n]
+                for n in self._symbol.list_arguments()
+                if n in self.arg_dict]
 
-def var(name, shape=None, dtype=None, **kwargs):  # pylint: disable=unused-argument
+    @property
+    def aux_arrays(self):
+        return [self.arg_dict[n]
+                for n in self._symbol.list_auxiliary_states()
+                if n in self.arg_dict]
+
+    @property
+    def aux_dict(self):
+        return {n: self.arg_dict[n]
+                for n in self._symbol.list_auxiliary_states()
+                if n in self.arg_dict}
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+
+def var(name, attr=None, shape=None, dtype=None, **kwargs):  # pylint: disable=unused-argument
     """Create a placeholder variable (``mx.sym.var``/``mx.sym.Variable``)."""
-    return Symbol(None, (), {}, name=name)
+    return Symbol(None, (), {}, name=name, attr=attr)
 
 
 Variable = var
+
+
+def _scalar_or_symbol(op_name, scalar_fn):
+    """Reference ``mx.sym.pow/maximum/minimum/hypot`` semantics: when BOTH
+    operands are python scalars the numeric value is returned, not a
+    Symbol (reference symbol/symbol.py ``pow``:3297 'If both are scalars,
+    returns a scalar')."""
+    def f(base, exp=None, **kwargs):
+        lhs, rhs = base, exp
+        if not isinstance(lhs, Symbol) and not isinstance(rhs, Symbol):
+            return scalar_fn(lhs, rhs)
+        return Symbol(op_name, (lhs, rhs), kwargs)
+
+    f.__name__ = op_name
+    return f
+
+
+pow = _scalar_or_symbol("power", lambda a, b: a ** b)  # noqa: A001
+power = _scalar_or_symbol("power", lambda a, b: a ** b)
+maximum = _scalar_or_symbol("maximum", lambda a, b: a if a > b else b)
+minimum = _scalar_or_symbol("minimum", lambda a, b: a if a < b else b)
+hypot = _scalar_or_symbol("hypot", lambda a, b: (a * a + b * b) ** 0.5)
 
 
 def Group(symbols):  # noqa: N802  (reference spelling)
@@ -327,7 +901,7 @@ def Group(symbols):  # noqa: N802  (reference spelling)
             flat.append(s)
     if not flat:
         raise MXNetError("Group needs at least one symbol")
-    return Symbol("_group", tuple(flat), {})
+    return Symbol("_group", tuple(flat), {}, name="Grouped")
 
 
 # Attr keys the legacy JSON upgrade hides/moves instead of parsing
@@ -394,6 +968,15 @@ def fromjson(text):
         op_sym = Symbol(op, tuple(args), kwargs, name=name)
         if name:
             op_sym.name = name
+        if op in _LAYER_INPUTS:
+            # aux-ness is not serialized in nnvm JSON — it derives from
+            # the op's input slots (reference FListAuxiliaryStates)
+            slots, aux_slots = _LAYER_INPUTS[op]
+            n_main = len(slots)
+            for j, a in enumerate(args[n_main:], start=n_main):
+                if isinstance(a, Symbol) and a._op is None and \
+                        j - n_main < len(aux_slots):
+                    a.attr["__aux__"] = "true"
         built.append(op_sym)
     heads = data.get("heads", [[len(built) - 1, 0, 0]])
     if len(heads) != 1:
@@ -432,21 +1015,68 @@ def load(fname):
     built = []
     for node in data["nodes"]:
         kwargs = {k: literal(v) for k, v in node.get("attrs", {}).items()}
+        sym_attr = node.get("sym_attr")
         if node["op"] == "null":
-            built.append(Symbol(None, (), {}, name=node["name"]))
+            built.append(Symbol(None, (), {}, name=node["name"],
+                                attr=sym_attr))
             continue
         args = tuple(
             built[a["node"]] if "node" in a else literal(a["const"])
             for a in node.get("args",
                               [{"node": i} for i in node["inputs"]]))
-        built.append(Symbol(node["op"], args, kwargs, name=node["name"]))
+        kw_names = node.get("kw_names", [])
+        if kw_names:  # trailing args were keyword inputs; __init__
+            n = len(kw_names)  # re-normalizes them
+            kwargs.update(zip(kw_names, args[-n:]))
+            args = args[:-n]
+        built.append(Symbol(node["op"], args, kwargs, name=node["name"],
+                            attr=sym_attr))
     return built[-1]
+
+
+# tensor-input slots of the layer ops, in positional order (reference op
+# registry FListInputNames); missing ones are auto-created as variables
+# named ``<opname>_<slot>`` — the reference behavior compose and
+# simple_bind rely on.  Slots after "|" are auxiliary states.
+_LAYER_INPUTS = {
+    "FullyConnected": (("data", "weight", "bias"), ()),
+    "Convolution": (("data", "weight", "bias"), ()),
+    "Deconvolution": (("data", "weight", "bias"), ()),
+    "Embedding": (("data", "weight"), ()),
+    "BatchNorm": (("data", "gamma", "beta"),
+                  ("moving_mean", "moving_var")),
+}
+
+
+def _auto_input_vars(op_name, resolved_name, args, kwargs):
+    """Fill missing tensor inputs with auto-named variables."""
+    slots, aux_slots = _LAYER_INPUTS[op_name]
+    no_bias = str(kwargs.get("no_bias", False)).lower() in ("true", "1")
+    use = [s for s in slots if not (s == "bias" and no_bias)]
+    all_slots = use + list(aux_slots)
+    filled = list(args)
+    for i, slot in enumerate(all_slots):
+        if i < len(args):
+            continue  # given positionally
+        if slot in kwargs:
+            filled.append(kwargs.pop(slot))
+            continue
+        v = Symbol(None, (), {}, name=f"{resolved_name}_{slot}")
+        if slot in aux_slots:
+            v.attr["__aux__"] = "true"
+        filled.append(v)
+    return tuple(filled), kwargs
 
 
 def _make_op(op_name, doc=None):
     def op_fn(*args, **kwargs):
         name = kwargs.pop("name", None)  # None -> NameManager auto-naming
         attr = kwargs.pop("attr", None)
+        if op_name in _LAYER_INPUTS:
+            from . import name as name_mod
+            resolved = name_mod.current().get(name, op_name.lower())
+            args, kwargs = _auto_input_vars(op_name, resolved, args, kwargs)
+            return Symbol(op_name, args, kwargs, name=resolved, attr=attr)
         return Symbol(op_name, args, kwargs, name=name, attr=attr)
 
     op_fn.__name__ = op_name

@@ -81,6 +81,10 @@ _STRIP = [
     re.compile(r"\b\d+-D\b"),
     # numpy includes shape=(...) in empty-array reprs; jax does not
     re.compile(r"shape=\([^)]*\)"),
+    # auto-naming counters ('fc3'/'add12'/'_plus4_output') reflect the
+    # doc author's session, not semantics — strip digits that follow an
+    # identifier of 2+ letters (never '1e5' exponents)
+    re.compile(r"(?<=[a-z_][a-z_])\d+(?=_|\b)"),
 ]
 _NUM = re.compile(r"-?(?:inf\b|nan\b|\d+\.?\d*(?:e[+-]?\d+)?|\.\d+(?:e[+-]?\d+)?)"
                   r"|\bTrue\b|\bFalse\b",
@@ -112,10 +116,17 @@ def _numbers(s):
 def _norm_text(s):
     s = s.replace("mxnet_tpu", "mxnet")
     s = s.replace("<type '", "<class '")  # py2-era reference docstrings
+    # type lists printed bare in reference docs ([numpy.float32, None])
+    s = re.sub(r"<class 'numpy\.(\w+)'>", r"numpy.\1", s)
     # mxnet.context is an alias module of mxnet.device in this build
     s = s.replace("mxnet.device.", "mxnet.context.")
     # scipy privatized its submodules after the reference was written
     s = re.sub(r"scipy\.sparse\._(\w+)\.", r"scipy.sparse.\1.", s)
+    # auto-name stems for the arithmetic dunders differ (_plus vs add)
+    for ref, ours in (("_plus", "add"), ("_minus", "subtract"),
+                      ("_mul", "multiply"), ("_div", "divide"),
+                      ("_power", "power")):
+        s = s.replace(ref, ours)
     for rx in _STRIP:
         s = rx.sub(" ", s)
     return " ".join(s.split())
@@ -233,6 +244,13 @@ def run_example(source, want, globs):
     except Exception as e:  # noqa: BLE001 - doctest semantics
         if expect_raise:
             return
+        # several reference docstrings document errors informally (the
+        # message text without a Traceback); only a want that TALKS about
+        # an error qualifies, and its numbers must match the message
+        wn = _numbers(want)
+        if wn and re.search(r"[Ee]rror|[Ee]xception|[Ii]nconsistent",
+                            want) and wn == _numbers(str(e)):
+            return
         raise ExampleFailure(
             f"example raised {type(e).__name__}: {e}\n  source: {source!r}")
     if expect_raise:
@@ -263,6 +281,10 @@ def run_example(source, want, globs):
                 raise ExampleFailure(
                     f"shape mismatch\n  source: {source!r}\n"
                     f"  want: {shp}\n  got:  {got_shape}")
+        return
+    if not want_nums and not _norm_text(want).strip("[](), "):
+        # repr scaffolding only (e.g. ``[<NDArray 2x3 @cpu(0)>]`` — a
+        # list of arrays with no pinned values)
         return
     if want_nums:
         got_nums = _numbers(got)
@@ -307,9 +329,11 @@ def run_block(examples, globs, skip_idx=()):
 def reset_mode(legacy=False):
     """Restore the np-semantics switches a docstring example may have
     flipped (``npx.set_np(dtype=True)`` in the reference arange block
-    would otherwise leak float64 defaults into every later block)."""
+    would otherwise leak float64 defaults into every later block).
+    Legacy files also clear np_shape so 0-dim conventions (0 = unknown
+    in infer_shape) read as the reference-era flags."""
     import mxnet_tpu as mx
-    mx.util.set_np(shape=True, array=not legacy, dtype=False)
+    mx.util.set_np(shape=not legacy, array=not legacy, dtype=False)
 
 
 def default_globs():

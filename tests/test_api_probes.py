@@ -223,7 +223,10 @@ def test_sym_resolved_op_metadata_and_star_import_fresh_process():
         "ns = {}\n"
         "exec('from mxnet_tpu.symbol import *', ns)\n"
         "s = ns['FullyConnected'](ns['var']('x'), num_hidden=4)\n"
-        "assert s.list_arguments() == ['x']\n"
+        # reference contract: missing layer params auto-create variables
+        # (symbol/register.py behavior compose and simple_bind rely on)
+        "assert s.list_arguments() == "
+        "['x', 'fullyconnected0_weight', 'fullyconnected0_bias']\n"
         "print('SYM_DIR_OK')\n")
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, timeout=300)
