@@ -52,44 +52,20 @@ profiler.device_op_table):
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
 
-# per-chip peaks by jax device_kind prefix:
-# (bf16 MXU flops/s, HBM bytes/s, ICI GB/s per link-direction pair)
-# longest-prefix entries first where prefixes overlap ("TPU v5 lite" before
-# "TPU v5") — _chip_peak matches in declaration order.
-_CHIP_PEAKS = {
-    "TPU v4": (275e12, 1228e9, 100e9),
-    "TPU v5 lite": (197e12, 819e9, 100e9),
-    "TPU v5p": (459e12, 2765e9, 200e9),
-    "TPU v5e": (197e12, 819e9, 100e9),
-    "TPU v5": (459e12, 2765e9, 200e9),
-    "TPU v6 lite": (918e12, 1640e9, 200e9),
-    "TPU v6e": (918e12, 1640e9, 200e9),
-}
-
-
-def _chip_peak(what):
-    """Peak for the local chip: what = 'flops' | 'hbm' | 'ici'."""
-    import jax
-
-    kind = jax.devices()[0].device_kind
-    for k, v in _CHIP_PEAKS.items():
-        if kind.startswith(k):
-            return v[{"flops": 0, "hbm": 1, "ici": 2}[what]]
-    return None
+# chip peaks + MFU accounting live in the telemetry subsystem
+# (mxnet_tpu/profiler/metrics.py) since the telemetry PR; these are the
+# bench-local spellings older rows referenced.
+from mxnet_tpu.profiler.metrics import (  # noqa: E402
+    TrainingMetrics,
+    chip_peak as _chip_peak,
+    peak_flops as _peak_flops,
+)
 
 BASE_INFER_IMG_S = 1076.81   # V100 fp32 bs32 inference, perf.md:193
 BASE_TRAIN_IMG_S = 363.69    # V100 fp32 bs128 training, perf.md:254
-
-
-def _peak_flops():
-    env = os.environ.get("MXNET_TPU_PEAK_FLOPS")
-    if env:
-        return float(env)
-    return _chip_peak("flops")
 
 
 def _emit(row):
@@ -164,6 +140,19 @@ def _dispatch_meta():
     if rtt is not None:
         meta["weather_dominated"] = bool(rtt > WEATHER_RTT_THRESHOLD_MS)
     return meta
+
+
+def _memory_meta():
+    """Allocator peak SINCE PROCESS START (jax memory_stats never resets),
+    from the telemetry subsystem — an upper bound on the row's footprint,
+    named accordingly; empty on backends that don't report (CPU)."""
+    from mxnet_tpu.profiler.metrics import process_peak_bytes_in_use
+
+    try:
+        peak = process_peak_bytes_in_use()
+    except Exception:
+        peak = 0
+    return {"process_peak_hbm_gb": round(peak / 2**30, 2)} if peak else {}
 
 
 def _measure_rtt_ms():
@@ -498,11 +487,16 @@ def _train_bench(net, loss_fn, optimizer, opt_params, data, labels,
     # guarantees compilation + execution happened before the timed loops
     fetch(step())
     dt = _timed_diff(step, fetch, k1, k2)
-    peak = _peak_flops()
-    # step_flops is per-step; a fused window executes `fuse` steps per dt
+    # MFU accounting via the telemetry subsystem: feed every timing sample
+    # into a TrainingMetrics (median step time x XLA-counted FLOPs against
+    # the chip peak) so BENCH rows and profiler.step_marker agree by
+    # construction. step_flops is per-step; a fused window executes
+    # `fuse` steps per dt.
     flops = (trainer.step_flops or 0) * (fuse or 1)
-    mfu = (flops / dt / peak) if (peak and flops) else None
-    return dt, mfu, trainer
+    tm = TrainingMetrics(flops_per_step=flops or None)
+    for d in (_LAST_SAMPLES or [dt]):
+        tm.record_step(d)
+    return dt, tm.mfu, trainer
 
 
 def _roofline(trainer):
@@ -575,6 +569,7 @@ def bench_resnet_train(dtype=None):
         "mfu": round(mfu, 4) if mfu else None,
         "cost_analysis_mfu_floor": _roofline(trainer),
         **_dispatch_meta(),
+        **_memory_meta(),
         **_spread(invert_for=BATCH),
     })
 
@@ -612,6 +607,7 @@ def bench_resnet_train_fused(n_fuse=8):
                    "saturation (exp/conv_chain_probe.json; the r3 "
                    "roofline_mfu_bound read cost-analysis bytes that "
                    "double-count convs)",
+        **_memory_meta(),
         **_spread(invert_for=n_fuse * BATCH),
     })
 
@@ -686,6 +682,7 @@ def bench_bert_train():
         "vs_mfu_target": round(mfu / 0.5, 3) if mfu else None,
         "mfu": round(mfu, 4) if mfu else None,
         **_dispatch_meta(),
+        **_memory_meta(),
         **_spread(invert_for=BATCH),
     })
 
@@ -708,6 +705,7 @@ def bench_bert_train_fused(n_fuse=8):
         "vs_baseline": None,
         "vs_mfu_target": round(mfu / 0.5, 3) if mfu else None,
         "mfu": round(mfu, 4) if mfu else None,
+        **_memory_meta(),
         **_spread(invert_for=n_fuse * BATCH),
     })
 
@@ -785,11 +783,16 @@ def bench_llama_long_seq(n_fuse=4, seq=2048, batch=4):
                 raise RuntimeError(
                     f"attention path assertion failed: arm {arm!r} "
                     f"traced {got!r}, wanted {want!r}")
-            # dt is per DISPATCH = n_fuse steps; flops is per step
+            # dt is per DISPATCH = n_fuse steps; flops is per step.
+            # tokens/s + MFU via the telemetry subsystem's accounting.
+            tm = TrainingMetrics(flops_per_step=n_fuse * flops,
+                                 tokens_per_step=n_fuse * batch * seq,
+                                 peak_flops=peak)
+            for d in (_LAST_SAMPLES or [dt]):
+                tm.record_step(d)
             arms[arm] = {
-                "tokens_s": round(n_fuse * batch * seq / dt, 1),
-                "mfu": round(n_fuse * flops / dt / peak, 4)
-                if peak else None,
+                "tokens_s": round(tm.tokens_per_sec, 1),
+                "mfu": round(tm.mfu, 4) if tm.mfu else None,
                 **_spread(invert_for=n_fuse * batch * seq),
             }
         finally:

@@ -81,7 +81,7 @@ flops = trainer.step_flops or 0
 print(f"bert bs{BATCH}: {dt*1e3:.2f} ms {BATCH/dt:.0f} samp/s "
       f"MFU {flops/dt/197e12:.3f} counted {flops/1e9:.0f} GF/step")
 
-profiler.set_config(filename="/tmp/bert_prof.json")
+profiler.set_config(filename="/tmp/bert_prof.json", profile_xla=True)
 profiler.set_state("run")
 for _ in range(3):
     loss = trainer.step(data, labels)

@@ -44,7 +44,7 @@ def run(params, x, m):
 
 with autograd.predict_mode():
     onp.asarray(run(params, x, 16))
-    profiler.set_config(filename="/tmp/int8_prof.json")
+    profiler.set_config(filename="/tmp/int8_prof.json", profile_xla=True)
     profiler.set_state("run")
     onp.asarray(run(params, x, 16))
     profiler.set_state("stop")

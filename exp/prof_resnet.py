@@ -28,7 +28,7 @@ y = jnp.array(onp.random.randint(0, 1000, (BATCH,)), dtype=jnp.int32)
 p, m, l = compiled(params, mom, x, y)
 float(l)
 
-profiler.set_config(filename="/tmp/rn_prof.json")
+profiler.set_config(filename="/tmp/rn_prof.json", profile_xla=True)
 profiler.set_state("run")
 for _ in range(3):
     p, m, l = compiled(p, m, x, y)

@@ -30,17 +30,28 @@ aux-state mutation semantics without mutation inside the compiled graph.
 from __future__ import annotations
 
 import threading
+import time
+import warnings
 from typing import List, Sequence
 
 from . import autograd
 from . import random as _rng
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, _slot_of, _tracked
+from .profiler import core as _prof
 
 _trace_state = threading.local()
 
 # sentinel marking a traced (array) position in a CachedOp call signature
 _TRACED = object()
+
+
+def _sig_limit():
+    # read per miss, not cached: a build is orders slower than an env read,
+    # and tests tune the threshold via the env var
+    from . import config
+
+    return config.get("MXNET_CACHEDOP_SIG_LIMIT")
 
 
 def _wrap_data(d):
@@ -91,13 +102,51 @@ class CachedOp:
         self.static_shape = static_shape
         self._cache = {}
         self._bwd_cache = {}
+        # telemetry (always maintained — int increments on an already-
+        # expensive path): per-instance cache traffic + compile wall time
+        self._hits = 0
+        self._misses = 0
+        self._compile_ns = 0
+        self._storm_warned = False
+
+    def cache_stats(self):
+        """Signature-cache telemetry: hits/misses/signatures/compile time."""
+        return {"hits": self._hits, "misses": self._misses,
+                "signatures": len(self._cache),
+                "compile_ms": self._compile_ns / 1e6}
 
     # -- helpers ----------------------------------------------------------
     def _lookup_or_build(self, key, grad_mode, args_tracked, static_args):
         entry = self._cache.get(key)
-        if entry is None:
-            entry = self._build(key, grad_mode, args_tracked, static_args)
-            self._cache[key] = entry
+        if entry is not None:
+            self._hits += 1
+            return entry
+        self._misses += 1
+        t0 = time.perf_counter_ns()
+        entry = self._build(key, grad_mode, args_tracked, static_args)
+        self._cache[key] = entry
+        t1 = time.perf_counter_ns()
+        self._compile_ns += t1 - t0
+        nsig = len(self._cache)
+        blk = type(self.block).__name__
+        if _prof.ENABLED:
+            _prof.record_duration(f"CachedOp::compile({blk})", "cachedop",
+                                  t0, t1,
+                                  args={"signatures": nsig,
+                                        "grad_mode": bool(grad_mode)})
+            _prof.incr_counter("cachedop.compiles", cat="cachedop")
+        limit = _sig_limit()
+        if nsig > limit and not self._storm_warned:
+            # recompile storm: something varies per call (shapes, dtypes,
+            # unhashable static args) and defeats the executable cache —
+            # the silent perf failure this counter exists to surface
+            self._storm_warned = True
+            _prof.incr_counter("cachedop.recompile_storms", cat="cachedop")
+            warnings.warn(
+                f"CachedOp({blk}) compiled {nsig} distinct signatures "
+                f"(> MXNET_CACHEDOP_SIG_LIMIT={limit}); likely a recompile "
+                "storm — per-call varying shapes, dtypes or static args "
+                "defeat the executable cache", RuntimeWarning, stacklevel=4)
         return entry
 
     def _write_back_state(self, state_params, new_states):
@@ -272,8 +321,15 @@ class CachedOp:
         tp_datas, st_datas = self._read_param_datas(entry)
         rng_key = _rng.next_key()
 
+        t0 = _prof.begin() if _prof.ENABLED else 0
         out_datas, new_states, vjp = entry["fwd"](tp_datas, st_datas, rng_key,
                                                   *arg_datas)
+        if t0:
+            # host-side dispatch window (XLA executes async; device time
+            # comes from profiler.device_op_stats)
+            _prof.record_duration(
+                f"CachedOp::forward({type(self.block).__name__})",
+                "cachedop", t0)
 
         self._write_back_state(state_params, new_states)
 
@@ -330,6 +386,7 @@ class CachedOpThreadSafe(CachedOp):
     def _lookup_or_build(self, key, grad_mode, args_tracked, static_args):
         entry = self._cache.get(key)
         if entry is not None:
+            self._hits += 1
             return entry
         with self._lock:  # double-checked: one thread traces/compiles
             entry = self._cache.get(key)
@@ -337,6 +394,10 @@ class CachedOpThreadSafe(CachedOp):
                 entry = super()._lookup_or_build(
                     key, grad_mode, args_tracked, static_args)
                 self._guard_first_call(entry)
+            else:
+                # raced build won while we waited: still a cache hit for
+                # cache_stats accounting
+                self._hits += 1
             return entry
 
     def _guard_first_call(self, entry):

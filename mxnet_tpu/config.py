@@ -105,3 +105,16 @@ register_flag(
     "MXNET_MODULE_SEED", None,
     "Base RNG seed for the test suite's per-test seeding (reference "
     "tests conftest.py reproduction flow).", int)
+register_flag(
+    "MXNET_PROFILER_AUTOSTART", False,
+    "Start the telemetry event bus (mxnet_tpu.profiler) at import; "
+    "reference MXNET_PROFILER_AUTOSTART contract.", _bool)
+register_flag(
+    "MXNET_PROFILER_IMPERATIVE", False,
+    "Opt into per-op imperative dispatch counters "
+    "(profiler.set_config(profile_imperative=True)).", _bool)
+register_flag(
+    "MXNET_CACHEDOP_SIG_LIMIT", 16,
+    "Distinct-signature count above which one CachedOp warns about a "
+    "recompile storm (varying shapes/dtypes/static args defeating the "
+    "executable cache).", int)

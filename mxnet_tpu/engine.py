@@ -33,6 +33,11 @@ import weakref
 
 _state = threading.local()
 
+# telemetry hot-state (mxnet_tpu.profiler.core), installed by the first
+# profiler.set_state('run'); None until then so unprofiled sessions pay a
+# single `is None` test per site (see ops/registry.py)
+_PROF = None
+
 # recently dispatched arrays (weakrefs): wait_all() drains these instead of
 # blocking on every live array in the process (jax.live_arrays() is O(all
 # arrays ever alive) — pathological when waitall() runs once per epoch).
@@ -69,6 +74,10 @@ def track_async(arrays):
             dq.append(weakref.ref(a))
         except TypeError:
             pass
+    prof = _PROF
+    if prof is not None and prof.ENABLED:
+        # async queue depth gauge: outstanding dispatches on this thread
+        prof.set_counter("engine.queue_depth", len(dq), cat="engine")
 
 
 def engine_type() -> str:
@@ -105,11 +114,22 @@ def maybe_sync(arrays):
 
 
 def wait_for_var(data):
-    """``Engine::WaitForVar`` analog: block until ``data`` is computed."""
+    """``Engine::WaitForVar`` analog: block until ``data`` is computed.
+    The stall duration is recorded while the profiler runs."""
+    prof = _PROF
+    if prof is None or not prof.ENABLED:
+        try:
+            return data.block_until_ready()
+        except AttributeError:
+            return data
+    t0 = prof.begin()
     try:
-        return data.block_until_ready()
-    except AttributeError:
-        return data
+        try:
+            return data.block_until_ready()
+        except AttributeError:
+            return data
+    finally:
+        prof.record_duration("engine::wait_for_var", "engine", t0)
 
 
 def wait_all():
@@ -123,6 +143,9 @@ def wait_all():
 
     from . import config
 
+    prof = _PROF
+    t0 = prof.begin() if prof is not None and prof.ENABLED else 0
+    drained = 0
     try:
         jax.effects_barrier()
     except Exception:
@@ -132,6 +155,9 @@ def wait_all():
             jax.block_until_ready(jax.live_arrays())
         except Exception:
             pass
+        if t0:
+            prof.record_duration("engine::wait_all", "engine", t0,
+                                 args={"mode": "full"})
         return
     with _pending_lock:
         deques = [dq for _, dq in _pending_registry.values()]
@@ -156,8 +182,13 @@ def wait_all():
                 continue
             try:
                 a.block_until_ready()
+                drained += 1
             except Exception:
                 pass
+    if t0:
+        prof.record_duration("engine::wait_all", "engine", t0,
+                             args={"drained": drained})
+        prof.set_counter("engine.queue_depth", 0, cat="engine")
 
 
 _BULK_SIZE = 15
@@ -177,12 +208,18 @@ def set_bulk_size(size):
 
 @contextlib.contextmanager
 def bulk(size: int = 15):
-    """Bulk-execution scope (``engine.h:311-317``). Advisory: XLA fuses."""
+    """Bulk-execution scope (``engine.h:311-317``). Advisory: XLA fuses.
+    The scope duration and flush size are recorded while profiling."""
     prev = set_bulk_size(size)
+    prof = _PROF
+    t0 = prof.begin() if prof is not None and prof.ENABLED else 0
     try:
         yield
     finally:
         set_bulk_size(prev)
+        if t0:
+            prof.record_duration("engine::bulk", "engine", t0,
+                                 args={"size": size})
 
 
 # ---------------------------------------------------------------------------

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from ..profiler import core as _prof
 from .base import KVStoreBase
 from .kvstore_local import KVStoreLocal, _normalize_grouped
 
@@ -107,7 +108,14 @@ class KVStoreDistTPUSync(KVStoreLocal):
                 mesh, P(tuple(mesh.axis_names), *([None] * len(shape)))),
             out_shardings=NamedSharding(mesh, P()),
         )
+        t0 = _prof.begin() if _prof.ENABLED else 0
         compiled = jitted.lower(sample).compile()
+        if t0:
+            # the AOT-compile half of the compile-vs-execute split: one
+            # event per (shape, dtype), execute timing lives in allreduce
+            _prof.record_duration("kvstore::allreduce_compile", "kvstore",
+                                  t0, args={"shape": list(shape),
+                                            "dtype": str(dtype)})
         self.last_hlo = compiled.as_text()
         self._allreduce_jit[key] = compiled
         return compiled
@@ -159,6 +167,7 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if len(arrays) == 1:
             return arrays
         datas = [a._data for a in arrays]
+        t0 = _prof.begin() if _prof.ENABLED else 0
         try:
             fast = self._collective_allreduce(datas)
         except Exception:
@@ -167,6 +176,12 @@ class KVStoreDistTPUSync(KVStoreLocal):
             fast = None
         if fast is not None:
             self.last_path = "collective"
+            if t0:
+                _prof.record_duration(
+                    "kvstore::allreduce", "kvstore", t0,
+                    args={"path": "collective",
+                          "shape": list(datas[0].shape),
+                          "bytes": sum(int(d.nbytes) for d in datas)})
             return [NDArray(d) for d in fast]
         self.last_path = "eager"
         stacked = jnp.stack(datas)
@@ -175,6 +190,11 @@ class KVStoreDistTPUSync(KVStoreLocal):
         for a in arrays:
             dev = list(a._data.devices())[0]
             out.append(NDArray(jax.device_put(summed, dev)))
+        if t0:
+            _prof.record_duration(
+                "kvstore::allreduce", "kvstore", t0,
+                args={"path": "eager", "shape": list(datas[0].shape),
+                      "bytes": sum(int(d.nbytes) for d in datas)})
         return out
 
     def _cross_process_sum(self, nd):
@@ -194,6 +214,7 @@ class KVStoreDistTPUSync(KVStoreLocal):
     def pushpull(self, key, value, out=None, priority=0):  # pylint: disable=unused-argument
         keys, values = _normalize_grouped(key, value)
         _, outs = _normalize_grouped(key, out)
+        tpp = _prof.begin() if _prof.ENABLED else 0
         multi_proc = _jax().process_count() > 1
         for k, vals, dsts in zip(keys, values, outs):
             if vals is not None and len(vals) > 1:
@@ -219,6 +240,12 @@ class KVStoreDistTPUSync(KVStoreLocal):
             else:
                 for d in dsts:
                     reduced[0].copyto(d)
+        if tpp:
+            _prof.record_duration(
+                "kvstore::pushpull", "kvstore", tpp,
+                args={"keys": len(keys),
+                      "bytes": sum(v.nbytes for vs in values if vs
+                                   for v in vs)})
 
     def broadcast(self, key, value, out, priority=0):
         """Replicate rank-0 value to all devices (reference Broadcast)."""
@@ -226,6 +253,7 @@ class KVStoreDistTPUSync(KVStoreLocal):
         _, outs = _normalize_grouped(key, out)
         import jax
 
+        tbc = _prof.begin() if _prof.ENABLED else 0
         for k, vals, dsts in zip(keys, values, outs):
             src = vals[0]
             self._store[k] = src
@@ -234,6 +262,9 @@ class KVStoreDistTPUSync(KVStoreLocal):
             for d in dsts:
                 dev = list(d._data.devices())[0]
                 d._set_data_internal(jax.device_put(src._data, dev))
+        if tbc:
+            _prof.record_duration("kvstore::broadcast", "kvstore", tbc,
+                                  args={"keys": len(keys)})
 
     # -- sharded-native helpers -------------------------------------------
     def shard(self, array: NDArray, spec):

@@ -62,10 +62,10 @@ def _to_jax(value, dtype=None, ctx: Context = None):
         if ctx is not None:
             data = jax.device_put(data, ctx.jax_device())
         return data
-    if dtype is None and isinstance(value, (bool, int, float)):
-        # python scalars follow MXNet's default_dtype rules: float->float32
-        dtype = _np.float32 if isinstance(value, float) else None
     host = _np.asarray(value, dtype=dtype)
+    # default-dtype rule for python floats, float lists AND scalars alike
+    # (asarray gives float64 for both): float32 unless set_np(dtype=True),
+    # so mx.np.array(1.5) and mx.np.array([1.5]) always agree
     if host.dtype == _np.float64 and dtype is None:
         from ..base import _thread_state
         if not _thread_state.np_dtype:  # set_np(dtype=True) keeps float64
@@ -754,13 +754,14 @@ class NDArray:
         """Reference codegen parity: the registry's op surface is exposed
         as bound NDArray methods (``x.exp()``, ``x.log_softmax()``,
         ``x.topk()`` — reference ``ndarray/register.py`` synthesizes these
-        from the C op registry at import).  Resolution goes through the
-        same table serving ``mx.nd.*``/``mx.sym.*``."""
+        from the C op registry at import).  Resolution is restricted to
+        the registered-op table (``legacy.resolve_method``): namespace
+        utilities never bind as methods, and typos raise AttributeError."""
         if name.startswith("_"):
             raise AttributeError(name)
         from ..ops import legacy
         try:
-            fn = legacy.resolve(name)
+            fn = legacy.resolve_method(name)
         except AttributeError:
             raise AttributeError(
                 f"'NDArray' object has no attribute {name!r}") from None

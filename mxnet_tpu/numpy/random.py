@@ -153,11 +153,20 @@ def poisson(lam=1.0, size=None, ctx=None, device=None):
 
 
 def multinomial(n, pvals, size=None):
-    pv = pvals._data if isinstance(pvals, NDArray) else _jnp().asarray(pvals)
-    shape = _size(size)
-    # jax.random.multinomial wants the FULL result shape incl. categories
-    counts = _jr().multinomial(_rng.next_key(), n, pv,
-                               shape=shape + pv.shape if shape else None)
+    """Sample counts over ``len(pvals)`` categories.
+
+    Sampled HOST-SIDE with numpy (like ``nonzero``):
+    ``jax.random.multinomial``'s binomial-scan implementation crashes the
+    experimental TPU worker process (ADVICE r5) — and the draw stays
+    deterministic by seeding numpy from this build's key stream."""
+    import jax
+
+    pv = pvals.asnumpy() if isinstance(pvals, NDArray) else _onp.asarray(pvals)
+    key = _rng.next_key()
+    seed = int(_onp.asarray(jax.random.key_data(key)).astype(
+        _onp.uint64).sum() % (2 ** 32))
+    counts = _onp.random.default_rng(seed).multinomial(
+        n, pv, size=_size(size) or None)
     return NDArray(counts)
 
 

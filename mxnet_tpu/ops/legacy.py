@@ -1175,9 +1175,11 @@ def _refusal(name, why):
 _MISSING = object()
 
 
-def resolve(name):
-    """Resolve a legacy op name to an NDArray-level callable, or raise
-    AttributeError (so module __getattr__ protocols keep working)."""
+def _resolve_cascade(name, fallback):
+    """Shared ALIASES -> FUNCS -> registry -> ``fallback(target)`` ->
+    NOT_SUPPORTED cascade behind both :func:`resolve` (mx.nd surface)
+    and :func:`resolve_method` (NDArray methods); ``fallback`` returns
+    ``_MISSING`` when it has nothing."""
     target = ALIASES.get(name, name)
     fn = FUNCS.get(target)
     if fn is not None:
@@ -1187,16 +1189,71 @@ def resolve(name):
         return reg.get(target)
     except MXNetError:
         pass
-    # sentinel, not None: np.newaxis IS None and must resolve to it
-    fn = getattr(_np(), target, _MISSING)
-    if fn is _MISSING:
-        fn = getattr(_npx(), target, _MISSING)
+    fn = fallback(target)
     if fn is not _MISSING:
         return fn
     why = NOT_SUPPORTED.get(name) or NOT_SUPPORTED.get(target)
     if why:
         return _refusal(name, why)
     raise AttributeError(name)
+
+
+def _np_npx_fallback(target):
+    # sentinel, not None: np.newaxis IS None and must resolve to it
+    fn = getattr(_np(), target, _MISSING)
+    if fn is _MISSING:
+        fn = getattr(_npx(), target, _MISSING)
+    return fn
+
+
+def resolve(name):
+    """Resolve a legacy op name to an NDArray-level callable, or raise
+    AttributeError (so module __getattr__ protocols keep working)."""
+    return _resolve_cascade(name, _np_npx_fallback)
+
+
+# np exports that are genuine elementwise OPERATORS taking the data array
+# first — the subset of the mx.np surface the reference C op registry also
+# exposes as NDArray methods (``x.exp()``, ``x.log()``...). NDArray
+# __getattr__ method resolution is restricted to this closed set plus
+# ALIASES/FUNCS/registry (ADVICE r5): namespace utilities (``array``,
+# ``zeros``, ``arange``, ...) must NOT become bound methods, and attribute
+# typos must raise AttributeError instead of returning nonsense partials.
+NDARRAY_METHOD_OPS = frozenset({
+    "abs", "absolute", "arccos", "arccosh", "arcsin", "arcsinh", "arctan",
+    "arctanh", "cbrt", "ceil", "cos", "cosh", "degrees", "exp", "expm1",
+    "fabs", "fix", "floor", "log", "log10", "log1p", "log2", "logical_not",
+    "negative", "ones_like", "radians", "reciprocal", "rint", "sign", "sin",
+    "sinh", "sqrt", "square", "tan", "tanh", "trunc", "zeros_like",
+})
+
+
+# op-table entries that take no data array first (creation / sampling):
+# real ops for the mx.nd surface, nonsense as bound NDArray methods
+_NON_METHOD_OPS = frozenset({
+    "arange", "random_uniform", "random_normal", "random_gamma",
+    "random_exponential", "random_poisson", "random_randint",
+    "random_negative_binomial", "random_generalized_negative_binomial",
+})
+
+
+def _curated_fallback(target):
+    if target in NDARRAY_METHOD_OPS:
+        fn = getattr(_np(), target, _MISSING)
+        if fn is not _MISSING:
+            return fn
+    return _MISSING
+
+
+def resolve_method(name):
+    """Resolve an NDArray method name through the REGISTERED op surface
+    only (the shared cascade with the curated elementwise set as its
+    fallback instead of the open np/npx surface); AttributeError for
+    everything else, so attribute typos surface instead of binding
+    arbitrary mx.np exports."""
+    if ALIASES.get(name, name) in _NON_METHOD_OPS:
+        raise AttributeError(name)
+    return _resolve_cascade(name, _curated_fallback)
 
 
 def _exportable(mod):

@@ -25,6 +25,11 @@ from ..base import MXNetError
 # global op table: name -> Op
 _OPS: Dict[str, "Op"] = {}
 
+# telemetry hot-state (mxnet_tpu.profiler.core), installed by the first
+# profiler.set_state('run') and never imported on the dispatch path: a
+# session that never profiles pays exactly one `is None` test per apply()
+_PROF = None
+
 # ---------------------------------------------------------------------------
 # Eager per-op jit cache (SURVEY.md §7 hard part 2)
 #
@@ -220,6 +225,12 @@ def apply(fn, args, kwargs=None, name="", record=True, sync_outputs=True,
     per-call overhead down on hot namespace ops.
     """
     import jax
+
+    prof = _PROF
+    if prof is not None and prof.IMPERATIVE:
+        # opt-in per-op call counters (profile_imperative): the role of the
+        # reference's imperative API events, without the always-on cost
+        prof.count_op(name or getattr(fn, "__name__", "op"))
 
     NDArray = _ndarray_cls()
     kwargs = kwargs or {}

@@ -1,0 +1,207 @@
+"""In-process telemetry event bus: scoped ranges, counters, chrome trace.
+
+Reference: ``src/profiler/profiler.cc`` (the chrome://tracing JSON writer
+behind ``MXDumpProfile``) and ``src/profiler/aggregate_stats.cc`` (the
+``dumps(reset)`` tables).  This module is the host-side store both map onto:
+instrumented call sites append complete ('X') events and counter ('C')
+events here, and every duration also lands in an aggregate
+``name -> [calls, total_s]`` table.
+
+Hot-path contract (the reason this module exists separately from the
+facade): instrumented modules guard each hook on the module-level
+``ENABLED`` / ``IMPERATIVE`` bools below — one attribute load and a branch
+when the profiler is stopped, no dict lookups, no function calls.  The
+hottest site of all (``ops/registry.apply``) goes one step further and
+checks an installed-module slot (``registry._PROF``) that stays ``None``
+until the first ``set_state('run')``, so sessions that never profile pay a
+single ``is None`` test per dispatch.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+# -- hot flags (read by instrumented modules; written by the facade) --------
+ENABLED = False      # event bus recording is on (profiler.set_state('run'))
+IMPERATIVE = False   # per-op dispatch counters (set_config(profile_imperative=True))
+
+_MAX_EVENTS = 2_000_000  # hard cap; beyond it events are counted as dropped
+
+_lock = threading.Lock()             # guards aggregates (events append via GIL)
+_events: list = []                   # chrome trace event dicts
+_dropped = 0
+_epoch_ns = time.perf_counter_ns()   # ts origin for the whole process
+_agg = collections.defaultdict(lambda: [0, 0.0])  # name -> [calls, total_s]
+_op_counts: collections.Counter = collections.Counter()  # imperative op calls
+_counters: dict = {}                 # counter name -> last value
+
+
+def begin() -> int:
+    """Timestamp for a range about to be recorded (perf_counter_ns)."""
+    return time.perf_counter_ns()
+
+
+def _ts_us(ns: int) -> float:
+    return (ns - _epoch_ns) / 1e3
+
+
+def start():
+    global ENABLED
+    ENABLED = True
+
+
+def stop():
+    global ENABLED, IMPERATIVE
+    ENABLED = False
+    IMPERATIVE = False
+
+
+def is_running() -> bool:
+    return ENABLED
+
+
+def reset():
+    """Drop all recorded events, aggregates and counters."""
+    global _dropped
+    with _lock:
+        _events.clear()
+        _agg.clear()
+        _op_counts.clear()
+        _counters.clear()
+        _dropped = 0
+
+
+def record_duration(name, cat, t0_ns, t1_ns=None, args=None):
+    """One completed range: aggregates always, a chrome 'X' event when the
+    bus is running (so ``profiler.scope`` keeps feeding ``dumps()`` even
+    with the profiler stopped — the pre-package behavior)."""
+    global _dropped
+    if t1_ns is None:
+        t1_ns = time.perf_counter_ns()
+    dur_s = (t1_ns - t0_ns) / 1e9
+    with _lock:
+        row = _agg[name]
+        row[0] += 1
+        row[1] += dur_s
+    if not ENABLED:
+        return
+    if len(_events) >= _MAX_EVENTS:
+        _dropped += 1
+        return
+    ev = {"ph": "X", "name": name, "cat": cat or "host",
+          "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFFFFFF,
+          "ts": round(_ts_us(t0_ns), 3),
+          "dur": round((t1_ns - t0_ns) / 1e3, 3)}
+    if args:
+        ev["args"] = args
+    _events.append(ev)
+
+
+def record_instant(name, cat="host", args=None):
+    """A point-in-time marker (chrome 'i' event)."""
+    global _dropped
+    if not ENABLED:
+        return
+    if len(_events) >= _MAX_EVENTS:
+        _dropped += 1
+        return
+    ev = {"ph": "i", "s": "t", "name": name, "cat": cat,
+          "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFFFFFF,
+          "ts": round(_ts_us(time.perf_counter_ns()), 3)}
+    if args:
+        ev["args"] = args
+    _events.append(ev)
+
+
+def set_counter(name, value, cat="counters"):
+    """Record a gauge value (chrome 'C' event when running)."""
+    global _dropped
+    _counters[name] = value
+    if not ENABLED:
+        return
+    if len(_events) >= _MAX_EVENTS:
+        _dropped += 1
+        return
+    _events.append({"ph": "C", "name": name, "cat": cat,
+                    "pid": os.getpid(),
+                    "ts": round(_ts_us(time.perf_counter_ns()), 3),
+                    "args": {"value": value}})
+
+
+def incr_counter(name, delta=1, cat="counters"):
+    set_counter(name, _counters.get(name, 0) + delta, cat=cat)
+
+
+def get_counter(name, default=0):
+    return _counters.get(name, default)
+
+
+def count_op(name):
+    """Imperative dispatch counter (guarded by IMPERATIVE at the call
+    site). A bare Counter increment — no event, no lock: losing a rare
+    racy increment is acceptable for call statistics."""
+    _op_counts[name] += 1
+
+
+def op_counts():
+    return dict(_op_counts)
+
+
+def aggregate_stats():
+    """``{name: {"calls", "total_s", "avg_s"}}`` over all recorded ranges."""
+    with _lock:
+        return {
+            name: {"calls": cnt, "total_s": tot,
+                   "avg_s": tot / cnt if cnt else 0.0}
+            for name, (cnt, tot) in _agg.items()
+        }
+
+
+def dumps_table(reset_after=False):
+    """Formatted aggregate table (``MXAggregateProfileStatsPrint`` analog):
+    ranges by total time, then per-op imperative call counts, then the
+    latest counter gauges."""
+    lines = [f"{'Name':<44}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    with _lock:
+        rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+    for name, (cnt, total) in rows:
+        lines.append(f"{name:<44}{cnt:>8}{total * 1e3:>12.3f}"
+                     f"{total / max(cnt, 1) * 1e3:>12.3f}")
+    if _op_counts:
+        lines.append("")
+        lines.append(f"{'Operator (imperative)':<44}{'Calls':>8}")
+        for name, cnt in _op_counts.most_common():
+            lines.append(f"{name:<44}{cnt:>8}")
+    if _counters:
+        lines.append("")
+        lines.append(f"{'Counter':<44}{'Value':>12}")
+        for name in sorted(_counters):
+            lines.append(f"{name:<44}{_counters[name]:>12}")
+    if reset_after:
+        # aggregate STATS only (the reference dumps(reset) contract):
+        # the chrome-trace events and counter gauges survive for dump()
+        with _lock:
+            _agg.clear()
+            _op_counts.clear()
+    return "\n".join(lines)
+
+
+def snapshot_events():
+    """Copy of the recorded chrome events (tests / tooling)."""
+    return list(_events)
+
+
+def dump(path):
+    """Write the chrome://tracing JSON (reference ``dump()`` contract:
+    load the file in chrome://tracing or Perfetto). Returns ``path``."""
+    meta = [{"ph": "M", "pid": os.getpid(), "name": "process_name",
+             "args": {"name": "mxnet_tpu host"}}]
+    doc = {"traceEvents": meta + _events, "displayTimeUnit": "ms"}
+    if _dropped:
+        doc["mxnet_tpu_dropped_events"] = _dropped
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

@@ -558,11 +558,20 @@ class Symbol:
                                               (1,) * len(kshape)))
                         padding = tuple(kw.get("pad",
                                                (0,) * len(kshape)))
-                        record(s._args[1], (nf, d[1]) + kshape, s.name)
+                        dilate = tuple(kw.get("dilate",
+                                              (1,) * len(kshape)))
+                        ngroup = int(kw.get("num_group", 1))
+                        # grouped conv: each filter sees C/num_group input
+                        # channels (reference nnvm ConvolutionInferShape)
+                        record(s._args[1],
+                               (nf, d[1] // ngroup) + kshape, s.name)
                         if len(s._args) > 2:
                             record(s._args[2], (nf,), s.name)
+                        # effective kernel under dilation:
+                        # k_eff = dilate*(k-1)+1
                         sp = tuple(
-                            (d[2 + i] + 2 * padding[i] - kshape[i])
+                            (d[2 + i] + 2 * padding[i]
+                             - (dilate[i] * (kshape[i] - 1) + 1))
                             // stride[i] + 1
                             for i in range(len(kshape)))
                         r = (d[0], nf) + sp
@@ -921,15 +930,23 @@ def _literal(v):
 
 
 def fromjson(text):
-    """Build a Symbol from REFERENCE nnvm graph JSON (the format
-    ``Symbol.tojson``/``HybridBlock.export`` wrote in real MXNet), with
-    the legacy upgrade semantics of
+    """Build a Symbol from symbol JSON: REFERENCE nnvm graph JSON (the
+    format ``Symbol.tojson``/``HybridBlock.export`` wrote in real MXNet)
+    OR this build's own v2 container (default ``tojson()`` output, marked
+    ``mxnet_tpu_symbol``), so the reference round-trip idiom
+    ``sym.fromjson(net.tojson())`` works for both formats.
+
+    nnvm input gets the legacy upgrade semantics of
     ``src/nnvm/legacy_json_util.cc``: pre-1.0 ``"attr"``/``"param"``
     dicts normalize to ``"attrs"``, hidden optimizer/placement keys
     (``lr_mult``, ``ctx_group``, …) and ``__shape__``-style variable
     annotations are dropped, and op names resolve through the shared
     legacy surface (CamelCase + snake_case, ops/legacy.py)."""
     data = json.loads(text) if isinstance(text, str) else text
+    if "mxnet_tpu_symbol" in data:
+        # our own container: node 'inputs' are flat ints, not nnvm
+        # [node, out, ver] triples — delegate to the tpu-format parser
+        return _from_tpu_json(data)
     if "nodes" not in data:
         raise MXNetError("not a symbol JSON (no 'nodes')")
     built = []
@@ -994,8 +1011,6 @@ def load(fname):
     """Reload a Symbol saved by :meth:`Symbol.save` — or a REFERENCE
     model-symbol.json (nnvm graph JSON incl. the pre-1.0 legacy layouts,
     upgraded per ``src/nnvm/legacy_json_util.cc``; see :func:`fromjson`)."""
-    import ast
-
     with open(fname) as f:
         data = json.load(f)
     if "mxnet_tpu_symbol" not in data:
@@ -1005,6 +1020,13 @@ def load(fname):
             "unrecognized symbol JSON (neither mxnet_tpu_symbol nor nnvm "
             "graph format); export models with HybridBlock.export and "
             "reload with SymbolBlock.imports")
+    return _from_tpu_json(data)
+
+
+def _from_tpu_json(data):
+    """Rebuild a Symbol from this build's v2 container (the default
+    ``tojson()``/:meth:`Symbol.save` format)."""
+    import ast
 
     def literal(r):
         try:

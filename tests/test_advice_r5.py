@@ -1,0 +1,140 @@
+"""Regression tests for the ADVICE round-5 findings fixed in the
+telemetry PR: Convolution shape inference (dilate/num_group), the
+fromjson/tojson round-trip, set_np(dtype=True) scalar creation,
+NDArray.__getattr__ restriction, and host-side multinomial."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu import sym, util
+from mxnet_tpu.base import MXNetError
+
+
+# -- Convolution shape inference: dilate + num_group ----------------------
+
+def test_conv_infer_shape_dilate():
+    """k_eff = dilate*(k-1)+1: a dilated conv feeding FC must infer the
+    FC weight from the DILATED output shape (ADVICE r5 #1)."""
+    data = sym.var("data")
+    c = sym.Convolution(data, num_filter=6, kernel=(3, 3), dilate=(2, 2))
+    fc = sym.FullyConnected(sym.Flatten(c), num_hidden=3)
+    shapes, outs = fc._infer_missing_arg_shapes({"data": (1, 4, 8, 8)})
+    # k_eff = 2*(3-1)+1 = 5 -> spatial (8-5)//1+1 = 4
+    assert outs == [(1, 3)]
+    fc_weight = [n for n in shapes if n.endswith("_weight")
+                 and "fullyconnected" in n]
+    assert shapes[fc_weight[0]] == (3, 6 * 4 * 4)
+
+
+def test_conv_infer_shape_num_group():
+    """Grouped conv weight is (num_filter, C//num_group) + kernel."""
+    data = sym.var("data")
+    c = sym.Convolution(data, num_filter=6, kernel=(3, 3), num_group=2)
+    shapes, outs = c._infer_missing_arg_shapes({"data": (2, 4, 8, 8)})
+    w = [n for n in shapes if n.endswith("_weight")][0]
+    assert shapes[w] == (6, 2, 3, 3)
+    assert outs == [(2, 6, 6, 6)]
+
+
+def test_conv_dilated_grouped_simple_bind_executes():
+    net = sym.FullyConnected(
+        sym.Flatten(sym.Convolution(sym.var("data"), num_filter=4,
+                                    kernel=(3, 3), dilate=(2, 2),
+                                    num_group=2)),
+        num_hidden=2)
+    exe = net.simple_bind(data=(1, 4, 9, 9))
+    (out,) = exe.forward()
+    assert out.shape == (1, 2)
+
+
+# -- fromjson consumes this build's own tojson ----------------------------
+
+def test_fromjson_roundtrips_default_tojson():
+    """sym.fromjson(net.tojson()) — the reference round-trip idiom — must
+    accept the default (tpu v2) format (ADVICE r5 #2)."""
+    net = sym.FullyConnected(sym.var("x"), num_hidden=5)
+    rt = sym.fromjson(net.tojson())
+    assert rt.list_arguments() == net.list_arguments()
+    assert rt._op == net._op
+
+
+def test_fromjson_roundtrip_evaluates_identically():
+    a = sym.var("a")
+    net = sym.FullyConnected(a * 2.0 + 1.0, num_hidden=3)
+    rt = sym.fromjson(net.tojson())
+    names = net.list_arguments()
+    args = {names[0]: mnp.ones((2, 4)),
+            names[1]: mnp.ones((3, 4)) * 0.1,
+            names[2]: mnp.zeros((3,))}
+    got = rt.eval(**args)[0].asnumpy()
+    want = net.eval(**args)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_fromjson_still_reads_nnvm_format():
+    net = sym.FullyConnected(sym.var("x"), num_hidden=5)
+    rt = sym.fromjson(net.tojson(fmt="nnvm"))
+    assert rt.list_arguments() == net.list_arguments()
+
+
+# -- set_np(dtype=True) python float scalars ------------------------------
+
+def test_set_np_dtype_scalar_and_sequence_agree():
+    prev = util.set_np_default_dtype(True)
+    try:
+        assert mnp.array(1.5).dtype == np.float64
+        assert mnp.array([1.5]).dtype == np.float64
+    finally:
+        util.set_np_default_dtype(prev)
+    # default mode: both float32
+    assert mnp.array(1.5).dtype == np.float32
+    assert mnp.array([1.5]).dtype == np.float32
+
+
+# -- NDArray.__getattr__ restricted to the op table -----------------------
+
+def test_getattr_typo_raises_attribute_error():
+    x = mnp.ones((3,))
+    with pytest.raises(AttributeError):
+        x.arrray  # pylint: disable=pointless-statement
+    # namespace utilities / creation ops must not bind as methods
+    for bad in ("array", "zeros", "arange", "empty", "random_uniform"):
+        with pytest.raises(AttributeError):
+            getattr(x, bad)
+
+
+def test_getattr_still_resolves_registered_ops():
+    x = mnp.ones((2, 3))
+    np.testing.assert_allclose(x.exp().asnumpy(), np.exp(np.ones((2, 3))),
+                               rtol=1e-6)
+    assert x.relu().shape == (2, 3)
+    assert x.log_softmax().shape == (2, 3)
+    # legacy FUNCS table entries keep working
+    assert x.slice_axis(axis=1, begin=0, end=2).shape == (2, 2)
+    # data-first creation-like ops stay methods (reference registry has them)
+    assert float(x.zeros_like().asnumpy().sum()) == 0.0
+    assert float(x.ones_like().asnumpy().sum()) == 6.0
+    # deliberate refusals still raise with guidance, not AttributeError
+    with pytest.raises(MXNetError):
+        x.SoftmaxOutput()
+
+
+# -- host-side multinomial ------------------------------------------------
+
+def test_multinomial_host_side_sampling():
+    counts = mnp.random.multinomial(20, [0.3, 0.7])
+    assert counts.shape == (2,)
+    assert int(counts.asnumpy().sum()) == 20
+
+    batched = mnp.random.multinomial(8, [0.25, 0.25, 0.5], size=(4, 2))
+    assert batched.shape == (4, 2, 3)
+    np.testing.assert_array_equal(batched.asnumpy().sum(axis=-1), 8)
+
+
+def test_multinomial_deterministic_under_seed():
+    mx.random.seed(7)
+    a = mnp.random.multinomial(100, [0.5, 0.5], size=(3,)).asnumpy()
+    mx.random.seed(7)
+    b = mnp.random.multinomial(100, [0.5, 0.5], size=(3,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
