@@ -968,6 +968,15 @@ def main():
     final["extra"] = {k: v for k, v in rows.items()}
     if failures:
         final["errors"] = failures
+    # resilience counters next to the telemetry numbers: BENCH rounds track
+    # robustness cost (retries/degradations should be 0 on a healthy chip;
+    # nonzero values explain a slow row before anyone re-runs it)
+    try:
+        from mxnet_tpu.resilience import resilience_stats
+
+        final["resilience"] = resilience_stats()
+    except Exception as e:
+        print(f"# resilience stats unavailable: {e}", file=sys.stderr)
     _emit(final)
     return 0
 

@@ -65,6 +65,14 @@ from .engine import wait_all as waitall  # noqa: E402
 
 context = device  # legacy module alias: mx.context.Context
 
+# MXNET_FAULT_PLAN: install the env-specified fault-injection plan at
+# import so its _FAULTS slots are live before the first dispatch (the
+# programmatic path is resilience.install_plan). One env read when unset.
+if _os.environ.get("MXNET_FAULT_PLAN"):
+    from .resilience import faults as _faults
+
+    _faults.get_plan()
+
 
 def cpu_count():
     import os
@@ -85,6 +93,7 @@ _LAZY_SUBMODULES = (
     "gluon",
     "parallel",
     "profiler",
+    "resilience",
     "runtime",
     "util",
     "test_utils",

@@ -42,6 +42,10 @@ from .profiler import core as _prof
 
 _trace_state = threading.local()
 
+# fault-injection hot-state (resilience.faults.FaultPlan slot, see
+# ops/registry.py): None until a plan installs
+_FAULTS = None
+
 # sentinel marking a traced (array) position in a CachedOp call signature
 _TRACED = object()
 
@@ -123,7 +127,8 @@ class CachedOp:
             return entry
         self._misses += 1
         t0 = time.perf_counter_ns()
-        entry = self._build(key, grad_mode, args_tracked, static_args)
+        entry = self._build_with_retry(key, grad_mode, args_tracked,
+                                       static_args)
         self._cache[key] = entry
         t1 = time.perf_counter_ns()
         self._compile_ns += t1 - t0
@@ -148,6 +153,24 @@ class CachedOp:
                 "storm — per-call varying shapes, dtypes or static args "
                 "defeat the executable cache", RuntimeWarning, stacklevel=4)
         return entry
+
+    def _build_with_retry(self, key, grad_mode, args_tracked, static_args):
+        """Trace/compile under the resilience retry policy: a transient
+        XLA compile failure (tunnel drop, RESOURCE_EXHAUSTED from a
+        concurrent compile) backs off and retries instead of failing the
+        training step; real trace errors re-raise on the first attempt."""
+        from .resilience import retry as _retry
+
+        def build():
+            flt = _FAULTS
+            if flt is not None:
+                flt.check("cachedop:compile",
+                          {"block": type(self.block).__name__})
+            return self._build(key, grad_mode, args_tracked, static_args)
+
+        return _retry.call_with_retry(
+            build, site=f"CachedOp::compile({type(self.block).__name__})",
+            policy=_retry.compile_policy())
 
     def _write_back_state(self, state_params, new_states):
         """Write back mutated state (BatchNorm running stats etc.)."""

@@ -118,3 +118,36 @@ register_flag(
     "Distinct-signature count above which one CachedOp warns about a "
     "recompile storm (varying shapes/dtypes/static args defeating the "
     "executable cache).", int)
+register_flag(
+    "MXNET_FAULT_PLAN", None,
+    "Fault-injection plan for the resilience subsystem: inline JSON or "
+    "@/path/to/plan.json (mxnet_tpu.resilience.faults docstring has the "
+    "schema). Installed lazily on first use; unset disables injection.")
+register_flag(
+    "MXNET_COLLECTIVE_TIMEOUT", 0.0,
+    "Seconds before the dist_tpu collective watchdog declares a hung "
+    "collective and raises CollectiveTimeoutError (then the circuit "
+    "breaker degrades to the eager fallback). 0 disables the watchdog "
+    "(zero overhead).", float)
+register_flag(
+    "MXNET_COMPILE_MAX_RETRIES", 2,
+    "Extra attempts for a transiently-failing XLA compile (CachedOp "
+    "build, dist_tpu AOT lower().compile()).", int)
+register_flag(
+    "MXNET_COLLECTIVE_MAX_RETRIES", 2,
+    "Extra attempts for a transiently-failing dist_tpu collective before "
+    "it counts as a fast-path failure (degradation + breaker).", int)
+register_flag(
+    "MXNET_RETRY_BASE_DELAY_MS", 5.0,
+    "First retry backoff delay in ms; doubles per attempt.", float)
+register_flag(
+    "MXNET_RETRY_MAX_DELAY_MS", 250.0,
+    "Backoff delay ceiling in ms.", float)
+register_flag(
+    "MXNET_COLLECTIVE_BREAKER_THRESHOLD", 3,
+    "Consecutive dist_tpu fast-path failures that trip the circuit "
+    "breaker open (eager fallback only until cooldown).", int)
+register_flag(
+    "MXNET_COLLECTIVE_BREAKER_COOLDOWN", 8,
+    "Fast-path queries the breaker stays open before letting one "
+    "half-open probe re-test the collective path.", int)

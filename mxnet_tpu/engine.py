@@ -31,12 +31,19 @@ import contextlib
 import threading
 import weakref
 
+from .base import MXNetError
+
 _state = threading.local()
 
 # telemetry hot-state (mxnet_tpu.profiler.core), installed by the first
 # profiler.set_state('run'); None until then so unprofiled sessions pay a
 # single `is None` test per site (see ops/registry.py)
 _PROF = None
+
+# fault-injection hot-state (resilience.faults.FaultPlan slot): None until
+# a plan installs; wait points consult it so simulated async device errors
+# surface exactly where contract (c) says real ones do
+_FAULTS = None
 
 # recently dispatched arrays (weakrefs): wait_all() drains these instead of
 # blocking on every live array in the process (jax.live_arrays() is O(all
@@ -132,12 +139,39 @@ def wait_for_var(data):
         prof.record_duration("engine::wait_for_var", "engine", t0)
 
 
+def _block_settled(a):
+    """Block on one tracked array. Returns ``'ok'``, ``'skip'``, or the
+    failure exception. Donated-away buffers (fused optimizer /
+    static_alloc donate arrays that were tracked as op outputs — blocking
+    on one raises 'Array has been deleted', including the race where the
+    delete lands after the ``is_deleted`` check) and non-waitable strays
+    are skips, not failures."""
+    try:
+        is_deleted = getattr(a, "is_deleted", None)
+        if is_deleted is not None and is_deleted():
+            return "skip"
+        a.block_until_ready()
+        return "ok"
+    except AttributeError:
+        return "skip"  # no block_until_ready: not async work
+    except Exception as e:
+        if "deleted" in str(e).lower():
+            return "skip"
+        return e
+
+
 def wait_all():
     """``MXNDArrayWaitAll`` analog: drain outstanding async work.
 
     Blocks on the recently-dispatched set (bounded deque of weakrefs) —
     O(recent ops), not O(live arrays). ``MXNET_WAITALL_FULL=1`` restores
     the exhaustive ``jax.live_arrays()`` sweep for debugging.
+
+    Contract (c) of the module docstring: async device errors re-raise at
+    wait points. The FIRST failure encountered while draining is kept and
+    re-raised as ``MXNetError`` after the drain completes — every other
+    outstanding array is still waited on first, so one poisoned dispatch
+    doesn't leave the rest of the queue untracked for the next wait_all.
     """
     import jax
 
@@ -146,49 +180,69 @@ def wait_all():
     prof = _PROF
     t0 = prof.begin() if prof is not None and prof.ENABLED else 0
     drained = 0
+    first_failure = None
+    flt = _FAULTS
+    if flt is not None:
+        flt.check("engine:wait")
     try:
         jax.effects_barrier()
-    except Exception:
-        pass
+    except AttributeError:
+        pass  # jax version without effects_barrier
+    except Exception as e:
+        first_failure = e
     if config.get("MXNET_WAITALL_FULL"):
         try:
-            jax.block_until_ready(jax.live_arrays())
+            live = jax.live_arrays()
         except Exception:
-            pass
+            live = []
+        for a in live:
+            r = _block_settled(a)
+            if r == "ok":
+                drained += 1
+            elif r != "skip" and first_failure is None:
+                first_failure = r
         if t0:
             prof.record_duration("engine::wait_all", "engine", t0,
-                                 args={"mode": "full"})
-        return
-    with _pending_lock:
-        deques = [dq for _, dq in _pending_registry.values()]
-        deques.append(_pending_orphans)
-        # prune registry entries for dead threads (their deques were just
-        # captured above and get drained below) — no per-thread leak
-        dead = []
-        for ident, (tref, _dq) in _pending_registry.items():
-            t = tref()  # bind once: the second deref could race GC
-            if t is None or not t.is_alive():
-                dead.append(ident)
-        for ident in dead:
-            del _pending_registry[ident]
-    for dq in deques:
-        while True:
-            try:
-                r = dq.popleft()
-            except IndexError:
-                break
-            a = r()
-            if a is None:
-                continue
-            try:
-                a.block_until_ready()
-                drained += 1
-            except Exception:
-                pass
-    if t0:
-        prof.record_duration("engine::wait_all", "engine", t0,
-                             args={"drained": drained})
-        prof.set_counter("engine.queue_depth", 0, cat="engine")
+                                 args={"mode": "full",
+                                       "failed": first_failure is not None})
+    else:
+        with _pending_lock:
+            deques = [dq for _, dq in _pending_registry.values()]
+            deques.append(_pending_orphans)
+            # prune registry entries for dead threads (their deques were
+            # just captured above and get drained below) — no per-thread
+            # leak
+            dead = []
+            for ident, (tref, _dq) in _pending_registry.items():
+                t = tref()  # bind once: the second deref could race GC
+                if t is None or not t.is_alive():
+                    dead.append(ident)
+            for ident in dead:
+                del _pending_registry[ident]
+        for dq in deques:
+            while True:
+                try:
+                    ref = dq.popleft()
+                except IndexError:
+                    break
+                a = ref()
+                if a is None:
+                    continue
+                r = _block_settled(a)
+                if r == "ok":
+                    drained += 1
+                elif r != "skip" and first_failure is None:
+                    first_failure = r
+        if t0:
+            prof.record_duration("engine::wait_all", "engine", t0,
+                                 args={"drained": drained,
+                                       "failed": first_failure is not None})
+            prof.set_counter("engine.queue_depth", 0, cat="engine")
+    if first_failure is not None:
+        raise MXNetError(
+            f"async operation failed, surfaced at wait_all: "
+            f"{type(first_failure).__name__}: {first_failure}"
+        ) from first_failure
 
 
 _BULK_SIZE = 15

@@ -123,11 +123,17 @@ class Block:
     reset_device = reset_ctx
 
     # -- persistence ------------------------------------------------------
+    def _params_data(self):
+        """name -> NDArray dict of every parameter's current buffer — THE
+        serialization view of this block, shared by save_parameters, the
+        estimator CheckpointHandler and resilience.checkpoint so the three
+        on-disk params payloads can never diverge."""
+        return {k: v.data() for k, v in self.collect_params().items()}
+
     def save_parameters(self, filename, deduplicate=False):  # pylint: disable=unused-argument
         from ..ndarray.utils import save
 
-        params = self.collect_params()
-        save(filename, {k: v.data() for k, v in params.items()})
+        save(filename, self._params_data())
 
     def load_parameters(self, filename, device=None, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False, dtype_source="current"):  # pylint: disable=unused-argument

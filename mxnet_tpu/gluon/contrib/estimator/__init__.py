@@ -15,3 +15,14 @@ from .event_handler import (
     TrainEnd,
     ValidationHandler,
 )
+
+
+def __getattr__(name):
+    # lazy: resilience.checkpoint subclasses the event-handler bases above,
+    # so an eager import here would be circular
+    if name == "ResilientCheckpointHandler":
+        from ....resilience.checkpoint import ResilientCheckpointHandler
+
+        return ResilientCheckpointHandler
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -197,10 +197,21 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
     def _save(self, estimator, tag, rotate=True):
         import os
 
+        from ....resilience.checkpoint import _atomic_write
+
+        from ....ndarray.utils import save_parameters_buffer
+
         path = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")
-        estimator.net.save_parameters(path + ".params")
+        # atomic per file (write-temp + fsync + rename): a crash mid-save
+        # can't leave a torn .params behind. The two files can still be
+        # from different saves after a crash between them — the
+        # single-container ResilientCheckpointHandler is the crash-safe
+        # upgrade; this keeps the reference's two-file layout readable.
+        _atomic_write(path + ".params",
+                      save_parameters_buffer(estimator.net._params_data()))
         if estimator.trainer is not None:
-            estimator.trainer.save_states(path + ".states")
+            _atomic_write(path + ".states",
+                          estimator.trainer.states_to_bytes())
         if not rotate:
             return  # the 'best' checkpoint never enters the rotation
         self.saved.append(path)

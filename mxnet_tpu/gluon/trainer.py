@@ -235,29 +235,37 @@ class Trainer:
                 s._set_data_internal(nsd)
 
     # -- persistence ------------------------------------------------------
-    def save_states(self, fname):
+    # the byte-level pair below is THE states format: save_states /
+    # load_states and the resilience checkpoint container both delegate
+    # here, so the two can never drift apart
+    def states_to_bytes(self) -> bytes:
         self._init_states()
         import pickle
 
-        blob = {
+        return pickle.dumps({
             "step": self._step_count,
             "states": [
                 [s.asnumpy() for s in _flatten_state(st)] for st in self._states
             ],
-        }
-        with open(fname, "wb") as f:
-            pickle.dump(blob, f)
+        })
 
-    def load_states(self, fname):
+    def load_states_from_bytes(self, raw: bytes):
         self._init_states()
         import pickle
 
-        with open(fname, "rb") as f:
-            blob = pickle.load(f)
+        blob = pickle.loads(raw)
         self._step_count = blob["step"]
         for st, arrs in zip(self._states, blob["states"]):
             for s, a in zip(_flatten_state(st), arrs):
                 s._set_data_internal(NDArray(a)._data)
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self.states_to_bytes())
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self.load_states_from_bytes(f.read())
 
 
 def _flatten_state(st):

@@ -21,11 +21,19 @@ Two operating modes:
 """
 from __future__ import annotations
 
+import warnings
+
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..profiler import core as _prof
+from ..resilience import counters as _res_counters
+from ..resilience import retry as _retry
 from .base import KVStoreBase
 from .kvstore_local import KVStoreLocal, _normalize_grouped
+
+# fault-injection hot-state (resilience.faults.FaultPlan slot, see
+# ops/registry.py): None until a plan installs
+_FAULTS = None
 
 
 def _jax():
@@ -48,6 +56,66 @@ class KVStoreDistTPUSync(KVStoreLocal):
         self._allreduce_jit = {}      # (shape, dtype) -> AOT-compiled psum
         self.last_path = None         # 'collective' | 'eager' (tests assert)
         self.last_hlo = None          # compiled HLO of the last collective
+        self.last_error = None        # why the fast path last degraded
+                                      # ("ExcType: msg" string, never the
+                                      # live exception — see
+                                      # _record_degradation)
+        from .. import config as _config
+
+        # resilience: after K consecutive fast-path failures stop trying
+        # the collective (straight to eager) until the cooldown lets one
+        # half-open probe through (resilience.retry.CircuitBreaker)
+        self._breaker = _retry.CircuitBreaker(
+            failure_threshold=_config.get(
+                "MXNET_COLLECTIVE_BREAKER_THRESHOLD"),
+            cooldown_calls=_config.get(
+                "MXNET_COLLECTIVE_BREAKER_COOLDOWN"),
+            name="kvstore.allreduce")
+        # retry policy + watchdog timeout resolved ONCE here, like the
+        # breaker thresholds above: allreduce runs per training step and
+        # must not re-read the environment per call (fault plans, by
+        # contrast, can be installed/cleared at any time — the _FAULTS
+        # slot is re-poked, not re-read)
+        self._retry_policy = _retry.collective_policy()
+        self._watchdog_timeout = _retry.collective_timeout()
+        self._stats = {"allreduce_calls": 0, "collective": 0, "eager": 0,
+                       "degradations": 0, "breaker_skips": 0}
+
+    def collective_stats(self):
+        """Resilience/degradation telemetry for this store (the
+        ``cache_stats()`` analog): path counts, why the fast path last
+        degraded, breaker state, process-wide retry counters."""
+        out = dict(self._stats)
+        out["breaker"] = self._breaker.snapshot()
+        out["last_error"] = self.last_error
+        out["retries"] = _res_counters.get("resilience.retries")
+        out["watchdog_timeouts"] = _res_counters.get(
+            "resilience.watchdog_timeouts")
+        return out
+
+    def _record_degradation(self, exc, op="allreduce"):
+        """Satellite fix: the fast path must not degrade silently — keep
+        the cause on ``last_error``, count it, and warn (rate-limited to
+        powers of ten so a degraded steady state doesn't spam one warning
+        per step)."""
+        # formatted, not the live exception: exc.__traceback__ would pin
+        # the failed attempt's frames (and the per-device gradient
+        # buffers they reference) for the life of the store
+        self.last_error = f"{type(exc).__name__}: {exc}"
+        self._stats["degradations"] += 1
+        n = self._stats["degradations"]
+        _res_counters.incr("resilience.degradations")
+        if _prof.ENABLED:
+            _prof.record_instant(f"resilience::degradation({op})",
+                                 "resilience",
+                                 args={"error": f"{type(exc).__name__}: "
+                                                f"{exc}"[:200]})
+        if n in (1, 10) or (n % 100 == 0):
+            warnings.warn(
+                f"kvstore {op} collective fast path degraded to the eager "
+                f"fallback ({n}x so far): {type(exc).__name__}: {exc} — "
+                "see collective_stats() for breaker state",
+                RuntimeWarning, stacklevel=3)
 
     # -- cluster shape ----------------------------------------------------
     @property
@@ -109,7 +177,20 @@ class KVStoreDistTPUSync(KVStoreLocal):
             out_shardings=NamedSharding(mesh, P()),
         )
         t0 = _prof.begin() if _prof.ENABLED else 0
-        compiled = jitted.lower(sample).compile()
+
+        def compile_fn():
+            flt = _FAULTS
+            if flt is not None:
+                flt.check("kvstore:allreduce_compile",
+                          {"shape": tuple(shape)})
+            return jitted.lower(sample).compile()
+
+        # transient compile failures (tunnel drop, concurrent-compile
+        # RESOURCE_EXHAUSTED) back off and retry; real lowering errors
+        # re-raise on the first attempt
+        compiled = _retry.call_with_retry(
+            compile_fn, site="kvstore::allreduce_compile",
+            policy=_retry.compile_policy())
         if t0:
             # the AOT-compile half of the compile-vs-execute split: one
             # event per (shape, dtype), execute timing lives in allreduce
@@ -128,6 +209,12 @@ class KVStoreDistTPUSync(KVStoreLocal):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        flt = _FAULTS
+        if flt is not None:
+            # per-ATTEMPT injection point: a 'transient' rule here is what
+            # the retry wrapper in allreduce() recovers from; a 'delay'
+            # rule simulates the stuck collective the watchdog bounds
+            flt.check("kvstore:allreduce", {"n": len(datas)})
         devs = self._mesh_devices()
         if len(datas) != len(devs) or len(devs) < 2:
             return None
@@ -160,6 +247,19 @@ class KVStoreDistTPUSync(KVStoreLocal):
         path (`_collective_allreduce`): one jitted XLA all-reduce over ICI
         with a replicated out-sharding. Anything else (same-device lists,
         partial meshes) takes the eager stack-and-sum fallback.
+
+        Resilience wrapping (outside → in): circuit breaker (skip the fast
+        path entirely while open), retry with backoff (transient errors),
+        watchdog (MXNET_COLLECTIVE_TIMEOUT bounds a hung collective — the
+        watched body blocks on the result, so the timeout covers execution,
+        not just dispatch). Any failure surfacing HERE records a
+        degradation and falls through to the eager fallback instead of
+        crashing. Scope caveat: with the watchdog disabled (the default)
+        the result is returned async, so an execution-phase device failure
+        surfaces later at a wait point (engine contract (c)) rather than
+        through this retry/fallback — enable the watchdog to pull
+        execution errors into the recovery path at the cost of a sync per
+        reduce.
         """
         import jax
         import jax.numpy as jnp
@@ -168,13 +268,53 @@ class KVStoreDistTPUSync(KVStoreLocal):
             return arrays
         datas = [a._data for a in arrays]
         t0 = _prof.begin() if _prof.ENABLED else 0
-        try:
-            fast = self._collective_allreduce(datas)
-        except Exception:
-            # never let the fast path take down a reduce the eager
-            # fallback can do (odd meshes, unexpected layouts)
-            fast = None
+        self._stats["allreduce_calls"] += 1
+        fast = None
+        if self._breaker.allow():
+            timeout = self._watchdog_timeout
+
+            def run_fast():
+                out = self._collective_allreduce(datas)
+                if timeout and out is not None:
+                    # under a watchdog the result must be BLOCKED on inside
+                    # the watched body — async dispatch would return long
+                    # before a hung ICI ring ever fails
+                    for d in out:
+                        d.block_until_ready()
+                return out
+
+            try:
+                fast = _retry.call_with_retry(
+                    lambda: _retry.run_with_watchdog(
+                        run_fast, timeout, site="kvstore::allreduce"),
+                    site="kvstore::allreduce",
+                    policy=self._retry_policy)
+            except Exception as exc:
+                # never let the fast path take down a reduce the eager
+                # fallback can do (odd meshes, unexpected layouts, injected
+                # or real collective failures)
+                fast = None
+                self._breaker.record_failure()
+                self._record_degradation(exc)
+            except BaseException:
+                # KeyboardInterrupt / SimulatedWorkerDeath mid-probe: the
+                # half-open probe slot must not leak (a leaked slot locks
+                # the store out of the collective path forever)
+                self._breaker.release_probe()
+                raise
+            else:
+                if fast is not None:
+                    self._breaker.record_success()
+                else:
+                    # fast None without an exception: the list simply
+                    # doesn't line up with the mesh — an expected shape of
+                    # input, not a fast-path failure; the breaker stays
+                    # put (but a half-open probe slot is released)
+                    self._breaker.release_probe()
+        else:
+            self._stats["breaker_skips"] += 1
         if fast is not None:
+            self._stats["collective"] += 1
             self.last_path = "collective"
             if t0:
                 _prof.record_duration(
@@ -184,7 +324,12 @@ class KVStoreDistTPUSync(KVStoreLocal):
                           "bytes": sum(int(d.nbytes) for d in datas)})
             return [NDArray(d) for d in fast]
         self.last_path = "eager"
-        stacked = jnp.stack(datas)
+        self._stats["eager"] += 1
+        # gather onto one device first: a per-device list degraded here by
+        # a collective failure spans devices, and jnp.stack refuses mixed
+        # placements (device_put is a no-op for the same-device case)
+        dev0 = next(iter(datas[0].devices()))
+        stacked = jnp.stack([jax.device_put(d, dev0) for d in datas])
         summed = jnp.sum(stacked, axis=0)
         out = []
         for a in arrays:
@@ -217,7 +362,22 @@ class KVStoreDistTPUSync(KVStoreLocal):
         tpp = _prof.begin() if _prof.ENABLED else 0
         multi_proc = _jax().process_count() > 1
         for k, vals, dsts in zip(keys, values, outs):
-            if vals is not None and len(vals) > 1:
+            if vals is None or any(v is None for v in vals):
+                # a None value group used to crash below (`reduced[0]` on
+                # None, the TypeError satellite); a group with ANY None
+                # entry is equally unusable — summing the remaining
+                # entries would silently drop one replica's contribution.
+                # Skip the key loudly instead.
+                warnings.warn(
+                    f"pushpull: key {k!r} has no usable value group "
+                    f"({'None' if vals is None else 'contains None'}) — "
+                    "skipping it; pass grads for every key or drop the "
+                    "key from the call", RuntimeWarning, stacklevel=2)
+                continue
+            flt = _FAULTS
+            if flt is not None:
+                flt.check("kvstore:pushpull", {"key": k})
+            if len(vals) > 1:
                 reduced = self.allreduce(vals)
             else:
                 reduced = vals
@@ -244,8 +404,10 @@ class KVStoreDistTPUSync(KVStoreLocal):
             _prof.record_duration(
                 "kvstore::pushpull", "kvstore", tpp,
                 args={"keys": len(keys),
+                      # None-tolerant like the skip-guard above: skipped
+                      # keys/entries contribute 0 bytes, not a crash
                       "bytes": sum(v.nbytes for vs in values if vs
-                                   for v in vs)})
+                                   for v in vs if v is not None)})
 
     def broadcast(self, key, value, out, priority=0):
         """Replicate rank-0 value to all devices (reference Broadcast)."""
@@ -259,9 +421,23 @@ class KVStoreDistTPUSync(KVStoreLocal):
             self._store[k] = src
             if dsts is None:
                 continue
-            for d in dsts:
-                dev = list(d._data.devices())[0]
-                d._set_data_internal(jax.device_put(src._data, dev))
+
+            def replicate(src=src, dsts=dsts):
+                flt = _FAULTS
+                if flt is not None:
+                    flt.check("kvstore:broadcast", {"key": k})
+                return [jax.device_put(src._data,
+                                       list(d._data.devices())[0])
+                        for d in dsts]
+
+            # transfer faults (transient device_put failures) retry with
+            # backoff; destinations are written only from a fully
+            # successful replication pass
+            placed = _retry.call_with_retry(
+                replicate, site="kvstore::broadcast",
+                policy=self._retry_policy)
+            for d, buf in zip(dsts, placed):
+                d._set_data_internal(buf)
         if tbc:
             _prof.record_duration("kvstore::broadcast", "kvstore", tbc,
                                   args={"keys": len(keys)})

@@ -30,6 +30,11 @@ _OPS: Dict[str, "Op"] = {}
 # session that never profiles pays exactly one `is None` test per apply()
 _PROF = None
 
+# fault-injection hot-state (mxnet_tpu.resilience.faults.FaultPlan),
+# installed by faults.install_plan() the same way: one `is None` test per
+# apply() when no plan is active
+_FAULTS = None
+
 # ---------------------------------------------------------------------------
 # Eager per-op jit cache (SURVEY.md §7 hard part 2)
 #
@@ -231,6 +236,14 @@ def apply(fn, args, kwargs=None, name="", record=True, sync_outputs=True,
         # opt-in per-op call counters (profile_imperative): the role of the
         # reference's imperative API events, without the always-on cost
         prof.count_op(name or getattr(fn, "__name__", "op"))
+
+    flt = _FAULTS
+    if flt is not None:
+        # injected transient dispatch error (resilience.faults): raised
+        # BEFORE any tape/cache mutation so a caller-level retry sees a
+        # clean slate. No info payload — building one per dispatch would
+        # cost more than the site check itself
+        flt.check("op:dispatch")
 
     NDArray = _ndarray_cls()
     kwargs = kwargs or {}

@@ -1,0 +1,77 @@
+"""Fault-tolerance subsystem: fault injection, retry/backoff, collective
+circuit breaker, atomic checkpoint/resume.
+
+PR 1 (the telemetry subsystem) made failures *visible*; this package makes
+the runtime *survive* them. Three cooperating layers:
+
+* :mod:`.faults` — deterministic, seedable fault plans (programmatic or
+  ``MXNET_FAULT_PLAN``) with injection hooks wired into op dispatch,
+  CachedOp compile, the dist_tpu collectives and engine wait points, so
+  every recovery path is testable on a CPU dev box.
+* :mod:`.retry` — transient-vs-fatal error classification, bounded
+  exponential backoff around XLA compiles and collectives, the
+  ``MXNET_COLLECTIVE_TIMEOUT`` hung-collective watchdog, and the
+  closed/open/half-open :class:`~.retry.CircuitBreaker` dist_tpu uses to
+  degrade to its eager fallback after repeated fast-path failures.
+* :mod:`.checkpoint` — crash-safe single-file checkpoints (write-temp +
+  fsync + atomic rename, CRC32 footer), corruption rollback to last-good,
+  ``load_latest`` resume, and the estimator-integrated
+  :class:`~.checkpoint.ResilientCheckpointHandler`.
+
+Everything emits ``resilience::*`` events/counters on the PR-1 profiler
+bus; :func:`resilience_stats` snapshots them for bench/BENCH rows.
+"""
+from __future__ import annotations
+
+from . import faults, retry
+from .faults import (FaultPlan, InjectedFaultError, SimulatedWorkerDeath,
+                     TransientFaultError, clear_plan, fault_point, get_plan,
+                     install_plan)
+from .retry import (CircuitBreaker, CollectiveTimeoutError, RetryPolicy,
+                    call_with_retry, collective_policy, collective_timeout,
+                    compile_policy, is_transient, run_with_watchdog)
+
+# checkpoint pulls gluon (event-handler bases); load it on first touch so
+# `from mxnet_tpu.resilience import faults` stays light
+_CHECKPOINT_NAMES = (
+    "checkpoint", "CheckpointCorruptError", "CheckpointManager",
+    "ResilientCheckpointHandler", "load_checkpoint", "save_checkpoint",
+)
+
+
+def __getattr__(name):
+    if name in _CHECKPOINT_NAMES:
+        import importlib
+
+        # NOT `from . import checkpoint`: the fromlist handler getattrs
+        # the package and would re-enter this __getattr__ unboundedly
+        _ckpt = importlib.import_module(__name__ + ".checkpoint")
+        globals()["checkpoint"] = _ckpt
+        for n in _CHECKPOINT_NAMES[1:]:
+            globals()[n] = getattr(_ckpt, n)
+        return globals()[name]
+    raise AttributeError(
+        f"module 'mxnet_tpu.resilience' has no attribute {name!r}")
+
+
+def resilience_stats():
+    """Process-wide resilience counters: retries, degradations, watchdog
+    timeouts, breaker trips, checkpoint traffic, injected faults. Source
+    of truth is the resilience-private store (mirrored to the profiler
+    bus but NOT cleared by ``profiler.reset()``). bench.py prints this
+    next to the telemetry summary so BENCH rounds track robustness
+    cost."""
+    from . import counters as _counters
+
+    keys = (
+        "resilience.retries",
+        "resilience.degradations",
+        "resilience.watchdog_timeouts",
+        "resilience.breaker_trips",
+        "resilience.checkpoints_saved",
+        "resilience.checkpoints_corrupt",
+        "resilience.faults_injected",
+    )
+    out = {k.split(".", 1)[1]: _counters.get(k) for k in keys}
+    out["fault_plan_active"] = faults._active is not None
+    return out

@@ -1,0 +1,36 @@
+"""Resilience-private counters, mirrored onto the profiler bus.
+
+The robustness counters (retries, degradations, breaker trips, checkpoint
+traffic) must survive ``profiler.reset()`` — telemetry housekeeping
+between profiling windows must not erase the record of a round that
+churned through transient failures (PERF.md's "nonzero counters explain a
+slow row" contract). So the source of truth lives here, and every
+increment is *mirrored* to the profiler counter bus so the values still
+show up in ``dumps_table()`` and chrome traces.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+from ..profiler import core as _prof
+
+_lock = threading.Lock()
+_counts: collections.Counter = collections.Counter()
+
+
+def incr(name, delta=1):
+    with _lock:
+        _counts[name] += delta
+    _prof.incr_counter(name, delta, cat="resilience")
+
+
+def get(name, default=0):
+    with _lock:
+        return _counts.get(name, default)
+
+
+def reset():
+    """Zero the resilience counters (tests; NOT called by profiler.reset)."""
+    with _lock:
+        _counts.clear()

@@ -1,0 +1,289 @@
+"""Deterministic, seedable fault injection for the TPU runtime.
+
+Production failure modes on a TPU pod are well known — transient XLA
+compile/dispatch errors, stuck or failed ICI collectives, whole-worker
+crashes — but none of them reproduce on a CPU dev box. This module makes
+them reproducible: a *fault plan* names injection **sites** wired into the
+dispatch layer (``ops/registry.apply``), CachedOp compile
+(``cachedop._lookup_or_build``), the dist_tpu collectives
+(``kvstore/dist_tpu``) and the engine wait points (``engine.wait_all``),
+and each rule in the plan decides deterministically — by hit index or by a
+seeded RNG — when that site throws a transient error, sleeps (a slow
+collective), raises a fatal error, or simulates worker death.
+
+Hot-path contract (same discipline as the profiler's ``_PROF`` slot): the
+instrumented modules each hold a module-level ``_FAULTS = None`` slot that
+:func:`install_plan` pokes and :func:`clear_plan` resets. A session that
+never injects faults pays one global load + ``is None`` test per site.
+
+Plan format (programmatic dicts or the ``MXNET_FAULT_PLAN`` env var as
+JSON, or ``@/path/to/plan.json``)::
+
+    {"seed": 7, "rules": [
+        {"site": "kvstore:allreduce", "kind": "transient", "at": [0, 1]},
+        {"site": "cachedop:compile",  "kind": "transient", "times": 1},
+        {"site": "op:dispatch",       "kind": "transient", "prob": 0.01},
+        {"site": "kvstore:allreduce", "kind": "delay", "seconds": 0.2,
+         "at": [5]},
+        {"site": "engine:wait",       "kind": "fatal", "at": [3]},
+        {"site": "estimator:batch",   "kind": "die", "at": [12]}
+    ]}
+
+Rule matching: ``site`` must equal the instrumented site name (or ``"*"``).
+Exactly one trigger per rule: ``at`` (list of 0-based hit indices for that
+rule), ``times`` (fire on the first N hits), or ``prob`` (per-hit
+probability from the plan-seeded RNG — deterministic for a fixed seed and
+hit sequence). Kinds:
+
+``transient``
+    raises :class:`TransientFaultError` — the retry layer classifies it
+    retryable, so recovery paths exercise end to end.
+``fatal``
+    raises :class:`InjectedFaultError` — never retried.
+``delay``
+    sleeps ``seconds`` (default 0.05) — a slow/stuck collective; pair with
+    ``MXNET_COLLECTIVE_TIMEOUT`` to exercise the watchdog.
+``die``
+    raises :class:`SimulatedWorkerDeath` (a ``BaseException``) — ordinary
+    ``except Exception`` recovery code cannot swallow it, so it unwinds the
+    whole training loop the way a SIGKILLed worker would, without killing
+    the test process.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..base import MXNetError
+from ..profiler import core as _prof
+from . import counters as _counters
+
+# Sites wired in this PR (documented; fault_point accepts any name so new
+# sites need no registry change):
+KNOWN_SITES = (
+    "op:dispatch",          # ops/registry.apply, before the op executes
+    "cachedop:compile",     # cachedop._lookup_or_build cache miss
+    "kvstore:allreduce",    # dist_tpu fast-path collective body
+    "kvstore:allreduce_compile",  # dist_tpu AOT lower().compile()
+    "kvstore:pushpull",     # dist_tpu.pushpull per-key loop
+    "kvstore:broadcast",    # dist_tpu.broadcast per-key loop
+    "engine:wait",          # engine.wait_all drain
+    "estimator:batch",      # ResilientCheckpointHandler.batch_end
+)
+
+
+class TransientFaultError(MXNetError):
+    """Injected error the retry layer classifies as retryable."""
+
+
+class InjectedFaultError(MXNetError):
+    """Injected error classified fatal (never retried)."""
+
+
+class SimulatedWorkerDeath(BaseException):
+    """Simulated whole-worker crash (SIGKILL analog, testable in-process).
+
+    Deliberately a ``BaseException``: the framework's defensive ``except
+    Exception`` blocks must not be able to 'survive' a worker death —
+    only a checkpoint/resume cycle can.
+    """
+
+
+class FaultPlan:
+    """A parsed, installed-once fault plan. Thread-safe; deterministic for
+    a fixed seed and per-site hit order."""
+
+    def __init__(self, spec):
+        if isinstance(spec, FaultPlan):
+            spec = spec.spec
+        if isinstance(spec, str):
+            spec = _parse_spec_str(spec)
+        if not isinstance(spec, dict) or "rules" not in spec:
+            raise MXNetError(
+                "fault plan must be a dict with a 'rules' list "
+                "(or JSON / @file via MXNET_FAULT_PLAN)")
+        self.spec = spec
+        self.seed = int(spec.get("seed", 0))
+        self._lock = threading.Lock()
+        self._rules = []
+        import random as _random
+
+        for i, r in enumerate(spec["rules"]):
+            site = r.get("site")
+            kind = r.get("kind", "transient")
+            if not site:
+                raise MXNetError(f"fault rule {i} missing 'site'")
+            if kind not in ("transient", "fatal", "delay", "die"):
+                raise MXNetError(f"fault rule {i}: unknown kind {kind!r}")
+            triggers = [t for t in ("at", "times", "prob") if t in r]
+            if len(triggers) != 1:
+                # a typoed trigger key would otherwise parse into a rule
+                # that silently never fires — a test built on it would
+                # pass while injecting nothing
+                raise MXNetError(
+                    f"fault rule {i} ({site}): exactly one trigger of "
+                    f"'at'/'times'/'prob' required, got {triggers or r}")
+            self._rules.append({
+                "site": site,
+                "kind": kind,
+                "at": set(r["at"]) if "at" in r else None,
+                "times": int(r["times"]) if "times" in r else None,
+                "prob": float(r["prob"]) if "prob" in r else None,
+                "seconds": float(r.get("seconds", 0.05)),
+                "message": r.get("message"),
+                # per-rule RNG: independent deterministic streams, immune
+                # to other rules' draw counts
+                "rng": _random.Random(self.seed * 1000003 + i),
+                "hits": 0,       # how often the site matched this rule
+                "fired": 0,      # how often it actually injected
+            })
+        # lock-free pre-filter: a hot site with no rule for it costs one
+        # frozenset lookup, not a lock + rule scan per dispatch
+        self._sites = frozenset(r["site"] for r in self._rules)
+        self._match_all = "*" in self._sites
+
+    def stats(self):
+        """Per-rule ``{site, kind, hits, fired}`` — tests assert on this."""
+        with self._lock:
+            return [{"site": r["site"], "kind": r["kind"],
+                     "hits": r["hits"], "fired": r["fired"]}
+                    for r in self._rules]
+
+    def fired_total(self):
+        with self._lock:
+            return sum(r["fired"] for r in self._rules)
+
+    def check(self, site, info=None):
+        """Evaluate every matching rule for one hit of ``site``; raises or
+        sleeps per the first rule that fires."""
+        if not self._match_all and site not in self._sites:
+            return
+        action = None
+        with self._lock:
+            for r in self._rules:
+                if r["site"] != site and r["site"] != "*":
+                    continue
+                idx = r["hits"]
+                r["hits"] += 1
+                fire = False
+                if r["at"] is not None:
+                    fire = idx in r["at"]
+                elif r["times"] is not None:
+                    fire = r["fired"] < r["times"]
+                elif r["prob"] is not None:
+                    fire = r["rng"].random() < r["prob"]
+                if fire and action is None:
+                    r["fired"] += 1
+                    action = r
+        if action is None:
+            return
+        kind = action["kind"]
+        msg = action["message"] or (
+            f"injected {kind} fault at {site} "
+            f"(plan seed {self.seed})")
+        _counters.incr("resilience.faults_injected")
+        if _prof.ENABLED:
+            _prof.record_instant(f"resilience::fault({site})", "resilience",
+                                 args={"kind": kind})
+        if kind == "delay":
+            time.sleep(action["seconds"])
+            return
+        if kind == "transient":
+            raise TransientFaultError(msg)
+        if kind == "die":
+            raise SimulatedWorkerDeath(msg)
+        raise InjectedFaultError(msg)
+
+
+# -- installation -----------------------------------------------------------
+
+_active: FaultPlan | None = None
+_env_checked = False
+_install_lock = threading.Lock()
+
+# instrumented modules whose _FAULTS slot mirrors the active plan
+_SLOT_MODULES = (
+    "mxnet_tpu.ops.registry",
+    "mxnet_tpu.cachedop",
+    "mxnet_tpu.engine",
+    "mxnet_tpu.kvstore.dist_tpu",
+)
+
+
+def _parse_spec_str(s):
+    s = s.strip()
+    if s.startswith("@"):
+        with open(s[1:]) as f:
+            s = f.read()
+    try:
+        return json.loads(s)
+    except ValueError as e:
+        raise MXNetError(f"MXNET_FAULT_PLAN is not valid JSON: {e}") from None
+
+
+def _poke_slots(value):
+    import importlib
+    import sys
+
+    for name in _SLOT_MODULES:
+        mod = sys.modules.get(name)
+        if mod is None:
+            # import so late installs still reach every site; these are
+            # all part of the core package and cheap once jax is up
+            try:
+                mod = importlib.import_module(name)
+            except Exception as e:
+                # never silent: an unpoked slot means that site injects
+                # NOTHING — a test asserting on it would pass vacuously
+                import warnings
+
+                warnings.warn(
+                    f"fault plan cannot reach site module {name} "
+                    f"({type(e).__name__}: {e}); faults for its sites "
+                    "will not fire", RuntimeWarning, stacklevel=3)
+                continue
+        setattr(mod, "_FAULTS", value)
+
+
+def install_plan(spec) -> FaultPlan:
+    """Install ``spec`` (dict / JSON string / ``@file`` / FaultPlan) as THE
+    process-wide fault plan, replacing any previous one."""
+    global _active
+    plan = spec if isinstance(spec, FaultPlan) else FaultPlan(spec)
+    with _install_lock:
+        _active = plan
+        _poke_slots(plan)
+    return plan
+
+
+def clear_plan():
+    """Remove the active fault plan (all sites return to zero-cost)."""
+    global _active, _env_checked
+    with _install_lock:
+        _active = None
+        _env_checked = True  # explicit clear also disables env re-install
+        _poke_slots(None)
+
+
+def get_plan() -> FaultPlan | None:
+    """The active plan; installs ``MXNET_FAULT_PLAN`` from the env on the
+    first call if nothing was installed programmatically."""
+    global _env_checked
+    if _active is None and not _env_checked:
+        with _install_lock:
+            _env_checked = True
+        from .. import config
+
+        raw = config.get("MXNET_FAULT_PLAN")
+        if raw:
+            install_plan(raw)
+    return _active
+
+
+def fault_point(site, info=None):
+    """Module-level convenience: evaluate ``site`` against the active plan
+    (used by call sites that don't keep their own slot)."""
+    plan = get_plan()
+    if plan is not None:
+        plan.check(site, info)

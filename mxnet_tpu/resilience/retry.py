@@ -1,0 +1,279 @@
+"""Retry/backoff, hung-collective watchdog, and the collective circuit
+breaker.
+
+Classification first: a retry layer that retries *everything* turns real
+bugs into slow bugs. :func:`is_transient` says yes only for (a) injected
+:class:`~.faults.TransientFaultError`, (b) the XLA/jax runtime error
+categories that are transient in production (RESOURCE_EXHAUSTED from a
+concurrent compile, UNAVAILABLE/ABORTED/DEADLINE_EXCEEDED from a flaky
+tunnel or preempted coordinator, connection resets), matched on the
+message because jaxlib does not export stable exception classes for them.
+Everything else — shape errors, tracer leaks, user bugs — re-raises on the
+first attempt.
+
+Pieces:
+
+* :class:`RetryPolicy` / :func:`call_with_retry` — bounded exponential
+  backoff. ``MXNET_COMPILE_MAX_RETRIES`` and
+  ``MXNET_COLLECTIVE_MAX_RETRIES`` size the two wired-in policies;
+  ``MXNET_RETRY_BASE_DELAY_MS`` / ``MXNET_RETRY_MAX_DELAY_MS`` shape the
+  backoff curve. Every retry emits a ``resilience::retry`` instant on the
+  profiler bus and bumps the ``resilience.retries`` counter.
+* :func:`run_with_watchdog` — runs a collective body on a fresh daemon
+  thread per engaged call and bounds the wait with
+  ``MXNET_COLLECTIVE_TIMEOUT`` seconds: a hung ICI collective becomes a
+  diagnosable :class:`CollectiveTimeoutError` instead of an infinite hang.
+  Disabled (timeout 0) it is never engaged — zero overhead. NOTE: on
+  timeout the thread is still blocked in the runtime (Python can't
+  preempt it) and leaks as a daemon; the caller is expected to degrade
+  (circuit breaker) rather than re-enter the fast path immediately.
+* :class:`CircuitBreaker` — closed → open after K consecutive failures,
+  open → half-open after a call-count cooldown (deterministic under test;
+  wall-clock cooldowns make flaky tests), half-open lets ONE probe through
+  and closes on success / re-opens on failure. State transitions emit
+  ``resilience::breaker`` instants.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..base import MXNetError
+from ..profiler import core as _prof
+from . import counters as _counters
+from .faults import InjectedFaultError, SimulatedWorkerDeath, \
+    TransientFaultError
+
+
+class CollectiveTimeoutError(MXNetError):
+    """A collective exceeded MXNET_COLLECTIVE_TIMEOUT (hung ICI analog)."""
+
+
+# message fragments marking transient runtime errors (jaxlib raises
+# RuntimeError/XlaRuntimeError with grpc-style status prefixes)
+_TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "CANCELLED",
+    "connection reset",
+    "Connection reset",
+    "remote_compile",     # tunnel-transport drops (see bench.py retry)
+    "Socket closed",
+    "failed to connect",
+    "Failed to connect",
+)
+
+
+def is_transient(exc) -> bool:
+    """Retryable? Injected transients yes, injected fatals no, runtime
+    errors by grpc-status message category."""
+    if isinstance(exc, TransientFaultError):
+        return True
+    if isinstance(exc, (InjectedFaultError, SimulatedWorkerDeath)):
+        return False
+    if isinstance(exc, CollectiveTimeoutError):
+        # a hung collective is not safely re-runnable in place: the hung
+        # attempt still owns the device stream — degrade, don't retry
+        return False
+    msg = str(exc)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+class RetryPolicy:
+    """Bounded exponential backoff: delay_i = min(base * 2**i, max)."""
+
+    def __init__(self, max_retries=2, base_delay_s=0.005, max_delay_s=0.25,
+                 classify=is_transient):
+        self.max_retries = int(max_retries)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.classify = classify
+
+    def delay(self, attempt) -> float:
+        return min(self.base_delay_s * (2 ** attempt), self.max_delay_s)
+
+
+def _env_policy(retries_flag):
+    from .. import config
+
+    return RetryPolicy(
+        max_retries=config.get(retries_flag),
+        base_delay_s=config.get("MXNET_RETRY_BASE_DELAY_MS") / 1e3,
+        max_delay_s=config.get("MXNET_RETRY_MAX_DELAY_MS") / 1e3)
+
+
+def compile_policy() -> RetryPolicy:
+    """Policy for XLA compiles (MXNET_COMPILE_MAX_RETRIES)."""
+    return _env_policy("MXNET_COMPILE_MAX_RETRIES")
+
+
+def collective_policy() -> RetryPolicy:
+    """Policy for dist_tpu collectives (MXNET_COLLECTIVE_MAX_RETRIES)."""
+    return _env_policy("MXNET_COLLECTIVE_MAX_RETRIES")
+
+
+def call_with_retry(fn, site, policy=None, on_retry=None):
+    """Run ``fn()``; on a transient failure back off and re-run, up to
+    ``policy.max_retries`` extra attempts. The last failure re-raises
+    unchanged (callers keep their existing except clauses)."""
+    policy = policy or RetryPolicy()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except SimulatedWorkerDeath:
+            raise
+        except Exception as exc:
+            if attempt >= policy.max_retries or not policy.classify(exc):
+                raise
+            _counters.incr("resilience.retries")
+            if _prof.ENABLED:
+                _prof.record_instant(
+                    f"resilience::retry({site})", "resilience",
+                    args={"attempt": attempt + 1,
+                          "error": f"{type(exc).__name__}: {exc}"[:200]})
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            time.sleep(policy.delay(attempt))
+            attempt += 1
+
+
+def retry_count() -> int:
+    """Process-wide successful-retry counter (bench/tests)."""
+    return _counters.get("resilience.retries")
+
+
+# -- watchdog ---------------------------------------------------------------
+
+
+def collective_timeout() -> float:
+    """MXNET_COLLECTIVE_TIMEOUT in seconds; 0/unset disables the watchdog."""
+    from .. import config
+
+    return config.get("MXNET_COLLECTIVE_TIMEOUT") or 0.0
+
+
+def run_with_watchdog(fn, timeout_s, site="collective"):
+    """Run ``fn()`` bounded by ``timeout_s``; raise
+    :class:`CollectiveTimeoutError` with a diagnosis instead of hanging.
+    ``timeout_s <= 0`` calls ``fn()`` inline (no thread, no overhead).
+
+    A fresh **daemon** thread per engaged call: a truly hung collective
+    leaks its thread without blocking interpreter exit or poisoning a
+    shared pool the next probe would queue behind.
+    """
+    if not timeout_s or timeout_s <= 0:
+        return fn()
+    box = {}
+    done = threading.Event()
+
+    def body():
+        try:
+            box["out"] = fn()
+        except BaseException as exc:  # rethrown on the caller thread
+            box["exc"] = exc
+        finally:
+            done.set()
+
+    t = threading.Thread(target=body, daemon=True,
+                         name=f"mxtpu-watchdog[{site}]")
+    t.start()
+    if not done.wait(timeout_s):
+        _counters.incr("resilience.watchdog_timeouts")
+        if _prof.ENABLED:
+            _prof.record_instant(f"resilience::watchdog_timeout({site})",
+                                 "resilience", args={"timeout_s": timeout_s})
+        raise CollectiveTimeoutError(
+            f"{site} did not complete within MXNET_COLLECTIVE_TIMEOUT="
+            f"{timeout_s}s — likely a hung ICI collective (peer down, "
+            "deadlocked mesh, or network partition). The attempt's thread "
+            "is still blocked in the runtime; degrading to the eager "
+            "fallback is the safe continuation.")
+    if "exc" in box:
+        raise box["exc"]
+    return box.get("out")
+
+
+# -- circuit breaker --------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with a call-count cooldown.
+
+    closed: calls allowed; ``failure_threshold`` consecutive ``record_failure``
+    calls trip it open. open: ``allow()`` is False for ``cooldown_calls``
+    queries, then half-open. half-open: exactly one probe allowed;
+    ``record_success`` closes, ``record_failure`` re-opens.
+    """
+
+    def __init__(self, failure_threshold=3, cooldown_calls=8, name="breaker"):
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_calls = int(cooldown_calls)
+        self.name = name
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self.trips = 0
+        self._denied = 0          # denials since the breaker opened
+        self._probe_out = False   # a half-open probe is in flight
+
+    def _transition(self, state):
+        self.state = state
+        if _prof.ENABLED:
+            _prof.record_instant(f"resilience::breaker({self.name})",
+                                 "resilience", args={"state": state})
+
+    def allow(self) -> bool:
+        """May the protected path run now? (also advances the cooldown)"""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                self._denied += 1
+                if self._denied >= self.cooldown_calls:
+                    self._transition("half_open")
+                    self._probe_out = False
+                return False
+            # half-open: one probe at a time
+            if self._probe_out:
+                return False
+            self._probe_out = True
+            return True
+
+    def release_probe(self):
+        """The allowed call never actually exercised the protected path
+        (e.g. ineligible input): free the half-open probe slot without a
+        state transition."""
+        with self._lock:
+            self._probe_out = False
+
+    def record_success(self):
+        with self._lock:
+            self.consecutive_failures = 0
+            self._probe_out = False
+            if self.state != "closed":
+                self._transition("closed")
+
+    def record_failure(self):
+        with self._lock:
+            self._probe_out = False
+            if self.state == "half_open":
+                self._denied = 0
+                self.trips += 1
+                _counters.incr("resilience.breaker_trips")
+                self._transition("open")
+                return
+            self.consecutive_failures += 1
+            if self.state == "closed" \
+                    and self.consecutive_failures >= self.failure_threshold:
+                self._denied = 0
+                self.trips += 1
+                _counters.incr("resilience.breaker_trips")
+                self._transition("open")
+
+    def snapshot(self):
+        with self._lock:
+            return {"state": self.state, "trips": self.trips,
+                    "consecutive_failures": self.consecutive_failures}

@@ -48,3 +48,6 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "seed(n): fix the RNG seed for a test")
     config.addinivalue_line("markers", "serial: run without xdist")
     config.addinivalue_line("markers", "integration: slower end-to-end test")
+    config.addinivalue_line(
+        "markers", "slow: excluded from tier-1 (-m 'not slow'); the "
+        "fault-injection stress loop and other long soak tests")
