@@ -891,6 +891,66 @@ def bench_lenet_eager():
     })
 
 
+def bench_guardrail_overhead():
+    """Numerical-guardrail cost on a small dense train step (PERF.md
+    'measured guardrail overhead'): baseline trainer vs one running the
+    full sentinel stack — LossScaler overflow check + global-norm clip per
+    step (the two per-step device-sync guardrails). The *disabled* cost
+    (no scaler, no clip — the production default) is a pair of `is None`
+    tests and is bounded separately by
+    tests/test_guardrails.py::test_disabled_guardrail_overhead_under_5pct."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, autograd, gluon
+    from mxnet_tpu import np as mnp
+
+    BATCH = 32
+    try:
+        ctx = mx.tpu()
+        ctx.jax_device()
+    except Exception:
+        ctx = mx.cpu()
+    x = mnp.array(onp.random.randn(BATCH, 64).astype("float32"), ctx=ctx)
+    y = mnp.array(onp.random.randn(BATCH, 1).astype("float32"), ctx=ctx)
+    loss_fn = gluon.loss.L2Loss()
+
+    def make(guarded):
+        net = gluon.nn.Dense(1, in_units=64)
+        net.initialize(ctx=ctx)
+        net(x)
+        kw = {"loss_scaler": amp.LossScaler(),
+              "clip_global_norm": 1e6} if guarded else {}
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 1e-3}, **kw)
+
+        def step():
+            with autograd.record():
+                l = tr.scale_loss(loss_fn(net(x), y).mean())
+            l.backward()
+            tr.step(1)
+            return l
+        return step
+
+    rates = {}
+    for guarded in (False, True):
+        step = make(guarded)
+        for _ in range(5):
+            float(step().asnumpy())
+        dt = _timed_diff(step, lambda l: float(l.asnumpy()), 5, 30)
+        rates[guarded] = 1.0 / dt
+    overhead = rates[False] / rates[True] - 1.0
+    return _emit({
+        "metric": "guardrail_overhead_dense_step",
+        "value": round(overhead * 100, 2),
+        "unit": "%",
+        "vs_baseline": None,
+        "base_steps_s": round(rates[False], 1),
+        "guarded_steps_s": round(rates[True], 1),
+        **_spread(),
+    })
+
+
 def bench_bandwidth():
     """KVStore push/pull bandwidth (tools/bandwidth parity, perf.md:263).
 
@@ -933,6 +993,7 @@ def main():
                      ("infer_int8", bench_resnet_infer_int8),
                      ("infer_pallas_fused", bench_resnet_infer_pallas_fused),
                      ("bandwidth", bench_bandwidth),
+                     ("guardrail_overhead", bench_guardrail_overhead),
                      ("lenet_eager", bench_lenet_eager),
                      ("bert", bench_bert_train),
                      ("bert_fused", bench_bert_train_fused),

@@ -109,15 +109,58 @@ convert_model = convert_hybrid_block
 
 class LossScaler:
     """Dynamic loss scaling (reference ``contrib/amp/loss_scaler.py``):
-    scale up every ``scale_window`` clean steps, halve on inf/nan."""
+    scale up every ``scale_window`` clean steps, halve on inf/nan.
+
+    Hardened for unattended runs: the scale is clamped to
+    ``[min_scale, max_scale]`` (defaults from ``MXNET_LOSS_SCALE_MIN`` /
+    ``MXNET_LOSS_SCALE_MAX``) so a pathological overflow streak can never
+    drive it to 0 (all gradients vanish, training silently stalls) and an
+    overflow-free month can never drive it to inf (the *scaler itself*
+    becomes the NaN source). Non-finite or non-positive scale values —
+    from a bad ``init_scale``, or state restored from a corrupt source —
+    are rejected at construction and repaired in :meth:`update`.
+
+    Attach to a ``gluon.Trainer(loss_scaler=...)``: the trainer checks the
+    (all-reduced) gradients each step, skips the update and scales down on
+    overflow, and folds the unscale into its fused update. ``overflows``
+    and ``skipped_steps`` count trips; every one lands on the resilience
+    counter bus (``resilience.loss_scale_overflows``).
+    """
 
     def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
-                 scale_window=2000, min_scale=1.0):
-        self.loss_scale = float(init_scale)
-        self._factor = scale_factor
+                 scale_window=2000, min_scale=None, max_scale=None):
+        from . import config as _config
+
+        self._min = float(min_scale if min_scale is not None
+                          else _config.get("MXNET_LOSS_SCALE_MIN"))
+        self._max = float(max_scale if max_scale is not None
+                          else _config.get("MXNET_LOSS_SCALE_MAX"))
+        if not (_onp.isfinite(self._min) and _onp.isfinite(self._max)
+                and 0.0 < self._min <= self._max):
+            raise MXNetError(
+                f"LossScaler needs 0 < min_scale <= max_scale (finite), "
+                f"got [{self._min}, {self._max}]")
+        if not (_onp.isfinite(init_scale) and init_scale > 0):
+            raise MXNetError(
+                f"LossScaler init_scale must be finite and > 0, got "
+                f"{init_scale}")
+        if not (_onp.isfinite(scale_factor) and scale_factor > 1.0):
+            raise MXNetError(
+                f"LossScaler scale_factor must be finite and > 1, got "
+                f"{scale_factor}")
+        self.loss_scale = self._clamp(float(init_scale))
+        self._factor = float(scale_factor)
         self._window = scale_window
-        self._min = min_scale
         self._unskipped = 0
+        self.overflows = 0
+        self.skipped_steps = 0
+
+    def _clamp(self, scale):
+        """Keep the scale finite, positive, and inside [min, max] no
+        matter what arithmetic produced it."""
+        if not _onp.isfinite(scale) or scale <= 0.0:
+            return self._min
+        return min(max(scale, self._min), self._max)
 
     def scale(self, loss):
         return loss * self.loss_scale
@@ -135,13 +178,18 @@ class LossScaler:
 
     def update(self, overflow):
         """Post-step bookkeeping; returns True if the step must be skipped."""
+        # repair first: loss_scale is a plain attribute, so externally
+        # assigned garbage (a corrupt restore) must not survive an update
+        self.loss_scale = self._clamp(self.loss_scale)
         if overflow:
-            self.loss_scale = max(self._min, self.loss_scale / self._factor)
+            self.overflows += 1
+            self.skipped_steps += 1
+            self.loss_scale = self._clamp(self.loss_scale / self._factor)
             self._unskipped = 0
             return True
         self._unskipped += 1
         if self._unskipped >= self._window:
-            self.loss_scale *= self._factor
+            self.loss_scale = self._clamp(self.loss_scale * self._factor)
             self._unskipped = 0
         return False
 

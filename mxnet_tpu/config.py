@@ -151,3 +151,43 @@ register_flag(
     "MXNET_COLLECTIVE_BREAKER_COOLDOWN", 8,
     "Fast-path queries the breaker stays open before letting one "
     "half-open probe re-test the collective path.", int)
+register_flag(
+    "MXNET_NAN_QUARANTINE", False,
+    "Pre-collective non-finite sentinel in dist_tpu.allreduce: a gradient "
+    "with NaN/Inf is caught BEFORE it poisons the whole mesh's allreduce. "
+    "Costs one fused isfinite reduction + host sync per reduced tensor, "
+    "so off by default.", _bool)
+register_flag(
+    "MXNET_NAN_QUARANTINE_MODE", "skip",
+    "What the quarantine does on trip: 'skip' raises NonFiniteGradError "
+    "(GuardrailHandler turns it into a skipped step); 'drop' excludes the "
+    "poisoned replicas and sums the clean ones, rescaled by "
+    "n_total/n_clean to keep the expected gradient magnitude.")
+register_flag(
+    "MXNET_GUARDRAIL_SPIKE_WINDOW", 32,
+    "Rolling-window length for the guardrail loss-spike detector "
+    "(resilience.guardrails.SpikeDetector).", int)
+register_flag(
+    "MXNET_GUARDRAIL_SPIKE_ZSCORE", 6.0,
+    "Z-score over the rolling window above which a loss value counts as "
+    "a spike (plus a 2x relative-jump floor for flat windows).", float)
+register_flag(
+    "MXNET_GUARDRAIL_WARMUP", 8,
+    "Steps the spike detector only builds statistics for before it may "
+    "flag (the initial loss cliff is expected, not an anomaly).", int)
+register_flag(
+    "MXNET_GUARDRAIL_MAX_SKIPS", 3,
+    "Consecutive guardrail skip-steps before escalation to "
+    "rewind-and-skip (GuardrailHandler).", int)
+register_flag(
+    "MXNET_GUARDRAIL_MAX_REWINDS", 2,
+    "Rewind-and-skip recoveries before GuardrailHandler gives up and "
+    "raises DivergenceError.", int)
+register_flag(
+    "MXNET_LOSS_SCALE_MIN", 1.0,
+    "Lower clamp for the dynamic LossScaler (amp.py): repeated overflows "
+    "can never drive the scale to 0.", float)
+register_flag(
+    "MXNET_LOSS_SCALE_MAX", 2.0 ** 24,
+    "Upper clamp for the dynamic LossScaler: a long overflow-free run "
+    "can never drive the scale to inf.", float)

@@ -10,6 +10,7 @@ from .event_handler import (
     EpochEnd,
     LoggingHandler,
     MetricHandler,
+    PreStep,
     StoppingHandler,
     TrainBegin,
     TrainEnd,
@@ -18,11 +19,15 @@ from .event_handler import (
 
 
 def __getattr__(name):
-    # lazy: resilience.checkpoint subclasses the event-handler bases above,
-    # so an eager import here would be circular
+    # lazy: resilience.checkpoint/guardrails subclass the event-handler
+    # bases above, so an eager import here would be circular
     if name == "ResilientCheckpointHandler":
         from ....resilience.checkpoint import ResilientCheckpointHandler
 
         return ResilientCheckpointHandler
+    if name == "GuardrailHandler":
+        from ....resilience.guardrails import GuardrailHandler
+
+        return GuardrailHandler
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

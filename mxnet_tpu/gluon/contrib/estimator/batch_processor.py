@@ -50,5 +50,16 @@ class BatchProcessor:
         with autograd.record():
             pred = estimator.net(data)
             loss = estimator.loss(pred, label).mean()
-        loss.backward()
+            # backward through the trainer's loss scaler (identity when no
+            # scaler is attached): step() unscales, so skipping the scale
+            # here would silently divide every update by loss_scale
+            scale = getattr(estimator.trainer, "scale_loss", None)
+            scaled = loss if scale is None else scale(loss)
+        scaled.backward()
+        # grads exist NOW: evaluate the trainer:grad fault site here so an
+        # injected 'nan' is visible to the pre-step guardrail sentinels
+        # (inside step() it would corrupt after the veto point)
+        check = getattr(estimator.trainer, "check_grad_faults", None)
+        if check is not None:
+            check()
         return data, label, pred, loss

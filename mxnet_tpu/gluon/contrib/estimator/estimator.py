@@ -6,8 +6,9 @@ from ....base import MXNetError
 from ... import Trainer, loss as gloss, metric as gmetric
 from .batch_processor import BatchProcessor
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
-                            LoggingHandler, MetricHandler, StoppingHandler,
-                            TrainBegin, TrainEnd, ValidationHandler)
+                            LoggingHandler, MetricHandler, PreStep,
+                            StoppingHandler, TrainBegin, TrainEnd,
+                            ValidationHandler)
 
 
 class _LossMetric(gmetric.Loss):
@@ -60,8 +61,8 @@ class Estimator:
             epochs = 1
         handlers = self._init_handlers(val_data, event_handlers,
                                        epochs, batches)
-        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
-            train_end = self._categorize(handlers)
+        train_begin, epoch_begin, batch_begin, pre_step, batch_end, \
+            epoch_end, train_end = self._categorize(handlers)
 
         for h in train_begin:
             h.train_begin(self)
@@ -74,7 +75,22 @@ class Estimator:
                     h.batch_begin(self, batch=batch)
                 _data, label, pred, l = \
                     self.batch_processor.fit_batch(self, batch)
-                self.trainer.step(1)
+                # pre-step vetting (numerical guardrails): any PreStep
+                # handler returning False vetoes the optimizer update for
+                # this batch — the weights never see it
+                step_ok = True
+                for h in pre_step:
+                    if h.pre_step(self, batch=batch, loss=l) is False:
+                        step_ok = False
+                if step_ok:
+                    try:
+                        self.trainer.step(1)
+                    except MXNetError as e:
+                        # e.g. the dist_tpu pre-collective NaN quarantine:
+                        # a PreStep handler may absorb it as a skip-step
+                        if not any(h.step_error(self, e)
+                                   for h in pre_step):
+                            raise
                 for h in batch_end:
                     h.batch_end(self, batch=batch, pred=pred, label=label,
                                 loss=l)
@@ -113,6 +129,7 @@ class Estimator:
         return ([h for h in handlers if isinstance(h, TrainBegin)],
                 [h for h in handlers if isinstance(h, EpochBegin)],
                 [h for h in handlers if isinstance(h, BatchBegin)],
+                [h for h in handlers if isinstance(h, PreStep)],
                 [h for h in handlers if isinstance(h, BatchEnd)],
                 [h for h in handlers if isinstance(h, EpochEnd)],
                 [h for h in handlers if isinstance(h, TrainEnd)])

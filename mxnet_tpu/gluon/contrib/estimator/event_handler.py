@@ -40,6 +40,26 @@ class BatchEnd:
         pass
 
 
+class PreStep:
+    """Handlers judging a batch BETWEEN backward and the optimizer step.
+
+    ``pre_step`` runs after ``fit_batch`` computed the loss and gradients
+    but before ``trainer.step`` applies them; returning ``False`` vetoes
+    the update for this batch (the fit loop still runs ``batch_end``, so
+    metrics/checkpoints observe the skipped batch). ``step_error`` is
+    offered any exception ``trainer.step`` raises; returning ``True``
+    absorbs it (the batch becomes a skip), ``False`` lets it propagate.
+    The numerical guardrails (``resilience.guardrails.GuardrailHandler``)
+    are the canonical implementation.
+    """
+
+    def pre_step(self, estimator, batch=None, loss=None):  # pylint: disable=unused-argument
+        return True
+
+    def step_error(self, estimator, exc):  # pylint: disable=unused-argument
+        return False
+
+
 class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
     """Stop at max_epoch/max_batch (reference ``event_handler.py:94``)."""
 

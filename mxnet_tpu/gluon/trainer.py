@@ -20,10 +20,24 @@ from ..ndarray.ndarray import NDArray
 from ..optimizer import Optimizer, create as create_optimizer
 from .parameter import Parameter
 
+# fault-injection hot-state (resilience.faults.FaultPlan slot, see
+# ops/registry.py): None until a plan installs. The `trainer:grad` site is
+# the one implementing the 'nan' kind — a matching rule poisons every
+# parameter gradient before allreduce/update, which is how the numerical
+# guardrails are exercised deterministically on CPU.
+_FAULTS = None
+
+
+def _guardrails():
+    from ..resilience import guardrails
+
+    return guardrails
+
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 loss_scaler=None, clip_global_norm=None):
         if isinstance(params, (dict,)):
             self._ordered_names = list(params.keys())
             params = list(params.values())
@@ -48,6 +62,15 @@ class Trainer:
         self._states = None
         self._fused = None
         self._step_count = 0
+        # numerical guardrails (resilience.guardrails / amp.LossScaler):
+        # both default off — a trainer that uses neither pays one `is
+        # None` test per step for each
+        self._loss_scaler = loss_scaler
+        if clip_global_norm is not None and not clip_global_norm > 0:
+            raise MXNetError(
+                f"clip_global_norm must be > 0, got {clip_global_norm}")
+        self._clip_global_norm = clip_global_norm
+        self._grad_fault_checked = False
 
     # -- properties -------------------------------------------------------
     @property
@@ -57,6 +80,26 @@ class Trainer:
     @property
     def optimizer(self):
         return self._optimizer
+
+    @property
+    def loss_scaler(self):
+        return self._loss_scaler
+
+    def set_loss_scaler(self, scaler):
+        """Attach (or detach with ``None``) a dynamic ``amp.LossScaler``:
+        the trainer then checks the all-reduced grads each step, skips the
+        update + scales down on overflow, and unscales inside the fused
+        update otherwise."""
+        self._loss_scaler = scaler
+
+    def scale_loss(self, loss):
+        """Scale one loss (or a list) by the attached scaler before
+        ``backward`` — identity when no scaler is attached."""
+        if self._loss_scaler is None:
+            return loss
+        from ..amp import scale_loss as _scale
+
+        return _scale(loss, self._loss_scaler)
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
@@ -104,9 +147,36 @@ class Trainer:
     # -- core step --------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads, then optimizer update; grads scaled by
-        ``rescale_grad/batch_size`` (reference semantics)."""
+        ``rescale_grad/batch_size`` (reference semantics).
+
+        With a ``loss_scaler`` attached every grad replica is sentinel
+        -checked before the allreduce: overflow ⇒ the update is skipped
+        and the scale halves (the grads carry ``loss_scale`` from the
+        scaled backward, so any inf/nan there is the overflow signal, and
+        skipping pre-collective keeps it out of the NaN quarantine); a
+        clean step folds the unscale into the update's rescale factor. With
+        ``clip_global_norm`` set the grads are globally norm-clipped
+        (threshold expressed in *unscaled* units) before the update.
+        """
         self._init_kvstore()
+        # the estimator's batch processor evaluates the site right after
+        # backward (so pre-step sentinels see the corruption); only plain
+        # training loops reach it here
+        if not self._grad_fault_checked:
+            self.check_grad_faults()
+        self._grad_fault_checked = False
         if self._update_on_kvstore:
+            if self._loss_scaler is not None \
+                    or self._clip_global_norm is not None:
+                # the server-side update path never sees the scaler's
+                # unscale/overflow check or the clip — pushing scaled
+                # grads would apply updates loss_scale-times too large,
+                # silently
+                raise MXNetError(
+                    "loss_scaler/clip_global_norm are not supported with "
+                    "update_on_kvstore=True (the optimizer runs on the "
+                    "store, past the guardrails); update on worker "
+                    "instead")
             # optimizer runs on the store (reference server-side update):
             # push grads, pull updated weights — no local update
             self._optimizer.rescale_grad = self._scale / batch_size
@@ -114,8 +184,70 @@ class Trainer:
                 kv = self._kvstore
                 kv.pushpull(i, p.list_grad(), out=p.list_data())
             return
+        scaler = self._loss_scaler
+        if scaler is not None:
+            gr = _guardrails()
+            # the scale the backward actually used — captured BEFORE
+            # update() may grow it at a window boundary
+            cur_scale = scaler.loss_scale
+            # overflow check BEFORE the allreduce: NaN on any replica
+            # would be NaN on all of them after the collective anyway,
+            # and skipping here keeps a scaler-managed overflow out of
+            # the dist_tpu NaN quarantine (which would otherwise raise
+            # before scaler.update ever ran — the scale would never
+            # adapt)
+            grads = []
+            for p in self._params:
+                grads.extend(p.list_grad())
+            overflow = not gr.all_finite(grads)
+            if scaler.update(overflow):
+                from ..profiler import core as _prof
+                from ..resilience import counters as _counters
+
+                _counters.incr("resilience.loss_scale_overflows")
+                if _prof.ENABLED:
+                    _prof.record_instant(
+                        "resilience::loss_scale(overflow)", "resilience",
+                        args={"new_scale": scaler.loss_scale})
+                return  # grads are garbage; next backward overwrites them
+            self._allreduce_grads()
+            self._apply_global_clip(scale_factor=cur_scale)
+            # fold the unscale into the fused update's single multiply
+            self._update(batch_size * cur_scale, ignore_stale_grad)
+            return
         self._allreduce_grads()
+        self._apply_global_clip()
         self._update(batch_size, ignore_stale_grad)
+
+    def _apply_global_clip(self, scale_factor=1.0):
+        if self._clip_global_norm is None:
+            return
+        # grads still carry the loss scale here, so the threshold (given
+        # in unscaled units) is scaled up to match
+        _guardrails().clip_by_global_norm(
+            [p.grad() for p in self._params],
+            self._clip_global_norm * scale_factor)
+
+    def check_grad_faults(self):
+        """Evaluate the ``trainer:grad`` fault site once per step: a
+        matching ``nan`` rule poisons every gradient replica the way a bad
+        bf16 kernel / overflowed backward would, so guardrail recovery is
+        testable end to end on CPU. The estimator's ``fit_batch`` calls
+        this right after ``backward`` (the poison must exist *before* the
+        pre-step sentinels run); ``step()`` calls it for plain loops and
+        skips it when the processor already did."""
+        self._grad_fault_checked = True
+        flt = _FAULTS
+        if flt is not None and flt.check(
+                "trainer:grad", {"step": self._step_count}) == "nan":
+            self._poison_grads()
+
+    def _poison_grads(self):
+        import jax.numpy as jnp
+
+        for p in self._params:
+            for g in p.list_grad():
+                g._set_data_internal(jnp.full_like(g._data, jnp.nan))
 
     def allreduce_grads(self):
         self._init_kvstore()
@@ -202,6 +334,11 @@ class Trainer:
                 new_p = []
                 new_s = []
                 for pd, gd, sd, lr, wd in zip(pdatas, gdatas, sdatas, lrs, wds):
+                    # ordering contract: rescale THEN clip, exactly like
+                    # Optimizer._prep_grad on the non-fused path — the two
+                    # paths must produce identical updates for the same
+                    # grads (regression:
+                    # tests/test_guardrails.py::test_fused_vs_eager_clip_ordering_parity)
                     g = gd.astype(pd.dtype) * scale
                     if opt.clip_gradient is not None:
                         import jax.numpy as jnp

@@ -36,23 +36,21 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
 
 
 def clip_global_norm(arrays, max_norm, check_isfinite=True):
-    """Rescale arrays so their global L2 norm <= max_norm (reference util)."""
-    import jax.numpy as jnp
+    """Rescale arrays so their global L2 norm <= max_norm (reference util).
 
-    total = None
-    for a in arrays:
-        n = jnp.sum(jnp.square(a._data.astype(jnp.float32)))
-        total = n if total is None else total + n
-    norm = float(jnp.sqrt(total))
+    Thin wrapper over ``resilience.guardrails.clip_by_global_norm`` — the
+    same fused-reduction implementation ``Trainer(clip_global_norm=...)``
+    uses, so the manual and the trainer-integrated paths cannot drift.
+    A non-finite norm leaves the arrays untouched (scaling can't fix it)
+    and warns when ``check_isfinite``.
+    """
+    from ..resilience.guardrails import clip_by_global_norm
+
+    _, norm = clip_by_global_norm(arrays, max_norm)
     if check_isfinite and not _onp.isfinite(norm):
         import warnings
 
         warnings.warn("nan or inf in clip_global_norm")
-        return norm
-    scale = max_norm / max(norm, max_norm)
-    if scale < 1.0:
-        for a in arrays:
-            a._set_data_internal(a._data * scale)
     return norm
 
 

@@ -78,8 +78,19 @@ class KVStoreDistTPUSync(KVStoreLocal):
         # slot is re-poked, not re-read)
         self._retry_policy = _retry.collective_policy()
         self._watchdog_timeout = _retry.collective_timeout()
+        # pre-collective NaN quarantine (resilience.guardrails): resolved
+        # once here like the knobs above — allreduce runs per step
+        self._nan_quarantine = bool(_config.get("MXNET_NAN_QUARANTINE"))
+        self._nan_quarantine_mode = str(
+            _config.get("MXNET_NAN_QUARANTINE_MODE"))
+        if self._nan_quarantine_mode not in ("skip", "drop"):
+            # a typo ('Drop') would otherwise silently behave as skip
+            raise MXNetError(
+                f"MXNET_NAN_QUARANTINE_MODE must be 'skip' or 'drop', "
+                f"got {self._nan_quarantine_mode!r}")
         self._stats = {"allreduce_calls": 0, "collective": 0, "eager": 0,
-                       "degradations": 0, "breaker_skips": 0}
+                       "degradations": 0, "breaker_skips": 0,
+                       "quarantined": 0}
 
     def collective_stats(self):
         """Resilience/degradation telemetry for this store (the
@@ -149,6 +160,59 @@ class KVStoreDistTPUSync(KVStoreLocal):
         total = jax.jit(
             lambda v: v.sum(), out_shardings=NamedSharding(mesh, P()))(x)
         total.block_until_ready()
+
+    def _quarantine_check(self, arrays, datas):
+        """Pre-collective NaN quarantine (``MXNET_NAN_QUARANTINE=1``): a
+        non-finite gradient is caught BEFORE the collective, because after
+        the allreduce every replica on the mesh carries the poison.
+
+        Returns ``None`` when every replica is finite. On trip:
+        ``mode='skip'`` raises :class:`~...resilience.guardrails.
+        NonFiniteGradError` (the estimator's GuardrailHandler turns it
+        into a skipped step); ``mode='drop'`` excludes the poisoned
+        replicas and returns the sum of the clean ones rescaled by
+        ``n_total/n_clean`` — the unbiased estimate of the full-mesh sum,
+        placed back on every source device.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        bad = [not bool(jnp.isfinite(d).all()) for d in datas]
+        if not any(bad):
+            return None
+        from ..resilience.guardrails import NonFiniteGradError
+
+        n, nbad = len(datas), sum(bad)
+        self._stats["quarantined"] += 1
+        _res_counters.incr("resilience.nan_quarantined")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::quarantine(allreduce)",
+                                 "resilience",
+                                 args={"bad_replicas": nbad, "of": n,
+                                       "mode": self._nan_quarantine_mode})
+        warnings.warn(
+            f"NaN quarantine: {nbad}/{n} gradient replica(s) non-finite "
+            f"before the allreduce (mode={self._nan_quarantine_mode})",
+            RuntimeWarning, stacklevel=3)
+        if self._nan_quarantine_mode == "drop" and nbad < n:
+            good = [d for d, b in zip(datas, bad) if not b]
+            dev0 = next(iter(good[0].devices()))
+            stacked = jnp.stack([jax.device_put(d, dev0) for d in good])
+            summed = jnp.sum(stacked, axis=0) * (n / len(good))
+            return [NDArray(jax.device_put(
+                summed, list(a._data.devices())[0])) for a in arrays]
+        if nbad == n:
+            raise NonFiniteGradError(
+                f"allreduce quarantine: every replica ({n}/{n}) contains "
+                "NaN/Inf — nothing to sum in any mode. Skip this step "
+                "(GuardrailHandler does this automatically) or attach a "
+                "LossScaler so overflows are absorbed pre-collective.")
+        raise NonFiniteGradError(
+            f"allreduce quarantine: {nbad}/{n} gradient replica(s) "
+            "contain NaN/Inf — the collective would poison every replica "
+            "on the mesh. Skip this step (GuardrailHandler does this "
+            "automatically), or set MXNET_NAN_QUARANTINE_MODE=drop to "
+            "sum the clean replicas only.")
 
     # -- collectives ------------------------------------------------------
     def _mesh_devices(self):
@@ -267,6 +331,13 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if len(arrays) == 1:
             return arrays
         datas = [a._data for a in arrays]
+        if self._nan_quarantine:
+            # BEFORE breaker/retry/watchdog: a poisoned input is not a
+            # fast-path failure, and the eager fallback must not sum it
+            # either
+            dropped = self._quarantine_check(arrays, datas)
+            if dropped is not None:
+                return dropped
         t0 = _prof.begin() if _prof.ENABLED else 0
         self._stats["allreduce_calls"] += 1
         fast = None

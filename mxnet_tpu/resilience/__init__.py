@@ -17,6 +17,11 @@ the runtime *survive* them. Three cooperating layers:
   fsync + atomic rename, CRC32 footer), corruption rollback to last-good,
   ``load_latest`` resume, and the estimator-integrated
   :class:`~.checkpoint.ResilientCheckpointHandler`.
+* :mod:`.guardrails` — numerical failure: NaN/Inf sentinels with
+  per-parameter attribution, the dist_tpu pre-collective NaN quarantine,
+  EWMA+z-score loss-spike detection, and the
+  :class:`~.guardrails.GuardrailHandler` skip-step → rewind-and-skip →
+  :class:`~.guardrails.DivergenceError` recovery policy.
 
 Everything emits ``resilience::*`` events/counters on the PR-1 profiler
 bus; :func:`resilience_stats` snapshots them for bench/BENCH rows.
@@ -31,24 +36,37 @@ from .retry import (CircuitBreaker, CollectiveTimeoutError, RetryPolicy,
                     call_with_retry, collective_policy, collective_timeout,
                     compile_policy, is_transient, run_with_watchdog)
 
-# checkpoint pulls gluon (event-handler bases); load it on first touch so
-# `from mxnet_tpu.resilience import faults` stays light
+# checkpoint and guardrails pull gluon (event-handler bases); load them on
+# first touch so `from mxnet_tpu.resilience import faults` stays light
 _CHECKPOINT_NAMES = (
     "checkpoint", "CheckpointCorruptError", "CheckpointManager",
     "ResilientCheckpointHandler", "load_checkpoint", "save_checkpoint",
 )
+_GUARDRAIL_NAMES = (
+    "guardrails", "DivergenceError", "GuardrailHandler",
+    "NonFiniteGradError", "SpikeDetector", "all_finite",
+    "attribute_nonfinite", "clip_by_global_norm", "nonfinite_count",
+)
 
 
 def __getattr__(name):
+    # NOT `from . import <mod>`: the fromlist handler getattrs the
+    # package and would re-enter this __getattr__ unboundedly
     if name in _CHECKPOINT_NAMES:
         import importlib
 
-        # NOT `from . import checkpoint`: the fromlist handler getattrs
-        # the package and would re-enter this __getattr__ unboundedly
         _ckpt = importlib.import_module(__name__ + ".checkpoint")
         globals()["checkpoint"] = _ckpt
         for n in _CHECKPOINT_NAMES[1:]:
             globals()[n] = getattr(_ckpt, n)
+        return globals()[name]
+    if name in _GUARDRAIL_NAMES:
+        import importlib
+
+        _gr = importlib.import_module(__name__ + ".guardrails")
+        globals()["guardrails"] = _gr
+        for n in _GUARDRAIL_NAMES[1:]:
+            globals()[n] = getattr(_gr, n)
         return globals()[name]
     raise AttributeError(
         f"module 'mxnet_tpu.resilience' has no attribute {name!r}")
@@ -71,6 +89,12 @@ def resilience_stats():
         "resilience.checkpoints_saved",
         "resilience.checkpoints_corrupt",
         "resilience.faults_injected",
+        # numerical guardrails (resilience.guardrails)
+        "resilience.sentinel_trips",
+        "resilience.guardrail_skips",
+        "resilience.guardrail_rewinds",
+        "resilience.nan_quarantined",
+        "resilience.loss_scale_overflows",
     )
     out = {k.split(".", 1)[1]: _counters.get(k) for k in keys}
     out["fault_plan_active"] = faults._active is not None

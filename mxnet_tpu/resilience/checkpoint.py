@@ -236,6 +236,18 @@ class CheckpointManager:
             except OSError:
                 pass
 
+    def quarantine(self, step, suffix=".corrupt"):
+        """Move one checkpoint out of the rotation by renaming it with
+        ``suffix`` (``.corrupt`` for CRC/structure failures, ``.poisoned``
+        when the guardrails find non-finite parameters in a CRC-valid
+        file). Returns True if the file was moved."""
+        path = self._path(step)
+        try:
+            os.replace(path, path + suffix)
+            return True
+        except OSError:
+            return False
+
     def load_latest(self, net=None, trainer=None):
         """Restore the newest valid checkpoint; corrupt files roll back to
         the previous one. Returns its ``meta`` dict (contains ``step``),
@@ -252,10 +264,7 @@ class CheckpointManager:
                 warnings.warn(
                     f"skipping corrupt checkpoint: {e}", RuntimeWarning,
                     stacklevel=2)
-                try:
-                    os.replace(path, path + ".corrupt")
-                except OSError:
-                    pass
+                self.quarantine(step)
             except MXNetError as e:
                 # CRC-valid but incompatible with THIS net/trainer (e.g. a
                 # params-only snapshot restored with a trainer, missing

@@ -48,6 +48,15 @@ hit sequence). Kinds:
     ``except Exception`` recovery code cannot swallow it, so it unwinds the
     whole training loop the way a SIGKILLed worker would, without killing
     the test process.
+``nan``
+    does NOT raise: :meth:`FaultPlan.check` returns the string ``"nan"``
+    and the *call site* corrupts its own payload (``trainer:grad`` poisons
+    every parameter gradient with NaN before the optimizer update). This is
+    how the numerical-guardrail paths — sentinel trip, pre-collective
+    quarantine, skip-step, rewind-and-skip — are exercised deterministically
+    on CPU. Sites that don't implement corruption ignore the return value,
+    so a ``nan`` rule on e.g. ``engine:wait`` fires (and is counted) but
+    has no effect.
 """
 from __future__ import annotations
 
@@ -70,6 +79,8 @@ KNOWN_SITES = (
     "kvstore:broadcast",    # dist_tpu.broadcast per-key loop
     "engine:wait",          # engine.wait_all drain
     "estimator:batch",      # ResilientCheckpointHandler.batch_end
+    "trainer:grad",         # gluon.Trainer.step, before allreduce/update
+                            # (the only site implementing the 'nan' kind)
 )
 
 
@@ -114,7 +125,7 @@ class FaultPlan:
             kind = r.get("kind", "transient")
             if not site:
                 raise MXNetError(f"fault rule {i} missing 'site'")
-            if kind not in ("transient", "fatal", "delay", "die"):
+            if kind not in ("transient", "fatal", "delay", "die", "nan"):
                 raise MXNetError(f"fault rule {i}: unknown kind {kind!r}")
             triggers = [t for t in ("at", "times", "prob") if t in r]
             if len(triggers) != 1:
@@ -156,7 +167,9 @@ class FaultPlan:
 
     def check(self, site, info=None):
         """Evaluate every matching rule for one hit of ``site``; raises or
-        sleeps per the first rule that fires."""
+        sleeps per the first rule that fires. Non-raising kinds return a
+        marker instead: ``"nan"`` tells a corruption-capable call site to
+        poison its payload (all other callers ignore the return value)."""
         if not self._match_all and site not in self._sites:
             return
         action = None
@@ -189,6 +202,8 @@ class FaultPlan:
         if kind == "delay":
             time.sleep(action["seconds"])
             return
+        if kind == "nan":
+            return "nan"
         if kind == "transient":
             raise TransientFaultError(msg)
         if kind == "die":
@@ -208,6 +223,7 @@ _SLOT_MODULES = (
     "mxnet_tpu.cachedop",
     "mxnet_tpu.engine",
     "mxnet_tpu.kvstore.dist_tpu",
+    "mxnet_tpu.gluon.trainer",
 )
 
 
@@ -283,7 +299,9 @@ def get_plan() -> FaultPlan | None:
 
 def fault_point(site, info=None):
     """Module-level convenience: evaluate ``site`` against the active plan
-    (used by call sites that don't keep their own slot)."""
+    (used by call sites that don't keep their own slot). Forwards
+    :meth:`FaultPlan.check`'s marker return (``"nan"``)."""
     plan = get_plan()
     if plan is not None:
-        plan.check(site, info)
+        return plan.check(site, info)
+    return None

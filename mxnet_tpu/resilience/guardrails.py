@@ -1,0 +1,503 @@
+"""Numerical guardrails: NaN/Inf sentinels, loss-spike detection, and
+rewind-and-skip recovery.
+
+PR 2 made the runtime survive *infrastructure* failures; this module makes
+*numerical* failure — a NaN gradient or a loss spike silently corrupting
+weights — detectable, attributable, and automatically recoverable. Three
+layers, mirroring how large bf16 runs handle divergence in production
+(dynamic loss scaling per Micikevicius et al.; PaLM-style
+rewind-to-checkpoint-and-skip-batches per Chowdhery et al. 2022):
+
+* **Sentinels** — cheap non-finite checks built from fused jax reductions:
+  :func:`all_finite` / :func:`nonfinite_count` over gradient or parameter
+  lists, :func:`attribute_nonfinite` for per-parameter blame on trip, and
+  the pre-collective quarantine ``KVStoreDistTPUSync`` runs when
+  ``MXNET_NAN_QUARANTINE=1`` so one worker's bad gradient cannot poison
+  the allreduce (the whole mesh would otherwise step on NaNs).
+* **Anomaly detection** — :class:`SpikeDetector`: EWMA + rolling-window
+  z-score over the loss (and optionally grad-norm) series flags spikes
+  *before* they become NaNs; :func:`clip_by_global_norm` is the matching
+  prevention tool (``gluon.Trainer(clip_global_norm=...)`` and
+  ``gluon.utils.clip_global_norm`` both use it).
+* **Recovery policy** — :class:`GuardrailHandler`, an estimator event
+  handler that escalates: **skip-step** (bad grads caught before the
+  update — the update is vetoed, weights stay clean) → **rewind** to
+  ``CheckpointManager.load_latest()`` + skip the offending batch window
+  (corruption detected after an update; numerically-poisoned checkpoints
+  are quarantined and rolled past) → :class:`DivergenceError` (no clean
+  checkpoint, or the trip/rewind budget is exhausted).
+
+Every action is counted in the PR-2 resilience counters
+(``resilience.sentinel_trips`` / ``guardrail_skips`` / ``guardrail_rewinds``
+/ ``nan_quarantined`` / ``loss_scale_overflows``) and traced as
+``resilience::guardrail(...)`` instants on the PR-1 profiler bus. The
+``nan`` fault kind (``resilience.faults``) makes every path here
+deterministically testable on CPU: a rule like ``{"site": "trainer:grad",
+"kind": "nan", "at": [5]}`` poisons all gradients at step 5.
+
+Hot-path contract: nothing in this module touches op dispatch. The only
+per-step costs when guardrails are *disabled* are the existing ``_FAULTS``
+slot test in ``Trainer.step`` and an ``is None`` test each for the loss
+scaler and global-norm clip — covered by the <5% eager-microloop bound in
+``tests/test_guardrails.py``.
+"""
+from __future__ import annotations
+
+import warnings
+
+from ..base import MXNetError
+from ..gluon.contrib.estimator.event_handler import (BatchEnd, PreStep,
+                                                     TrainBegin)
+from ..profiler import core as _prof
+from . import counters as _counters
+
+
+class NonFiniteGradError(MXNetError):
+    """A gradient failed the non-finite sentinel (raised by the
+    pre-collective quarantine in skip mode; handled as a skip-step by the
+    estimator when a :class:`GuardrailHandler` is installed)."""
+
+
+class DivergenceError(MXNetError):
+    """Guardrail escalation exhausted: no clean checkpoint to rewind to,
+    or the skip/rewind budget ran out. The run cannot self-heal."""
+
+
+# -- sentinels (jit-friendly fused reductions) ------------------------------
+
+
+def _datas(arrays):
+    """Unwrap NDArrays to jax arrays; pass raw jax arrays through."""
+    return [getattr(a, "_data", a) for a in arrays if a is not None]
+
+
+def _by_device(datas):
+    """Group jax arrays by placement: per-array reductions combine
+    on-device within a group (a cross-device eager add throws), and each
+    group pays ONE host sync — so the guardrail cost per step is a sync
+    per *device*, not per parameter (PERF.md's contract)."""
+    groups = {}
+    for d in datas:
+        try:
+            key = frozenset(d.devices())
+        except (AttributeError, TypeError):
+            key = None
+        groups.setdefault(key, []).append(d)
+    return groups.values()
+
+
+def nonfinite_count(arrays) -> int:
+    """Total number of non-finite elements across ``arrays`` (NDArrays or
+    jax arrays, possibly spanning devices). Fused ``isfinite -> sum``
+    reductions, one host sync per device group."""
+    import jax.numpy as jnp
+
+    total = 0
+    for group in _by_device(_datas(arrays)):
+        n = None
+        for d in group:
+            c = (~jnp.isfinite(d)).sum()
+            n = c if n is None else n + c
+        total += int(n)
+    return total
+
+
+def all_finite(arrays) -> bool:
+    """True iff every element of every array is finite. Reductions fuse
+    on-device per group; one host sync per device group (short-circuits
+    on the first bad group)."""
+    import jax.numpy as jnp
+
+    for group in _by_device(_datas(arrays)):
+        ok = None
+        for d in group:
+            f = jnp.isfinite(d).all()
+            ok = f if ok is None else jnp.logical_and(ok, f)
+        if not bool(ok):
+            return False
+    return True
+
+
+def attribute_nonfinite(named_arrays):
+    """Per-parameter blame for a sentinel trip: ``[(name, bad, total),
+    ...]`` for every entry with at least one non-finite element.
+    ``named_arrays``: dict name -> NDArray/jax array, or an iterable of
+    ``(name, array)`` pairs."""
+    items = named_arrays.items() if hasattr(named_arrays, "items") \
+        else named_arrays
+    out = []
+    for name, a in items:
+        if a is None:
+            continue
+        bad = nonfinite_count([a])
+        if bad:
+            d = getattr(a, "_data", a)
+            out.append((name, bad, int(d.size)))
+    return out
+
+
+def global_norm(arrays) -> float:
+    """Global L2 norm over a list of arrays (fp32 accumulation; square
+    -sums combine on-device per device group, one host sync per group)."""
+    import math
+
+    import jax.numpy as jnp
+
+    total = 0.0
+    for group in _by_device(_datas(arrays)):
+        n = None
+        for d in group:
+            s = jnp.sum(jnp.square(d.astype(jnp.float32)))
+            n = s if n is None else n + s
+        total += float(n)
+    return math.sqrt(total)
+
+
+def clip_by_global_norm(arrays, max_norm, in_place=True):
+    """Rescale ``arrays`` (NDArrays or jax arrays) so their global L2 norm
+    is at most ``max_norm``. Returns ``(arrays, norm)`` where ``norm`` is
+    the pre-clip global norm (a float — callers feed it to a
+    :class:`SpikeDetector`).
+
+    A non-finite norm cannot be fixed by scaling (``inf * scale`` is
+    ``inf``/``nan``): the arrays are left untouched and the caller's
+    sentinel/guardrail layer decides (skip the step, rewind). NDArray
+    inputs are clipped in place via ``_set_data_internal`` when
+    ``in_place``; raw jax arrays are returned as a new list.
+    """
+    import jax.numpy as jnp
+
+    norm = global_norm(arrays)
+    if not _isfinite_float(norm):
+        return arrays, norm
+    if norm <= max_norm:
+        return arrays, norm
+    scale = max_norm / norm
+    if in_place and all(hasattr(a, "_set_data_internal") for a in arrays
+                        if a is not None):
+        for a in arrays:
+            if a is not None:
+                a._set_data_internal(a._data * scale)
+        return arrays, norm
+    # positions (including None holes) are preserved so callers can zip
+    # the result against the original parameter list
+    return [None if a is None else jnp.asarray(getattr(a, "_data", a))
+            * scale for a in arrays], norm
+
+
+def _isfinite_float(x) -> bool:
+    import math
+
+    return math.isfinite(x)
+
+
+# -- anomaly detection ------------------------------------------------------
+
+
+class SpikeDetector:
+    """EWMA + rolling z-score anomaly detector for a scalar training
+    series (loss, grad-norm).
+
+    ``update(value)`` returns a verdict:
+
+    * ``None`` — value is ordinary; it was absorbed into the statistics.
+    * ``"nonfinite"`` — value is NaN/Inf (never absorbed).
+    * ``"spike"`` — value exceeds ``ewma + zscore * std`` of the last
+      ``window`` clean values (and a minimum relative jump, so a flat
+      early loss curve with near-zero variance doesn't flag noise).
+      Spikes are NOT absorbed: a genuine divergence ramp can't drag the
+      baseline up after it and mask itself.
+
+    The first ``warmup`` values only build statistics (initial transients
+    — a falling loss cliff at step 0 — are expected, not anomalies).
+    Deterministic: pure arithmetic on the values fed in, no wall clock.
+    """
+
+    def __init__(self, window=32, zscore=6.0, warmup=8, min_rel_jump=2.0):
+        import collections
+
+        self.window = int(window)
+        self.zscore = float(zscore)
+        self.warmup = int(warmup)
+        self.min_rel_jump = float(min_rel_jump)
+        self._values = collections.deque(maxlen=self.window)
+        self._ewma = None
+        self._alpha = 2.0 / (self.window + 1.0)
+        self.seen = 0
+
+    def reset(self):
+        """Forget all statistics (called after a rewind: the loss series
+        the stats described has been rolled back)."""
+        self._values.clear()
+        self._ewma = None
+        self.seen = 0
+
+    def update(self, value):
+        import math
+
+        v = float(value)
+        if not math.isfinite(v):
+            return "nonfinite"
+        if self.seen >= self.warmup and len(self._values) >= 2:
+            mean = sum(self._values) / len(self._values)
+            var = sum((x - mean) ** 2 for x in self._values) \
+                / len(self._values)
+            std = math.sqrt(var)
+            # floor the band: a perfectly flat window (std 0) would flag
+            # the next ulp of noise without the relative-jump term
+            band = max(self.zscore * std,
+                       (self.min_rel_jump - 1.0) * abs(self._ewma))
+            if v > self._ewma + band and v > mean + band:
+                return "spike"
+        self._values.append(v)
+        self._ewma = v if self._ewma is None \
+            else self._alpha * v + (1 - self._alpha) * self._ewma
+        self.seen += 1
+        return None
+
+    def snapshot(self):
+        return {"seen": self.seen, "ewma": self._ewma,
+                "window_len": len(self._values)}
+
+
+# -- recovery policy --------------------------------------------------------
+
+
+def _flag(name):
+    from .. import config
+
+    return config.get(name)
+
+
+class GuardrailHandler(TrainBegin, PreStep, BatchEnd):
+    """Estimator guardrail: veto bad updates, rewind past corruption.
+
+    Wire-up::
+
+        ckpt = ResilientCheckpointHandler(dir, batch_period=1)
+        guard = GuardrailHandler(manager=ckpt)
+        ckpt.resume(est)
+        est.fit(train_data, batches=N, event_handlers=[ckpt, guard])
+
+    Per batch (in estimator order):
+
+    1. ``pre_step`` (before ``trainer.step``): the loss sentinel + spike
+       detector judge this batch's loss; with ``check_grads`` the gradient
+       sentinel judges the freshly-computed grads. Any trip **vetoes the
+       optimizer update** — the weights never see the bad batch (the
+       cheap recovery level: skip-step).
+    2. ``batch_end`` (after the update, *before* the checkpoint handler
+       saves — ``priority=-1500``): with ``check_params`` the parameter
+       sentinel catches an update that corrupted the weights anyway
+       (finite-but-huge grads, a poisoned collective). That can't be
+       skipped — the handler **rewinds**: ``manager.load_latest()``,
+       quarantining numerically-poisoned checkpoints and rolling back
+       until a finite one loads. Training continues with the *next*
+       batch, so the window between the restored checkpoint and the
+       current batch is skipped, PaLM-style.
+    3. More than ``max_consecutive_skips`` consecutive vetoes escalates
+       skip → rewind (the data isn't transiently bad, the state is);
+       more than ``max_rewinds`` rewinds — or a rewind with no manager
+       or no clean checkpoint — raises :class:`DivergenceError`.
+
+    A :exc:`NonFiniteGradError` raised *inside* ``trainer.step`` (the
+    dist_tpu pre-collective quarantine) is routed to :meth:`step_error`
+    and handled as a skip-step.
+
+    Defaults come from the ``MXNET_GUARDRAIL_*`` env knobs (see
+    RESILIENCE.md); constructor arguments win.
+    """
+
+    def __init__(self, manager=None, check_grads=True, check_params=False,
+                 spike_window=None, spike_zscore=None, warmup=None,
+                 max_consecutive_skips=None, max_rewinds=None,
+                 priority=-1500):
+        # manager: a CheckpointManager, or anything exposing `.manager`
+        # (ResilientCheckpointHandler) so one object serves both handlers
+        self.manager = getattr(manager, "manager", manager)
+        self.check_grads = bool(check_grads)
+        self.check_params = bool(check_params)
+        self.max_consecutive_skips = int(
+            max_consecutive_skips if max_consecutive_skips is not None
+            else _flag("MXNET_GUARDRAIL_MAX_SKIPS"))
+        self.max_rewinds = int(
+            max_rewinds if max_rewinds is not None
+            else _flag("MXNET_GUARDRAIL_MAX_REWINDS"))
+        self.detector = SpikeDetector(
+            window=int(spike_window if spike_window is not None
+                       else _flag("MXNET_GUARDRAIL_SPIKE_WINDOW")),
+            zscore=float(spike_zscore if spike_zscore is not None
+                         else _flag("MXNET_GUARDRAIL_SPIKE_ZSCORE")),
+            warmup=int(warmup if warmup is not None
+                       else _flag("MXNET_GUARDRAIL_WARMUP")))
+        self.priority = priority
+        self.stats = {"sentinel_trips": 0, "skips": 0, "rewinds": 0,
+                      "last_trip": None}
+        self._consecutive = 0
+
+    # -- bookkeeping ------------------------------------------------------
+    def train_begin(self, estimator, *args, **kwargs):
+        self._consecutive = 0
+
+    def _trip(self, reason, detail=None):
+        self.stats["sentinel_trips"] += 1
+        self.stats["last_trip"] = reason if detail is None \
+            else f"{reason}: {detail}"
+        _counters.incr("resilience.sentinel_trips")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::sentinel_trip", "resilience",
+                                 args={"reason": reason,
+                                       "detail": str(detail)[:200]})
+
+    def _skip(self, reason):
+        self.stats["skips"] += 1
+        _counters.incr("resilience.guardrail_skips")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::guardrail(skip)",
+                                 "resilience", args={"reason": reason})
+        warnings.warn(
+            f"guardrail: skipping optimizer update ({reason}); "
+            f"{self._consecutive} consecutive trip(s)",
+            RuntimeWarning, stacklevel=3)
+        return False  # the pre_step veto value
+
+    # -- level 1: veto the update -----------------------------------------
+    def pre_step(self, estimator, batch=None, loss=None):
+        """Judge this batch before ``trainer.step``. Returning False vetoes
+        the optimizer update for this batch."""
+        lval = None
+        if loss is not None:
+            try:
+                import numpy as _onp
+
+                lval = float(_onp.asarray(loss.asnumpy()
+                                          if hasattr(loss, "asnumpy")
+                                          else loss).mean())
+            except (TypeError, ValueError):
+                lval = None
+        if lval is not None:
+            verdict = self.detector.update(lval)
+            if verdict == "nonfinite":
+                self._consecutive += 1
+                self._trip("nonfinite_loss", lval)
+                # a NaN loss means the FORWARD pass was already bad. If
+                # the weights are clean the batch itself is poison — skip
+                # it; if the weights are not, no skip can help — rewind.
+                params = [p.data() for p in estimator.trainer._params]
+                if not all_finite(params):
+                    self._rewind(estimator, "nonfinite_params_at_loss")
+                    return False
+                return self._maybe_escalate(estimator, "nonfinite_loss")
+            if verdict == "spike":
+                self._consecutive += 1
+                self._trip("loss_spike", lval)
+                return self._maybe_escalate(estimator, "loss_spike")
+        # with a LossScaler attached, non-finite grads are the scaler's
+        # signal (skip update + halve scale inside trainer.step) — vetoing
+        # here would starve scaler.update and turn a routine fp16
+        # overflow streak into a DivergenceError
+        if self.check_grads \
+                and getattr(estimator.trainer, "loss_scaler", None) is None:
+            named = []
+            for p in estimator.trainer._params:
+                gl = p.list_grad()
+                if len(gl) == 1:
+                    named.append((p.name, gl[0]))
+                else:  # blame must cover every replica, not just dev 0
+                    named.extend((f"{p.name}[{i}]", g)
+                                 for i, g in enumerate(gl))
+            if not all_finite([g for _, g in named]):
+                self._consecutive += 1
+                blame = attribute_nonfinite(named)
+                self._trip("nonfinite_grad",
+                           [f"{n} ({b}/{t})" for n, b, t in blame[:8]])
+                return self._maybe_escalate(estimator, "nonfinite_grad")
+        self._consecutive = 0
+        return True
+
+    def step_error(self, estimator, exc):
+        """``trainer.step`` raised; absorb quarantine trips as a skip."""
+        if isinstance(exc, NonFiniteGradError):
+            self._consecutive += 1
+            self._trip("quarantine", exc)
+            self._maybe_escalate(estimator, "quarantine")
+            return True
+        return False
+
+    def _maybe_escalate(self, estimator, reason):
+        if self._consecutive > self.max_consecutive_skips:
+            self._rewind(estimator, f"{reason} x{self._consecutive}")
+            return False
+        return self._skip(reason)
+
+    # -- level 2: rewind past the corruption -------------------------------
+    def batch_end(self, estimator, *args, **kwargs):
+        if not self.check_params:
+            return
+        params = [p.data() for p in estimator.trainer._params]
+        if all_finite(params):
+            return
+        blame = attribute_nonfinite(
+            [(p.name, p.data()) for p in estimator.trainer._params])
+        self._trip("nonfinite_params",
+                   [f"{n} ({b}/{t})" for n, b, t in blame[:8]])
+        self._rewind(estimator, "nonfinite_params")
+
+    def _rewind(self, estimator, reason):
+        """Restore the newest *numerically clean* checkpoint into the
+        estimator's net + trainer; poisoned checkpoints (saved after the
+        corrupting update but before detection) are quarantined as
+        ``.poisoned`` and rolled past."""
+        if self.stats["rewinds"] >= self.max_rewinds:
+            raise DivergenceError(
+                f"guardrail rewind budget exhausted "
+                f"({self.stats['rewinds']}/{self.max_rewinds}) — "
+                f"latest trip: {reason}. The run is diverging faster than "
+                "rewind-and-skip can recover; lower the learning rate or "
+                "inspect the data pipeline.")
+        if self.manager is None:
+            raise DivergenceError(
+                f"guardrail tripped ({reason}) with weights corrupted and "
+                "no CheckpointManager to rewind to — pass manager= (or a "
+                "ResilientCheckpointHandler) to GuardrailHandler, or "
+                "enable check_grads so corruption is vetoed pre-update.")
+        while True:
+            meta = self.manager.load_latest(net=estimator.net,
+                                            trainer=estimator.trainer)
+            if meta is None:
+                raise DivergenceError(
+                    f"guardrail tripped ({reason}) but no numerically "
+                    "clean checkpoint exists to rewind to.")
+            params = [p.data() for p in estimator.trainer._params]
+            if all_finite(params):
+                break
+            # the newest checkpoint was saved AFTER the corrupting update:
+            # CRC-valid but numerically poisoned. Quarantine it (distinct
+            # suffix from CRC corruption) and roll back further.
+            step = int(meta.get("step", 0))
+            if not self.manager.quarantine(step, suffix=".poisoned"):
+                # rename failed (permissions, concurrent removal):
+                # looping would reload the same poisoned file forever
+                raise DivergenceError(
+                    f"guardrail tripped ({reason}) and checkpoint step "
+                    f"{step} contains non-finite parameters but could "
+                    "not be quarantined — cannot rewind past it.")
+            warnings.warn(
+                f"guardrail: checkpoint step {step} contains non-finite "
+                "parameters (saved after the corrupting update) — "
+                "quarantined as .poisoned, rolling back further",
+                RuntimeWarning, stacklevel=3)
+        self.stats["rewinds"] += 1
+        self._consecutive = 0
+        self.detector.reset()  # the series those stats described is gone
+        _counters.incr("resilience.guardrail_rewinds")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::guardrail(rewind)",
+                                 "resilience",
+                                 args={"reason": str(reason)[:200],
+                                       "to_step": meta.get("step")})
+        warnings.warn(
+            f"guardrail: rewound to checkpoint step {meta.get('step')} "
+            f"({reason}); the batch window since then is skipped",
+            RuntimeWarning, stacklevel=3)
+        return meta

@@ -1,0 +1,885 @@
+"""Numerical guardrail tests (mxnet_tpu/resilience/guardrails.py): the
+`nan` fault kind and trainer:grad poisoning site, non-finite sentinels
+with attribution, clip_by_global_norm + the fused/eager clip-ordering
+regression, the SpikeDetector, hardened LossScaler clamps and Trainer
+integration, the dist_tpu pre-collective NaN quarantine, GuardrailHandler
+skip-step / rewind-and-skip loss parity vs uninterrupted runs (the
+acceptance scenarios), escalation to DivergenceError, counters/trace
+accounting, and the disabled-guardrail eager-microloop overhead bound."""
+import logging
+import os
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon
+from mxnet_tpu import np as mnp
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.profiler import core as _prof
+from mxnet_tpu.resilience import (counters, faults, guardrails,
+                                  resilience_stats)
+from mxnet_tpu.resilience.guardrails import (DivergenceError,
+                                             GuardrailHandler,
+                                             NonFiniteGradError,
+                                             SpikeDetector, all_finite,
+                                             attribute_nonfinite,
+                                             clip_by_global_norm,
+                                             nonfinite_count)
+
+
+@pytest.fixture(autouse=True)
+def _clean_guardrail_state():
+    """Every test starts/ends with no fault plan, reset counters, and no
+    leftover guardrail env knobs."""
+    faults.clear_plan()
+    _prof.reset()
+    counters.reset()
+    saved = {k: os.environ.pop(k, None)
+             for k in ("MXNET_FAULT_PLAN", "MXNET_NAN_QUARANTINE",
+                       "MXNET_NAN_QUARANTINE_MODE",
+                       "MXNET_GUARDRAIL_MAX_SKIPS",
+                       "MXNET_GUARDRAIL_MAX_REWINDS",
+                       "MXNET_GUARDRAIL_SPIKE_WINDOW",
+                       "MXNET_GUARDRAIL_SPIKE_ZSCORE",
+                       "MXNET_GUARDRAIL_WARMUP",
+                       "MXNET_LOSS_SCALE_MIN", "MXNET_LOSS_SCALE_MAX")}
+    logging.getLogger("mxnet_tpu.estimator").setLevel(logging.ERROR)
+    yield
+    faults.clear_plan()
+    _prof.reset()
+    counters.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# nan fault kind + trainer:grad site
+# ---------------------------------------------------------------------------
+
+
+def test_nan_fault_kind_returns_marker_not_raise():
+    plan = faults.install_plan({"rules": [
+        {"site": "s", "kind": "nan", "at": [1]}]})
+    assert plan.check("s") is None
+    assert plan.check("s") == "nan"
+    assert plan.check("s") is None
+    assert plan.fired_total() == 1
+    assert resilience_stats()["faults_injected"] == 1
+
+
+def test_nan_rule_on_non_corrupting_site_is_harmless():
+    """A nan rule on a site that doesn't implement corruption fires (and
+    counts) but has no effect — engine.wait_all ignores the marker."""
+    from mxnet_tpu import engine
+
+    plan = faults.install_plan({"rules": [
+        {"site": "engine:wait", "kind": "nan", "times": 1}]})
+    engine.wait_all()  # must not raise
+    assert plan.fired_total() == 1
+
+
+def _dense_trainer(units=3, out=2, **trainer_kw):
+    net = gluon.nn.Dense(out, in_units=units)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, **trainer_kw)
+    return net, tr
+
+
+def test_trainer_grad_site_poisons_all_grads():
+    """A 'nan' rule at trainer:grad corrupts every gradient at exactly the
+    planned step — without guardrails the weights go NaN, the corruption
+    the GuardrailHandler exists to stop."""
+    net, tr = _dense_trainer()
+    faults.install_plan({"rules": [
+        {"site": "trainer:grad", "kind": "nan", "at": [1]}]})
+    finite_after = []
+    for _ in range(3):
+        with autograd.record():
+            loss = (net(mnp.ones((2, 3))) ** 2).sum()
+        loss.backward()
+        tr.step(1)
+        finite_after.append(
+            all_finite([p.data() for p in net.collect_params().values()]))
+    assert finite_after == [True, False, False]
+
+
+# ---------------------------------------------------------------------------
+# sentinels
+# ---------------------------------------------------------------------------
+
+
+def test_sentinels_finite_and_count():
+    a = mnp.ones((4,))
+    b = mnp.array([1.0, float("nan"), float("inf"), 2.0])
+    assert all_finite([a]) and all_finite([])
+    assert not all_finite([a, b])
+    assert nonfinite_count([a]) == 0
+    assert nonfinite_count([a, b]) == 2
+    # integer arrays are trivially finite, not an error
+    assert all_finite([mnp.array([1, 2, 3])])
+
+
+def test_attribute_nonfinite_blames_the_right_params():
+    blame = attribute_nonfinite({
+        "w": mnp.ones((4,)),
+        "b": mnp.array([float("nan"), 1.0]),
+        "m": mnp.array([float("inf")] * 3),
+    })
+    assert ("b", 1, 2) in blame and ("m", 3, 3) in blame
+    assert not any(n == "w" for n, _, _ in blame)
+
+
+# ---------------------------------------------------------------------------
+# clip_by_global_norm + trainer wiring
+# ---------------------------------------------------------------------------
+
+
+def test_clip_by_global_norm_math_and_nonfinite_passthrough():
+    arrs = [mnp.ones((3,)) * 3.0, mnp.ones((3,)) * 4.0]
+    _, norm = clip_by_global_norm(arrs, 1.0)
+    assert norm == pytest.approx(onp.sqrt(75.0))
+    total = sum(float(onp.square(a.asnumpy()).sum()) for a in arrs)
+    assert onp.sqrt(total) == pytest.approx(1.0, rel=1e-6)
+    # under the threshold: untouched
+    arrs2 = [mnp.ones((2,))]
+    _, norm2 = clip_by_global_norm(arrs2, 10.0)
+    assert norm2 == pytest.approx(onp.sqrt(2.0))
+    onp.testing.assert_allclose(arrs2[0].asnumpy(), onp.ones((2,)))
+    # non-finite norm: scaling can't fix it — arrays left alone
+    bad = [mnp.array([float("nan"), 1.0])]
+    _, norm3 = clip_by_global_norm(bad, 1.0)
+    assert not onp.isfinite(norm3)
+    assert onp.isnan(bad[0].asnumpy()[0]) and bad[0].asnumpy()[1] == 1.0
+
+
+def test_clip_by_global_norm_preserves_none_holes():
+    """Non-in-place results keep positions (incl. None) so callers can
+    zip against the original parameter list."""
+    import jax.numpy as jnp
+
+    out, norm = clip_by_global_norm(
+        [jnp.ones((3,)) * 3.0, None, jnp.ones((3,)) * 4.0], 1.0,
+        in_place=False)
+    assert len(out) == 3 and out[1] is None
+    assert norm == pytest.approx(onp.sqrt(75.0))
+    total = float(onp.square(out[0]).sum() + onp.square(out[2]).sum())
+    assert onp.sqrt(total) == pytest.approx(1.0, rel=1e-6)
+
+
+def test_gluon_utils_clip_global_norm_delegates():
+    """The reference util and the guardrail util are one implementation."""
+    arrs = [mnp.ones((4,)) * 2.0]
+    norm = gluon.utils.clip_global_norm(arrs, 1.0)
+    assert norm == pytest.approx(4.0)
+    assert float(onp.linalg.norm(arrs[0].asnumpy())) \
+        == pytest.approx(1.0, rel=1e-6)
+    with pytest.warns(UserWarning, match="nan or inf"):
+        gluon.utils.clip_global_norm([mnp.array([float("nan")])], 1.0)
+
+
+def _same_init_pair(**kw2):
+    """Two Dense nets with identical weights (independent buffers: the
+    fused update donates, so sharing would invalidate one net's params)."""
+    n1 = gluon.nn.Dense(2, in_units=3)
+    n1.initialize()
+    n1(mnp.ones((1, 3)))
+    n2 = gluon.nn.Dense(2, in_units=3)
+    n2.initialize()
+    n2(mnp.ones((1, 3)))
+    for p1, p2 in zip(n1.collect_params().values(),
+                      n2.collect_params().values()):
+        p2.set_data(mnp.array(p1.data().asnumpy()))
+    return n1, n2
+
+
+def test_trainer_clip_global_norm_matches_manual():
+    n1, n2 = _same_init_pair()
+    t1 = gluon.Trainer(n1.collect_params(), "sgd", {"learning_rate": 0.1})
+    t2 = gluon.Trainer(n2.collect_params(), "sgd", {"learning_rate": 0.1},
+                       clip_global_norm=0.5)
+    x = mnp.array(onp.random.randn(4, 3).astype("float32"))
+    with autograd.record():
+        (n1(x) ** 2).sum().backward()
+    # manual: reference-style clip then step
+    gluon.utils.clip_global_norm(
+        [p.grad() for p in n1.collect_params().values()], 0.5)
+    t1.step(4)
+    with autograd.record():
+        (n2(x) ** 2).sum().backward()
+    t2.step(4)
+    for p1, p2 in zip(n1.collect_params().values(),
+                      n2.collect_params().values()):
+        onp.testing.assert_allclose(p2.data().asnumpy(),
+                                    p1.data().asnumpy(), rtol=1e-6)
+
+
+def test_fused_vs_eager_clip_ordering_parity():
+    """Satellite: the fused multi-tensor path's rescale-then-clip must
+    match Optimizer._prep_grad's non-fused ordering on the same grads —
+    with rescale != 1 and grads straddling the clip threshold, any
+    ordering difference shows up immediately."""
+    n1, n2 = _same_init_pair()
+    kw = {"learning_rate": 0.1, "momentum": 0.9, "clip_gradient": 0.05,
+          "rescale_grad": 0.25}
+    t_fused = gluon.Trainer(n1.collect_params(), "sgd", dict(kw))
+    t_eager = gluon.Trainer(n2.collect_params(), "sgd", dict(kw))
+    # force the reference eager per-param path on the second trainer
+    t_eager._optimizer.fused_safe = False
+    x = mnp.array(onp.random.randn(8, 3).astype("float32") * 5.0)
+    for _ in range(3):  # momentum state must agree across steps too
+        with autograd.record():
+            (n1(x) ** 2).sum().backward()
+        t_fused.step(2)
+        with autograd.record():
+            (n2(x) ** 2).sum().backward()
+        t_eager.step(2)
+    for p1, p2 in zip(n1.collect_params().values(),
+                      n2.collect_params().values()):
+        onp.testing.assert_allclose(p2.data().asnumpy(),
+                                    p1.data().asnumpy(),
+                                    rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# spike detector
+# ---------------------------------------------------------------------------
+
+
+def test_spike_detector_flags_spike_after_warmup():
+    d = SpikeDetector(window=8, zscore=4.0, warmup=4)
+    series = [1.0, 0.9, 0.8, 0.85, 0.82, 0.81, 0.8, 0.79]
+    assert all(d.update(v) is None for v in series)
+    assert d.update(50.0) == "spike"
+    assert d.update(float("nan")) == "nonfinite"
+    assert d.update(float("inf")) == "nonfinite"
+    # the spike was NOT absorbed: a follow-up ordinary value is clean
+    assert d.update(0.78) is None
+
+
+def test_spike_detector_warmup_and_noise_tolerance():
+    d = SpikeDetector(window=8, zscore=4.0, warmup=4)
+    # a 100x jump during warmup is tolerated (initial transients)
+    assert d.update(100.0) is None
+    assert d.update(1.0) is None
+    # gaussian noise around a level never flags at z=4 with the relative
+    # floor in place
+    rng = onp.random.RandomState(0)
+    d2 = SpikeDetector(window=16, zscore=6.0, warmup=4)
+    verdicts = [d2.update(1.0 + 0.05 * rng.randn()) for _ in range(200)]
+    assert all(v is None for v in verdicts)
+
+
+def test_spike_detector_reset():
+    d = SpikeDetector(window=4, zscore=3.0, warmup=2)
+    for v in (1.0, 1.0, 1.0, 1.0):
+        d.update(v)
+    d.reset()
+    assert d.seen == 0
+    assert d.update(1000.0) is None  # back in warmup
+
+
+# ---------------------------------------------------------------------------
+# hardened LossScaler (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_loss_scaler_overflow_streak_clamps_at_min():
+    s = amp.LossScaler(init_scale=8.0, scale_factor=2.0, min_scale=1.0,
+                       max_scale=2.0 ** 20)
+    for _ in range(50):
+        assert s.update(True) is True
+    assert s.loss_scale == 1.0  # never 0, never negative
+    assert s.overflows == 50 and s.skipped_steps == 50
+
+
+def test_loss_scaler_growth_clamps_at_max():
+    s = amp.LossScaler(init_scale=4.0, scale_factor=2.0, scale_window=1,
+                       min_scale=1.0, max_scale=64.0)
+    for _ in range(100):
+        s.update(False)
+    assert s.loss_scale == 64.0  # never inf
+
+
+def test_loss_scaler_repairs_nonfinite_scale():
+    s = amp.LossScaler(init_scale=4.0, min_scale=2.0, max_scale=64.0)
+    s.loss_scale = float("inf")  # e.g. restored from a corrupt source
+    s.update(True)
+    assert onp.isfinite(s.loss_scale) and 2.0 <= s.loss_scale <= 64.0
+    s.loss_scale = float("nan")
+    s.update(False)
+    assert onp.isfinite(s.loss_scale) and 2.0 <= s.loss_scale <= 64.0
+
+
+def test_loss_scaler_rejects_bad_construction():
+    with pytest.raises(MXNetError, match="init_scale"):
+        amp.LossScaler(init_scale=float("inf"))
+    with pytest.raises(MXNetError, match="init_scale"):
+        amp.LossScaler(init_scale=0.0)
+    with pytest.raises(MXNetError, match="min_scale"):
+        amp.LossScaler(min_scale=8.0, max_scale=2.0)
+    with pytest.raises(MXNetError, match="scale_factor"):
+        amp.LossScaler(scale_factor=1.0)
+
+
+def test_loss_scaler_env_clamp_defaults():
+    os.environ["MXNET_LOSS_SCALE_MIN"] = "4.0"
+    os.environ["MXNET_LOSS_SCALE_MAX"] = "16.0"
+    s = amp.LossScaler(init_scale=1024.0)
+    assert s.loss_scale == 16.0  # init clamped into the env range
+    for _ in range(10):
+        s.update(True)
+    assert s.loss_scale == 4.0
+
+
+# ---------------------------------------------------------------------------
+# Trainer + LossScaler integration
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_overflow_skips_update_and_scales_down():
+    net, tr = _dense_trainer(loss_scaler=amp.LossScaler(init_scale=8.0))
+    before = {k: v.data().asnumpy().copy()
+              for k, v in net.collect_params().items()}
+    with autograd.record():
+        loss = tr.scale_loss((net(mnp.ones((2, 3))) ** 2).sum())
+    loss.backward()
+    for p in tr._params:  # force the overflow the scaler must catch
+        g = p.grad()
+        g._set_data_internal(g._data * float("nan"))
+    tr.step(1)
+    after = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+    for k in before:  # the update was skipped — weights untouched
+        onp.testing.assert_array_equal(after[k], before[k])
+    assert tr.loss_scaler.loss_scale == 4.0
+    assert tr.loss_scaler.skipped_steps == 1
+    assert resilience_stats()["loss_scale_overflows"] == 1
+
+
+def test_trainer_update_on_kvstore_rejects_guardrails():
+    """Server-side updates never see the scaler's unscale or the clip —
+    the combination must fail loudly, not push loss_scale-times-too-large
+    updates."""
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    net(mnp.ones((1, 3)))
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       kvstore="local", update_on_kvstore=True,
+                       loss_scaler=amp.LossScaler())
+    with autograd.record():
+        (net(mnp.ones((2, 3))) ** 2).sum().backward()
+    with pytest.raises(MXNetError, match="update_on_kvstore"):
+        tr.step(2)
+
+
+@pytest.mark.integration
+def test_estimator_with_scaler_matches_estimator_without():
+    """The estimator's fit_batch scales the loss through the trainer's
+    scaler and step() unscales — end to end the updates must be identical
+    to an unscaled run (the regression: an unscaled backward + unscaling
+    step silently divides every update by loss_scale)."""
+    batches = _make_batches(n=6)
+
+    def run(scaler):
+        mx.random.seed(7)
+        onp.random.seed(7)
+        net = gluon.nn.Dense(1)
+        net.initialize()
+        net(mnp.ones((4, 3)))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, loss_scaler=scaler)
+        from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+        est = Estimator(net, gluon.loss.L2Loss(), trainer=tr,
+                        train_metrics=[gluon.metric.MAE()])
+        est.fit(batches, batches=len(batches))
+        return {k: v.data().asnumpy()
+                for k, v in net.collect_params().items()}, tr
+
+    ref, _ = run(None)
+    got, tr = run(amp.LossScaler(init_scale=64.0))
+    assert tr.loss_scaler.overflows == 0
+    for k in ref:
+        onp.testing.assert_allclose(got[k], ref[k], rtol=1e-5, atol=1e-7)
+
+
+def test_guardrail_defers_nonfinite_grads_to_loss_scaler():
+    """With a LossScaler attached, non-finite grads are the scaler's
+    overflow signal: the guardrail must NOT veto the step (that would
+    starve scaler.update and the scale would never adapt) — the scaler
+    skips the update and halves the scale instead."""
+    batches = _make_batches(n=6)
+    mx.random.seed(7)
+    onp.random.seed(7)
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    net(mnp.ones((4, 3)))
+    scaler = amp.LossScaler(init_scale=8.0)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, loss_scaler=scaler)
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    est = Estimator(net, gluon.loss.L2Loss(), trainer=tr,
+                    train_metrics=[gluon.metric.MAE()])
+    guard = GuardrailHandler(check_grads=True)
+    faults.install_plan({"rules": [
+        {"site": "trainer:grad", "kind": "nan", "at": [2]}]})
+    est.fit(batches, batches=len(batches), event_handlers=[guard])
+    faults.clear_plan()
+    assert scaler.skipped_steps == 1 and scaler.loss_scale == 4.0
+    assert guard.stats["skips"] == 0  # the guardrail stayed out of it
+    assert all_finite([p.data() for p in tr._params])
+
+
+def test_trainer_scaled_clean_step_matches_unscaled():
+    """Scale-by-S at the loss + unscale folded into the update must land
+    on the same weights as a plain unscaled step."""
+    n1, n2 = _same_init_pair()
+    t1 = gluon.Trainer(n1.collect_params(), "sgd", {"learning_rate": 0.1})
+    t2 = gluon.Trainer(n2.collect_params(), "sgd", {"learning_rate": 0.1},
+                       loss_scaler=amp.LossScaler(init_scale=16.0))
+    x = mnp.array(onp.random.randn(4, 3).astype("float32"))
+    with autograd.record():
+        (n1(x) ** 2).sum().backward()
+    t1.step(4)
+    with autograd.record():
+        l2 = t2.scale_loss((n2(x) ** 2).sum())
+    l2.backward()
+    t2.step(4)
+    for p1, p2 in zip(n1.collect_params().values(),
+                      n2.collect_params().values()):
+        onp.testing.assert_allclose(p2.data().asnumpy(),
+                                    p1.data().asnumpy(),
+                                    rtol=1e-5, atol=1e-7)
+    assert t2.loss_scaler.overflows == 0
+
+
+# ---------------------------------------------------------------------------
+# pre-collective NaN quarantine (dist_tpu)
+# ---------------------------------------------------------------------------
+
+
+def _per_device_ones(shape=(4,)):
+    import jax
+    import jax.numpy as jnp
+
+    return [mx.nd.NDArray(jax.device_put(jnp.ones(shape), d))
+            for d in jax.devices()]
+
+
+def _poison_replica(arrs, idx):
+    import jax.numpy as jnp
+
+    arrs[idx]._set_data_internal(arrs[idx]._data * jnp.nan)
+    return arrs
+
+
+def test_quarantine_skip_mode_raises_before_the_collective():
+    os.environ["MXNET_NAN_QUARANTINE"] = "1"
+    from mxnet_tpu.kvstore.dist_tpu import KVStoreDistTPUSync
+
+    kv = KVStoreDistTPUSync()
+    arrs = _poison_replica(_per_device_ones(), 2)
+    with pytest.warns(RuntimeWarning, match="NaN quarantine"):
+        with pytest.raises(NonFiniteGradError, match="would poison"):
+            kv.allreduce(arrs)
+    s = kv.collective_stats()
+    assert s["quarantined"] == 1
+    # NOT a fast-path failure: no degradation, breaker untouched
+    assert s["degradations"] == 0
+    assert s["breaker"]["consecutive_failures"] == 0
+    assert resilience_stats()["nan_quarantined"] == 1
+
+
+def test_quarantine_drop_mode_sums_clean_replicas():
+    os.environ["MXNET_NAN_QUARANTINE"] = "1"
+    os.environ["MXNET_NAN_QUARANTINE_MODE"] = "drop"
+    import jax
+
+    from mxnet_tpu.kvstore.dist_tpu import KVStoreDistTPUSync
+
+    kv = KVStoreDistTPUSync()
+    n = len(jax.devices())
+    arrs = _poison_replica(_per_device_ones(), 1)
+    with pytest.warns(RuntimeWarning, match="NaN quarantine"):
+        out = kv.allreduce(arrs)
+    # n-1 clean ones, rescaled by n/(n-1): the unbiased full-mesh estimate
+    onp.testing.assert_allclose(out[0].asnumpy(), onp.full((4,), float(n)),
+                                rtol=1e-6)
+    assert all_finite(out)
+    # every replica keeps its original device placement
+    for a, o in zip(arrs, out):
+        assert a._data.devices() == o._data.devices()
+
+
+def test_quarantine_drop_mode_all_bad_still_raises():
+    os.environ["MXNET_NAN_QUARANTINE"] = "1"
+    os.environ["MXNET_NAN_QUARANTINE_MODE"] = "drop"
+    from mxnet_tpu.kvstore.dist_tpu import KVStoreDistTPUSync
+
+    kv = KVStoreDistTPUSync()
+    arrs = _per_device_ones()
+    for i in range(len(arrs)):
+        _poison_replica(arrs, i)
+    with pytest.warns(RuntimeWarning, match="NaN quarantine"):
+        # the message must not advise the mode that's already set
+        with pytest.raises(NonFiniteGradError, match="every replica"):
+            kv.allreduce(arrs)
+
+
+def test_quarantine_mode_validated_at_construction():
+    os.environ["MXNET_NAN_QUARANTINE_MODE"] = "Drop"  # typo'd case
+    from mxnet_tpu.kvstore.dist_tpu import KVStoreDistTPUSync
+
+    with pytest.raises(MXNetError, match="skip.*drop|drop.*skip"):
+        KVStoreDistTPUSync()
+
+
+def test_quarantine_off_by_default_no_check():
+    from mxnet_tpu.kvstore.dist_tpu import KVStoreDistTPUSync
+
+    kv = KVStoreDistTPUSync()
+    assert not kv._nan_quarantine
+    arrs = _poison_replica(_per_device_ones(), 0)
+    out = kv.allreduce(arrs)  # poison flows through (production default)
+    assert not all_finite(out)
+    assert kv.collective_stats()["quarantined"] == 0
+
+
+# ---------------------------------------------------------------------------
+# estimator recovery: the acceptance scenarios
+# ---------------------------------------------------------------------------
+
+
+def _make_batches(n=10, batch=4, dim=3, seed=0):
+    rng = onp.random.RandomState(seed)
+    return [(mnp.array(rng.randn(batch, dim).astype("float32")),
+             mnp.array(rng.randn(batch, 1).astype("float32")))
+            for _ in range(n)]
+
+
+def _fresh_estimator(seed=7):
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    net(mnp.ones((4, 3)))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    return Estimator(net, gluon.loss.L2Loss(), trainer=tr,
+                     train_metrics=[gluon.metric.MAE()])
+
+
+def _params_np(est):
+    return {k: v.data().asnumpy()
+            for k, v in est.net.collect_params().items()}
+
+
+def _probe_loss(est, batches):
+    with autograd.predict_mode():
+        pred = est.net(batches[0][0])
+        return float(est.loss(pred, batches[0][1]).mean().asnumpy())
+
+
+K = 5  # the poisoned batch in the parity scenarios
+
+
+def _clean_reference(batches):
+    """The comparison run: same seed, never sees batch K."""
+    est = _fresh_estimator()
+    clean = batches[:K] + batches[K + 1:]
+    est.fit(clean, batches=len(clean))
+    return est
+
+
+@pytest.mark.integration
+def test_skip_step_parity_exact():
+    """NaN grads at batch K, caught by the pre-step grad sentinel: the
+    update is vetoed, and the final weights EXACTLY match a clean run
+    that never saw batch K (same seed)."""
+    batches = _make_batches()
+    ref = _params_np(_clean_reference(batches))
+
+    est = _fresh_estimator()
+    guard = GuardrailHandler(check_grads=True)
+    faults.install_plan({"rules": [
+        {"site": "trainer:grad", "kind": "nan", "at": [K]}]})
+    with pytest.warns(RuntimeWarning, match="skipping optimizer update"):
+        est.fit(batches, batches=len(batches), event_handlers=[guard])
+    faults.clear_plan()
+    got = _params_np(est)
+    for k in ref:
+        onp.testing.assert_array_equal(got[k], ref[k])
+    assert guard.stats["skips"] == 1
+    assert guard.stats["rewinds"] == 0
+    assert "nonfinite_grad" in guard.stats["last_trip"]
+    assert resilience_stats()["guardrail_skips"] == 1
+
+
+@pytest.mark.integration
+def test_rewind_and_skip_parity_exact(tmp_path):
+    """The acceptance scenario: NaN grads at batch K slip past (grad
+    sentinel off), corrupt the weights, are detected post-update by the
+    parameter sentinel, and recovery rewinds to the last checkpoint +
+    skips the batch window — landing EXACTLY on the loss trajectory of a
+    clean run that never saw batch K (same seed)."""
+    from mxnet_tpu.gluon.contrib.estimator import ResilientCheckpointHandler
+
+    batches = _make_batches()
+    ref_est = _clean_reference(batches)
+    ref = _params_np(ref_est)
+    ref_loss = _probe_loss(ref_est, batches)
+
+    est = _fresh_estimator()
+    ck = ResilientCheckpointHandler(str(tmp_path), batch_period=1)
+    guard = GuardrailHandler(manager=ck, check_grads=False,
+                            check_params=True)
+    faults.install_plan({"rules": [
+        {"site": "trainer:grad", "kind": "nan", "at": [K]}]})
+    with pytest.warns(RuntimeWarning, match="rewound to checkpoint"):
+        est.fit(batches, batches=len(batches), event_handlers=[ck, guard])
+    faults.clear_plan()
+
+    got = _params_np(est)
+    for k in ref:
+        onp.testing.assert_array_equal(got[k], ref[k])
+    assert _probe_loss(est, batches) == ref_loss
+    assert guard.stats["rewinds"] == 1
+    assert guard.stats["skips"] == 0
+    assert resilience_stats()["guardrail_rewinds"] == 1
+
+
+@pytest.mark.integration
+def test_rewind_quarantines_poisoned_checkpoint(tmp_path):
+    """When the checkpoint handler runs BEFORE the guardrail (priority
+    flipped), the corrupting batch's checkpoint is saved with NaN weights;
+    the rewind must detect that, quarantine it as .poisoned, and roll back
+    to the older clean one — still landing on exact parity."""
+    from mxnet_tpu.gluon.contrib.estimator import ResilientCheckpointHandler
+
+    batches = _make_batches()
+    ref = _params_np(_clean_reference(batches))
+
+    est = _fresh_estimator()
+    ck = ResilientCheckpointHandler(str(tmp_path), batch_period=1)
+    guard = GuardrailHandler(manager=ck, check_grads=False,
+                            check_params=True, priority=100)  # after ck
+    faults.install_plan({"rules": [
+        {"site": "trainer:grad", "kind": "nan", "at": [K]}]})
+    with pytest.warns(RuntimeWarning):
+        est.fit(batches, batches=len(batches), event_handlers=[ck, guard])
+    faults.clear_plan()
+
+    poisoned = [f for f in os.listdir(tmp_path) if f.endswith(".poisoned")]
+    assert len(poisoned) == 1
+    got = _params_np(est)
+    for k in ref:
+        onp.testing.assert_array_equal(got[k], ref[k])
+    assert guard.stats["rewinds"] == 1
+
+
+@pytest.mark.integration
+def test_rewind_unquarantinable_poisoned_checkpoint_diverges(tmp_path):
+    """If the poisoned checkpoint cannot be renamed, the rewind loop must
+    raise DivergenceError instead of reloading the same NaN file
+    forever."""
+    from mxnet_tpu.gluon.contrib.estimator import ResilientCheckpointHandler
+
+    batches = _make_batches()
+    est = _fresh_estimator()
+    ck = ResilientCheckpointHandler(str(tmp_path), batch_period=1)
+    guard = GuardrailHandler(manager=ck, check_grads=False,
+                            check_params=True, priority=100)  # after ck
+    ck.manager.quarantine = lambda *a, **k: False  # rename always fails
+    faults.install_plan({"rules": [
+        {"site": "trainer:grad", "kind": "nan", "at": [K]}]})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(DivergenceError,
+                           match="could not be quarantined"):
+            est.fit(batches, batches=len(batches),
+                    event_handlers=[ck, guard])
+    faults.clear_plan()
+
+
+def test_nonfinite_loss_with_clean_weights_skips_not_rewinds():
+    """A NaN in the DATA makes the loss non-finite while the weights are
+    still healthy: the guardrail attributes it to the batch (skip), not
+    the state (rewind)."""
+    batches = _make_batches(n=6)
+    x_bad = batches[2][0].asnumpy().copy()
+    x_bad[0, 0] = float("nan")
+    batches[2] = (mnp.array(x_bad), batches[2][1])
+
+    est = _fresh_estimator()
+    guard = GuardrailHandler(check_grads=True)
+    with pytest.warns(RuntimeWarning, match="skipping optimizer update"):
+        est.fit(batches, batches=len(batches), event_handlers=[guard])
+    assert guard.stats["skips"] >= 1
+    assert guard.stats["rewinds"] == 0
+    assert "nonfinite_loss" in guard.stats["last_trip"]
+    assert all_finite([p.data() for p in est.trainer._params])
+
+
+@pytest.mark.integration
+def test_escalation_consecutive_skips_then_rewinds_then_diverges(tmp_path):
+    """Persistent corruption escalates: skip-step x max_consecutive_skips,
+    then rewind, then (budget exhausted) DivergenceError."""
+    from mxnet_tpu.gluon.contrib.estimator import ResilientCheckpointHandler
+
+    batches = _make_batches(n=24)
+    est = _fresh_estimator()
+    ck = ResilientCheckpointHandler(str(tmp_path), batch_period=1)
+    guard = GuardrailHandler(manager=ck, check_grads=True,
+                            max_consecutive_skips=2, max_rewinds=1)
+    faults.install_plan({"rules": [
+        {"site": "trainer:grad", "kind": "nan", "times": 1000}]})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(DivergenceError, match="rewind budget"):
+            est.fit(batches, batches=len(batches),
+                    event_handlers=[ck, guard])
+    faults.clear_plan()
+    # 2 skips -> rewind #1 -> 2 skips -> rewind #2 refused (budget 1)
+    assert guard.stats["rewinds"] == 1
+    assert guard.stats["skips"] == 4
+    # every skip kept the weights finite (the veto worked each time)
+    assert all_finite([p.data() for p in est.trainer._params])
+
+
+def test_divergence_error_without_manager():
+    """Corrupted weights with no checkpoint manager: nothing to rewind to,
+    the run must fail loudly instead of training on NaNs."""
+    batches = _make_batches(n=6)
+    est = _fresh_estimator()
+    guard = GuardrailHandler(check_grads=False, check_params=True)
+    faults.install_plan({"rules": [
+        {"site": "trainer:grad", "kind": "nan", "at": [1]}]})
+    with pytest.raises(DivergenceError, match="no CheckpointManager"):
+        est.fit(batches, batches=len(batches), event_handlers=[guard])
+    faults.clear_plan()
+
+
+def test_step_error_absorbs_quarantine_trips():
+    """A NonFiniteGradError from inside trainer.step (the dist_tpu
+    quarantine) is absorbed as a skip by the handler; anything else
+    propagates."""
+    est = _fresh_estimator()
+    guard = GuardrailHandler(check_grads=False)
+    with pytest.warns(RuntimeWarning, match="skipping optimizer update"):
+        assert guard.step_error(est, NonFiniteGradError("quarantined")) \
+            is True
+    assert guard.stats["skips"] == 1
+    assert "quarantine" in guard.stats["last_trip"]
+    assert guard.step_error(est, MXNetError("something else")) is False
+
+
+# ---------------------------------------------------------------------------
+# accounting: counters + profiler bus
+# ---------------------------------------------------------------------------
+
+
+def test_guardrail_counters_in_resilience_stats():
+    s = resilience_stats()
+    assert set(s) >= {"sentinel_trips", "guardrail_skips",
+                      "guardrail_rewinds", "nan_quarantined",
+                      "loss_scale_overflows"}
+    assert all(s[k] == 0 for k in ("sentinel_trips", "guardrail_skips",
+                                   "guardrail_rewinds"))
+
+
+def test_guardrail_events_on_profiler_bus():
+    """Trips/skips land as resilience::* instants while the bus runs."""
+    from mxnet_tpu import profiler
+
+    batches = _make_batches(n=4)
+    est = _fresh_estimator()
+    guard = GuardrailHandler(check_grads=True)
+    faults.install_plan({"rules": [
+        {"site": "trainer:grad", "kind": "nan", "at": [1]}]})
+    profiler.set_state("run")
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            est.fit(batches, batches=len(batches), event_handlers=[guard])
+    finally:
+        profiler.set_state("stop")
+        faults.clear_plan()
+    names = {e["name"] for e in _prof.snapshot_events()}
+    assert "resilience::sentinel_trip" in names
+    assert "resilience::guardrail(skip)" in names
+
+
+# ---------------------------------------------------------------------------
+# overhead bound + tier-1 gate script
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_guardrail_overhead_under_5pct():
+    """Guardrails present-but-disabled (no scaler, no clip, an installed
+    plan whose rules never match the loop's sites — the production
+    default) must stay within the PR-1/PR-2 5% eager-microloop overhead
+    bound. Mirrors test_stopped_resilience_overhead's measurement
+    discipline, including the 15% hard-fail threshold for suite-load
+    noise."""
+    import time as _time
+
+    x = mnp.ones((4,))
+
+    def loop(n=10_000):
+        y = x
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            y = y + 1.0
+        y.wait_to_read()
+        return _time.perf_counter() - t0
+
+    guard = GuardrailHandler(check_grads=True, check_params=True)  # idle
+
+    def measure(rounds=7):
+        base = active = float("inf")
+        for _ in range(rounds):
+            faults.clear_plan()
+            base = min(base, loop())
+            faults.install_plan({"rules": [
+                {"site": "trainer:grad", "kind": "nan", "times": 1}]})
+            active = min(active, loop())
+        faults.clear_plan()
+        return base, active
+
+    loop(2000)  # warm jit/op caches
+    base, active = measure()
+    if active > base * 1.05:
+        base, active = measure(rounds=9)
+    if active > base * 1.05:
+        base, active = measure(rounds=11)
+    assert active <= base * 1.15, (
+        f"disabled-guardrail overhead {active / base - 1:.1%} "
+        f"(no-plan {base:.3f}s, idle-guardrail {active:.3f}s)")
+    assert guard.stats["sentinel_trips"] == 0
+
+
+def test_run_tier1_script_matches_roadmap_gate():
+    """Satellite: tools/run_tier1.sh is the tier-1 gate — it must carry
+    the ROADMAP command's load-bearing pieces (pipefail, the slow-marker
+    exclusion, the plugin pins, the DOTS_PASSED report) and be runnable."""
+    import subprocess
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "run_tier1.sh")
+    assert os.path.exists(path)
+    assert os.access(path, os.X_OK)
+    src = open(path).read()
+    for piece in ("set -o pipefail", "not slow", "DOTS_PASSED",
+                  "--continue-on-collection-errors", "no:cacheprovider",
+                  "no:xdist", "no:randomly", "JAX_PLATFORMS=cpu"):
+        assert piece in src, f"run_tier1.sh lost {piece!r}"
+    r = subprocess.run(["bash", "-n", path], capture_output=True)
+    assert r.returncode == 0, r.stderr

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Tier-1 verify gate — the EXACT command from ROADMAP.md, wrapped so
+# builders and CI invoke the same gate (same pipefail discipline, same
+# DOTS_PASSED report) instead of each reassembling it by hand.
+#
+# Usage:  tools/run_tier1.sh [extra pytest args...]
+#   e.g.  tools/run_tier1.sh tests/test_guardrails.py
+# Exit status is pytest's (pipefail-preserved through the tee).
+set -u
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+TIMEOUT_S="${TIER1_TIMEOUT:-870}"
+
+rm -f "$LOG"
+timeout -k 10 "$TIMEOUT_S" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit "$rc"
