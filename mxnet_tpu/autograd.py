@@ -295,7 +295,12 @@ def _run_backward(heads, head_grads, retain_graph, create_graph=False):
     """
     import jax.numpy as jnp
 
+    from . import engine
     from .ndarray.ndarray import NDArray
+
+    # tape boundary: any pending bulk segment must flush BEFORE the walk —
+    # it installs the segment tape nodes the heads' _tape links point at
+    engine.flush_current("tape")
 
     def lift(x):
         return NDArray(x) if create_graph and not isinstance(x, NDArray) else x
@@ -353,6 +358,7 @@ def _run_backward(heads, head_grads, retain_graph, create_graph=False):
         if create_graph:
             in_cts = _node_vjp_recorded(node, cts)
         else:
+            engine._count_dispatch()  # one backward executable per node
             in_cts = node.vjp_fn(tuple(cts) if len(cts) > 1 else cts[0])
         if not isinstance(in_cts, (tuple, list)):
             in_cts = (in_cts,)
@@ -467,8 +473,12 @@ class Function:
         raise NotImplementedError
 
     def __call__(self, *inputs):
+        from . import engine
         from .ndarray.ndarray import NDArray, _tracked, _slot_of
 
+        # custom Functions capture input tape slots eagerly — pending bulk
+        # segments must install their tape nodes first
+        engine.flush_current("tape")
         with pause():
             outputs = self.forward(*inputs)
         single = not isinstance(outputs, (list, tuple))

@@ -60,6 +60,9 @@ def _sig_limit():
 
 def _wrap_data(d):
     w = NDArray.__new__(NDArray)
+    w._view_parent = None
+    w._view_key = None
+    w._view_pver = 0
     w._data = d
     w._tape = None
     w._leaf = None

@@ -1,0 +1,487 @@
+"""Deferred-dispatch conformance suite (engine bulk segments).
+
+Contract under test: inside ``engine.bulk(N)`` imperative ops record into
+a per-thread segment flushed as ONE compiled executable — with results
+(values, gradients, updated params) BITWISE identical to unbulked per-op
+dispatch, flush-on-materialize/tape semantics, NaiveEngine forced to
+size 1, fault plans still tripping per recorded op, and the default-off
+path inside the established <5% eager-microloop overhead bound.
+"""
+import contextlib
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, gluon
+from mxnet_tpu import np
+from mxnet_tpu.ops import registry
+from mxnet_tpu.resilience import faults
+
+
+@contextlib.contextmanager
+def _unbulked():
+    """Pin deferral OFF for a comparison arm — the suite must stay
+    meaningful under the tier-1 MXNET_ENGINE_BULK_SIZE=16 second pass,
+    where a bare nullcontext would silently bulk both arms."""
+    prev = engine.set_bulk_size(0)
+    try:
+        yield
+    finally:
+        engine.set_bulk_size(prev)
+
+
+def setup_function(_fn):
+    # tests assert on flush/dispatch counters: start each from zero
+    engine.flush_current("manual")
+    engine.bulk_stats(reset=True)
+    engine.reset_dispatch_count()
+
+
+# ---------------------------------------------------------------------------
+# Laziness + flush semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ops_defer_and_flush_on_materialize():
+    a = np.array(onp.arange(6.0, dtype="float32").reshape(2, 3))
+    with engine.bulk(16):
+        b = np.tanh((a + 1) * 2)
+        # pending: lazy placeholder, shape/dtype answered WITHOUT a flush
+        assert type(b._buf) is engine._LazyRef
+        assert b.shape == (2, 3) and str(b.dtype) == "float32"
+        assert engine.bulk_stats()["flushes"] == 0
+        out = b.asnumpy()  # materialization flushes
+        stats = engine.bulk_stats()
+        assert stats["flushes"] == 1
+        assert stats["reasons"] == {"materialize": 1}
+        assert stats["ops_flushed"] == 3
+    ref = np.tanh((a + 1) * 2).asnumpy()
+    onp.testing.assert_array_equal(out, ref)
+
+
+def test_segment_flushes_at_size_cap():
+    a = np.array(onp.ones((4,), "float32"))
+    with engine.bulk(3):
+        b = a + 1
+        c = b + 1
+        d = c + 1  # 3rd op: cap reached, flush without materialization
+        assert engine.bulk_stats()["reasons"].get("size") == 1
+        assert type(d._buf) is not engine._LazyRef or d._buf.value is not None
+        e = d + 1  # lands in a fresh segment
+        assert type(e._buf) is engine._LazyRef and e._buf.value is None
+    onp.testing.assert_array_equal(e.asnumpy(), onp.full((4,), 5.0, "f4"))
+
+
+def test_flush_on_tape_boundary_and_backward_parity():
+    xv = onp.random.randn(5, 4).astype("float32")
+
+    def run(bulked):
+        x = np.array(xv)
+        x.attach_grad()
+        scope = engine.bulk(16) if bulked else _unbulked()
+        with scope:
+            with autograd.record():
+                y = ((x * 2 + 1) ** 2).sum()
+            y.backward()  # tape boundary: flush installs the segment node
+            return x.grad.asnumpy().copy()
+
+    g_plain = run(False)
+    engine.bulk_stats(reset=True)
+    g_bulk = run(True)
+    assert engine.bulk_stats()["reasons"].get("tape") == 1
+    onp.testing.assert_array_equal(g_plain, g_bulk)
+
+
+def test_segment_cache_hits_in_steady_state():
+    a = np.array(onp.ones((8,), "float32"))
+    for _ in range(4):
+        with engine.bulk(16):
+            out = np.tanh((a + 1) * 2).asnumpy()
+    stats = engine.bulk_stats()
+    assert stats["flushes"] == 4
+    # one compile, then replay of the cached segment executable
+    assert stats["cache_hits"] >= 3
+    onp.testing.assert_array_equal(
+        out, np.tanh((a + 1) * 2).asnumpy())
+
+
+def test_wait_all_flushes_pending_segment():
+    a = np.array(onp.ones((4,), "float32"))
+    with engine.bulk(16):
+        b = a * 3
+        assert type(b._buf) is engine._LazyRef
+        engine.wait_all()
+        assert engine.bulk_stats()["reasons"].get("wait") == 1
+        assert b._buf.value is not None
+    onp.testing.assert_array_equal(b.asnumpy(), onp.full((4,), 3.0, "f4"))
+
+
+def test_wait_all_drains_other_threads_segments():
+    """wait_all's drain-all contract covers segments recorded on OTHER
+    threads: their deferred ops must be submitted (and any errors
+    surfaced) before wait_all returns."""
+    recorded = threading.Event()
+    release = threading.Event()
+    out = {}
+
+    def worker():
+        engine.set_bulk_size(16)
+        a = np.array(onp.ones((4,), "float32"))
+        b = a + 5
+        out["ref"] = b._buf
+        out["handle"] = b
+        recorded.set()
+        release.wait(timeout=10)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    assert recorded.wait(timeout=10)
+    assert type(out["ref"]) is engine._LazyRef and out["ref"].value is None
+    engine.wait_all()  # must flush the WORKER's pending segment too
+    assert out["ref"].value is not None, \
+        "wait_all returned with another thread's segment still pending"
+    release.set()
+    t.join()
+    onp.testing.assert_array_equal(out["handle"].asnumpy(),
+                                   onp.full((4,), 6.0, "f4"))
+
+
+def test_undeferrable_rng_op_flushes_then_dispatches():
+    """Dropout draws a key per call: never deferred (a cached segment
+    would bake the mask) — it flushes the pending segment, dispatches
+    directly, and randomness survives."""
+    from mxnet_tpu.ops import nn as _nn
+
+    a = np.ones((32, 32))
+    with engine.bulk(32):
+        b = a * 2  # pending
+        with autograd.train_mode():
+            d1 = _nn.dropout(b, p=0.5).asnumpy()
+            d2 = _nn.dropout(b, p=0.5).asnumpy()
+    assert (d1 != d2).any(), "dropout mask froze under bulking"
+    assert engine.bulk_stats()["reasons"].get("undeferrable", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Bitwise parity: eager LeNet training step + >=5x dispatch collapse
+# ---------------------------------------------------------------------------
+
+
+def _lenet():
+    mx.random.seed(7)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(6, 5, activation="relu"), gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(16, 5, activation="relu"), gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(), gluon.nn.Dense(120, activation="relu"),
+            gluon.nn.Dense(84, activation="relu"), gluon.nn.Dense(10))
+    net.initialize()
+    return net
+
+
+def _lenet_steps(bulk_n, xv, yv, n_steps=2):
+    net = _lenet()
+    x = np.array(xv)
+    y = np.array(yv)
+    with autograd.predict_mode():
+        net(x)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    losses = []
+    dispatches = []
+    for _ in range(n_steps):
+        scope = engine.bulk(bulk_n) if bulk_n else _unbulked()
+        before = engine.dispatch_count()
+        with scope:
+            with autograd.record():
+                l = loss_fn(net(x), y).mean()
+            l.backward()
+            tr.step(1)
+            losses.append(float(l.asnumpy()))
+        dispatches.append(engine.dispatch_count() - before)
+    params = {k: v.data().asnumpy().copy()
+              for k, v in net.collect_params().items()}
+    return losses, params, dispatches
+
+
+@pytest.mark.serial
+def test_lenet_step_bitwise_parity_and_5x_dispatch_drop():
+    """The PR's acceptance gate: with engine.bulk(16) an eager LeNet
+    train step makes >=5x fewer executable invocations than unbulked,
+    with bitwise-identical losses and updated parameters."""
+    rng = onp.random.RandomState(0)
+    xv = rng.randn(8, 1, 28, 28).astype("float32")
+    yv = rng.randint(0, 10, (8,)).astype("int64")
+    l_plain, p_plain, d_plain = _lenet_steps(0, xv, yv)
+    l_bulk, p_bulk, d_bulk = _lenet_steps(16, xv, yv)
+    assert l_plain == l_bulk, f"loss drift: {l_plain} vs {l_bulk}"
+    for k in p_plain:
+        onp.testing.assert_array_equal(
+            p_plain[k], p_bulk[k],
+            err_msg=f"param {k} not bitwise identical under bulk(16)")
+    # steady-state step (step 2: caches warm on both arms)
+    assert d_plain[-1] >= 5 * d_bulk[-1], (
+        f"dispatch drop below 5x: {d_plain[-1]} unbulked vs "
+        f"{d_bulk[-1]} bulked")
+    stats = engine.bulk_stats()
+    assert stats["flushes"] >= 2 and stats["ops_flushed"] >= 20
+
+
+def test_autograd_train_step_bitwise_parity():
+    """Plain (non-gluon) autograd train step: forward under record,
+    backward, manual SGD — gradients and weights bulk-vs-unbulked must
+    agree BITWISE across steps. The per-op fences pin every op's
+    numerics, so the only sanctioned divergence is the loss SCALAR: XLA
+    may pick a different reduce emitter for a reduction inside a fused
+    segment module than for its standalone executable (<= 1 ulp)."""
+    rng = onp.random.RandomState(3)
+    xv = rng.randn(16, 8).astype("float32")
+    wv = rng.randn(8, 4).astype("float32")
+
+    def run(bulked):
+        x = np.array(xv)
+        w = np.array(wv)
+        w.attach_grad()
+        outs, grads = [], []
+        for _ in range(3):
+            scope = engine.bulk(16) if bulked else _unbulked()
+            with scope:
+                with autograd.record():
+                    h = np.tanh(x @ w)
+                    l = (h * h).mean()
+                l.backward()
+                grads.append(w.grad.asnumpy().copy())
+                w -= 0.1 * w.grad
+                outs.append(float(l.asnumpy()))
+        return outs, grads, w.asnumpy().copy()
+
+    l_plain, g_plain, w_plain = run(False)
+    l_bulk, g_bulk, w_bulk = run(True)
+    for gp, gb in zip(g_plain, g_bulk):
+        onp.testing.assert_array_equal(gp, gb)
+    onp.testing.assert_array_equal(w_plain, w_bulk)
+    onp.testing.assert_allclose(l_plain, l_bulk, rtol=3e-7, atol=0)
+
+
+def test_pause_inside_bulk_blocks_gradient():
+    """An op recorded under autograd.pause() is a CONSTANT on the tape;
+    the segment vjp must not conduct gradient through it (stop_gradient
+    fences in the replay), matching unbulked eager exactly."""
+    xv = onp.random.RandomState(5).rand(4).astype("float32") + 0.5
+
+    def run(bulked):
+        x = np.array(xv)
+        x.attach_grad()
+        scope = engine.bulk(16) if bulked else _unbulked()
+        with scope:
+            with autograd.record():
+                y = x * x
+                with autograd.pause():
+                    s = y * 3.0  # constant w.r.t. the tape
+                z = (y * s).sum()
+            z.backward()
+            return x.grad.asnumpy().copy()
+
+    g_plain = run(False)
+    g_bulk = run(True)
+    onp.testing.assert_array_equal(g_plain, g_bulk)
+    # and both equal d/dx (y * const) = 2x * (3x^2) = 6x^3
+    onp.testing.assert_allclose(g_plain, 6 * xv ** 3, rtol=1e-5)
+
+
+def test_seeded_rng_stream_identical_bulk_vs_unbulked():
+    """The recorder's eval_shape probe must not burn RNG keys: a seeded
+    program draws the SAME random stream with bulking on or off (the
+    probe rewinds any keys an RNG op consumed during abstract tracing)."""
+    from mxnet_tpu.ops import nn as _nn
+
+    def draws(bulked):
+        mx.random.seed(123)
+        a = np.ones((16, 16))
+        scope = engine.bulk(16) if bulked else _unbulked()
+        with scope:
+            with autograd.train_mode():
+                d1 = _nn.dropout(a * 1.0, p=0.5).asnumpy()
+            r = np.random.uniform(size=(8,)).asnumpy()
+        return d1, r
+
+    d_plain, r_plain = draws(False)
+    d_bulk, r_bulk = draws(True)
+    onp.testing.assert_array_equal(d_plain, d_bulk)
+    onp.testing.assert_array_equal(r_plain, r_bulk)
+
+
+# ---------------------------------------------------------------------------
+# NaiveEngine + thread-local bulk size
+# ---------------------------------------------------------------------------
+
+
+def test_naive_engine_forces_segment_size_one():
+    prev = engine.engine_type()
+    engine.set_engine_type("NaiveEngine")
+    try:
+        a = np.array(onp.ones((4,), "float32"))
+        with engine.bulk(16):
+            b = a + 1
+            # synchronous semantics preserved: nothing deferred
+            assert type(b._buf) is not engine._LazyRef
+        assert engine.bulk_stats()["flushes"] == 0
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_bulk_size_is_thread_local():
+    """Satellite: a bulk() scope on one thread must not change another
+    thread's flush threshold mid-step (each thread sees only ITS size,
+    whatever the process default)."""
+    seen = {}
+    barrier = threading.Barrier(2)
+
+    def bulky():
+        with engine.bulk(64):
+            barrier.wait()
+            seen["bulky"] = engine._active_bulk_size()
+            barrier.wait()
+            seen["bulky_after"] = engine._active_bulk_size()
+
+    def plain():
+        engine.set_bulk_size(0)  # this thread opts out, others unaffected
+        barrier.wait()
+        seen["plain"] = engine._active_bulk_size()
+        a = np.array(onp.ones((2,), "float32"))
+        b = a + 1  # must dispatch eagerly: bulking is off on THIS thread
+        seen["plain_lazy"] = type(b._buf) is engine._LazyRef
+        barrier.wait()
+
+    t1 = threading.Thread(target=bulky)
+    t2 = threading.Thread(target=plain)
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert seen["bulky"] == 64, "bulk scope size lost on its own thread"
+    assert seen["bulky_after"] == 64, "another thread's opt-out leaked in"
+    assert seen["plain"] == 0, "bulk scope leaked across threads"
+    assert seen["plain_lazy"] is False
+
+
+def test_set_bulk_size_returns_previous_and_flushes():
+    prev = engine.set_bulk_size(32)
+    try:
+        assert engine.set_bulk_size(prev) == 32
+    finally:
+        engine.set_bulk_size(prev)
+
+
+# ---------------------------------------------------------------------------
+# Fault injection through deferral
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_fault_site_fires_per_recorded_op_at_flush():
+    """The op:dispatch fault site must hit once per RECORDED op when the
+    segment flushes — deferral cannot make injected faults vanish — and
+    the error surfaces at the materialization point."""
+    plan = faults.install_plan({"seed": 1, "rules": [
+        {"site": "op:dispatch", "kind": "transient", "at": [2]}]})
+    try:
+        a = np.array(onp.ones((4,), "float32"))
+        with engine.bulk(16):
+            b = a + 1
+            c = b * 2
+            d = c - 3
+            with pytest.raises(mx.base.MXNetError):
+                d.asnumpy()  # flush fires op:dispatch x3; rule trips at #2
+        st = plan.stats()[0]
+        assert st["hits"] == 3, "one op:dispatch hit per recorded op"
+        assert st["fired"] == 1
+        # every poisoned lazy handle re-surfaces the failure
+        with pytest.raises(mx.base.MXNetError):
+            b.asnumpy()
+    finally:
+        faults.clear_plan()
+
+
+def test_wait_for_var_fires_engine_wait_fault_site():
+    """Satellite: wait_for_var previously skipped the engine:wait fault
+    check that wait_all performs; both wait points must surface injected
+    async errors (contract (c))."""
+    plan = faults.install_plan({"seed": 1, "rules": [
+        {"site": "engine:wait", "kind": "fatal", "times": 1}]})
+    try:
+        a = np.array(onp.ones((2,), "float32"))
+        with pytest.raises(mx.base.MXNetError):
+            a.wait_to_read()
+        assert plan.stats()[0]["fired"] == 1
+    finally:
+        faults.clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# Registry cache-clear observability (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_eager_jit_clear_counter_and_warning():
+    stats = registry.cache_stats()
+    assert set(stats) >= {"size", "bwd_size", "skips", "clears", "limit"}
+    before = stats["clears"]
+    saved_max = registry._EAGER_JIT_MAX
+    saved_clears = registry._EAGER_JIT_CLEARS
+    prev_bulk = engine.set_bulk_size(0)  # exercise the per-op cache path
+    try:
+        registry._EAGER_JIT_MAX = registry.eager_jit_cache_size() + 1
+        registry._EAGER_JIT_CLEARS = 0
+        a = np.array(onp.ones((3,), "float32"))
+        with pytest.warns(RuntimeWarning, match="runaway"):
+            for i in range(4):  # distinct static configs force new entries
+                np.sum(a * 1.0, axis=0)
+                np.clip(a, 0.0, float(i + 2))
+        assert registry.cache_stats()["clears"] >= 1
+    finally:
+        registry._EAGER_JIT_MAX = saved_max
+        registry._EAGER_JIT_CLEARS = max(saved_clears, before)
+        engine.set_bulk_size(prev_bulk)
+
+
+# ---------------------------------------------------------------------------
+# Default-off overhead bound
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serial
+def test_disabled_bulk_overhead_under_5pct():
+    """10k-iteration eager microloop: with the bulk machinery present but
+    disabled (the production default), overhead vs a loop that never
+    consults the gate must stay under the established 5% bound."""
+    x = np.ones((4,))
+
+    def loop(n=10_000):
+        y = x
+        t0 = time.perf_counter()
+        for _ in range(n):
+            y = y + 1.0
+        y.wait_to_read()
+        return time.perf_counter() - t0
+
+    saved = engine._BULK_POSSIBLE
+
+    def measure(rounds=7):
+        base = gated = float("inf")
+        for _ in range(rounds):
+            engine._BULK_POSSIBLE = False  # gate short-circuits in apply
+            base = min(base, loop())
+            engine._BULK_POSSIBLE = True   # gate consulted, bulking off
+            engine.set_bulk_size(0)
+            gated = min(gated, loop())
+        return base, gated
+
+    try:
+        loop(2000)  # warm jit caches before either measurement
+        base, gated = measure()
+        if gated > base * 1.05:  # timing noise: one clean re-measure
+            base, gated = measure(rounds=9)
+    finally:
+        engine._BULK_POSSIBLE = saved
+    assert gated <= base * 1.05, (
+        f"disabled-bulk overhead {gated / base - 1:.1%} "
+        f"(baseline {base:.3f}s, gated {gated:.3f}s)")
