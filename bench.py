@@ -1049,6 +1049,60 @@ def bench_guardrail_overhead():
     })
 
 
+def bench_llama_decode(max_new=32, n_requests=16):
+    """Serving row (mxnet_tpu.serve): bucketed KV-cache autoregressive
+    decode on the 12L llama serve config. Reports ``decode_tokens_s``
+    (steady-state token rate, prefill excluded) and ``p99_latency_ms``
+    (whole-request wall time) so BENCH rounds track the serving SLO
+    alongside training throughput. Warmup compiles the full bucket
+    lattice; the measured phase asserts ZERO recompiles — a recompile
+    here is a perf bug, not noise, and fails the row loudly."""
+    import numpy as onp
+
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.serve import Generator
+    from mxnet_tpu.serve.metrics import percentile
+
+    net = get_llama("llama_serve_12l_test")
+    net.initialize()
+    gen = Generator(net, max_seq=64, batch_buckets=(1, 4),
+                    prompt_buckets=(16,))
+    warm = gen.warmup()
+    rng = onp.random.RandomState(0)
+    lat_ms = []
+    tokens = 0
+    decode_s = 0.0
+    for i in range(n_requests):
+        n_prompts = 4 if i % 2 else 1  # alternate batch buckets
+        prompts = [rng.randint(1, 500,
+                               size=int(rng.randint(4, 13))).tolist()
+                   for _ in range(n_prompts)]
+        t1 = time.perf_counter()
+        outs, info = gen.generate(prompts, max_new_tokens=max_new)
+        lat_ms.append((time.perf_counter() - t1) * 1e3)
+        # steady-state rate: each request's FIRST token is sampled from
+        # prefill logits, so only decode_steps tokens/row count here
+        tokens += info["decode_steps"] * len(prompts)
+        decode_s += info["decode_ms"] / 1e3
+    gen.assert_no_recompiles()
+    stats = gen.session.cache_stats()
+    toks_s = tokens / decode_s if decode_s > 0 else 0.0
+    return _emit({
+        "metric": "llama_decode_tokens_s",
+        "value": round(toks_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "decode_tokens_s": round(toks_s, 1),
+        "p50_latency_ms": round(percentile(lat_ms, 50), 2),
+        "p99_latency_ms": round(percentile(lat_ms, 99), 2),
+        "requests": n_requests,
+        "max_new_tokens": max_new,
+        "signatures": stats["signatures"],
+        "serve_hits": stats["serve_hits"],
+        "warmup_s": round(warm["wall_s"], 2),
+    })
+
+
 def bench_bandwidth():
     """KVStore push/pull bandwidth (tools/bandwidth parity, perf.md:263).
 
@@ -1096,6 +1150,7 @@ def main():
                      ("lenet_eager_bulk16", bench_lenet_eager_bulk),
                      ("bert", bench_bert_train),
                      ("bert_fused", bench_bert_train_fused),
+                     ("llama_decode", bench_llama_decode),
                      ("llama_long_seq", bench_llama_long_seq),
                      ("llama_long_seq4k",
                       lambda: bench_llama_long_seq(seq=4096, batch=2)),

@@ -106,6 +106,7 @@ _LAZY_SUBMODULES = (
     "amp",
     "dlpack",
     "models",
+    "serve",
     "symbol",
     "sym",
     "metric",

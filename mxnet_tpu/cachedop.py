@@ -103,10 +103,16 @@ class CachedOp:
     """Compiled, signature-cached executor for a HybridBlock."""
 
     def __init__(self, block, static_alloc=False, static_shape=False,
-                 flags=()):  # pylint: disable=unused-argument
+                 flags=(), compiler_options=None):  # pylint: disable=unused-argument
         self.block = block
         self.static_alloc = static_alloc
         self.static_shape = static_shape
+        # per-executable XLA overrides (jax.jit compiler_options). The
+        # serving engine pins the deterministic legacy CPU runtime here:
+        # the thunk runtime's codegen partitioning varies with graph
+        # shape, which breaks the decode-vs-prefill bitwise contract
+        self._compiler_options = dict(compiler_options) \
+            if compiler_options else None
         self._cache = {}
         self._bwd_cache = {}
         # telemetry (always maintained — int increments on an already-
@@ -115,12 +121,52 @@ class CachedOp:
         self._misses = 0
         self._compile_ns = 0
         self._storm_warned = False
+        self._serve_hits = 0
+        self._call_tls = threading.local()
 
     def cache_stats(self):
-        """Signature-cache telemetry: hits/misses/signatures/compile time."""
+        """Signature-cache telemetry: hits/misses/signatures/compile time
+        (plus ``serve_hits``, the warm calls issued through
+        ``mxnet_tpu.serve`` — see :meth:`record_serve_hit`)."""
         return {"hits": self._hits, "misses": self._misses,
                 "signatures": len(self._cache),
+                "serve_hits": self._serve_hits,
                 "compile_ms": self._compile_ns / 1e6}
+
+    def signature_count(self) -> int:
+        """Number of distinct compiled signatures (executables) held.
+
+        The serving engine's "no recompiles after warmup" assertion is
+        exactly: this count does not move between two points in time.
+        """
+        return len(self._cache)
+
+    def bucket_keys(self):
+        """The cached signature keys themselves — each is one compiled
+        bucket: (arg shapes/dtypes, param shapes/dtypes, state
+        shapes/dtypes, train-mode, grad-mode, tracked-args, static args).
+        Exposed so ``serve.engine`` (and users) can see exactly which
+        padded shapes are resident."""
+        return list(self._cache.keys())
+
+    def record_serve_hit(self, n=1):
+        """Count ``n`` warm serve-path executions into ``cache_stats()``.
+        Called by ``serve.engine.InferenceSession`` after a call that hit
+        an already-compiled signature."""
+        self._serve_hits += int(n)
+
+    def begin_serve_call(self):
+        """Arm per-thread warm-call tracking: after the next call on this
+        thread, :meth:`call_was_warm` reports whether it compiled. Thread-
+        local, so concurrent serving threads can't misattribute another
+        thread's cold compile to their own warm call (a global
+        misses-delta snapshot would)."""
+        self._call_tls.compiled = False
+
+    def call_was_warm(self):
+        """True if no signature was compiled on THIS thread since
+        :meth:`begin_serve_call`."""
+        return not getattr(self._call_tls, "compiled", True)
 
     # -- helpers ----------------------------------------------------------
     def _lookup_or_build(self, key, grad_mode, args_tracked, static_args):
@@ -129,6 +175,7 @@ class CachedOp:
             self._hits += 1
             return entry
         self._misses += 1
+        self._call_tls.compiled = True
         t0 = time.perf_counter_ns()
         entry = self._build_with_retry(key, grad_mode, args_tracked,
                                        static_args)
@@ -260,7 +307,7 @@ class CachedOp:
                 (out_datas, new_states), vjp = jax.vjp(for_vjp, tuple(tp_datas), *diff_args)
                 return out_datas, new_states, vjp
 
-            fwd_jit = jax.jit(fwd)
+            fwd_jit = jax.jit(fwd, compiler_options=self._compiler_options)
         else:
             def fwd(tp_datas, st_datas, rng_key, *arg_datas):
                 out_datas, new_states = replay(tp_datas, st_datas, rng_key,
@@ -268,7 +315,8 @@ class CachedOp:
                 return out_datas, new_states, None
 
             donate = (1,) if self.static_alloc else ()
-            fwd_jit = jax.jit(fwd, donate_argnums=donate)
+            fwd_jit = jax.jit(fwd, donate_argnums=donate,
+                              compiler_options=self._compiler_options)
 
         def bwd(vjp, out_cts, state_shapes_dtypes):
             import jax.numpy as jnp
@@ -404,10 +452,15 @@ class CachedOpThreadSafe(CachedOp):
     """
 
     def __init__(self, block, static_alloc=False, static_shape=False,
-                 flags=()):
+                 flags=(), compiler_options=None):
         super().__init__(block, static_alloc=static_alloc,
-                         static_shape=static_shape, flags=flags)
+                         static_shape=static_shape, flags=flags,
+                         compiler_options=compiler_options)
         self._lock = threading.RLock()
+
+    def record_serve_hit(self, n=1):
+        with self._lock:  # += is not atomic; concurrent flushers race
+            super().record_serve_hit(n)
 
     def _lookup_or_build(self, key, grad_mode, args_tracked, static_args):
         entry = self._cache.get(key)

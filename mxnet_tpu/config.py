@@ -203,6 +203,39 @@ register_flag(
     "Rewind-and-skip recoveries before GuardrailHandler gives up and "
     "raises DivergenceError.", int)
 register_flag(
+    "MXNET_SERVE_BATCH_TIMEOUT_MS", 5.0,
+    "DynamicBatcher flush deadline: an admitted request waits at most this "
+    "long for batch-mates before the partial batch dispatches "
+    "(mxnet_tpu.serve.batcher).", float)
+register_flag(
+    "MXNET_SERVE_MAX_BATCH", 8,
+    "DynamicBatcher flush size: a batch dispatches immediately once this "
+    "many requests are queued (should match the serving session's largest "
+    "batch bucket).", int)
+register_flag(
+    "MXNET_SERVE_MAX_QUEUE", 64,
+    "Admission-control cap on DynamicBatcher queue depth: submissions "
+    "beyond it fast-reject with ServiceUnavailable (503) instead of "
+    "building an unbounded backlog.", int)
+register_flag(
+    "MXNET_SERVE_TIMEOUT_MS", 0.0,
+    "Per-execution watchdog for serve.InferenceSession: a hung executable "
+    "becomes a fast ServiceUnavailable (503) after this many ms instead "
+    "of wedging the serving thread. 0 disables (zero overhead).", float)
+register_flag(
+    "MXNET_SERVE_BREAKER_THRESHOLD", 3,
+    "Consecutive InferenceSession execution failures that trip the "
+    "session circuit breaker open (requests fast-reject until cooldown).",
+    int)
+register_flag(
+    "MXNET_SERVE_BREAKER_COOLDOWN", 8,
+    "Rejected calls the serve breaker stays open before letting one "
+    "half-open probe re-test the session.", int)
+register_flag(
+    "MXNET_SERVE_METRICS_WINDOW", 2048,
+    "Ring-buffer sample count backing the serve p50/p95/p99 latency "
+    "percentiles (serve.metrics).", int)
+register_flag(
     "MXNET_LOSS_SCALE_MIN", 1.0,
     "Lower clamp for the dynamic LossScaler (amp.py): repeated overflows "
     "can never drive the scale to 0.", float)

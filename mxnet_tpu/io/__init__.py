@@ -72,6 +72,10 @@ class NDArrayIter(DataIter):
     """Iterate numpy/NDArray (+label) dicts (reference ``io.py:605``).
 
     ``last_batch_handle``: 'pad' (wrap), 'discard', or 'roll_over'.
+
+    Training-input overlap: wrap in :class:`PrefetchIter` —
+    ``PrefetchIter(NDArrayIter(data, label, batch_size), num_prefetch=2)``
+    — to pull batches on a background thread while the device computes.
     """
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
@@ -124,25 +128,33 @@ class NDArrayIter(DataIter):
         self.cursor += self.batch_size
         return self.cursor < len(self._epoch)
 
+    def _batch_indices(self):
+        start = self.cursor
+        idx = self._epoch[start:start + self.batch_size]
+        while len(idx) < self.batch_size:  # only reachable with pad: wrap
+            # wrap REPEATEDLY — with batch_size > num_data a single wrap
+            # produced a short batch whose shape broke downstream
+            # fixed-shape consumers (the last-batch regression in
+            # tests/test_data_io.py)
+            idx = idx + self._epoch[:self.batch_size - len(idx)]
+        return idx
+
     def _slice(self, arrays):
         from .. import numpy as mnp
 
-        out = []
-        start = self.cursor
-        end = self.cursor + self.batch_size
-        idx = self._epoch[start:end]
-        if len(idx) < self.batch_size:  # only reachable with pad: wrap
-            idx = idx + self._epoch[:self.batch_size - len(idx)]
-        idx = _onp.asarray(idx)
-        for _, v in arrays:
-            out.append(mnp.array(v[idx]))
-        return out
+        idx = _onp.asarray(self._batch_indices())
+        return [mnp.array(v[idx]) for _, v in arrays]
 
     def getdata(self):
         return self._slice(self.data)
 
     def getlabel(self):
         return self._slice(self.label)
+
+    def getindex(self):
+        """Source-sample indices of the current batch (wrap-padded tail
+        included), matching the reference's DataBatch.index contract."""
+        return _onp.asarray(self._batch_indices(), dtype=_onp.int64)
 
     def getpad(self):
         if self.last_batch_handle == "pad" \
@@ -303,57 +315,110 @@ class ResizeIter(DataIter):
         return batch
 
 
-class PrefetchingIter(DataIter):
-    """Background-thread prefetch wrapper (reference ``io.py:463`` /
-    ``src/io/iter_prefetcher.h``)."""
+class PrefetchIter(DataIter):
+    """Background-thread prefetch with a configurable depth.
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    Wrap any :class:`DataIter` (``PrefetchIter(NDArrayIter(...),
+    num_prefetch=2)``) and up to ``num_prefetch`` batches are pulled ahead
+    on a daemon thread while the consumer computes — host-side input
+    pipeline overlaps device compute, the role of the reference's
+    threaded ``iter_prefetcher.h`` with its configurable buffer.
+
+    A producer-side exception is re-raised on the consumer thread at the
+    batch where it occurred (not swallowed, not reordered). Once the
+    stream ends (or errors), further ``next()`` calls keep raising
+    ``StopIteration`` (or the same error) until :meth:`reset` — same
+    repeat-terminal contract as :class:`NDArrayIter`.
+    """
+
+    def __init__(self, data_iter, num_prefetch=2):
         import queue
         import threading
 
-        if not isinstance(iters, list):
-            iters = [iters]
-        assert len(iters) == 1, "composite prefetch not supported"
-        self.data_iter = iters[0]
-        super().__init__(self.data_iter.batch_size)
-        self._queue = queue.Queue(maxsize=2)
-        self._stop = threading.Event()
+        if num_prefetch < 1:
+            raise MXNetError("num_prefetch must be >= 1")
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        self.data_iter = data_iter
+        self.num_prefetch = int(num_prefetch)
+        self._queue_mod = queue
+        self._threading = threading
+        self._queue = None
         self._thread = None
+        self._stop = threading.Event()
+        self._done = False
+        self._error = None
         self._start()
 
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
     def _start(self):
-        import threading
+        self._queue = self._queue_mod.Queue(maxsize=self.num_prefetch)
+        self._done = False
+        self._error = None
 
         def run():
             try:
                 for batch in self.data_iter:
                     if self._stop.is_set():
                         return
-                    self._queue.put(batch)
-            finally:
-                self._queue.put(None)
+                    self._queue.put(("batch", batch))
+                self._queue.put(("done", None))
+            except Exception as exc:  # pylint: disable=broad-except
+                self._queue.put(("error", exc))
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = self._threading.Thread(
+            target=run, daemon=True, name="mxtpu-prefetch")
         self._thread.start()
 
-    def reset(self):
+    def _drain(self):
         self._stop.set()
         while self._thread.is_alive():
             try:
                 self._queue.get_nowait()
-            except Exception:  # pylint: disable=broad-except
+            except self._queue_mod.Empty:
                 pass
-            self._thread.join(timeout=0.1)
+            self._thread.join(timeout=0.05)
         self._stop.clear()
+
+    def reset(self):
+        self._drain()
         self.data_iter.reset()
-        self._queue = __import__("queue").Queue(maxsize=2)
         self._start()
 
     def next(self):
-        batch = self._queue.get()
-        if batch is None:
+        if self._done:
+            # terminal state is sticky until reset(): the producer thread
+            # has exited, so another queue.get() would block forever
+            if self._error is not None:
+                raise self._error
             raise StopIteration
-        return batch
+        kind, payload = self._queue.get()
+        if kind == "batch":
+            return payload
+        self._done = True
+        if kind == "error":
+            self._error = payload
+            raise payload
+        raise StopIteration
+
+
+class PrefetchingIter(PrefetchIter):
+    """Reference-API prefetch wrapper (reference ``io.py:463`` /
+    ``src/io/iter_prefetcher.h``): :class:`PrefetchIter` at the
+    reference's fixed depth of 2, accepting the legacy list-of-iters
+    calling convention."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, list):
+            iters = [iters]
+        assert len(iters) == 1, "composite prefetch not supported"
+        super().__init__(iters[0], num_prefetch=2)
 
 
 def _init_data(data, allow_empty, default_name):

@@ -82,23 +82,65 @@ class LlamaAttention(HybridBlock):
         b, t, _ = x.shape
         return x.reshape(b, t, n, self._head_dim).transpose(0, 2, 1, 3)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, start_pos=None):
+        """Causal attention; ``cache=`` switches to the serving decode path.
+
+        Training/prefill-without-cache (``cache is None``) is the original
+        path — flash kernel on TPU, unchanged numerics. With ``cache`` (a
+        per-layer KV slot from :class:`mxnet_tpu.serve.KVCache`) and
+        ``start_pos`` ((B,) absolute position of ``x[:, 0]``), the new
+        K/V rows are RoPE-rotated, written into the preallocated ring,
+        and attention runs over the full ring through the shape-stable
+        ``cached_attention`` op — per-token decode logits are bitwise
+        identical to a full re-prefill through this same path.
+        """
         from .. import numpy as mnp
 
         b, t, _ = x.shape
-        q = self._heads_split(self.q_proj(x), self._heads)
-        k = self._heads_split(self.k_proj(x), self._kv_heads)
-        v = self._heads_split(self.v_proj(x), self._kv_heads)
-        cos_t, sin_t = _rope_tables(t, self._head_dim, self._theta)
-        cos = mnp.array(cos_t)
-        sin = mnp.array(sin_t)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
         rep = self._heads // self._kv_heads
-        if rep > 1:  # expand kv heads for the attention kernel
-            k = mnp.repeat(k, rep, axis=1)
-            v = mnp.repeat(v, rep, axis=1)
-        out = _ops.attention(q, k, v, causal=True)
+        if cache is None:
+            q = self._heads_split(self.q_proj(x), self._heads)
+            k = self._heads_split(self.k_proj(x), self._kv_heads)
+            v = self._heads_split(self.v_proj(x), self._kv_heads)
+            cos_t, sin_t = _rope_tables(t, self._head_dim, self._theta)
+            cos = mnp.array(cos_t)
+            sin = mnp.array(sin_t)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            if rep > 1:  # expand kv heads for the attention kernel
+                k = mnp.repeat(k, rep, axis=1)
+                v = mnp.repeat(v, rep, axis=1)
+            out = _ops.attention(q, k, v, causal=True)
+        else:
+            if start_pos is None:
+                raise MXNetError("cache= requires start_pos (the (B,) "
+                                 "absolute position of x[:, 0])")
+            # stable_dense, not Dense: the whole cache path must be
+            # shape-stable so T=1 decode bitwise-matches T=bucket prefill
+            q = self._heads_split(
+                _ops.stable_dense(x, self.q_proj.weight.data()),
+                self._heads)
+            k = self._heads_split(
+                _ops.stable_dense(x, self.k_proj.weight.data()),
+                self._kv_heads)
+            v = self._heads_split(
+                _ops.stable_dense(x, self.v_proj.weight.data()),
+                self._kv_heads)
+            cos_t, sin_t = _rope_tables(cache.max_seq, self._head_dim,
+                                        self._theta)
+            cos, sin = _ops.rope_positions(mnp.array(cos_t),
+                                           mnp.array(sin_t), start_pos, t)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            k_all = _ops.kv_cache_write(cache.k, k, start_pos)
+            v_all = _ops.kv_cache_write(cache.v, v, start_pos)
+            cache.update(k_all, v_all)
+            if rep > 1:  # expand the (unrepeated) cached kv heads at use
+                k_all = mnp.repeat(k_all, rep, axis=1)
+                v_all = mnp.repeat(v_all, rep, axis=1)
+            out = _ops.cached_attention(q, k_all, v_all, start_pos)
+            out = out.transpose(0, 2, 1, 3).reshape(b, t, self._units)
+            return _ops.stable_dense(out, self.o_proj.weight.data())
         out = out.transpose(0, 2, 1, 3).reshape(b, t, self._units)
         return self.o_proj(out)
 
@@ -115,7 +157,15 @@ class LlamaFFN(HybridBlock):
         self.down_proj = nn.Dense(units, flatten=False, use_bias=False,
                                   in_units=hidden_size)
 
-    def forward(self, x):
+    def forward(self, x, stable=False):
+        if stable:
+            # serving decode path: shape-stable projections (see
+            # ops.nn.stable_dense) keep T=1 bitwise equal to T=bucket
+            g = _ops.activation(
+                _ops.stable_dense(x, self.gate_proj.weight.data()), "silu")
+            return _ops.stable_dense(
+                g * _ops.stable_dense(x, self.up_proj.weight.data()),
+                self.down_proj.weight.data())
         g = _ops.activation(self.gate_proj(x), "silu")
         return self.down_proj(g * self.up_proj(x))
 
@@ -129,9 +179,10 @@ class LlamaBlock(HybridBlock):
         self.ffn_norm = nn.RMSNorm(epsilon=norm_eps, in_channels=units)
         self.ffn = LlamaFFN(units, hidden_size)
 
-    def forward(self, x):
-        x = x + self.attention(self.attn_norm(x))
-        x = x + self.ffn(self.ffn_norm(x))
+    def forward(self, x, cache=None, start_pos=None):
+        x = x + self.attention(self.attn_norm(x), cache=cache,
+                               start_pos=start_pos)
+        x = x + self.ffn(self.ffn_norm(x), stable=cache is not None)
         return x
 
 
@@ -182,10 +233,26 @@ class LlamaModel(HybridBlock):
             self.lm_head = nn.Dense(vocab_size, flatten=False,
                                     use_bias=False, in_units=units)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, cache=None, start_pos=None):
         x = self.embed(input_ids)
         from ..cachedop import in_trace
 
+        if cache is not None:
+            # serving decode path: per-layer KV rings, no remat (inference
+            # saves no activations, so recompute would be pure waste).
+            # Every matmul on this path is ops.nn.stable_dense — with the
+            # serving engine's pinned CPU runtime that makes the T=1
+            # decode executable bitwise equal, per position, to the
+            # T=bucket prefill executable (the serve parity contract);
+            # the fusion_fence additionally pins each layer boundary so
+            # the contract can't regress via cross-layer fusion choices
+            for i, blk in enumerate(self._blocks):
+                x = blk(x, cache=cache.layer(i), start_pos=start_pos)
+                x = _ops.fusion_fence(x)
+            x = self.norm(x)
+            w = (self.embed.weight.data() if self._tie
+                 else self.lm_head.weight.data())
+            return _ops.stable_dense(x, w)
         if self._remat and in_trace():
             # only under a functionalized trace (ShardedTrainer/CachedOp):
             # the eager tape records per-op and cannot see through
@@ -249,6 +316,12 @@ class LlamaModel(HybridBlock):
 _LLAMA_CONFIGS = {
     "llama_tiny_test": dict(units=64, hidden_size=128, num_layers=2,
                             num_heads=4, num_kv_heads=2, vocab_size=256),
+    # the 12-layer serving-parity config (tests/test_serve.py, bench
+    # llama_decode): full 12-deep residual/cache stack at widths a CPU
+    # tier-1 run can decode in seconds
+    "llama_serve_12l_test": dict(units=128, hidden_size=256, num_layers=12,
+                                 num_heads=4, num_kv_heads=2,
+                                 vocab_size=512),
     "llama2_7b": dict(units=4096, hidden_size=11008, num_layers=32,
                       num_heads=32, num_kv_heads=32, vocab_size=32000),
     "llama3_8b": dict(units=4096, hidden_size=14336, num_layers=32,

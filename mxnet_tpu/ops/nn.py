@@ -857,6 +857,147 @@ def attention(query, key, value, mask=None, causal=False, scale=None,
 
 
 # ---------------------------------------------------------------------------
+# KV-cache serving ops (mxnet_tpu.serve)
+#
+# These four ops are the compute core of autoregressive decode. They are
+# deliberately written in a *shape-stable* formulation: every reduction
+# (score dot products, softmax statistics, the value-weighted sum) runs
+# over the LAST axis of a tensor whose reduced extent is fixed by the
+# cache length, never by the query length. On the XLA CPU/TPU backends
+# this makes the per-position arithmetic bitwise identical whether the
+# query block is a full prefill (T = bucket) or a single decode token
+# (T = 1) — the property tests/test_serve.py asserts. A batched
+# dot_general here would NOT have it (its tiling changes with T; measured
+# ~1e-5 drift on CPU), which is why these do not reuse ``attention``.
+# ---------------------------------------------------------------------------
+
+
+def kv_cache_write(cache, new, start_pos):
+    """Write ``new`` (B, H, T, D) into the ring ``cache`` (B, H, S, D) at
+    per-row positions ``start_pos[b] + [0..T)``.
+
+    Gather+select formulation (``take_along_axis`` + ``where``) instead of
+    a scatter: deterministic, differentiable-free, and exact — selected
+    elements are copied, not arithmetically merged, so ``-0.0`` and
+    payload bits survive untouched.
+    """
+
+    def f(c, n, sp):
+        jnp = _jnp()
+        s_len = c.shape[2]
+        t_len = n.shape[2]
+        s_idx = jnp.arange(s_len, dtype=jnp.int32)[None, :]      # (1, S)
+        sp_ = sp.astype(jnp.int32)[:, None]                      # (B, 1)
+        in_window = (s_idx >= sp_) & (s_idx < sp_ + t_len)       # (B, S)
+        src = jnp.clip(s_idx - sp_, 0, t_len - 1)                # (B, S)
+        gathered = jnp.take_along_axis(n, src[:, None, :, None], axis=2)
+        return jnp.where(in_window[:, None, :, None], gathered, c)
+
+    return _apply(f, (cache, new, start_pos), name="kv_cache_write")
+
+
+def cached_attention(query, key, value, start_pos, scale=None):
+    """Causal attention of ``query`` (B, H, T, D) — absolute positions
+    ``start_pos[b] + t`` — over a KV ring (B, H, S, D).
+
+    Positions ``> start_pos[b] + t`` (future tokens, unwritten or padded
+    ring slots) are masked to ``-inf`` before the softmax; their
+    probabilities are exactly 0.0, so ring garbage contributes exact zeros
+    to the value sum. See the section comment for why this is a
+    mul+reduce, not a dot.
+    """
+    d = query.shape[-1]
+    sc = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+
+    def f(q, k, v, sp):
+        jnp = _jnp()
+        t_len = q.shape[2]
+        s_len = k.shape[2]
+        pos = sp.astype(jnp.int32)[:, None] \
+            + jnp.arange(t_len, dtype=jnp.int32)[None, :]        # (B, T)
+        valid = jnp.arange(s_len, dtype=jnp.int32)[None, None, :] \
+            <= pos[:, :, None]                                   # (B, T, S)
+        s = jnp.sum(q[:, :, :, None, :] * k[:, :, None, :, :],
+                    axis=-1) * sc                                # (B, H, T, S)
+        s = jnp.where(valid[:, None], s, -jnp.inf)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        p = e / jnp.sum(e, axis=-1, keepdims=True)
+        return jnp.sum(p[:, :, :, :, None] * v[:, :, None, :, :], axis=-2)
+
+    return _apply(f, (query, key, value, start_pos), name="cached_attention")
+
+
+def rope_positions(cos_table, sin_table, start_pos, length):
+    """Gather per-row RoPE rows for positions ``start_pos[b] + [0..length)``
+    from (S, D/2) tables; returns a ``(cos, sin)`` pair shaped
+    (B, 1, length, D/2) — broadcastable over the head axis."""
+
+    def f(ct, st, sp):
+        jnp = _jnp()
+        pos = sp.astype(jnp.int32)[:, None] \
+            + jnp.arange(length, dtype=jnp.int32)[None, :]       # (B, T)
+        return jnp.take(ct, pos, axis=0)[:, None], \
+            jnp.take(st, pos, axis=0)[:, None]
+
+    return _apply(f, (cos_table, sin_table, start_pos),
+                  name="rope_positions")
+
+
+def stable_dense(data, weight, bias=None):
+    """Shape-stable fully-connected: ``data`` (..., U) x ``weight`` (O, U)
+    -> (..., O), reducing over the last axis with the same mul+reduce
+    formulation as ``cached_attention``.
+
+    XLA CPU's gemm/gemv dispatch accumulates in an M-dependent order once
+    the intra-op thread pool partitions the work (measured 1e-5 drift
+    between the T=1 and T=64 rows of the SAME projection under the test
+    mesh), so a ``dot``-based projection breaks the decode-vs-prefill
+    bitwise contract. Here every output element is one sequential chain
+    over U regardless of the leading shape. Serving-path only: training
+    keeps ``fully_connected``'s gemm (MXU/BLAS) throughput.
+    """
+
+    def f(x, w, *b):
+        jnp = _jnp()
+        out = jnp.sum(x[..., None, :] * w, axis=-1)
+        return out + b[0] if b else out
+
+    args = (data, weight) if bias is None else (data, weight, bias)
+    return _apply(f, args, name="stable_dense")
+
+
+def fusion_fence(data):
+    """Identity that pins ``data`` as an XLA fusion boundary
+    (``optimization_barrier``). The serving decode path threads one
+    between decoder layers: without it XLA fuses reductions across layer
+    boundaries differently for the T=1 and T=bucket executables (measured
+    ~4 ulp logits drift on the 12-layer config), which would break the
+    decode-vs-prefill bitwise contract the shape-stable ops above
+    establish per layer."""
+
+    def f(x):
+        import jax
+
+        return jax.lax.optimization_barrier(x)
+
+    return _apply(f, (data,), name="fusion_fence")
+
+
+def gather_positions(data, indices):
+    """Per-row gather along axis 1: ``data`` (B, T, ...) at ``indices``
+    (B,) -> (B, ...). Serving uses it to pick each request's last-real-
+    position logits out of a padded prefill block."""
+
+    def f(x, i):
+        jnp = _jnp()
+        idx = i.astype(jnp.int32).reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.take_along_axis(x, idx, axis=1)[:, 0]
+
+    return _apply(f, (data, indices), name="gather_positions")
+
+
+# ---------------------------------------------------------------------------
 # misc framework extras
 # ---------------------------------------------------------------------------
 

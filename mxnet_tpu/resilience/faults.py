@@ -85,6 +85,9 @@ KNOWN_SITES = (
     "estimator:batch",      # ResilientCheckpointHandler.batch_end
     "trainer:grad",         # gluon.Trainer.step, before allreduce/update
                             # (the only site implementing the 'nan' kind)
+    "serve:execute",        # serve.engine.InferenceSession.run, inside
+                            # the watchdog window (a 'delay' fault models
+                            # a hung execution and must trip the timeout)
 )
 
 

@@ -1,0 +1,37 @@
+"""Inference serving subsystem: dynamic batching, bucketed AOT
+executables, KV-cache decode.
+
+The serving split the reference ecosystem made with mxnet-model-server
+(a serving layer over ``Module.predict``), rebuilt TPU-first over this
+framework's own substrate:
+
+* :class:`InferenceSession` (``engine``) — pads requests onto a small
+  (batch, seq) bucket lattice compiled through ``CachedOpThreadSafe``,
+  so steady-state serving never recompiles; guarded by the resilience
+  circuit breaker, execution watchdog, and fault sites.
+* :class:`DynamicBatcher` (``batcher``) — admission-controlled request
+  queue: flush on max-batch-size or deadline, O(1) fast-reject (503)
+  when full, per-request failure isolation.
+* :class:`Generator` / :class:`KVCache` (``generate``) — autoregressive
+  decode for the llama-family models with preallocated per-layer KV
+  rings; per-token logits bitwise-match a full re-prefill.
+* :class:`ServeMetrics` (``metrics``) — p50/p95/p99 latency, queue
+  depth, batch occupancy, tokens/s; emitted as ``serve::*`` events on
+  the profiler bus.
+
+See SERVING.md for architecture, bucket policy, and the env knobs
+(``MXNET_SERVE_*``).
+"""
+from __future__ import annotations
+
+from .batcher import DynamicBatcher
+from .engine import InferenceSession, ServeError, ServiceUnavailable, \
+    pick_bucket
+from .generate import Generator, KVCache, sample_tokens
+from .metrics import ServeMetrics, percentile
+
+__all__ = [
+    "InferenceSession", "DynamicBatcher", "Generator", "KVCache",
+    "ServeMetrics", "ServeError", "ServiceUnavailable", "sample_tokens",
+    "pick_bucket", "percentile",
+]

@@ -1,0 +1,205 @@
+"""`DynamicBatcher`: request admission + deadline-bounded batch assembly.
+
+Single requests enter through :meth:`DynamicBatcher.submit` and come back
+as futures; a background flusher thread assembles batches and hands them
+to a ``runner`` callable. A batch dispatches when either trigger fires:
+
+* **flush-on-full** — ``max_batch_size`` requests are waiting, or
+* **flush-on-deadline** — the *oldest* admitted request has waited
+  ``MXNET_SERVE_BATCH_TIMEOUT_MS``; latecomers never extend the deadline
+  (no unbounded batch-coalescing tail latency).
+
+Admission control is a hard queue-depth cap (``MXNET_SERVE_MAX_QUEUE``):
+beyond it :meth:`submit` fast-rejects with
+:class:`~mxnet_tpu.serve.engine.ServiceUnavailable` *synchronously* — the
+overloaded server sheds load in O(1) instead of growing a backlog whose
+every entry will miss its SLO anyway.
+
+Failure isolation: a runner exception fails the *requests of that batch*
+(each future carries the error) and the flusher thread keeps serving —
+an injected ``op:dispatch`` fault is a per-request 5xx, not a dead server.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+from .engine import ServeError, ServiceUnavailable
+from .metrics import ServeMetrics
+
+
+class _Pending:
+    __slots__ = ("payload", "future", "t_enq", "t_dispatch")
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.future = Future()
+        self.t_enq = time.monotonic()
+        self.t_dispatch = None
+
+
+class DynamicBatcher:
+    """Deadline/size-triggered dynamic batching queue.
+
+    Parameters
+    ----------
+    runner : callable(list) -> list
+        Executes one assembled batch of payloads; must return one result
+        per payload (an :class:`InferenceSession`-backed closure in the
+        serving stack, but any callable works).
+    max_batch_size, timeout_ms, max_queue : optional overrides of the
+        ``MXNET_SERVE_*`` config flags.
+    """
+
+    def __init__(self, runner, max_batch_size=None, timeout_ms=None,
+                 max_queue=None, name="batcher", metrics=None, start=True):
+        from .. import config
+
+        self.runner = runner
+        self.max_batch_size = int(max_batch_size if max_batch_size is not None
+                                  else config.get("MXNET_SERVE_MAX_BATCH"))
+        if self.max_batch_size < 1:
+            raise ServeError(
+                f"max_batch_size must be >= 1, got {self.max_batch_size}")
+        self.timeout_s = (timeout_ms if timeout_ms is not None
+                          else config.get("MXNET_SERVE_BATCH_TIMEOUT_MS")) / 1e3
+        self.max_queue = int(max_queue if max_queue is not None
+                             else config.get("MXNET_SERVE_MAX_QUEUE"))
+        if self.max_queue < 0:
+            raise ServeError(
+                f"max_queue must be >= 0, got {self.max_queue}")
+        self.name = name
+        self.metrics = metrics or ServeMetrics(name)
+        self._queue = []               # FIFO of _Pending (guarded by _cond)
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = None
+        if start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True,
+            name=f"mxtpu-serve-batcher[{self.name}]")
+        self._thread.start()
+
+    def close(self, timeout=5.0):
+        """Stop the flusher. Already-admitted requests are drained first;
+        anything still queued after the drain fails with 503."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        with self._cond:
+            leftovers, self._queue = self._queue, []
+        for p in leftovers:
+            p.future.set_exception(ServiceUnavailable(
+                f"batcher {self.name!r} shut down before dispatch"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, payload):
+        """Admit one request; returns a :class:`concurrent.futures.Future`.
+        Raises :class:`ServiceUnavailable` synchronously when the queue is
+        at ``max_queue`` (admission control) or the batcher is closed."""
+        with self._cond:
+            if self._closed:
+                raise ServiceUnavailable(
+                    f"batcher {self.name!r} is shut down")
+            if len(self._queue) >= self.max_queue:
+                self.metrics.observe_reject()
+                raise ServiceUnavailable(
+                    f"batcher {self.name!r} queue is full "
+                    f"({self.max_queue} waiting); shed load upstream")
+            p = _Pending(payload)
+            self._queue.append(p)
+            self.metrics.set_queue_depth(len(self._queue))
+            self._cond.notify()
+        return p.future
+
+    def queue_depth(self):
+        with self._cond:
+            return len(self._queue)
+
+    # -- flusher ------------------------------------------------------------
+    def _take_batch(self):
+        """Block until a batch is due; returns a list of _Pending (empty
+        on shutdown). Flush triggers: size >= max_batch_size, or oldest
+        entry older than timeout_s."""
+        with self._cond:
+            while True:
+                if self._queue:
+                    if len(self._queue) >= self.max_batch_size:
+                        batch = self._queue[:self.max_batch_size]
+                        del self._queue[:self.max_batch_size]
+                        self.metrics.set_queue_depth(len(self._queue))
+                        return batch
+                    age = time.monotonic() - self._queue[0].t_enq
+                    remaining = self.timeout_s - age
+                    if remaining <= 0 or self._closed:
+                        # deadline hit — or shutting down: drain what's
+                        # queued NOW instead of sitting out the deadline
+                        batch, self._queue = self._queue, []
+                        self.metrics.set_queue_depth(0)
+                        return batch
+                    self._cond.wait(remaining)
+                elif self._closed:
+                    return []
+                else:
+                    self._cond.wait(0.5)
+
+    def _flush_loop(self):
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                if self._closed:
+                    return
+                continue
+            now = time.monotonic()
+            for p in batch:
+                p.t_dispatch = now
+            self.metrics.observe_batch(len(batch), self.max_batch_size)
+            try:
+                results = self.runner([p.payload for p in batch])
+                if len(results) != len(batch):
+                    raise ServiceUnavailable(
+                        f"batcher runner returned {len(results)} results "
+                        f"for a {len(batch)}-request batch")
+            except Exception as exc:  # pylint: disable=broad-except
+                # (BaseException — e.g. an injected SimulatedWorkerDeath —
+                # still kills the flusher: worker-death semantics belong
+                # to the resilience harness, not per-request errors.)
+                # the batch fails, the SERVER does not: every affected
+                # request gets the error on its future and the loop
+                # continues (the test for an injected op:dispatch fault)
+                self._settle(batch, error=exc)
+                continue
+            self._settle(batch, results=results)
+
+    def _settle(self, batch, results=None, error=None):
+        done = time.monotonic()
+        for i, p in enumerate(batch):
+            queue_ms = (p.t_dispatch - p.t_enq) * 1e3
+            exec_ms = (done - p.t_dispatch) * 1e3
+            self.metrics.observe_request(queue_ms, exec_ms,
+                                         ok=error is None)
+            if error is None:
+                p.future.set_result(results[i])
+            else:
+                p.future.set_exception(error)
+
+    def stats(self):
+        out = self.metrics.snapshot()
+        out["queue_depth"] = self.queue_depth()
+        return out

@@ -1,0 +1,306 @@
+"""`InferenceSession`: bucketed AOT-compiled serving executor.
+
+The serving analog of mxnet-model-server's worker atop ``Module.predict``:
+a :class:`~mxnet_tpu.cachedop.CachedOpThreadSafe` wraps the block, and the
+session *pads every call onto a small lattice of (batch, seq) buckets* so
+steady-state traffic only ever replays already-compiled executables — the
+recompile storm that per-request shapes would cause is structurally
+impossible, and ``assert_no_recompiles`` turns that into a testable
+invariant via ``cachedop.signature_count()``.
+
+Resilience wiring (all existing subsystems, reused):
+
+* cold-bucket compiles go through ``resilience.retry.call_with_retry``
+  (the CachedOp build path) — a transient XLA compile failure backs off
+  and retries instead of failing the request;
+* a :class:`~mxnet_tpu.resilience.retry.CircuitBreaker` guards the
+  session: repeated execution failures trip it open and requests
+  fast-reject with a 503-style :class:`ServiceUnavailable` until a
+  half-open probe heals it;
+* ``MXNET_SERVE_TIMEOUT_MS`` bounds each execution with the resilience
+  watchdog — a hung executable becomes a fast 503 instead of wedging the
+  serving thread;
+* the ``serve:execute`` fault site lets the fault-injection harness fail
+  individual executions deterministically.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..cachedop import CachedOpThreadSafe
+from ..profiler import core as _prof
+from ..resilience import faults as _faults
+from ..resilience.retry import CircuitBreaker, CollectiveTimeoutError, \
+    run_with_watchdog
+from .metrics import ServeMetrics
+
+
+class ServeError(MXNetError):
+    """Base class for serving-path errors; carries an HTTP-style status."""
+
+    status = 500
+
+
+class ServiceUnavailable(ServeError):
+    """Fast-reject: queue full, breaker open, or execution timed out (503)."""
+
+    status = 503
+
+
+def _deterministic_compiler_options():
+    """XLA overrides for serving executables. On the CPU backend the
+    default thunk runtime partitions fused loops differently per graph
+    shape — even the shape-stable mul+reduce ops (``ops.nn.stable_dense``,
+    ``cached_attention``) drift a few ulps between the T=1 and T=bucket
+    executables under it; pin the legacy runtime, whose codegen is
+    shape-stable for those formulations (both pieces are needed: with
+    gemm-based Dense the legacy runtime drifts too). Other backends
+    compile with their defaults."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return {"xla_cpu_use_thunk_runtime": False}
+    return None
+
+
+def pick_bucket(n, buckets):
+    """Smallest bucket >= n; raises :class:`ServeError` when n overflows
+    the largest bucket (the request can never be served — reject it
+    loudly rather than silently truncating)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ServeError(
+        f"request size {n} exceeds the largest configured bucket "
+        f"{buckets[-1]}; raise the session's bucket lattice or shard the "
+        "request")
+
+
+class InferenceSession:
+    """Bucketed, breaker-guarded, AOT-compiled executor for one block.
+
+    Parameters
+    ----------
+    block : HybridBlock
+        The model (parameters must be initialized).
+    batch_buckets : sequence of int
+        Ascending batch-size lattice; every call's leading axis pads up to
+        one of these.
+    seq_buckets : sequence of int, optional
+        Ascending sequence-length lattice for axis 1 of 2-D+ inputs
+        (token arrays). ``None`` disables seq padding.
+    pad_value : scalar
+        Fill for padded sequence positions (token id 0 by default).
+    """
+
+    def __init__(self, block, batch_buckets=(1, 2, 4, 8), seq_buckets=None,
+                 pad_value=0, name=None):
+        from .. import config
+
+        self.block = block
+        self.batch_buckets = tuple(sorted(int(b) for b in batch_buckets))
+        self.seq_buckets = (tuple(sorted(int(s) for s in seq_buckets))
+                            if seq_buckets else None)
+        self.pad_value = pad_value
+        self.name = name or type(block).__name__
+        self._op = CachedOpThreadSafe(
+            block, compiler_options=_deterministic_compiler_options())
+        self.metrics = ServeMetrics(self.name)
+        self.breaker = CircuitBreaker(
+            failure_threshold=config.get("MXNET_SERVE_BREAKER_THRESHOLD"),
+            cooldown_calls=config.get("MXNET_SERVE_BREAKER_COOLDOWN"),
+            name=f"serve:{self.name}")
+        self._warm_signatures = None
+        self._shapes_ready = False
+        self._lock = threading.Lock()
+
+    # -- raw protected execution -------------------------------------------
+    def _timeout_s(self):
+        from .. import config
+
+        return config.get("MXNET_SERVE_TIMEOUT_MS") / 1e3
+
+    def run(self, *args):
+        """Execute one already-bucketed call under the full protection
+        stack (breaker -> fault site -> watchdog -> cachedop). Raises
+        :class:`ServiceUnavailable` on breaker-open or timeout; any other
+        failure propagates unchanged (the batcher maps it onto the
+        requests of the affected batch)."""
+        from .. import autograd
+
+        if not self._shapes_ready:
+            # complete any deferred (shape-inferred) parameter init with
+            # one eager pass — CachedOp keys on param shapes, which don't
+            # exist yet for in_units=0 Dense until a first forward
+            with self._lock:
+                if not self._shapes_ready:
+                    params = self.block.collect_params().values()
+                    if any(getattr(p, "_deferred_init", None) is not None
+                           and p._data is None for p in params):
+                        with autograd.predict_mode():
+                            self.block(*args)
+                    self._shapes_ready = True
+        if not self.breaker.allow():
+            self.metrics.observe_reject()
+            raise ServiceUnavailable(
+                f"serve session {self.name!r}: circuit breaker is "
+                f"{self.breaker.state} after repeated execution failures; "
+                "retry after cooldown")
+        self._op.begin_serve_call()
+        t0 = time.perf_counter()
+        try:
+            def body():
+                # fault site INSIDE the watchdog window: an injected
+                # delay models a hung execution and must trip the timeout
+                _faults.fault_point("serve:execute", {"session": self.name})
+                with autograd.predict_mode():
+                    return self._op(*args)
+
+            out = run_with_watchdog(body, self._timeout_s(),
+                                    site=f"serve:{self.name}")
+        except CollectiveTimeoutError as exc:
+            self.breaker.record_failure()
+            raise ServiceUnavailable(
+                f"serve session {self.name!r}: execution exceeded "
+                f"MXNET_SERVE_TIMEOUT_MS ({exc})") from exc
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        exec_ms = (time.perf_counter() - t0) * 1e3
+        if self._op.call_was_warm():
+            # warm-path call: every signature it touched was already
+            # compiled — the steady-state serving invariant. Tracked
+            # per-thread, so a concurrent thread's cold compile can't
+            # misattribute this call
+            self._op.record_serve_hit()
+        if _prof.ENABLED:
+            _prof.record_instant(f"serve::execute({self.name})", "serve",
+                                 args={"exec_ms": round(exec_ms, 3)})
+        return out
+
+    # -- bucketed predict ---------------------------------------------------
+    def _pad_input(self, data):
+        """Pad a host array onto the bucket lattice. Returns
+        (padded_ndarray, real_batch, real_seq)."""
+        from .. import numpy as mnp
+        from ..ndarray.ndarray import NDArray
+
+        if isinstance(data, NDArray):
+            data = data.asnumpy()
+        data = _onp.asarray(data)
+        b = data.shape[0]
+        bb = pick_bucket(b, self.batch_buckets)
+        padded = data
+        if bb > b:
+            # batch rows pad by edge-repeat (a real row: no NaN/denormal
+            # surprises in the dead lanes)
+            padded = _onp.pad(padded,
+                              [(0, bb - b)] + [(0, 0)] * (data.ndim - 1),
+                              mode="edge")
+        t = None
+        if self.seq_buckets is not None and data.ndim > 1:
+            t = data.shape[1]
+            st = pick_bucket(t, self.seq_buckets)
+            if st > t:  # seq positions pad with pad_value
+                seq_w = [(0, 0), (0, st - t)] + [(0, 0)] * (data.ndim - 2)
+                padded = _onp.pad(padded, seq_w, mode="constant",
+                                  constant_values=self.pad_value)
+        return mnp.array(padded), b, t
+
+    def predict(self, data):
+        """Serve one request batch: pad onto the bucket lattice, execute,
+        slice the outputs back to the real request shape — the batch
+        axis always, and the seq axis of any output that preserved the
+        padded seq extent (positions past the real length are pad-token
+        artifacts, not model output)."""
+        padded, b, t = self._pad_input(data)
+        st = padded.shape[1] if padded.ndim > 1 else None
+        out = self.run(padded)
+
+        def unpad(o):
+            o = o[:b]
+            if t is not None and t != st and o.ndim >= 2 \
+                    and o.shape[1] == st:
+                o = o[:, :t]
+            return o
+
+        if isinstance(out, (tuple, list)):
+            return type(out)(unpad(o) for o in out)
+        return unpad(out)
+
+    def __call__(self, data):
+        return self.predict(data)
+
+    # -- warmup & recompile accounting --------------------------------------
+    def warmup(self, example):
+        """Compile every (batch, seq) bucket combination from one example
+        input (an array shaped like a single request batch). After this,
+        any request within the lattice executes with zero compiles."""
+        from ..ndarray.ndarray import NDArray
+
+        if isinstance(example, NDArray):
+            example = example.asnumpy()
+        example = _onp.asarray(example)
+        row = example[:1]
+        t0 = time.perf_counter()
+        for bb in self.batch_buckets:
+            tiled = _onp.repeat(row, bb, axis=0)
+            if self.seq_buckets is not None and example.ndim > 1:
+                for st in self.seq_buckets:
+                    self.predict(_resize_seq(tiled, st, self.pad_value))
+            else:
+                self.predict(tiled)
+        self.freeze_signatures()
+        if _prof.ENABLED:
+            _prof.record_instant(
+                f"serve::warmup({self.name})", "serve",
+                args={"signatures": self._op.signature_count(),
+                      "wall_s": round(time.perf_counter() - t0, 3)})
+        return self._op.signature_count()
+
+    def freeze_signatures(self):
+        """Mark the current signature set as the warm set for
+        :meth:`assert_no_recompiles`."""
+        self._warm_signatures = self._op.signature_count()
+
+    def assert_no_recompiles(self):
+        """Raise :class:`ServeError` if any compile happened since
+        :meth:`freeze_signatures` / :meth:`warmup` — the steady-state
+        serving invariant, checked from ``cachedop.signature_count()``."""
+        if self._warm_signatures is None:
+            raise ServeError("assert_no_recompiles called before warmup()")
+        now = self._op.signature_count()
+        if now != self._warm_signatures:
+            raise ServeError(
+                f"serve session {self.name!r} recompiled after warmup: "
+                f"{self._warm_signatures} -> {now} signatures "
+                f"(bucket keys: {self._op.bucket_keys()!r})")
+
+    def signature_count(self):
+        return self._op.signature_count()
+
+    def cache_stats(self):
+        return self._op.cache_stats()
+
+    def stats(self):
+        """Combined serving snapshot: metrics + executable cache + breaker."""
+        out = self.metrics.snapshot()
+        out["cache"] = self.cache_stats()
+        out["breaker"] = self.breaker.snapshot()
+        return out
+
+
+def _resize_seq(arr, seq, pad_value):
+    """Pad or slice axis 1 of a host array to exactly ``seq``."""
+    t = arr.shape[1]
+    if t == seq:
+        return arr
+    if t > seq:
+        return arr[:, :seq]
+    w = [(0, 0), (0, seq - t)] + [(0, 0)] * (arr.ndim - 2)
+    return _onp.pad(arr, w, mode="constant", constant_values=pad_value)

@@ -1,0 +1,353 @@
+"""Autoregressive decode with a real KV cache.
+
+Without this module, generating token ``t`` re-runs the full prefill over
+``t`` positions — O(n^2) work per sequence. :class:`KVCache` preallocates
+per-layer K/V rings to ``max_seq`` and the decode step feeds exactly one
+new token through the model (``cache=`` / ``start_pos=`` path in
+``models/llama.py``), so each generated token costs one T=1 executable
+replay.
+
+Parity contract (asserted per-token in ``tests/test_serve.py``): the
+decode path's logits are **bitwise identical** to re-running the full
+prefill through the same cache-mode path. Both arms compile through the
+shape-stable serving ops in ``ops/nn.py`` (see the section comment there)
+— the KV cache is a pure work-skipping transform, not an approximation.
+
+Shapes are bucketed the serving way: one decode executable per batch
+bucket (T=1 is constant), one prefill executable per (batch, prompt)
+bucket; after :meth:`Generator.warmup` a decode stream of any admitted
+shape triggers zero XLA compiles.
+
+Sampling (``greedy``, temperature, top-k) draws its keys from
+``mxnet_tpu.random`` — seeded, reproducible streams, same as training.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as _onp
+
+from .. import random as _rng
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..ops import nn as _ops
+from .engine import InferenceSession, pick_bucket
+
+
+class _LayerKV:
+    """One layer's view of the cache: read k/v, write back the updated
+    rings (functional update — inside a trace these are tracers)."""
+
+    __slots__ = ("_cache", "_idx")
+
+    def __init__(self, cache, idx):
+        self._cache = cache
+        self._idx = idx
+
+    @property
+    def k(self):
+        return self._cache._k[self._idx]
+
+    @property
+    def v(self):
+        return self._cache._v[self._idx]
+
+    @property
+    def max_seq(self):
+        return self._cache.max_seq
+
+    def update(self, new_k, new_v):
+        self._cache._k[self._idx] = new_k
+        self._cache._v[self._idx] = new_v
+
+
+class KVCache:
+    """Preallocated per-layer K/V rings for autoregressive decode.
+
+    Layout: ``num_layers`` pairs of (batch, kv_heads, max_seq, head_dim)
+    NDArrays, zero-initialized. Position accounting lives with the caller
+    (per-row ``start_pos`` vectors) — the cache itself is pure storage, so
+    one compiled executable serves every decode step.
+    """
+
+    def __init__(self, keys, values, max_seq):
+        if len(keys) != len(values):
+            raise MXNetError("KVCache needs one value ring per key ring")
+        self._k = list(keys)
+        self._v = list(values)
+        self.max_seq = int(max_seq)
+
+    @classmethod
+    def alloc(cls, model, batch, max_seq, dtype="float32"):
+        """Zeroed rings sized from the model's attention geometry."""
+        from .. import numpy as mnp
+
+        keys, values = [], []
+        for blk in model._blocks:
+            attn = blk.attention
+            shape = (int(batch), attn._kv_heads, int(max_seq),
+                     attn._head_dim)
+            keys.append(mnp.zeros(shape, dtype=dtype))
+            values.append(mnp.zeros(shape, dtype=dtype))
+        return cls(keys, values, max_seq)
+
+    @property
+    def num_layers(self):
+        return len(self._k)
+
+    @property
+    def batch(self):
+        return self._k[0].shape[0]
+
+    def layer(self, i) -> _LayerKV:
+        return _LayerKV(self, i)
+
+    def flat(self):
+        """Interleaved [k0, v0, k1, v1, ...] — the executable's calling
+        convention for cache state."""
+        out = []
+        for k, v in zip(self._k, self._v):
+            out.extend((k, v))
+        return out
+
+    @classmethod
+    def from_flat(cls, arrays, max_seq):
+        arrays = list(arrays)
+        if len(arrays) % 2:
+            raise MXNetError("flat KVCache needs an even array count")
+        return cls(arrays[0::2], arrays[1::2], max_seq)
+
+    def nbytes(self):
+        return sum(int(_onp.prod(a.shape)) * _onp.dtype(a.dtype).itemsize
+                   for a in self._k + self._v)
+
+
+class _CacheForward(HybridBlock):
+    """The compiled serving step: (tokens, start_pos, last_idx, *rings) ->
+    (last-position logits, *updated rings).
+
+    One forward serves both phases — prefill (T = prompt bucket,
+    start_pos = 0, last_idx = prompt_len - 1) and decode (T = 1,
+    start_pos = per-row position, last_idx = 0). The phases differ only
+    by shape, i.e. by CachedOp signature, never by code path: that shared
+    path is what makes the bitwise decode-vs-prefill parity hold.
+    """
+
+    def __init__(self, model, max_seq, **kwargs):
+        super().__init__(**kwargs)
+        self.model = model  # child registration shares the params
+        self._max_seq = int(max_seq)
+
+    def forward(self, tokens, start_pos, last_idx, *flat_cache):
+        cache = KVCache.from_flat(flat_cache, self._max_seq)
+        logits = self.model(tokens, cache=cache, start_pos=start_pos)
+        last = _ops.gather_positions(logits, last_idx)
+        return (last,) + tuple(cache.flat())
+
+
+def sample_tokens(logits, temperature=0.0, top_k=None):
+    """Next-token choice from (B, vocab) logits.
+
+    ``temperature <= 0`` is greedy argmax; otherwise softmax sampling at
+    the given temperature, optionally truncated to the ``top_k`` largest
+    logits. Randomness comes from ``mxnet_tpu.random``'s key stream, so
+    ``mx.random.seed(n)`` reproduces a generation exactly.
+    Returns a host numpy (B,) int32 array.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ndarray.ndarray import NDArray
+
+    data = logits._data if isinstance(logits, NDArray) else jnp.asarray(logits)
+    if temperature is None or temperature <= 0.0:
+        return _onp.asarray(jnp.argmax(data, axis=-1)).astype(_onp.int32)
+    scaled = data / float(temperature)
+    if top_k is not None and 0 < int(top_k) < scaled.shape[-1]:
+        kth = jax.lax.top_k(scaled, int(top_k))[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    key = _rng.next_key()
+    return _onp.asarray(
+        jax.random.categorical(key, scaled, axis=-1)).astype(_onp.int32)
+
+
+class Generator:
+    """Bucketed KV-cache generation server for decoder LMs.
+
+    Wraps the model into a :class:`_CacheForward` step compiled through an
+    :class:`InferenceSession` (breaker, watchdog, fault site, serve-hit
+    accounting all apply to every prefill and every decode step).
+
+    Parameters
+    ----------
+    model : LlamaModel (or any block with ``_blocks[i].attention`` KV
+        geometry and a ``cache=``/``start_pos=`` forward).
+    max_seq : ring length — prompt + generated tokens must fit.
+    batch_buckets / prompt_buckets : the compiled shape lattice.
+    """
+
+    def __init__(self, model, max_seq=128, batch_buckets=(1, 2, 4),
+                 prompt_buckets=None, pad_id=0, name="llama_decode"):
+        self.model = model
+        self.max_seq = int(max_seq)
+        self.batch_buckets = tuple(sorted(int(b) for b in batch_buckets))
+        if prompt_buckets is None:
+            prompt_buckets, p = [], 16
+            while p < self.max_seq:
+                prompt_buckets.append(p)
+                p *= 2
+            prompt_buckets.append(self.max_seq)
+        self.prompt_buckets = tuple(sorted(set(int(p)
+                                               for p in prompt_buckets)))
+        if self.prompt_buckets[-1] > self.max_seq:
+            raise MXNetError("prompt bucket exceeds max_seq")
+        self.pad_id = int(pad_id)
+        self._step = _CacheForward(model, self.max_seq)
+        # bucketing is done here (cache shapes are part of the lattice);
+        # the session provides the protected raw-run path
+        self.session = InferenceSession(
+            self._step, batch_buckets=self.batch_buckets,
+            seq_buckets=self.prompt_buckets, pad_value=self.pad_id,
+            name=name)
+        self.metrics = self.session.metrics
+        self._zero_caches = {}  # batch bucket -> shared zeroed rings
+
+    def _fresh_cache(self, batch_bucket):
+        """Zeroed rings for one batch bucket, allocated once and shared
+        by every request: device arrays are immutable and prefill/decode
+        return functionally-updated rings without touching their input
+        cache, so reuse is safe — and the serving hot path skips
+        2 x num_layers allocations + zero-fills per request."""
+        cache = self._zero_caches.get(batch_bucket)
+        if cache is None:
+            cache = self._zero_caches.setdefault(
+                batch_bucket,
+                KVCache.alloc(self.model, batch_bucket, self.max_seq))
+        return cache
+
+    # -- phase helpers (also the parity-test surface) -----------------------
+    def _run(self, tokens, start_pos, last_idx, cache):
+        from .. import numpy as mnp
+
+        out = self.session.run(
+            mnp.array(_onp.asarray(tokens, _onp.int32)),
+            mnp.array(_onp.asarray(start_pos, _onp.int32)),
+            mnp.array(_onp.asarray(last_idx, _onp.int32)),
+            *cache.flat())
+        logits, flat = out[0], out[1:]
+        return logits, KVCache.from_flat(flat, self.max_seq)
+
+    def prefill(self, prompts, prompt_lens, cache):
+        """Run the prompt block through the cache path. ``prompts`` is a
+        host (B, T_bucket) int array (already padded), ``prompt_lens`` the
+        (B,) real lengths. Returns ((B, vocab) last-real-position logits,
+        updated cache)."""
+        b = len(prompt_lens)
+        zeros = _onp.zeros(b, _onp.int32)
+        last = _onp.asarray(prompt_lens, _onp.int32) - 1
+        return self._run(prompts, zeros, last, cache)
+
+    def decode_step(self, tokens, positions, cache):
+        """One T=1 decode step: ``tokens`` (B,) the just-sampled ids,
+        ``positions`` (B,) their absolute positions. Returns the next
+        (B, vocab) logits and the updated cache."""
+        toks = _onp.asarray(tokens, _onp.int32).reshape(-1, 1)
+        zeros = _onp.zeros(len(toks), _onp.int32)
+        return self._run(toks, _onp.asarray(positions, _onp.int32),
+                         zeros, cache)
+
+    # -- the serving API ----------------------------------------------------
+    def _pad_prompts(self, prompts):
+        lens = _onp.asarray([len(p) for p in prompts], _onp.int32)
+        if int(lens.min()) < 1:
+            raise MXNetError("empty prompt (need >= 1 token)")
+        t_bucket = pick_bucket(int(lens.max()), self.prompt_buckets)
+        b_bucket = pick_bucket(len(prompts), self.batch_buckets)
+        toks = _onp.full((b_bucket, t_bucket), self.pad_id, _onp.int32)
+        for i, p in enumerate(prompts):
+            toks[i, :len(p)] = p
+        full_lens = _onp.ones(b_bucket, _onp.int32)
+        full_lens[:len(prompts)] = lens
+        # dead batch lanes replay prompt 0's first token at length 1
+        toks[len(prompts):, 0] = toks[0, 0]
+        return toks, full_lens, b_bucket
+
+    def generate(self, prompts, max_new_tokens=32, temperature=0.0,
+                 top_k=None, stop_ids=()):
+        """Generate continuations for a batch of prompts (lists of ids).
+
+        Returns ``(outputs, info)``: per-prompt generated id lists (stop
+        token excluded) and a stats dict (tokens/s, per-phase wall time).
+        """
+        t_start = time.perf_counter()
+        toks, lens, b_bucket = self._pad_prompts(prompts)
+        n_real = len(prompts)
+        max_new = int(max_new_tokens)
+        if int(lens.max()) + max_new > self.max_seq:
+            raise MXNetError(
+                f"prompt ({int(lens.max())}) + max_new_tokens ({max_new}) "
+                f"exceeds max_seq ({self.max_seq})")
+        cache = self._fresh_cache(b_bucket)
+        logits, cache = self.prefill(toks, lens, cache)
+        t_prefill = time.perf_counter()
+
+        out = [[] for _ in range(n_real)]
+        stopped = [False] * n_real
+        positions = lens.copy()  # next write position per row
+        stop = set(int(s) for s in stop_ids)
+        n_decoded = 0
+        for step in range(max_new):
+            next_ids = sample_tokens(logits, temperature=temperature,
+                                     top_k=top_k)
+            for i in range(n_real):
+                if stopped[i]:
+                    continue
+                tid = int(next_ids[i])
+                if tid in stop:
+                    stopped[i] = True
+                else:
+                    out[i].append(tid)
+            if all(stopped) or step == max_new - 1:
+                # the last sampled token needs no successor logits —
+                # running decode_step here would be a discarded T=1 pass
+                break
+            logits, cache = self.decode_step(next_ids, positions, cache)
+            positions = positions + 1
+            n_decoded += 1
+        t_done = time.perf_counter()
+        decode_s = t_done - t_prefill
+        n_tokens = sum(len(o) for o in out)
+        self.metrics.observe_tokens(n_tokens, decode_s)
+        info = {
+            "prefill_ms": (t_prefill - t_start) * 1e3,
+            "decode_ms": decode_s * 1e3,
+            "decode_steps": n_decoded,
+            "tokens_s": n_tokens / decode_s if decode_s > 0 else 0.0,
+            "total_ms": (t_done - t_start) * 1e3,
+        }
+        return out, info
+
+    # -- warmup / invariants -------------------------------------------------
+    def warmup(self):
+        """Compile every (batch bucket x prompt bucket) prefill and every
+        batch bucket's decode step; freezes the signature set so
+        ``assert_no_recompiles`` guards steady state."""
+        t0 = time.perf_counter()
+        for bb in self.batch_buckets:
+            for pb in self.prompt_buckets:
+                cache = self._fresh_cache(bb)
+                toks = _onp.zeros((bb, pb), _onp.int32)
+                lens = _onp.ones(bb, _onp.int32)
+                logits, cache = self.prefill(toks, lens, cache)
+                if pb == self.prompt_buckets[0]:
+                    ids = _onp.zeros(bb, _onp.int32)
+                    self.decode_step(ids, lens, cache)
+        self.session.freeze_signatures()
+        return {"signatures": self.session.signature_count(),
+                "wall_s": time.perf_counter() - t0}
+
+    def assert_no_recompiles(self):
+        self.session.assert_no_recompiles()
+
+    def stats(self):
+        return self.session.stats()

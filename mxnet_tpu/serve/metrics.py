@@ -1,0 +1,141 @@
+"""Serving SLO metrics: latency percentiles, queue depth, batch occupancy,
+tokens/s.
+
+One :class:`ServeMetrics` instance rides along with each serving component
+(session, batcher, generator — they can share one). Observations land in
+bounded rings (``MXNET_SERVE_METRICS_WINDOW`` samples) so a long-lived
+server's snapshot cost stays flat, and every observation also emits a
+``serve::*`` event through the profiler bus (``mxnet_tpu.profiler``) when
+it is recording — the same chrome-trace/aggregate pipeline the training
+stack uses, so a serve trace and a train trace read the same way.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+from ..profiler import core as _prof
+
+
+def percentile(samples, pct):
+    """Nearest-rank percentile of an unsorted sequence (0 < pct <= 100).
+    Returns 0.0 on no samples — a dashboard-friendly zero, not a crash."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    rank = max(0, min(len(s) - 1, int(round(pct / 100.0 * len(s))) - 1))
+    return s[rank]
+
+
+class ServeMetrics:
+    """Thread-safe serving telemetry accumulator."""
+
+    def __init__(self, name="serve", window=None):
+        if window is None:
+            from .. import config
+
+            window = config.get("MXNET_SERVE_METRICS_WINDOW")
+        self.name = name
+        self._lock = threading.Lock()
+        self._latency_ms = collections.deque(maxlen=int(window))
+        self._queue_ms = collections.deque(maxlen=int(window))
+        self._exec_ms = collections.deque(maxlen=int(window))
+        self.requests = 0
+        self.errors = 0
+        self.rejects = 0
+        self.batches = 0
+        self._batch_size_sum = 0
+        self._occupancy_sum = 0.0
+        self.tokens = 0
+        self._token_time_s = 0.0
+        self.queue_depth = 0  # gauge, written by the batcher
+
+    # -- observations -------------------------------------------------------
+    def observe_request(self, queue_ms=0.0, exec_ms=0.0, ok=True):
+        """One request completed (or failed after admission)."""
+        total = queue_ms + exec_ms
+        with self._lock:
+            self.requests += 1
+            if not ok:
+                self.errors += 1
+            self._latency_ms.append(total)
+            self._queue_ms.append(queue_ms)
+            self._exec_ms.append(exec_ms)
+        if _prof.ENABLED:
+            t1 = _prof.begin()
+            _prof.record_duration(f"serve::request({self.name})", "serve",
+                                  t1 - int(total * 1e6), t1,
+                                  args={"queue_ms": round(queue_ms, 3),
+                                        "exec_ms": round(exec_ms, 3),
+                                        "ok": bool(ok)})
+
+    def observe_batch(self, size, capacity):
+        """One batch dispatched: ``size`` live requests padded into a
+        ``capacity``-slot bucket (occupancy = size/capacity)."""
+        occ = size / capacity if capacity else 0.0
+        with self._lock:
+            self.batches += 1
+            self._batch_size_sum += size
+            self._occupancy_sum += occ
+        if _prof.ENABLED:
+            _prof.record_instant(f"serve::batch({self.name})", "serve",
+                                 args={"size": size, "capacity": capacity,
+                                       "occupancy": round(occ, 3)})
+
+    def observe_reject(self):
+        """One fast-rejected submission (queue full / breaker open)."""
+        with self._lock:
+            self.rejects += 1
+        if _prof.ENABLED:
+            _prof.record_instant(f"serve::reject({self.name})", "serve")
+
+    def observe_tokens(self, n, dt_s):
+        """``n`` tokens decoded in ``dt_s`` seconds."""
+        with self._lock:
+            self.tokens += int(n)
+            self._token_time_s += float(dt_s)
+        if _prof.ENABLED and dt_s > 0:
+            _prof.set_counter(f"serve.tokens_s({self.name})",
+                              round(n / dt_s, 1), cat="serve")
+
+    def set_queue_depth(self, depth):
+        self.queue_depth = int(depth)
+        if _prof.ENABLED:
+            _prof.set_counter(f"serve.queue_depth({self.name})", int(depth),
+                              cat="serve")
+
+    # -- readout ------------------------------------------------------------
+    def latency_percentiles(self):
+        with self._lock:
+            lat = list(self._latency_ms)
+        return {"p50_ms": percentile(lat, 50), "p95_ms": percentile(lat, 95),
+                "p99_ms": percentile(lat, 99)}
+
+    def snapshot(self):
+        """Full SLO readout (the dict SERVING.md documents)."""
+        with self._lock:
+            lat = list(self._latency_ms)
+            q = list(self._queue_ms)
+            e = list(self._exec_ms)
+            batches = self.batches
+            out = {
+                "name": self.name,
+                "requests": self.requests,
+                "errors": self.errors,
+                "rejects": self.rejects,
+                "batches": batches,
+                "queue_depth": self.queue_depth,
+                "mean_batch_size": (self._batch_size_sum / batches
+                                    if batches else 0.0),
+                "batch_occupancy": (self._occupancy_sum / batches
+                                    if batches else 0.0),
+                "tokens": self.tokens,
+                "tokens_s": (self.tokens / self._token_time_s
+                             if self._token_time_s > 0 else 0.0),
+            }
+        out["p50_ms"] = percentile(lat, 50)
+        out["p95_ms"] = percentile(lat, 95)
+        out["p99_ms"] = percentile(lat, 99)
+        out["queue_p99_ms"] = percentile(q, 99)
+        out["exec_p99_ms"] = percentile(e, 99)
+        return out

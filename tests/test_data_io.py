@@ -276,3 +276,137 @@ def test_transforms_crop_resize_and_rotation():
     r0 = T.RandomRotation((-30, 30), rotate_with_proba=0.0)(
         img.astype("float32"))
     np_.testing.assert_array_equal(np_.asarray(r0), img.astype("float32"))
+
+
+def test_ndarray_iter_last_batch_pad_roundtrip():
+    """Regression: len(data) % batch_size != 0 must report a correct
+    getpad() on the final batch and round-trip every sample exactly once
+    per epoch (wrap rows are duplicates, identified by batch.index)."""
+    from mxnet_tpu.io import NDArrayIter
+
+    X = np.arange(10, dtype="float32").reshape(10, 1)
+    it = NDArrayIter(X, None, batch_size=4, last_batch_handle="pad")
+    seen, pads = [], []
+    for batch in it:
+        vals = batch.data[0].asnumpy().ravel()
+        assert batch.data[0].shape == (4, 1)  # fixed shape incl. tail
+        assert len(batch.index) == 4
+        np.testing.assert_array_equal(vals, X[batch.index].ravel())
+        real = 4 - batch.pad
+        seen.extend(vals[:real].tolist())
+        pads.append(batch.pad)
+    assert pads == [0, 0, 2]  # only the final batch pads
+    assert sorted(seen) == list(range(10))  # no sample dropped, none twice
+
+
+def test_ndarray_iter_pad_wraps_repeatedly():
+    """batch_size > num_data: the pad wrap must repeat until the batch is
+    full (a single wrap used to emit a short, shape-breaking batch)."""
+    from mxnet_tpu.io import NDArrayIter
+
+    X = np.arange(3, dtype="float32").reshape(3, 1)
+    it = NDArrayIter(X, None, batch_size=8, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 1
+    assert batches[0].data[0].shape == (8, 1)
+    assert batches[0].pad == 5
+    np.testing.assert_array_equal(
+        batches[0].data[0].asnumpy().ravel(),
+        [0, 1, 2, 0, 1, 2, 0, 1])
+
+
+def test_prefetch_iter_matches_wrapped_iter():
+    from mxnet_tpu.io import NDArrayIter, PrefetchIter
+
+    X = np.random.randn(10, 3).astype("float32")
+    y = np.arange(10).astype("float32")
+    ref = [(b.data[0].asnumpy(), b.label[0].asnumpy())
+           for b in NDArrayIter(X, y, batch_size=3)]
+    pf = PrefetchIter(NDArrayIter(X, y, batch_size=3), num_prefetch=2)
+    assert pf.batch_size == 3
+    assert [d.name for d in pf.provide_data] == ["data"]
+    for epoch in range(2):  # reset() must restart cleanly
+        got = [(b.data[0].asnumpy(), b.label[0].asnumpy()) for b in pf]
+        assert len(got) == len(ref)
+        for (gd, gl), (rd, rl) in zip(got, ref):
+            np.testing.assert_array_equal(gd, rd)
+            np.testing.assert_array_equal(gl, rl)
+        pf.reset()
+
+
+def test_prefetch_iter_propagates_producer_error():
+    from mxnet_tpu.io import DataIter, PrefetchIter
+
+    class Boom(DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.n = 0
+
+        def iter_next(self):
+            self.n += 1
+            if self.n > 2:
+                raise RuntimeError("producer exploded")
+            return True
+
+        def getdata(self):
+            return [mx.np.zeros((2, 1))]
+
+        def getlabel(self):
+            return []
+
+        def getpad(self):
+            return 0
+
+        @property
+        def provide_data(self):
+            return []
+
+        @property
+        def provide_label(self):
+            return []
+
+    pf = PrefetchIter(Boom(), num_prefetch=2)
+    next(pf)
+    next(pf)
+    with pytest.raises(RuntimeError, match="producer exploded"):
+        next(pf)
+    # the error is sticky, not a deadlock: the producer thread has
+    # exited, so a blocking queue.get() here would hang forever
+    with pytest.raises(RuntimeError, match="producer exploded"):
+        next(pf)
+
+
+def test_prefetch_iter_repeats_stop_iteration_after_exhaustion():
+    from mxnet_tpu.io import NDArrayIter, PrefetchIter
+
+    pf = PrefetchIter(NDArrayIter(np.zeros((4, 1), "float32"),
+                                  batch_size=2), num_prefetch=2)
+    assert len(list(pf)) == 2
+    for _ in range(3):  # regression: this used to block forever
+        with pytest.raises(StopIteration):
+            next(pf)
+    pf.reset()
+    assert len(list(pf)) == 2
+
+
+def test_prefetching_iter_legacy_wrapper():
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+
+    X = np.arange(12, dtype="float32").reshape(6, 2)
+    ref = [b.data[0].asnumpy() for b in NDArrayIter(X, batch_size=2)]
+    it = PrefetchingIter([NDArrayIter(X, batch_size=2)])
+    got = [b.data[0].asnumpy() for b in it]
+    assert len(got) == len(ref)
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(g, r)
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_iter_rejects_bad_depth():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.io import NDArrayIter, PrefetchIter
+
+    with pytest.raises(MXNetError, match="num_prefetch"):
+        PrefetchIter(NDArrayIter(np.zeros((4, 1), "float32"),
+                                 batch_size=2), num_prefetch=0)

@@ -62,3 +62,9 @@ def test_matrix_factorization_tiny():
 def test_adversary_fgsm():
     out = _run("adversary_fgsm.py", "--cpu", "--steps", "30")
     assert "FGSM dropped accuracy" in out
+
+
+def test_serve_llama_tiny():
+    out = _run("serve_llama.py", "--config", "llama_tiny_test",
+               "--max-new-tokens", "4", "--clients", "4")
+    assert "0 recompiles after warmup" in out

@@ -44,4 +44,15 @@ if [[ "${TIER1_BULK:-0}" != "0" ]]; then
         rc=$bulk_rc
     fi
 fi
+# Serve smoke pass (TIER1_SERVE=0 to skip): one InferenceSession behind a
+# DynamicBatcher, 32 concurrent requests — asserts correct results, a p99
+# latency bound, zero recompiles after warmup, and clean shutdown.
+if [[ "${TIER1_SERVE:-1}" != "0" ]]; then
+    timeout -k 10 120 env JAX_PLATFORMS=cpu \
+        python tools/serve_smoke.py
+    serve_rc=$?
+    if [[ "$rc" -eq 0 && "$serve_rc" -ne 0 ]]; then
+        rc=$serve_rc
+    fi
+fi
 exit "$rc"

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Tier-1 serving smoke (tools/run_tier1.sh): spin up an
+``InferenceSession`` behind a ``DynamicBatcher``, push 32 concurrent
+client requests, and assert the serving SLO surface end to end:
+
+* every request completes with the right answer (vs an unbatched
+  reference forward),
+* p99 whole-request latency stays under ``SERVE_SMOKE_P99_MS``
+  (default 5000 ms — generous for CPU CI, tight enough to catch a
+  recompile storm or a wedged flusher),
+* zero XLA recompiles after warmup (``assert_no_recompiles``),
+* the batcher shuts down cleanly (flusher thread joins, late submits
+  are fast-rejected with 503).
+
+Exit status 0 on pass; nonzero with a one-line reason otherwise.
+"""
+import os
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import mxnet_tpu as mx  # noqa: F401  (framework init)
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import numpy as mnp
+    from mxnet_tpu.serve import (DynamicBatcher, InferenceSession,
+                                 ServiceUnavailable)
+
+    p99_bound_ms = float(os.environ.get("SERVE_SMOKE_P99_MS", "5000"))
+    n_clients = 32
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(8))
+    net.initialize()
+
+    sess = InferenceSession(net, batch_buckets=(1, 2, 4, 8), name="smoke")
+    sess.warmup(np.zeros((1, 16), np.float32))
+
+    def runner(payloads):
+        out = sess.predict(np.stack(payloads)).asnumpy()
+        return [out[i] for i in range(len(payloads))]
+
+    rng = np.random.RandomState(7)
+    xs = [rng.randn(16).astype(np.float32) for _ in range(n_clients)]
+    results = [None] * n_clients
+    errors = []
+
+    with DynamicBatcher(runner, max_batch_size=8, timeout_ms=5.0,
+                        max_queue=64, metrics=sess.metrics,
+                        name="smoke") as batcher:
+        def client(i):
+            try:
+                results[i] = batcher.submit(xs[i]).result(timeout=60)
+            except Exception as exc:  # noqa: BLE001
+                errors.append((i, exc))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(90)
+    # context exit = clean shutdown; verify the flusher actually died
+    if batcher._thread.is_alive():
+        print("SERVE_SMOKE=FAIL flusher thread survived close()")
+        return 1
+    try:
+        batcher.submit(xs[0])
+        print("SERVE_SMOKE=FAIL late submit after close() was accepted")
+        return 1
+    except ServiceUnavailable:
+        pass
+
+    if errors:
+        i, exc = errors[0]
+        print(f"SERVE_SMOKE=FAIL request {i}: {type(exc).__name__}: {exc}")
+        return 1
+    with autograd.predict_mode():
+        ref = net(mnp.array(np.stack(xs))).asnumpy()
+    got = np.stack(results)
+    if not np.allclose(got, ref, rtol=1e-5, atol=1e-6):
+        print(f"SERVE_SMOKE=FAIL wrong results "
+              f"(maxdiff {np.abs(got - ref).max():.3g})")
+        return 1
+    try:
+        sess.assert_no_recompiles()
+    except Exception as exc:  # noqa: BLE001
+        print(f"SERVE_SMOKE=FAIL {exc}")
+        return 1
+    snap = sess.metrics.snapshot()
+    if snap["p99_ms"] > p99_bound_ms:
+        print(f"SERVE_SMOKE=FAIL p99 {snap['p99_ms']:.1f}ms "
+              f"> bound {p99_bound_ms}ms")
+        return 1
+    print(f"SERVE_SMOKE=PASS requests={snap['requests']} "
+          f"p50={snap['p50_ms']:.1f}ms p99={snap['p99_ms']:.1f}ms "
+          f"occupancy={snap['batch_occupancy']:.2f} "
+          f"signatures={sess.signature_count()} "
+          f"serve_hits={sess.cache_stats()['serve_hits']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
