@@ -236,6 +236,34 @@ register_flag(
     "Ring-buffer sample count backing the serve p50/p95/p99 latency "
     "percentiles (serve.metrics).", int)
 register_flag(
+    "MXNET_SERVE_DEADLINE_MS", 0.0,
+    "Default request deadline attached at DynamicBatcher.submit when the "
+    "caller passes none: expired requests are cancelled at every stage "
+    "boundary (admission, queue sweep, post-execute settle) with "
+    "DeadlineExceeded (504) instead of completing late. 0 disables — no "
+    "deadline checks anywhere (the original semantics).", float)
+register_flag(
+    "MXNET_SERVE_DEADLINE_GRACE_MS", 0.0,
+    "Slack past a request's deadline within which a completed result is "
+    "still delivered (counted as a late_completion against goodput); "
+    "beyond deadline+grace the result is discarded and the future "
+    "settles with DeadlineExceeded.", float)
+register_flag(
+    "MXNET_SERVE_BATCH_QUEUE_SHARE", 1.0,
+    "Fraction of MXNET_SERVE_MAX_QUEUE the batch priority class may "
+    "occupy; batch-class submits beyond it shed with 503 so interactive "
+    "traffic always finds queue headroom. 1.0 (default) reserves "
+    "nothing.", float)
+register_flag(
+    "MXNET_SERVE_RATE_LIMIT", 0.0,
+    "Token-bucket refill rate (requests/s) gating batch-class admission "
+    "in DynamicBatcher.submit; interactive traffic is never rate-"
+    "limited. 0 disables the bucket.", float)
+register_flag(
+    "MXNET_SERVE_RATE_BURST", 16,
+    "Token-bucket capacity for MXNET_SERVE_RATE_LIMIT: the batch-class "
+    "burst admitted from an idle bucket before the rate applies.", int)
+register_flag(
     "MXNET_LOSS_SCALE_MIN", 1.0,
     "Lower clamp for the dynamic LossScaler (amp.py): repeated overflows "
     "can never drive the scale to 0.", float)

@@ -102,6 +102,9 @@ class KVStoreDistTPUSync(KVStoreLocal):
         out["retries"] = _res_counters.get("resilience.retries")
         out["watchdog_timeouts"] = _res_counters.get(
             "resilience.watchdog_timeouts")
+        # abandoned watchdog bodies (still-running orphans can mutate
+        # state behind the fast path — operator signal, not noise)
+        out["watchdog_orphans"] = _retry.watchdog_orphans()
         return out
 
     def _record_degradation(self, exc, op="allreduce"):

@@ -88,6 +88,14 @@ KNOWN_SITES = (
     "serve:execute",        # serve.engine.InferenceSession.run, inside
                             # the watchdog window (a 'delay' fault models
                             # a hung execution and must trip the timeout)
+    "serve:queue",          # serve.batcher.DynamicBatcher.submit, before
+                            # admission — the error surfaces synchronously
+                            # on the submitter (a failed admission path),
+                            # a 'delay' models a slow admission stall
+    "serve:decode",         # serve.generate.Generator.decode_step, once
+                            # per T=1 decode step — kills a generation
+                            # stream mid-decode (prefill is covered by
+                            # serve:execute)
 )
 
 

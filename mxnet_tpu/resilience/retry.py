@@ -155,6 +155,28 @@ def collective_timeout() -> float:
     return config.get("MXNET_COLLECTIVE_TIMEOUT") or 0.0
 
 
+# Orphan accounting: a timed-out watchdog body cannot be preempted — the
+# abandoned thread keeps running and CAN STILL MUTATE STATE (write a
+# KV-cache ring, bump a BatchNorm stat, complete a collective) after the
+# caller has already degraded. That risk must be visible, not silent:
+# every abandonment counts into ``resilience.watchdog_orphans`` (total)
+# and a live gauge that decrements when an orphan eventually finishes.
+_orphan_lock = threading.Lock()
+_orphans_live = 0
+
+
+def watchdog_orphans():
+    """Orphaned watchdog-body accounting: ``{"total": every body ever
+    abandoned at timeout, "live": those still running right now}``. A
+    nonzero ``live`` means abandoned executions may still mutate state
+    behind the serving/training path (surfaced via ``collective_stats()``
+    and ``InferenceSession.stats()``)."""
+    with _orphan_lock:
+        live = _orphans_live
+    return {"total": _counters.get("resilience.watchdog_orphans"),
+            "live": live}
+
+
 def run_with_watchdog(fn, timeout_s, site="collective"):
     """Run ``fn()`` bounded by ``timeout_s``; raise
     :class:`CollectiveTimeoutError` with a diagnosis instead of hanging.
@@ -162,35 +184,66 @@ def run_with_watchdog(fn, timeout_s, site="collective"):
 
     A fresh **daemon** thread per engaged call: a truly hung collective
     leaks its thread without blocking interpreter exit or poisoning a
-    shared pool the next probe would queue behind.
+    shared pool the next probe would queue behind. Each abandonment is
+    counted (:func:`watchdog_orphans`) and warned about at 1/10/100/...
+    occurrences — the orphaned body keeps running and can still mutate
+    state, so a climbing orphan count is an operator signal, not noise.
     """
+    global _orphans_live
     if not timeout_s or timeout_s <= 0:
         return fn()
     box = {}
     done = threading.Event()
 
     def body():
+        global _orphans_live
         try:
             box["out"] = fn()
         except BaseException as exc:  # rethrown on the caller thread
             box["exc"] = exc
         finally:
+            with _orphan_lock:
+                box["done"] = True
+                if box.get("abandoned"):
+                    # the waiter gave up on us long ago; retire the orphan
+                    _orphans_live -= 1
             done.set()
 
     t = threading.Thread(target=body, daemon=True,
                          name=f"mxtpu-watchdog[{site}]")
     t.start()
     if not done.wait(timeout_s):
-        _counters.incr("resilience.watchdog_timeouts")
-        if _prof.ENABLED:
-            _prof.record_instant(f"resilience::watchdog_timeout({site})",
-                                 "resilience", args={"timeout_s": timeout_s})
-        raise CollectiveTimeoutError(
-            f"{site} did not complete within MXNET_COLLECTIVE_TIMEOUT="
-            f"{timeout_s}s — likely a hung ICI collective (peer down, "
-            "deadlocked mesh, or network partition). The attempt's thread "
-            "is still blocked in the runtime; degrading to the eager "
-            "fallback is the safe continuation.")
+        with _orphan_lock:
+            timed_out = not box.get("done")
+            if timed_out:
+                box["abandoned"] = True
+                _orphans_live += 1
+        if timed_out:
+            _counters.incr("resilience.watchdog_timeouts")
+            _counters.incr("resilience.watchdog_orphans")
+            n = _counters.get("resilience.watchdog_orphans")
+            if _prof.ENABLED:
+                _prof.record_instant(
+                    f"resilience::watchdog_timeout({site})", "resilience",
+                    args={"timeout_s": timeout_s, "orphans": n})
+            if n in (1, 10) or n % 100 == 0:
+                import warnings
+
+                warnings.warn(
+                    f"watchdog abandoned a timed-out body at {site} "
+                    f"({n} orphan(s) so far, "
+                    f"{watchdog_orphans()['live']} still running) — the "
+                    "orphaned execution keeps running and can still "
+                    "mutate state; see watchdog_orphans() / "
+                    "collective_stats()", RuntimeWarning, stacklevel=2)
+            raise CollectiveTimeoutError(
+                f"{site} did not complete within MXNET_COLLECTIVE_TIMEOUT="
+                f"{timeout_s}s — likely a hung ICI collective (peer down, "
+                "deadlocked mesh, or network partition). The attempt's "
+                "thread is still blocked in the runtime; degrading to the "
+                "eager fallback is the safe continuation.")
+        # the body finished between the wait timing out and the lock —
+        # not an orphan, use its result
     if "exc" in box:
         raise box["exc"]
     return box.get("out")

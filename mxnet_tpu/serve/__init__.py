@@ -24,14 +24,15 @@ See SERVING.md for architecture, bucket policy, and the env knobs
 """
 from __future__ import annotations
 
-from .batcher import DynamicBatcher
-from .engine import InferenceSession, ServeError, ServiceUnavailable, \
-    pick_bucket
+from .batcher import PRIORITIES, DynamicBatcher, TokenBucket
+from .engine import DeadlineExceeded, InferenceSession, ServeError, \
+    ServiceUnavailable, pick_bucket
 from .generate import Generator, KVCache, sample_tokens
 from .metrics import ServeMetrics, percentile
 
 __all__ = [
     "InferenceSession", "DynamicBatcher", "Generator", "KVCache",
-    "ServeMetrics", "ServeError", "ServiceUnavailable", "sample_tokens",
-    "pick_bucket", "percentile",
+    "ServeMetrics", "ServeError", "ServiceUnavailable", "DeadlineExceeded",
+    "TokenBucket", "PRIORITIES", "sample_tokens", "pick_bucket",
+    "percentile",
 ]

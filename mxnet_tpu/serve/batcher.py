@@ -9,6 +9,29 @@ to a ``runner`` callable. A batch dispatches when either trigger fires:
   ``MXNET_SERVE_BATCH_TIMEOUT_MS``; latecomers never extend the deadline
   (no unbounded batch-coalescing tail latency).
 
+Overload safety (all off by default — a priority-free, deadline-free
+deployment behaves exactly like the original FIFO batcher):
+
+* **request deadlines** — ``submit(deadline_ms=...)`` (or the
+  ``MXNET_SERVE_DEADLINE_MS`` default) attaches an absolute deadline.
+  Expired requests are cancelled at every stage boundary — rejected at
+  admission, swept out of the queue before each flush, and re-checked at
+  settle time so a completion past deadline + ``MXNET_SERVE_DEADLINE_
+  GRACE_MS`` becomes a :class:`DeadlineExceeded` (504) instead of a
+  silent late delivery the client already gave up on.
+* **two-class priority** — ``submit(priority="interactive"|"batch")``.
+  Batches assemble interactive-first; under queue pressure the shedding
+  is lowest-first: an interactive arrival displaces the *newest* queued
+  batch-class request (its future settles with a 503 shed) before the
+  interactive class ever sees a reject. ``MXNET_SERVE_BATCH_QUEUE_SHARE``
+  caps the queue fraction the batch class may occupy, and
+  ``MXNET_SERVE_RATE_LIMIT`` / ``MXNET_SERVE_RATE_BURST`` put a token
+  bucket in front of batch-class admission.
+* **graceful drain** — :meth:`drain` stops admission and waits for the
+  queue and the in-flight batch to settle; :meth:`resume` reopens.
+  :meth:`close` with a wedged runner fails every still-queued AND
+  in-flight future with 503 instead of leaking them.
+
 Admission control is a hard queue-depth cap (``MXNET_SERVE_MAX_QUEUE``):
 beyond it :meth:`submit` fast-rejects with
 :class:`~mxnet_tpu.serve.engine.ServiceUnavailable` *synchronously* — the
@@ -18,25 +41,77 @@ every entry will miss its SLO anyway.
 Failure isolation: a runner exception fails the *requests of that batch*
 (each future carries the error) and the flusher thread keeps serving —
 an injected ``op:dispatch`` fault is a per-request 5xx, not a dead server.
+A runner may also return an ``Exception`` instance in a result slot to
+fail that single request (the Generator runner uses this for per-row
+deadline retirement). The ``serve:queue`` fault site fires inside
+``submit`` so the chaos harness can fail admission deterministically.
 """
 from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
+import warnings
+from concurrent.futures import Future, InvalidStateError
 
-from .engine import ServeError, ServiceUnavailable
+from ..resilience import faults as _faults
+from .engine import DeadlineExceeded, ServeError, ServiceUnavailable
 from .metrics import ServeMetrics
+
+PRIORITIES = ("interactive", "batch")
+#: admission order = shed order reversed: the batch class sheds first.
+_PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill, ``burst`` capacity.
+    ``rate <= 0`` means unlimited (every :meth:`take` succeeds)."""
+
+    def __init__(self, rate, burst):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._tokens = self.burst
+        self._t_last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, n=1.0):
+        if self.rate <= 0:
+            return True
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t_last) * self.rate)
+            self._t_last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
 
 
 class _Pending:
-    __slots__ = ("payload", "future", "t_enq", "t_dispatch")
+    __slots__ = ("payload", "future", "t_enq", "t_dispatch", "priority",
+                 "deadline")
 
-    def __init__(self, payload):
+    def __init__(self, payload, priority="interactive", deadline=None):
         self.payload = payload
         self.future = Future()
         self.t_enq = time.monotonic()
         self.t_dispatch = None
+        self.priority = priority
+        self.deadline = deadline  # absolute time.monotonic() or None
+
+
+def _settle_future(fut, result=None, error=None):
+    """Settle exactly once: a future that already carries an outcome (the
+    close-timeout path racing a runner that eventually returned) is left
+    untouched. Returns True if this call settled it."""
+    try:
+        if error is not None:
+            fut.set_exception(error)
+        else:
+            fut.set_result(result)
+        return True
+    except InvalidStateError:
+        return False
 
 
 class DynamicBatcher:
@@ -47,7 +122,8 @@ class DynamicBatcher:
     runner : callable(list) -> list
         Executes one assembled batch of payloads; must return one result
         per payload (an :class:`InferenceSession`-backed closure in the
-        serving stack, but any callable works).
+        serving stack, but any callable works). A result slot holding an
+        ``Exception`` instance fails that request alone.
     max_batch_size, timeout_ms, max_queue : optional overrides of the
         ``MXNET_SERVE_*`` config flags.
     """
@@ -69,11 +145,28 @@ class DynamicBatcher:
         if self.max_queue < 0:
             raise ServeError(
                 f"max_queue must be >= 0, got {self.max_queue}")
+        # overload knobs, resolved once (submit runs per request and must
+        # not re-read the environment)
+        self.default_deadline_s = (
+            config.get("MXNET_SERVE_DEADLINE_MS") or 0.0) / 1e3
+        self.deadline_grace_s = (
+            config.get("MXNET_SERVE_DEADLINE_GRACE_MS") or 0.0) / 1e3
+        share = float(config.get("MXNET_SERVE_BATCH_QUEUE_SHARE"))
+        if not 0.0 <= share <= 1.0:
+            raise ServeError(
+                f"MXNET_SERVE_BATCH_QUEUE_SHARE must be in [0, 1], "
+                f"got {share}")
+        self.batch_queue_cap = int(self.max_queue * share)
+        self.rate_limiter = TokenBucket(
+            config.get("MXNET_SERVE_RATE_LIMIT"),
+            config.get("MXNET_SERVE_RATE_BURST"))
         self.name = name
         self.metrics = metrics or ServeMetrics(name)
         self._queue = []               # FIFO of _Pending (guarded by _cond)
+        self._inflight = []            # batch currently inside the runner
         self._cond = threading.Condition()
         self._closed = False
+        self._draining = False
         self._thread = None
         if start:
             self.start()
@@ -89,17 +182,56 @@ class DynamicBatcher:
 
     def close(self, timeout=5.0):
         """Stop the flusher. Already-admitted requests are drained first;
-        anything still queued after the drain fails with 503."""
+        anything still queued after the drain fails with 503. If the
+        flusher misses the join deadline (a runner wedged mid-batch),
+        every still-queued future AND the wedged batch's futures fail
+        with 503 — nothing is left to hang forever. Should the wedged
+        runner later return, its settle attempt finds the futures already
+        carrying the 503 and is dropped (exactly-once settle)."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+        stuck = []
         if self._thread is not None:
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                with self._cond:
+                    stuck = list(self._inflight)
         with self._cond:
             leftovers, self._queue = self._queue, []
-        for p in leftovers:
-            p.future.set_exception(ServiceUnavailable(
+            self.metrics.set_queue_depth(0)
+        if stuck:
+            warnings.warn(
+                f"batcher {self.name!r}: flusher did not join within "
+                f"{timeout}s (runner wedged mid-batch); failing its "
+                f"{len(stuck)} in-flight and {len(leftovers)} queued "
+                "request(s) with 503 instead of leaking them",
+                RuntimeWarning, stacklevel=2)
+        for p in stuck + leftovers:
+            _settle_future(p.future, error=ServiceUnavailable(
                 f"batcher {self.name!r} shut down before dispatch"))
+
+    def drain(self, timeout=30.0):
+        """Stop admission and wait until the queue AND the in-flight batch
+        are empty. Returns True once quiesced (every admitted future has
+        settled), False on timeout. Admission stays stopped either way;
+        :meth:`resume` reopens it."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()   # wake the flusher: flush NOW
+            while self._queue or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+    def resume(self):
+        """Reopen admission after :meth:`drain`."""
+        with self._cond:
+            self._draining = False
+            self._cond.notify_all()
 
     def __enter__(self):
         return self
@@ -109,23 +241,104 @@ class DynamicBatcher:
         return False
 
     # -- admission ----------------------------------------------------------
-    def submit(self, payload):
+    def _resolve_deadline(self, deadline_ms):
+        if deadline_ms is not None:
+            return (time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms > 0 else None)
+        if self.default_deadline_s > 0:
+            return time.monotonic() + self.default_deadline_s
+        return None
+
+    def submit(self, payload, priority="interactive", deadline_ms=None):
         """Admit one request; returns a :class:`concurrent.futures.Future`.
-        Raises :class:`ServiceUnavailable` synchronously when the queue is
-        at ``max_queue`` (admission control) or the batcher is closed."""
+
+        ``priority`` is ``"interactive"`` (default — never shed in favor
+        of batch work) or ``"batch"`` (sheds first under pressure).
+        ``deadline_ms`` attaches a relative deadline (<= 0 disables even
+        when ``MXNET_SERVE_DEADLINE_MS`` sets a default).
+
+        Raises synchronously: :class:`ServiceUnavailable` when the queue
+        is full of equal-or-higher-priority work, the batch-class share or
+        token bucket rejects, or the batcher is closed/draining;
+        :class:`DeadlineExceeded` when the deadline is already in the
+        past at admission."""
+        if priority not in _PRIORITY_RANK:
+            raise ServeError(
+                f"unknown priority {priority!r}; use one of {PRIORITIES}")
+        # admission fault site OUTSIDE the lock: an injected delay models
+        # a slow admission path, not a queue-lock convoy
+        _faults.fault_point("serve:queue", {"batcher": self.name,
+                                            "priority": priority})
+        deadline = self._resolve_deadline(deadline_ms)
+        now = time.monotonic()
+        if deadline is not None and now >= deadline:
+            self.metrics.observe_deadline("admit", priority)
+            raise DeadlineExceeded(
+                f"batcher {self.name!r}: request deadline expired "
+                "before admission")
+        shed = None
         with self._cond:
             if self._closed:
                 raise ServiceUnavailable(
                     f"batcher {self.name!r} is shut down")
-            if len(self._queue) >= self.max_queue:
+            if self._draining:
                 self.metrics.observe_reject()
                 raise ServiceUnavailable(
-                    f"batcher {self.name!r} queue is full "
-                    f"({self.max_queue} waiting); shed load upstream")
-            p = _Pending(payload)
+                    f"batcher {self.name!r} is draining; no new work "
+                    "admitted until resume()")
+            if priority == "batch" and self.batch_queue_cap < self.max_queue:
+                n_batch = sum(1 for p in self._queue
+                              if p.priority == "batch")
+                if n_batch >= self.batch_queue_cap:
+                    self.metrics.observe_shed("batch", reason="share")
+                    raise ServiceUnavailable(
+                        f"batcher {self.name!r}: batch-class queue share "
+                        f"({self.batch_queue_cap} of {self.max_queue}) is "
+                        "full; shed")
+            if len(self._queue) >= self.max_queue:
+                # shed-lowest-first: an interactive arrival displaces the
+                # NEWEST queued lower-priority request (newest: it has
+                # waited least, so killing it wastes the least invested
+                # queue time) instead of being rejected
+                victim_idx = None
+                if priority == "interactive":
+                    for i in range(len(self._queue) - 1, -1, -1):
+                        if _PRIORITY_RANK[self._queue[i].priority] \
+                                > _PRIORITY_RANK[priority]:
+                            victim_idx = i
+                            break
+                if victim_idx is None:
+                    self.metrics.observe_reject()
+                    if priority == "batch":
+                        self.metrics.observe_shed("batch",
+                                                  reason="pressure")
+                    raise ServiceUnavailable(
+                        f"batcher {self.name!r} queue is full "
+                        f"({self.max_queue} waiting); shed load upstream")
+                shed = self._queue.pop(victim_idx)
+            # rate-limit LAST, after every other reject: a token must only
+            # be spent on a request that is actually admitted — otherwise
+            # retries against a full/draining batcher drain the bucket and
+            # the effective rate becomes attempts, not admissions
+            if priority == "batch" and not self.rate_limiter.take():
+                if shed is not None:
+                    # can't happen (only interactive displaces), but never
+                    # lose a popped victim
+                    self._queue.append(shed)
+                self.metrics.observe_shed("batch", reason="rate")
+                raise ServiceUnavailable(
+                    f"batcher {self.name!r}: batch-class token bucket "
+                    f"empty (MXNET_SERVE_RATE_LIMIT="
+                    f"{self.rate_limiter.rate:g}/s); shed")
+            p = _Pending(payload, priority=priority, deadline=deadline)
             self._queue.append(p)
             self.metrics.set_queue_depth(len(self._queue))
             self._cond.notify()
+        if shed is not None:
+            self.metrics.observe_shed(shed.priority, reason="pressure")
+            _settle_future(shed.future, error=ServiceUnavailable(
+                f"batcher {self.name!r}: shed under queue pressure to "
+                "admit higher-priority work"))
         return p.future
 
     def queue_depth(self):
@@ -133,35 +346,84 @@ class DynamicBatcher:
             return len(self._queue)
 
     # -- flusher ------------------------------------------------------------
+    def _sweep_expired_locked(self, now):
+        """Remove queue entries whose deadline has passed (caller holds
+        ``_cond``); returns them for settling outside the lock."""
+        expired = [p for p in self._queue
+                   if p.deadline is not None and now >= p.deadline]
+        if expired:
+            dead = set(id(p) for p in expired)
+            self._queue = [p for p in self._queue if id(p) not in dead]
+        return expired
+
     def _take_batch(self):
-        """Block until a batch is due; returns a list of _Pending (empty
-        on shutdown). Flush triggers: size >= max_batch_size, or oldest
-        entry older than timeout_s."""
+        """Block until a batch is due; returns (batch, expired) — expired
+        entries are settled by the caller with DeadlineExceeded. Flush
+        triggers: size >= max_batch_size, the oldest entry older than
+        timeout_s, or drain/close (dispatch NOW). Batches assemble
+        interactive-first (stable within each class)."""
         with self._cond:
             while True:
+                now = time.monotonic()
+                expired = self._sweep_expired_locked(now)
+                if expired:
+                    self.metrics.set_queue_depth(len(self._queue))
+                    return [], expired
                 if self._queue:
+                    # sort only on the dispatch branches — a wakeup that
+                    # goes back to waiting must not pay O(n log n) under
+                    # the lock submitters contend for
                     if len(self._queue) >= self.max_batch_size:
-                        batch = self._queue[:self.max_batch_size]
-                        del self._queue[:self.max_batch_size]
+                        ordered = sorted(
+                            self._queue,
+                            key=lambda p: _PRIORITY_RANK[p.priority])
+                        batch = ordered[:self.max_batch_size]
+                        taken = set(id(p) for p in batch)
+                        self._queue = [p for p in self._queue
+                                       if id(p) not in taken]
+                        self._inflight = list(batch)
                         self.metrics.set_queue_depth(len(self._queue))
-                        return batch
-                    age = time.monotonic() - self._queue[0].t_enq
+                        return batch, []
+                    age = now - self._queue[0].t_enq
                     remaining = self.timeout_s - age
-                    if remaining <= 0 or self._closed:
-                        # deadline hit — or shutting down: drain what's
-                        # queued NOW instead of sitting out the deadline
-                        batch, self._queue = self._queue, []
+                    if remaining <= 0 or self._closed or self._draining:
+                        # flush deadline hit — or drain/shutdown: dispatch
+                        # what's queued NOW instead of sitting it out
+                        batch = sorted(
+                            self._queue,
+                            key=lambda p: _PRIORITY_RANK[p.priority])
+                        self._queue = []
+                        self._inflight = list(batch)
                         self.metrics.set_queue_depth(0)
-                        return batch
-                    self._cond.wait(remaining)
+                        return batch, []
+                    # wake early enough to expire the nearest deadline
+                    nearest = min((p.deadline - now for p in self._queue
+                                   if p.deadline is not None),
+                                  default=remaining)
+                    self._cond.wait(max(1e-4, min(remaining, nearest)))
                 elif self._closed:
-                    return []
+                    return [], []
                 else:
                     self._cond.wait(0.5)
 
     def _flush_loop(self):
         while True:
-            batch = self._take_batch()
+            batch, expired = self._take_batch()
+            if expired:
+                now = time.monotonic()
+                for p in expired:
+                    self.metrics.observe_deadline("queue", p.priority)
+                    self.metrics.observe_request(
+                        (now - p.t_enq) * 1e3, 0.0, ok=False,
+                        priority=p.priority)
+                    _settle_future(p.future, error=DeadlineExceeded(
+                        f"batcher {self.name!r}: deadline expired after "
+                        f"{(now - p.t_enq) * 1e3:.1f}ms in queue"))
+                with self._cond:
+                    # the sweep may have emptied the queue: wake drain()
+                    # waiters now, not at their timeout
+                    self._cond.notify_all()
+                continue
             if not batch:
                 if self._closed:
                     return
@@ -192,12 +454,32 @@ class DynamicBatcher:
         for i, p in enumerate(batch):
             queue_ms = (p.t_dispatch - p.t_enq) * 1e3
             exec_ms = (done - p.t_dispatch) * 1e3
+            out, exc = None, error
+            if exc is None:
+                out = results[i]
+                if isinstance(out, BaseException):
+                    # per-request failure returned in a result slot
+                    out, exc = None, out
+            deadline_ok = True
+            if exc is None and p.deadline is not None and done > p.deadline:
+                if done > p.deadline + self.deadline_grace_s:
+                    # the client's budget ran out mid-execution: a 504,
+                    # never a silent late delivery
+                    self.metrics.observe_deadline("execute", p.priority)
+                    exc = DeadlineExceeded(
+                        f"batcher {self.name!r}: completed "
+                        f"{(done - p.deadline) * 1e3:.1f}ms past deadline "
+                        f"(grace {self.deadline_grace_s * 1e3:.0f}ms)")
+                else:
+                    deadline_ok = False  # delivered, but counted late
             self.metrics.observe_request(queue_ms, exec_ms,
-                                         ok=error is None)
-            if error is None:
-                p.future.set_result(results[i])
-            else:
-                p.future.set_exception(error)
+                                         ok=exc is None,
+                                         priority=p.priority,
+                                         deadline_ok=deadline_ok)
+            _settle_future(p.future, result=out, error=exc)
+        with self._cond:
+            self._inflight = []
+            self._cond.notify_all()
 
     def stats(self):
         out = self.metrics.snapshot()

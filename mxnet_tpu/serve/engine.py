@@ -51,6 +51,21 @@ class ServiceUnavailable(ServeError):
     status = 503
 
 
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed before (or while) it was served (504).
+
+    Distinct from :class:`ServiceUnavailable` on purpose: a 503 means the
+    *server* shed or failed the request and a retry may succeed; a 504
+    means the *request's own time budget* ran out — the client has already
+    moved on and a silent late completion would be worse than the error.
+    Raised at every stage boundary (admission, queue sweep, post-execute
+    settle, between decode steps) so an expired request never burns more
+    server time than the stage it is already inside.
+    """
+
+    status = 504
+
+
 def _deterministic_compiler_options():
     """XLA overrides for serving executables. On the CPU backend the
     default thunk runtime partitions fused loops differently per graph
@@ -117,6 +132,14 @@ class InferenceSession:
         self._warm_signatures = None
         self._shapes_ready = False
         self._lock = threading.Lock()
+        # drain/swap lifecycle: _quiesce guards the in-flight count;
+        # drain() flips _draining and waits for it to reach zero. The
+        # thread-local bypass lets swap()'s own warmup run while external
+        # admission is still stopped.
+        self._quiesce = threading.Condition()
+        self._inflight = 0
+        self._draining = False
+        self._bypass = threading.local()
 
     # -- raw protected execution -------------------------------------------
     def _timeout_s(self):
@@ -132,56 +155,76 @@ class InferenceSession:
         requests of the affected batch)."""
         from .. import autograd
 
-        if not self._shapes_ready:
-            # complete any deferred (shape-inferred) parameter init with
-            # one eager pass — CachedOp keys on param shapes, which don't
-            # exist yet for in_units=0 Dense until a first forward
-            with self._lock:
-                if not self._shapes_ready:
-                    params = self.block.collect_params().values()
-                    if any(getattr(p, "_deferred_init", None) is not None
-                           and p._data is None for p in params):
-                        with autograd.predict_mode():
-                            self.block(*args)
-                    self._shapes_ready = True
-        if not self.breaker.allow():
-            self.metrics.observe_reject()
-            raise ServiceUnavailable(
-                f"serve session {self.name!r}: circuit breaker is "
-                f"{self.breaker.state} after repeated execution failures; "
-                "retry after cooldown")
-        self._op.begin_serve_call()
-        t0 = time.perf_counter()
+        with self._quiesce:
+            if self._draining and not getattr(self._bypass, "on", False):
+                self.metrics.observe_reject()
+                raise ServiceUnavailable(
+                    f"serve session {self.name!r} is draining; no new "
+                    "work admitted until swap/resume")
+            self._inflight += 1
         try:
-            def body():
-                # fault site INSIDE the watchdog window: an injected
-                # delay models a hung execution and must trip the timeout
-                _faults.fault_point("serve:execute", {"session": self.name})
-                with autograd.predict_mode():
-                    return self._op(*args)
+            if not self._shapes_ready:
+                # complete any deferred (shape-inferred) parameter init
+                # with one eager pass — CachedOp keys on param shapes,
+                # which don't exist yet for in_units=0 Dense until a
+                # first forward. Inside the admission gate + in-flight
+                # count on purpose: this pass executes the model, and a
+                # concurrent swap() must not see "quiesced" while it runs
+                with self._lock:
+                    if not self._shapes_ready:
+                        params = self.block.collect_params().values()
+                        if any(getattr(p, "_deferred_init", None)
+                               is not None and p._data is None
+                               for p in params):
+                            with autograd.predict_mode():
+                                self.block(*args)
+                        self._shapes_ready = True
+            if not self.breaker.allow():
+                self.metrics.observe_reject()
+                raise ServiceUnavailable(
+                    f"serve session {self.name!r}: circuit breaker is "
+                    f"{self.breaker.state} after repeated execution "
+                    "failures; retry after cooldown")
+            self._op.begin_serve_call()
+            t0 = time.perf_counter()
+            try:
+                def body():
+                    # fault site INSIDE the watchdog window: an injected
+                    # delay models a hung execution and must trip the
+                    # timeout
+                    _faults.fault_point("serve:execute",
+                                        {"session": self.name})
+                    with autograd.predict_mode():
+                        return self._op(*args)
 
-            out = run_with_watchdog(body, self._timeout_s(),
-                                    site=f"serve:{self.name}")
-        except CollectiveTimeoutError as exc:
-            self.breaker.record_failure()
-            raise ServiceUnavailable(
-                f"serve session {self.name!r}: execution exceeded "
-                f"MXNET_SERVE_TIMEOUT_MS ({exc})") from exc
-        except Exception:
-            self.breaker.record_failure()
-            raise
-        self.breaker.record_success()
-        exec_ms = (time.perf_counter() - t0) * 1e3
-        if self._op.call_was_warm():
-            # warm-path call: every signature it touched was already
-            # compiled — the steady-state serving invariant. Tracked
-            # per-thread, so a concurrent thread's cold compile can't
-            # misattribute this call
-            self._op.record_serve_hit()
-        if _prof.ENABLED:
-            _prof.record_instant(f"serve::execute({self.name})", "serve",
-                                 args={"exec_ms": round(exec_ms, 3)})
-        return out
+                out = run_with_watchdog(body, self._timeout_s(),
+                                        site=f"serve:{self.name}")
+            except CollectiveTimeoutError as exc:
+                self.breaker.record_failure()
+                raise ServiceUnavailable(
+                    f"serve session {self.name!r}: execution exceeded "
+                    f"MXNET_SERVE_TIMEOUT_MS ({exc})") from exc
+            except Exception:
+                self.breaker.record_failure()
+                raise
+            self.breaker.record_success()
+            exec_ms = (time.perf_counter() - t0) * 1e3
+            if self._op.call_was_warm():
+                # warm-path call: every signature it touched was already
+                # compiled — the steady-state serving invariant. Tracked
+                # per-thread, so a concurrent thread's cold compile can't
+                # misattribute this call
+                self._op.record_serve_hit()
+            if _prof.ENABLED:
+                _prof.record_instant(f"serve::execute({self.name})",
+                                     "serve",
+                                     args={"exec_ms": round(exec_ms, 3)})
+            return out
+        finally:
+            with self._quiesce:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._quiesce.notify_all()
 
     # -- bucketed predict ---------------------------------------------------
     def _pad_input(self, data):
@@ -288,11 +331,159 @@ class InferenceSession:
         return self._op.cache_stats()
 
     def stats(self):
-        """Combined serving snapshot: metrics + executable cache + breaker."""
+        """Combined serving snapshot: metrics + executable cache + breaker
+        + watchdog-orphan accounting (abandoned execution bodies that may
+        still be running — see resilience.retry.watchdog_orphans)."""
+        from ..resilience.retry import watchdog_orphans
+
         out = self.metrics.snapshot()
         out["cache"] = self.cache_stats()
         out["breaker"] = self.breaker.snapshot()
+        out["watchdog_orphans"] = watchdog_orphans()
         return out
+
+    # -- drain / hot swap / health -------------------------------------------
+    def drain(self, timeout=30.0):
+        """Stop admitting work and wait for every in-flight execution to
+        settle. Returns True once quiesced, False on timeout (admission
+        stays stopped either way — call :meth:`resume` to reopen, or
+        :meth:`swap` which resumes itself). Idempotent."""
+        deadline = time.monotonic() + float(timeout)
+        with self._quiesce:
+            self._draining = True
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._quiesce.wait(remaining)
+        if _prof.ENABLED:
+            _prof.record_instant(f"serve::drain({self.name})", "serve")
+        return True
+
+    def resume(self):
+        """Reopen admission after :meth:`drain`."""
+        with self._quiesce:
+            self._draining = False
+            self._quiesce.notify_all()
+
+    def _signature_matches(self, new_block):
+        """True when ``new_block``'s parameter lattice (count, shapes,
+        dtypes, grad_req, in order) is identical to the serving block's —
+        the condition under which the warm executables, which read param
+        buffers at call time and key on param signatures, serve the new
+        weights without a single recompile."""
+        try:
+            olds = list(self.block.collect_params().values())
+            news = list(new_block.collect_params().values())
+            if len(olds) != len(news):
+                return False
+            for po, pn in zip(olds, news):
+                do, dn = po.data(), pn.data()
+                if (tuple(do.shape) != tuple(dn.shape)
+                        or do.dtype != dn.dtype
+                        or po.grad_req != pn.grad_req):
+                    return False
+            return True
+        except Exception:
+            # uninitialized / deferred params on either side: no warm path
+            return False
+
+    def swap(self, new_block, example=None, timeout=30.0):
+        """Hot-swap the served model: drain, switch executables atomically,
+        resume. Returns the swap mode.
+
+        * ``"warm"`` — ``new_block`` has the same parameter signature as
+          the serving block: its weights are transplanted into the live
+          parameter slots, so every already-compiled bucket executable
+          (which reads param buffers per call) keeps serving —
+          :meth:`assert_no_recompiles` still holds afterwards.
+        * ``"cold"`` — different architecture/shapes: a fresh CachedOp
+          replaces the old one; if ``example`` is given the full bucket
+          lattice is re-warmed (through the internal admission bypass)
+          before traffic resumes, and the new signature set is frozen.
+
+        Raises :class:`ServiceUnavailable` if the drain times out —
+        admission is resumed so the old model keeps serving."""
+        from .. import autograd
+        from .. import numpy as mnp
+
+        t0 = time.perf_counter()
+        if not self.drain(timeout):
+            self.resume()
+            raise ServiceUnavailable(
+                f"serve session {self.name!r}: swap aborted — in-flight "
+                f"work did not settle within {timeout}s; still serving "
+                "the old model")
+        try:
+            if example is not None:
+                # complete any deferred (shape-inferred) init on the
+                # incoming block with one eager pass, so the signature
+                # match sees real shapes and a same-architecture model
+                # takes the warm path
+                params = new_block.collect_params().values()
+                if any(getattr(p, "_deferred_init", None) is not None
+                       and p._data is None for p in params):
+                    with autograd.predict_mode():
+                        new_block(mnp.array(_onp.asarray(example)))
+            if self._signature_matches(new_block):
+                mode = "warm"
+                olds = list(self.block.collect_params().values())
+                news = list(new_block.collect_params().values())
+                for po, pn in zip(olds, news):
+                    po.set_data(pn.data())
+            else:
+                mode = "cold"
+                self.block = new_block
+                self._op = CachedOpThreadSafe(
+                    new_block,
+                    compiler_options=_deterministic_compiler_options())
+                self._warm_signatures = None
+                self._shapes_ready = False
+                if example is not None:
+                    self._bypass.on = True
+                    try:
+                        self.warmup(example)
+                    finally:
+                        self._bypass.on = False
+        finally:
+            self.resume()
+        self.metrics.observe_swap(mode, time.perf_counter() - t0)
+        return mode
+
+    def health(self):
+        """Liveness probe payload: lifecycle state, breaker, in-flight
+        count, error rate over the metrics window, warm flag, watchdog
+        orphans. Always answers (a wedged executor is the watchdog's
+        problem, not the probe's)."""
+        from ..resilience.retry import watchdog_orphans
+
+        snap = self.metrics.snapshot()
+        with self._quiesce:
+            draining = self._draining
+            inflight = self._inflight
+        requests = snap["requests"]
+        return {
+            "state": "draining" if draining else "serving",
+            "ready": self.ready(),
+            "warm": self._warm_signatures is not None,
+            "inflight": inflight,
+            "breaker": self.breaker.snapshot(),
+            "error_rate": (snap["errors"] / requests) if requests else 0.0,
+            "rejects": snap["rejects"],
+            "sheds": snap["sheds"],
+            "deadline_expired": snap["deadline_expired"],
+            "watchdog_orphans": watchdog_orphans(),
+        }
+
+    def ready(self):
+        """Readiness probe: warm (lattice compiled + frozen), admitting
+        (not draining), and the breaker is not open. A False here is the
+        load balancer's cue to route around this replica."""
+        with self._quiesce:
+            if self._draining:
+                return False
+        return (self._warm_signatures is not None
+                and self.breaker.state != "open")
 
 
 def _resize_seq(arr, seq, pad_value):

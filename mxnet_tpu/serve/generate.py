@@ -31,6 +31,7 @@ from .. import random as _rng
 from ..base import MXNetError
 from ..gluon.block import HybridBlock
 from ..ops import nn as _ops
+from ..resilience import faults as _faults
 from .engine import InferenceSession, pick_bucket
 
 
@@ -250,7 +251,12 @@ class Generator:
     def decode_step(self, tokens, positions, cache):
         """One T=1 decode step: ``tokens`` (B,) the just-sampled ids,
         ``positions`` (B,) their absolute positions. Returns the next
-        (B, vocab) logits and the updated cache."""
+        (B, vocab) logits and the updated cache. The ``serve:decode``
+        fault site fires once per step, so the chaos harness can kill a
+        generation stream mid-decode (distinct from ``serve:execute``,
+        which also covers prefill)."""
+        _faults.fault_point("serve:decode",
+                            {"session": self.session.name})
         toks = _onp.asarray(tokens, _onp.int32).reshape(-1, 1)
         zeros = _onp.zeros(len(toks), _onp.int32)
         return self._run(toks, _onp.asarray(positions, _onp.int32),
@@ -273,11 +279,22 @@ class Generator:
         return toks, full_lens, b_bucket
 
     def generate(self, prompts, max_new_tokens=32, temperature=0.0,
-                 top_k=None, stop_ids=()):
+                 top_k=None, stop_ids=(), deadlines=None):
         """Generate continuations for a batch of prompts (lists of ids).
 
+        ``deadlines`` (optional) carries absolute ``time.monotonic()``
+        deadlines — one scalar for the whole batch or one per prompt. A
+        row whose deadline passes is **retired between decode steps**: it
+        stops consuming decode work, keeps the tokens generated so far,
+        and lands in ``info["deadline_expired"]`` so the serving layer can
+        settle its future with :class:`~.engine.DeadlineExceeded` instead
+        of delivering late. When every live row has expired the whole
+        decode loop exits early. ``None`` (default) checks nothing — the
+        original semantics, bitwise included.
+
         Returns ``(outputs, info)``: per-prompt generated id lists (stop
-        token excluded) and a stats dict (tokens/s, per-phase wall time).
+        token excluded) and a stats dict (tokens/s, per-phase wall time,
+        expired row indices).
         """
         t_start = time.perf_counter()
         toks, lens, b_bucket = self._pad_prompts(prompts)
@@ -287,12 +304,22 @@ class Generator:
             raise MXNetError(
                 f"prompt ({int(lens.max())}) + max_new_tokens ({max_new}) "
                 f"exceeds max_seq ({self.max_seq})")
+        if deadlines is not None:
+            try:
+                deadlines = [float(d) for d in deadlines]
+            except TypeError:
+                deadlines = [float(deadlines)] * n_real
+            if len(deadlines) != n_real:
+                raise MXNetError(
+                    f"generate() got {len(deadlines)} deadlines for "
+                    f"{n_real} prompts")
         cache = self._fresh_cache(b_bucket)
         logits, cache = self.prefill(toks, lens, cache)
         t_prefill = time.perf_counter()
 
         out = [[] for _ in range(n_real)]
         stopped = [False] * n_real
+        expired = [False] * n_real
         positions = lens.copy()  # next write position per row
         stop = set(int(s) for s in stop_ids)
         n_decoded = 0
@@ -307,6 +334,16 @@ class Generator:
                     stopped[i] = True
                 else:
                     out[i].append(tid)
+            if deadlines is not None:
+                # retire expired rows at the step boundary: their decode
+                # budget is spent — burning further T=1 passes for output
+                # nobody will read is the overload failure mode
+                now = time.monotonic()
+                for i in range(n_real):
+                    if not stopped[i] and now >= deadlines[i]:
+                        stopped[i] = True
+                        expired[i] = True
+                        self.metrics.observe_deadline("decode")
             if all(stopped) or step == max_new - 1:
                 # the last sampled token needs no successor logits —
                 # running decode_step here would be a discarded T=1 pass
@@ -324,6 +361,7 @@ class Generator:
             "decode_steps": n_decoded,
             "tokens_s": n_tokens / decode_s if decode_s > 0 else 0.0,
             "total_ms": (t_done - t_start) * 1e3,
+            "deadline_expired": [i for i in range(n_real) if expired[i]],
         }
         return out, info
 

@@ -12,19 +12,24 @@ stack uses, so a serve trace and a train trace read the same way.
 from __future__ import annotations
 
 import collections
+import math
 import threading
 
 from ..profiler import core as _prof
 
 
 def percentile(samples, pct):
-    """Nearest-rank percentile of an unsorted sequence (0 < pct <= 100).
-    Returns 0.0 on no samples — a dashboard-friendly zero, not a crash."""
+    """Nearest-rank percentile of an unsorted sequence (0 < pct <= 100):
+    the smallest sample such that at least ``pct`` percent of the window
+    is <= it, i.e. rank ``ceil(pct/100 * n)`` (1-based). ``round()`` would
+    banker's-round even-window ranks off by one (p50 of ``[1, 2]`` must
+    be 1, not 2). Returns 0.0 on no samples — a dashboard-friendly zero,
+    not a crash."""
     if not samples:
         return 0.0
     s = sorted(samples)
-    rank = max(0, min(len(s) - 1, int(round(pct / 100.0 * len(s))) - 1))
-    return s[rank]
+    rank = max(1, min(len(s), int(math.ceil(pct / 100.0 * len(s)))))
+    return s[rank - 1]
 
 
 class ServeMetrics:
@@ -36,10 +41,14 @@ class ServeMetrics:
 
             window = config.get("MXNET_SERVE_METRICS_WINDOW")
         self.name = name
+        self._window = int(window)
         self._lock = threading.Lock()
         self._latency_ms = collections.deque(maxlen=int(window))
         self._queue_ms = collections.deque(maxlen=int(window))
         self._exec_ms = collections.deque(maxlen=int(window))
+        # per-priority-class latency rings, materialized on first use so a
+        # priority-free deployment's snapshot stays byte-identical
+        self._class_lat = {}
         self.requests = 0
         self.errors = 0
         self.rejects = 0
@@ -49,18 +58,40 @@ class ServeMetrics:
         self.tokens = 0
         self._token_time_s = 0.0
         self.queue_depth = 0  # gauge, written by the batcher
+        # overload-safety counters (tentpole: deadline + shed + drain)
+        self.sheds = collections.Counter()             # priority -> count
+        self.deadline_expired = collections.Counter()  # stage -> count
+        self.goodput = 0          # ok completions inside their deadline
+        self.late_completions = 0  # delivered past deadline (inside grace)
+        self.rate_limited = 0
+        self.swaps = 0
 
     # -- observations -------------------------------------------------------
-    def observe_request(self, queue_ms=0.0, exec_ms=0.0, ok=True):
-        """One request completed (or failed after admission)."""
+    def observe_request(self, queue_ms=0.0, exec_ms=0.0, ok=True,
+                        priority=None, deadline_ok=True):
+        """One request completed (or failed after admission).
+        ``priority`` feeds the per-class percentile rings; ``deadline_ok``
+        False marks a completion that was delivered late (inside grace) —
+        it counts against goodput."""
         total = queue_ms + exec_ms
         with self._lock:
             self.requests += 1
             if not ok:
                 self.errors += 1
+            elif deadline_ok:
+                self.goodput += 1
+            else:
+                self.late_completions += 1
             self._latency_ms.append(total)
             self._queue_ms.append(queue_ms)
             self._exec_ms.append(exec_ms)
+            if priority is not None:
+                ring = self._class_lat.get(priority)
+                if ring is None:
+                    ring = self._class_lat.setdefault(
+                        priority,
+                        collections.deque(maxlen=self._window))
+                ring.append(total)
         if _prof.ENABLED:
             t1 = _prof.begin()
             _prof.record_duration(f"serve::request({self.name})", "serve",
@@ -89,6 +120,40 @@ class ServeMetrics:
         if _prof.ENABLED:
             _prof.record_instant(f"serve::reject({self.name})", "serve")
 
+    def observe_shed(self, priority, reason="pressure"):
+        """One request shed by the overload policy (always the lowest
+        priority class present — ``reason`` says which mechanism fired:
+        ``pressure`` for queue-displacement, ``rate`` for the token
+        bucket, ``share`` for the batch-class queue-share cap)."""
+        with self._lock:
+            self.sheds[priority] += 1
+            if reason == "rate":
+                self.rate_limited += 1
+        if _prof.ENABLED:
+            _prof.record_instant(f"serve::shed({self.name})", "serve",
+                                 args={"priority": priority,
+                                       "reason": reason})
+
+    def observe_deadline(self, stage, priority=None):
+        """One request cancelled at a stage boundary because its deadline
+        passed (``admit`` / ``queue`` / ``execute`` / ``decode``)."""
+        with self._lock:
+            self.deadline_expired[stage] += 1
+        if _prof.ENABLED:
+            _prof.record_instant(f"serve::deadline({self.name})", "serve",
+                                 args={"stage": stage,
+                                       "priority": priority})
+
+    def observe_swap(self, mode, wall_s=0.0):
+        """One model hot-swap completed (``warm`` = weights transplanted
+        into the live executables, ``cold`` = fresh compile)."""
+        with self._lock:
+            self.swaps += 1
+        if _prof.ENABLED:
+            _prof.record_instant(f"serve::swap({self.name})", "serve",
+                                 args={"mode": mode,
+                                       "wall_s": round(wall_s, 3)})
+
     def observe_tokens(self, n, dt_s):
         """``n`` tokens decoded in ``dt_s`` seconds."""
         with self._lock:
@@ -111,6 +176,18 @@ class ServeMetrics:
         return {"p50_ms": percentile(lat, 50), "p95_ms": percentile(lat, 95),
                 "p99_ms": percentile(lat, 99)}
 
+    def class_percentiles(self):
+        """Per-priority-class latency percentiles: ``{priority: {p50_ms,
+        p95_ms, p99_ms, n}}`` — the overload SLO surface (the bound is on
+        the *interactive* class, not the blended window)."""
+        with self._lock:
+            rings = {k: list(v) for k, v in self._class_lat.items()}
+        return {k: {"p50_ms": percentile(v, 50),
+                    "p95_ms": percentile(v, 95),
+                    "p99_ms": percentile(v, 99),
+                    "n": len(v)}
+                for k, v in rings.items()}
+
     def snapshot(self):
         """Full SLO readout (the dict SERVING.md documents)."""
         with self._lock:
@@ -132,7 +209,14 @@ class ServeMetrics:
                 "tokens": self.tokens,
                 "tokens_s": (self.tokens / self._token_time_s
                              if self._token_time_s > 0 else 0.0),
+                "sheds": dict(self.sheds),
+                "deadline_expired": dict(self.deadline_expired),
+                "goodput": self.goodput,
+                "late_completions": self.late_completions,
+                "rate_limited": self.rate_limited,
+                "swaps": self.swaps,
             }
+        out["class_percentiles"] = self.class_percentiles()
         out["p50_ms"] = percentile(lat, 50)
         out["p95_ms"] = percentile(lat, 95)
         out["p99_ms"] = percentile(lat, 99)

@@ -298,6 +298,148 @@ def test_breaker_unit_trip_halfopen_recover():
     assert b.state == "open" and b.trips == 3
 
 
+def test_breaker_half_open_single_probe_under_concurrency():
+    """N threads racing allow() in half-open must release EXACTLY one
+    probe — a lost race here would let a thundering herd re-hammer a
+    barely-recovered backend."""
+    import threading
+
+    b = retry.CircuitBreaker(failure_threshold=1, cooldown_calls=2)
+    b.record_failure()
+    assert b.state == "open"
+    [b.allow() for _ in range(2)]          # cooldown -> half_open
+    assert b.state == "half_open"
+
+    n = 16
+    results = [None] * n
+    barrier = threading.Barrier(n)
+
+    def racer(i):
+        barrier.wait(timeout=10)
+        results[i] = b.allow()
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert sum(1 for r in results if r) == 1, results
+    assert b.state == "half_open"
+
+
+def test_breaker_failed_probe_reopens_with_full_cooldown():
+    """A failed half-open probe re-opens the breaker AND resets the
+    cooldown count: the next half-open transition needs the full
+    cooldown_calls denials again, not a stale remainder."""
+    b = retry.CircuitBreaker(failure_threshold=1, cooldown_calls=3)
+    b.record_failure()
+    [b.allow() for _ in range(3)]
+    assert b.state == "half_open"
+    assert b.allow()                       # the probe
+    b.record_failure()                     # probe fails
+    assert b.state == "open" and b.trips == 2
+    # the cooldown starts over: exactly 3 denials before half-open
+    assert [b.allow() for _ in range(3)] == [False, False, False]
+    assert b.state == "half_open"
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_concurrent_probe_failure_race():
+    """Racers each call allow() once while half-open, and every winner
+    fails its probe concurrently with the losers' calls. Losers arriving
+    after a re-open legitimately advance the fresh cooldown, so a second
+    probe can be released — but probes are strictly serialized (never two
+    outstanding, each failed probe is a counted trip) and the breaker
+    must land coherent and heal."""
+    import threading
+
+    b = retry.CircuitBreaker(failure_threshold=1, cooldown_calls=4)
+    b.record_failure()
+    trips_before = b.trips
+    [b.allow() for _ in range(4)]
+    assert b.state == "half_open"
+    n = 8
+    results = [None] * n
+    barrier = threading.Barrier(n)
+
+    def racer(i):
+        barrier.wait(timeout=10)
+        got = b.allow()
+        results[i] = got
+        if got:
+            b.record_failure()             # the won probe fails mid-race
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    winners = sum(1 for r in results if r)
+    # 8 one-shot racers against cooldown_calls=4 can fund at most two
+    # probe windows (probe + 4 denials + probe = 6 calls); zero winners
+    # would mean the half-open slot was lost
+    assert 1 <= winners <= 2, results
+    # every released probe failed, so every one must be a counted trip —
+    # a winner the trip count doesn't see would be a lost update
+    assert b.trips == trips_before + winners
+    # each failed probe re-opened; losers' calls may have completed the
+    # next cooldown — both states are coherent outcomes, and either way
+    # the breaker must heal from here
+    assert b.state in ("open", "half_open")
+    for _ in range(8):
+        if b.allow():
+            break
+    else:
+        pytest.fail("breaker never offered a probe after re-open")
+    b.record_success()
+    assert b.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# watchdog orphan accounting
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_orphan_counted_and_retired():
+    """A timed-out watchdog body is an ORPHAN — it keeps running and can
+    still mutate state. The abandonment is counted (total), tracked while
+    alive (live), warned about, and the gauge retires when the body
+    finally finishes."""
+    release = __import__("threading").Event()
+
+    with pytest.warns(RuntimeWarning, match="orphan"):
+        with pytest.raises(retry.CollectiveTimeoutError):
+            retry.run_with_watchdog(lambda: release.wait(10), 0.05,
+                                    site="orphan-test")
+    s = retry.watchdog_orphans()
+    assert s["total"] >= 1
+    assert s["live"] >= 1
+    release.set()
+    deadline = time.time() + 5
+    while retry.watchdog_orphans()["live"] > 0:
+        assert time.time() < deadline, "orphan never retired"
+        time.sleep(0.01)
+    s2 = retry.watchdog_orphans()
+    assert s2["total"] == s["total"]       # total is monotonic
+    assert s2["live"] == 0
+
+
+def test_watchdog_orphans_exposed_in_collective_stats():
+    kv = _make_kv()
+    s = kv.collective_stats()
+    assert "watchdog_orphans" in s
+    assert set(s["watchdog_orphans"]) == {"total", "live"}
+
+
+def test_watchdog_completion_at_the_buzzer_is_not_an_orphan():
+    """A body that finishes within the timeout window is a plain success:
+    no orphan counted, result returned."""
+    before = retry.watchdog_orphans()["total"]
+    assert retry.run_with_watchdog(lambda: 42, 5.0, site="fast") == 42
+    assert retry.watchdog_orphans()["total"] == before
+
+
 def test_allreduce_breaker_trips_and_halfopen_recovers():
     """End-to-end: persistent fast-path failures trip the breaker to the
     eager fallback (no more fast-path attempts), and once the faults stop

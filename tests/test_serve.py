@@ -487,6 +487,35 @@ class TestServeMetrics:
         assert p["p95_ms"] == 95.0
         assert p["p99_ms"] == 99.0
 
+    def test_percentile_nearest_rank_table(self):
+        """Table-driven pin of ceil-based nearest-rank percentiles.
+        ``int(round(...))`` banker's rounding put even-window ranks off
+        by one (p50 of [1, 2] came out 2); the definition is rank
+        ``ceil(pct/100 * n)``, 1-based."""
+        from mxnet_tpu.serve import percentile
+
+        cases = [
+            # (samples, pct, expected)
+            ([1, 2], 50, 1),          # THE regression: round() gave 2
+            ([1, 2], 51, 2),
+            ([1, 2], 100, 2),
+            ([1, 2, 3, 4], 25, 1),    # round(1.0)=1 was right by luck
+            ([1, 2, 3, 4], 50, 2),    # round(2.0)=2 ok; ceil agrees
+            ([1, 2, 3, 4], 75, 3),
+            ([1, 2, 3, 4], 76, 4),
+            ([15, 20, 35, 40, 50], 30, 20),  # classic nearest-rank table
+            ([15, 20, 35, 40, 50], 40, 20),
+            ([15, 20, 35, 40, 50], 50, 35),
+            ([15, 20, 35, 40, 50], 100, 50),
+            ([7], 1, 7),
+            ([7], 99, 7),
+            ([3, 1, 2], 50, 2),       # unsorted input
+            ([], 99, 0.0),            # empty window -> dashboard zero
+        ]
+        for samples, pct, want in cases:
+            got = percentile(samples, pct)
+            assert got == want, (samples, pct, got, want)
+
     def test_snapshot_counts(self):
         m = ServeMetrics("t", window=8)
         m.observe_request(1.0, 2.0, ok=True)

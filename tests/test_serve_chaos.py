@@ -1,0 +1,510 @@
+"""Overload-safety conformance for ``mxnet_tpu/serve/``: deadline
+propagation across every stage boundary, priority-aware load shedding,
+graceful drain / hot swap / health probes, the close-timeout leak fix,
+and the chaos soak harness (``tools/chaos_soak.py``) as a pytest surface.
+
+The soak's acceptance invariants — exactly-once settle, no silent late
+completions, batch-class-only sheds, bounded interactive p99, clean
+drain, warm same-signature swap — run as a short smoke in tier-1 and as
+the full-length soak behind ``-m slow``.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401 — registers config flags
+from mxnet_tpu import gluon
+from mxnet_tpu.models.llama import get_llama
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.serve import (DeadlineExceeded, DynamicBatcher, Generator,
+                             InferenceSession, ServiceUnavailable,
+                             TokenBucket)
+
+from tools.chaos_soak import run_soak
+
+
+@pytest.fixture
+def no_faults():
+    yield
+    faults.clear_plan()
+
+
+def _make_classifier(out=4):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(out))
+    net.initialize()
+    return net
+
+
+def _warm_session(name, out=4):
+    net = _make_classifier(out)
+    sess = InferenceSession(net, batch_buckets=(1, 2, 4), name=name)
+    sess.warmup(np.zeros((1, 8), np.float32))
+    return net, sess
+
+
+class _BlockedRunner:
+    """A runner wedged on an event — the queue backs up behind it."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = []
+
+    def __call__(self, batch):
+        self.release.wait(10)
+        self.calls.append(len(batch))
+        return list(batch)
+
+
+def _wait_until(cond, timeout=5.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, msg
+        time.sleep(0.002)
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation: cancelled at every stage boundary
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlinePropagation:
+    def test_expired_at_admission_rejects_synchronously(self):
+        with DynamicBatcher(lambda b: b, max_batch_size=2, timeout_ms=5.0,
+                            max_queue=8, name="adm") as b:
+            with pytest.raises(DeadlineExceeded, match="before admission"):
+                b.submit("x", deadline_ms=1e-6)
+        assert b.metrics.deadline_expired == {"admit": 1}
+
+    def test_expired_in_queue_settles_504(self):
+        """A queued request whose deadline passes is swept out and its
+        future settles with DeadlineExceeded — the flusher wakes for the
+        nearest deadline, not just the batch-assembly timeout."""
+        with DynamicBatcher(lambda b: b, max_batch_size=8,
+                            timeout_ms=10_000.0, max_queue=8,
+                            name="qexp") as b:
+            f = b.submit("x", deadline_ms=40.0)
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded, match="in queue"):
+                f.result(timeout=5)
+            # swept near the deadline, not at the 10s batch timeout
+            assert time.monotonic() - t0 < 2.0
+        assert b.metrics.deadline_expired == {"queue": 1}
+        assert b.queue_depth() == 0
+
+    def test_completion_past_deadline_plus_grace_is_504(self):
+        """The client's budget ran out mid-execution: the result is
+        discarded and the future carries a 504, never a silent late
+        delivery."""
+        def slow_runner(batch):
+            time.sleep(0.12)
+            return list(batch)
+
+        with DynamicBatcher(slow_runner, max_batch_size=1, timeout_ms=0.0,
+                            max_queue=8, name="late") as b:
+            assert b.deadline_grace_s == 0.0  # default: no grace
+            f = b.submit("x", deadline_ms=30.0)
+            with pytest.raises(DeadlineExceeded, match="past deadline"):
+                f.result(timeout=5)
+        assert b.metrics.deadline_expired == {"execute": 1}
+
+    def test_completion_within_grace_is_delivered_but_counted_late(self):
+        def slow_runner(batch):
+            time.sleep(0.08)
+            return list(batch)
+
+        with DynamicBatcher(slow_runner, max_batch_size=1, timeout_ms=0.0,
+                            max_queue=8, name="grace") as b:
+            b.deadline_grace_s = 10.0
+            f = b.submit("x", deadline_ms=20.0)
+            assert f.result(timeout=5) == "x"  # delivered...
+        assert b.metrics.late_completions == 1  # ...but not goodput
+        assert b.metrics.goodput == 0
+        assert b.metrics.deadline_expired == {}
+
+    def test_no_deadline_means_no_checks(self):
+        """Off-by-default: a deadline-free submit never sees deadline
+        machinery — original semantics, and every on-time completion is
+        goodput."""
+        with DynamicBatcher(lambda b: b, max_batch_size=2, timeout_ms=2.0,
+                            max_queue=8, name="nodl") as b:
+            assert b.submit("x").result(timeout=5) == "x"
+        assert b.metrics.deadline_expired == {}
+        assert b.metrics.late_completions == 0
+        assert b.metrics.goodput == 1
+
+    def test_decode_retires_expired_row_mid_stream(self):
+        """A generation row whose deadline passes is retired between
+        decode steps (keeps its partial output, stops burning T=1 passes)
+        while live rows decode to completion."""
+        net = get_llama("llama_tiny_test")
+        net.initialize()
+        gen = Generator(net, max_seq=32, batch_buckets=(2,),
+                        prompt_buckets=(8,), name="dl_decode")
+        now = time.monotonic()
+        outs, info = gen.generate([[3, 5, 7], [9, 2]], max_new_tokens=6,
+                                  deadlines=[now, now + 60.0])
+        assert info["deadline_expired"] == [0]
+        assert len(outs[1]) == 6  # the live row is unaffected
+        assert gen.metrics.deadline_expired["decode"] >= 1
+
+    def test_decode_without_deadlines_is_unchanged(self):
+        net = get_llama("llama_tiny_test")
+        net.initialize()
+        gen = Generator(net, max_seq=32, batch_buckets=(1,),
+                        prompt_buckets=(8,), name="nodl_decode")
+        outs, info = gen.generate([[3, 5, 7]], max_new_tokens=4)
+        assert info["deadline_expired"] == []
+        assert len(outs[0]) == 4
+
+
+# ---------------------------------------------------------------------------
+# Priority-aware load shedding
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityShedding:
+    def test_interactive_displaces_newest_batch_request(self):
+        runner = _BlockedRunner()
+        b = DynamicBatcher(runner, max_batch_size=1, timeout_ms=0.0,
+                           max_queue=2, name="shed")
+        try:
+            first = b.submit(0, priority="batch")   # goes in flight
+            _wait_until(lambda: b.queue_depth() == 0)
+            b1 = b.submit(1, priority="batch")
+            b2 = b.submit(2, priority="batch")      # queue now full
+            hi = b.submit(3, priority="interactive")
+            # the NEWEST batch request was shed to admit the interactive
+            with pytest.raises(ServiceUnavailable, match="shed under"):
+                b2.result(timeout=5)
+            assert not b1.done()
+            runner.release.set()
+            assert first.result(timeout=5) == 0
+            assert b1.result(timeout=5) == 1
+            assert hi.result(timeout=5) == 3
+        finally:
+            runner.release.set()
+            b.close()
+        assert dict(b.metrics.sheds) == {"batch": 1}
+
+    def test_full_queue_of_equal_priority_rejects(self):
+        runner = _BlockedRunner()
+        b = DynamicBatcher(runner, max_batch_size=1, timeout_ms=0.0,
+                           max_queue=1, name="eqfull")
+        try:
+            b.submit(0, priority="interactive")
+            _wait_until(lambda: b.queue_depth() == 0)
+            b.submit(1, priority="interactive")     # fills the queue
+            # no lower-priority victim -> even interactive rejects
+            with pytest.raises(ServiceUnavailable, match="queue is full"):
+                b.submit(2, priority="interactive")
+            with pytest.raises(ServiceUnavailable, match="queue is full"):
+                b.submit(3, priority="batch")
+        finally:
+            runner.release.set()
+            b.close()
+        assert b.metrics.sheds.get("interactive", 0) == 0
+
+    def test_batch_queue_share_cap_shed(self):
+        runner = _BlockedRunner()
+        b = DynamicBatcher(runner, max_batch_size=1, timeout_ms=0.0,
+                           max_queue=8, name="share")
+        b.batch_queue_cap = 1
+        try:
+            b.submit(0, priority="interactive")
+            _wait_until(lambda: b.queue_depth() == 0)
+            b.submit(1, priority="batch")           # within the share
+            with pytest.raises(ServiceUnavailable, match="queue share"):
+                b.submit(2, priority="batch")
+            # interactive traffic still finds headroom
+            b.submit(3, priority="interactive")
+        finally:
+            runner.release.set()
+            b.close()
+        assert dict(b.metrics.sheds) == {"batch": 1}
+
+    def test_token_bucket_rate_limits_batch_only(self):
+        with DynamicBatcher(lambda b: b, max_batch_size=4, timeout_ms=2.0,
+                            max_queue=16, name="rate") as b:
+            b.rate_limiter = TokenBucket(rate=1.0, burst=1.0)
+            assert b.submit("b0", priority="batch").result(timeout=5) == "b0"
+            with pytest.raises(ServiceUnavailable, match="token bucket"):
+                b.submit("b1", priority="batch")
+            # interactive is never rate-limited
+            f = b.submit("i0", priority="interactive")
+            assert f.result(timeout=5) == "i0"
+        assert b.metrics.rate_limited == 1
+        assert dict(b.metrics.sheds) == {"batch": 1}
+
+    def test_token_bucket_refills(self):
+        tb = TokenBucket(rate=10.0, burst=1.0)
+        assert tb.take()
+        assert not tb.take()
+        time.sleep(0.25)
+        assert tb.take()  # ~2.5 tokens refilled, capped at burst=1
+
+    def test_unknown_priority_rejected_loudly(self):
+        with DynamicBatcher(lambda b: b, max_batch_size=2,
+                            timeout_ms=2.0, name="prio") as b:
+            with pytest.raises(Exception, match="unknown priority"):
+                b.submit("x", priority="urgent")
+
+    def test_batches_assemble_interactive_first(self):
+        """When a mixed queue flushes, interactive requests occupy the
+        batch slots first; overflow batch-class work waits."""
+        runner = _BlockedRunner()
+        b = DynamicBatcher(runner, max_batch_size=2, timeout_ms=0.0,
+                           max_queue=8, name="order")
+        try:
+            b.submit(0, priority="batch")  # alone -> in flight first
+            _wait_until(lambda: b.queue_depth() == 0)
+            lo = b.submit("lo", priority="batch")
+            hi1 = b.submit("hi1", priority="interactive")
+            hi2 = b.submit("hi2", priority="interactive")
+            runner.release.set()
+            assert hi1.result(timeout=5) == "hi1"
+            assert hi2.result(timeout=5) == "hi2"
+            assert lo.result(timeout=5) == "lo"
+            # flush 2 was the two interactive requests, not FIFO order
+            assert runner.calls[1] == 2
+        finally:
+            runner.release.set()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# serve:queue fault site
+# ---------------------------------------------------------------------------
+
+
+class TestQueueFaultSite:
+    def test_injected_admission_fault_surfaces_synchronously(self, no_faults):
+        faults.install_plan({"seed": 0, "rules": [
+            {"site": "serve:queue", "kind": "transient", "at": [0]}]})
+        with DynamicBatcher(lambda b: b, max_batch_size=2,
+                            timeout_ms=2.0, name="qfault") as b:
+            with pytest.raises(Exception, match="[Ii]njected"):
+                b.submit("x")
+            faults.clear_plan()
+            assert b.submit("y").result(timeout=5) == "y"
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain / hot swap / health probes
+# ---------------------------------------------------------------------------
+
+
+class TestDrainSwapHealth:
+    def test_batcher_drain_settles_everything_then_blocks_admission(self):
+        done = []
+
+        def runner(batch):
+            time.sleep(0.01)
+            done.append(len(batch))
+            return list(batch)
+
+        b = DynamicBatcher(runner, max_batch_size=4, timeout_ms=50.0,
+                           max_queue=32, name="drain")
+        try:
+            futs = [b.submit(i) for i in range(6)]
+            assert b.drain(timeout=10)
+            assert b.queue_depth() == 0
+            assert all(f.done() for f in futs)
+            assert [f.result() for f in futs] == list(range(6))
+            with pytest.raises(ServiceUnavailable, match="draining"):
+                b.submit("late")
+            b.resume()
+            assert b.submit("after").result(timeout=5) == "after"
+        finally:
+            b.close()
+
+    def test_drain_wakes_fast_when_sweep_empties_queue(self):
+        """A queue emptied by the expired-deadline sweep must wake
+        drain() immediately, not leave it sleeping to its timeout."""
+        runner = _BlockedRunner()
+        b = DynamicBatcher(runner, max_batch_size=1, timeout_ms=0.0,
+                           max_queue=8, name="sweepdrain")
+        try:
+            b.submit(0)                         # dispatches, wedges
+            _wait_until(lambda: b.queue_depth() == 0)
+            f = b.submit(1, deadline_ms=30.0)   # queued behind the wedge
+            t0 = time.monotonic()
+            done = []
+            waiter = threading.Thread(
+                target=lambda: done.append(b.drain(timeout=30.0)),
+                daemon=True)
+            waiter.start()
+            time.sleep(0.1)                     # let the sweep fire
+            runner.release.set()                # settle the wedged batch
+            waiter.join(10)
+            assert done == [True]
+            assert time.monotonic() - t0 < 5.0  # NOT the 30s timeout
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=1)
+        finally:
+            runner.release.set()
+            b.close()
+
+    def test_session_drain_blocks_and_resume_reopens(self):
+        _, sess = _warm_session("sdrain")
+        x = np.zeros((1, 8), np.float32)
+        assert sess.drain(timeout=5)
+        assert sess.health()["state"] == "draining"
+        assert not sess.ready()
+        with pytest.raises(ServiceUnavailable, match="draining"):
+            sess.predict(x)
+        sess.resume()
+        assert sess.ready()
+        assert sess.predict(x).shape == (1, 4)
+        sess.assert_no_recompiles()
+
+    def test_warm_swap_same_signature_zero_recompiles(self):
+        _, sess = _warm_session("wswap")
+        net2 = _make_classifier()
+        x = np.ones((2, 8), np.float32)
+        from mxnet_tpu import autograd
+        from mxnet_tpu import numpy as mnp
+
+        with autograd.predict_mode():
+            ref2 = net2(mnp.array(x)).asnumpy()
+        assert sess.swap(net2, example=np.zeros((1, 8), np.float32)) \
+            == "warm"
+        # the swapped weights serve through the ORIGINAL executables
+        np.testing.assert_allclose(sess.predict(x).asnumpy(), ref2,
+                                   rtol=1e-5, atol=1e-6)
+        sess.assert_no_recompiles()
+        assert sess.ready()
+        assert sess.metrics.swaps == 1
+
+    def test_cold_swap_different_architecture_rewarms(self):
+        _, sess = _warm_session("cswap")
+        net2 = _make_classifier(out=7)  # different output width
+        assert sess.swap(net2, example=np.zeros((1, 8), np.float32)) \
+            == "cold"
+        assert sess.ready()  # example given -> re-warmed + frozen
+        assert sess.predict(np.zeros((2, 8), np.float32)).shape == (2, 7)
+        sess.assert_no_recompiles()
+
+    def test_swap_timeout_aborts_and_keeps_old_model(self, no_faults):
+        _, sess = _warm_session("tswap")
+        x = np.zeros((1, 8), np.float32)
+        faults.install_plan({"seed": 0, "rules": [
+            {"site": "serve:execute", "kind": "delay", "seconds": 0.6,
+             "times": 1}]})
+        slow = threading.Thread(target=lambda: sess.predict(x),
+                                daemon=True)
+        slow.start()
+        _wait_until(lambda: sess.health()["inflight"] > 0)
+        with pytest.raises(ServiceUnavailable, match="swap aborted"):
+            sess.swap(_make_classifier(), timeout=0.05)
+        slow.join(10)
+        # admission was resumed: the OLD model still serves
+        assert sess.predict(x).shape == (1, 4)
+        sess.assert_no_recompiles()
+
+    def test_health_ready_contract(self):
+        net = _make_classifier()
+        sess = InferenceSession(net, batch_buckets=(1,), name="probe")
+        h = sess.health()
+        assert {"state", "ready", "warm", "inflight", "breaker",
+                "error_rate", "watchdog_orphans"} <= set(h)
+        assert not sess.ready()            # not warmed yet
+        sess.warmup(np.zeros((1, 8), np.float32))
+        assert sess.ready()
+        for _ in range(sess.breaker.failure_threshold):
+            sess.breaker.record_failure()
+        assert sess.breaker.state == "open"
+        assert not sess.ready()            # breaker open -> route around
+        sess.breaker.record_success()
+        assert sess.ready()
+
+
+# ---------------------------------------------------------------------------
+# close(timeout) leak fix (satellite): wedged runner, no stranded futures
+# ---------------------------------------------------------------------------
+
+
+class TestCloseTimeout:
+    def test_close_with_wedged_runner_fails_futures_503(self):
+        runner = _BlockedRunner()
+        b = DynamicBatcher(runner, max_batch_size=1, timeout_ms=0.0,
+                           max_queue=8, name="wedge")
+        inflight = b.submit("inflight")
+        _wait_until(lambda: b.queue_depth() == 0)  # it reached the runner
+        queued = b.submit("queued")
+        with pytest.warns(RuntimeWarning, match="wedged"):
+            b.close(timeout=0.3)
+        # BOTH the wedged batch's future and the queued one fail fast
+        # with 503 — before this fix they hung forever
+        for f in (inflight, queued):
+            with pytest.raises(ServiceUnavailable, match="shut down"):
+                f.result(timeout=1)
+        # the runner eventually un-wedges: its settle attempt must be
+        # dropped (exactly-once), and the flusher thread must exit
+        runner.release.set()
+        _wait_until(lambda: not b._thread.is_alive(), timeout=10,
+                    msg="flusher never exited after un-wedge")
+        with pytest.raises(ServiceUnavailable, match="shut down"):
+            inflight.result(timeout=1)  # still the 503, not the result
+
+    def test_clean_close_needs_no_timeout_path(self):
+        import warnings
+
+        with warnings.catch_warnings(record=True) as record:
+            warnings.simplefilter("always")
+            b = DynamicBatcher(lambda b: b, max_batch_size=2,
+                               timeout_ms=2.0, name="clean")
+            f = b.submit("x")
+            assert f.result(timeout=5) == "x"
+            b.close(timeout=5)
+        assert not any(isinstance(w.message, RuntimeWarning)
+                       for w in record)
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak: the acceptance invariants, smoke in tier-1, full behind slow
+# ---------------------------------------------------------------------------
+
+
+def _assert_soak_invariants(report):
+    assert report["ok"], "\n".join(report["violations"])
+    assert report["outcomes"]["unexpected"] == 0
+    assert report["outcomes"]["ok"] > 0
+    # exactly-once settle: the client books balance
+    assert sum(report["outcomes"].values()) >= report["admitted"]
+    assert report["late_completions_client"] == 0
+    assert all(k == "batch" for k in report["sheds"])
+    assert report["interactive_p99_ms"] <= report["p99_bound_ms"]
+    assert report["swap_mode"] == "warm"
+    assert report["faults_fired"] > 0  # chaos actually happened
+
+
+class TestChaosSoak:
+    def test_soak_smoke_64_clients(self):
+        """~3s of 64 concurrent mixed-priority clients under the seeded
+        fault plan: every acceptance invariant, tier-1 sized."""
+        report = run_soak(duration_s=2.5, clients=64, seed=11,
+                          decode=False, verbose=False)
+        _assert_soak_invariants(report)
+
+    @pytest.mark.slow
+    def test_soak_full_with_decode_leg(self):
+        """The full-length soak: more clients, longer duration, plus the
+        Generator/serve:decode leg with mid-decode deadline retirement."""
+        report = run_soak(duration_s=20.0, clients=96, seed=7,
+                          decode=True, verbose=False)
+        _assert_soak_invariants(report)
+        assert report["decode"]["faulted"] > 0
+        assert report["decode"]["expired_rows"] == 1
+
+    @pytest.mark.slow
+    def test_soak_seed_sweep(self):
+        """Different seeds fire different fault schedules; the invariants
+        are seed-independent."""
+        for seed in (1, 23):
+            report = run_soak(duration_s=6.0, clients=64, seed=seed,
+                              decode=False, verbose=False)
+            _assert_soak_invariants(report)

@@ -55,4 +55,17 @@ if [[ "${TIER1_SERVE:-1}" != "0" ]]; then
         rc=$serve_rc
     fi
 fi
+# Chaos soak smoke (TIER1_CHAOS=0 to skip): ~15s of 64 concurrent
+# mixed-priority clients under a seeded fault plan — asserts exactly-once
+# future settlement, no silent late completions, batch-class-only sheds,
+# bounded interactive p99, clean drain, and a warm (zero-recompile) hot
+# swap. The full soak lives in tests/test_serve_chaos.py behind -m slow.
+if [[ "${TIER1_CHAOS:-1}" != "0" ]]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python tools/chaos_soak.py --duration "${TIER1_CHAOS_S:-6}" --clients 64
+    chaos_rc=$?
+    if [[ "$rc" -eq 0 && "$chaos_rc" -ne 0 ]]; then
+        rc=$chaos_rc
+    fi
+fi
 exit "$rc"
