@@ -1049,6 +1049,32 @@ def bench_guardrail_overhead():
     })
 
 
+def bench_elastic_resume():
+    """MULTICHIP elastic row (resilience.elastic): a dp8 training run on
+    the 8-device mesh killed mid-step by an injected chip_loss, resumed
+    at dp4 from its own sharded checkpoint. Reports the recovery
+    wall-time (MeshDegraded catch → mesh shrink → kvstore rebind →
+    reshard-on-resume restore) and the steps lost to the kill; the
+    bitwise dp4-reference parity check runs inside the leg and fails the
+    row loudly on any divergence."""
+    from tools.elastic_soak import run_kill_reshard
+
+    violations, row = run_kill_reshard(seed=7, n_batches=12)
+    if violations:
+        raise RuntimeError(f"elastic kill-and-reshard violated: "
+                           f"{violations}")
+    return _emit({
+        "metric": "elastic_kill_reshard_recovery_ms",
+        "value": round(row["recovery_wall_s"] * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": None,
+        "steps_lost": row["steps_lost"],
+        "dp": f"{row['dp_from']}->{row['dp_to']}",
+        "killed_replica": row["killed_replica"],
+        "parity": "bitwise",
+    })
+
+
 def bench_llama_decode(max_new=32, n_requests=16):
     """Serving row (mxnet_tpu.serve): bucketed KV-cache autoregressive
     decode on the 12L llama serve config. Reports ``decode_tokens_s``
@@ -1146,6 +1172,7 @@ def main():
                      ("infer_pallas_fused", bench_resnet_infer_pallas_fused),
                      ("bandwidth", bench_bandwidth),
                      ("guardrail_overhead", bench_guardrail_overhead),
+                     ("elastic_resume", bench_elastic_resume),
                      ("lenet_eager", bench_lenet_eager),
                      ("lenet_eager_bulk16", bench_lenet_eager_bulk),
                      ("bert", bench_bert_train),

@@ -264,6 +264,38 @@ register_flag(
     "Token-bucket capacity for MXNET_SERVE_RATE_LIMIT: the batch-class "
     "burst admitted from an idle bucket before the rate applies.", int)
 register_flag(
+    "MXNET_ELASTIC", False,
+    "Elastic multichip training (resilience.elastic): dist_tpu classifies "
+    "collective failures that look like a LOST DEVICE GROUP (injected "
+    "chip_loss, dead-peer runtime errors) as MeshDegraded instead of "
+    "degrading to the eager fallback, so an ElasticTrainingHandler can "
+    "shrink the mesh and resume from a sharded checkpoint. Off (default): "
+    "every failure keeps the PR-2 degrade/retry semantics bitwise.", _bool)
+register_flag(
+    "MXNET_ELASTIC_MAX_RESTARTS", 2,
+    "Mesh-loss restarts an ElasticTrainingHandler absorbs before "
+    "re-raising MeshDegraded (a mesh shedding chips repeatedly is a "
+    "hardware incident, not a recoverable blip).", int)
+register_flag(
+    "MXNET_ELASTIC_MIN_REPLICAS", 1,
+    "Fewest surviving data-parallel replicas an elastic restart will "
+    "resume on; fewer survivors re-raises MeshDegraded.", int)
+register_flag(
+    "MXNET_DESYNC_CHECK_STEPS", 0,
+    "Cadence (in batches) of the cross-replica parameter-fingerprint "
+    "desync audit (resilience.elastic.DesyncAuditHandler). 0 (default) "
+    "disables the audit — one int compare per batch.", int)
+register_flag(
+    "MXNET_DESYNC_MAX_RESYNCS", 2,
+    "Resync-from-peer repairs the desync audit performs before "
+    "escalating to rewind (then DivergenceError).", int)
+register_flag(
+    "MXNET_STRAGGLER_THRESHOLD_MS", 0.0,
+    "Per-replica collective-arrival-lag EWMA (ms) above which the "
+    "straggler monitor flags a replica (resilience.stragglers counter + "
+    "rate-limited warning). 0 (default): tracking-only, never flags.",
+    float)
+register_flag(
     "MXNET_LOSS_SCALE_MIN", 1.0,
     "Lower clamp for the dynamic LossScaler (amp.py): repeated overflows "
     "can never drive the scale to 0.", float)

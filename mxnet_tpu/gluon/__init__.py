@@ -3,7 +3,7 @@ from __future__ import annotations
 
 from . import loss, metric, nn, utils
 from .block import Block, HybridBlock, SymbolBlock
-from .parameter import Constant, Parameter, ParameterDict
+from .parameter import Constant, Parameter, ParameterDict, replica_context
 from .trainer import Trainer
 
 

@@ -15,6 +15,7 @@ TPU redesign notes:
 """
 from __future__ import annotations
 
+import threading as _threading
 from collections import OrderedDict
 
 import numpy as _onp
@@ -27,6 +28,42 @@ from ..ndarray.ndarray import NDArray
 
 class DeferredInitializationError(MXNetError):
     """Parameter accessed before its shape is fully known."""
+
+
+_REPLICA = _threading.local()
+
+
+class replica_context:
+    """``with replica_context(ctx):`` — within the scope, ``p.data()`` /
+    ``p.grad()`` with no explicit context resolve to the replica on
+    ``ctx`` (when the parameter has one) instead of the first replica.
+
+    This is the reference's per-device forward convention (classic gluon
+    blocks call ``param.data(x.context)``) expressed as a scope, so every
+    existing ``p.data()`` call site — Dense/Conv forwards, the v1
+    ``hybrid_forward`` binding — becomes replica-aware without threading
+    a context argument through each one. The elastic data-parallel batch
+    processor (``resilience.elastic``) wraps each per-replica
+    forward/backward in one. Zero cost outside a scope beyond a
+    thread-local attribute probe; parameters without a replica on ``ctx``
+    fall back to their first replica unchanged."""
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_REPLICA, "ctx", None)
+        _REPLICA.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _REPLICA.ctx = self._prev
+        return False
+
+
+def _active_replica_ctx():
+    return getattr(_REPLICA, "ctx", None)
 
 
 def _shape_complete(shape):
@@ -216,6 +253,9 @@ class Parameter:
                     "data; re-initialize with force_reinit=True to train")
         self._check_initialized(ctx)
         if ctx is None:
+            act = _active_replica_ctx()
+            if act is not None and act in self._data:
+                return self._data[act]
             return next(iter(self._data.values()))
         return self._data[ctx]
 
@@ -228,6 +268,9 @@ class Parameter:
             raise MXNetError(
                 f"Parameter {self._name} has no gradient (grad_req={self._grad_req!r})")
         if ctx is None:
+            act = _active_replica_ctx()
+            if act is not None and act in self._grad:
+                return self._grad[act]
             return next(iter(self._grad.values()))
         return self._grad[ctx]
 

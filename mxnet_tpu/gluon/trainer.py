@@ -136,6 +136,19 @@ class Trainer:
         self._init_kvstore()
         return self._kvstore
 
+    def rebind_kvstore(self, kvstore):
+        """Swap the gradient-reduction backend mid-run (elastic restart:
+        the old store's mesh lost a device group; the new store was built
+        on the surviving mesh). The optimizer, states, and step count are
+        untouched — only the reduction path changes."""
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "rebind_kvstore is not supported with update_on_kvstore "
+                "(the optimizer state lives on the store being replaced)")
+        self._kvstore = kvstore
+        self._kvstore_type = kvstore
+        self._kv_initialized = True
+
     # -- state ------------------------------------------------------------
     def _init_states(self):
         if self._states is None:
@@ -214,10 +227,12 @@ class Trainer:
             self._apply_global_clip(scale_factor=cur_scale)
             # fold the unscale into the fused update's single multiply
             self._update(batch_size * cur_scale, ignore_stale_grad)
+            self._check_param_faults()
             return
         self._allreduce_grads()
         self._apply_global_clip()
         self._update(batch_size, ignore_stale_grad)
+        self._check_param_faults()
 
     def _apply_global_clip(self, scale_factor=1.0):
         if self._clip_global_norm is None:
@@ -248,6 +263,31 @@ class Trainer:
         for p in self._params:
             for g in p.list_grad():
                 g._set_data_internal(jnp.full_like(g._data, jnp.nan))
+
+    def _check_param_faults(self):
+        """Evaluate the ``trainer:param`` fault site after the optimizer
+        update: a matching ``param_corrupt`` rule perturbs ONE replica's
+        parameter copies — finite but drifted, the silent single-replica
+        divergence the desync audit (``resilience.elastic``) exists to
+        catch. No plan installed: one slot test per step."""
+        flt = _FAULTS
+        if flt is None:
+            return
+        mk = flt.check("trainer:param", {"step": self._step_count})
+        if isinstance(mk, dict) and mk.get("kind") == "param_corrupt":
+            self._corrupt_replica(int(mk.get("replica", 0)))
+
+    def _corrupt_replica(self, replica):
+        """Drift replica ``replica``'s parameter copies by a small finite
+        perturbation (×(1+2^-10)+2^-10): large enough that a parameter
+        fingerprint can never collide, small enough that training stays
+        finite until the audit catches it."""
+        for p in self._params:
+            datas = p.list_data()
+            if replica >= len(datas):
+                continue
+            d = datas[replica]
+            d._set_data_internal(d._data * (1.0 + 2.0 ** -10) + 2.0 ** -10)
 
     def allreduce_grads(self):
         self._init_kvstore()
@@ -284,6 +324,16 @@ class Trainer:
 
         sparse_is = {i for i, p in enumerate(self._params)
                      if isinstance(p.grad(), RowSparseNDArray)}
+        # data-parallel replica count: >1 when parameters were initialized
+        # on a context LIST (one replica per device). Every replica must
+        # be stepped — updating only replica 0 would silently desync the
+        # mesh (exactly the drift the desync audit exists to catch).
+        n_rep = max((len(p._data) for p in self._params), default=1)
+        if n_rep > 1 and any(len(p._data) != n_rep for p in self._params):
+            raise MXNetError(
+                "multi-replica update: parameters carry inconsistent "
+                f"replica counts {[len(p._data) for p in self._params]} — "
+                "initialize every parameter on the same context list")
         if sparse_is:
             # row-sparse grads take the per-param lazy path (reading them
             # through the fused jit would densify); dense params continue
@@ -307,6 +357,12 @@ class Trainer:
         fused_safe = getattr(opt, "fused_safe", True) and not (
             opt.multi_precision
             and any(p.dtype == _onp.float16 for p in self._params))
+        if n_rep > 1 and (sparse_is or not fused_safe):
+            raise MXNetError(
+                "multi-replica (data-parallel context list) training is "
+                "only supported through the fused dense update path; "
+                "sparse grads or fused_safe=False optimizers would update "
+                "replica 0 only and silently desync the others")
         if not fused_safe:
             # eager per-param path (reference semantics; needed for
             # optimizers with python-side state or per-step RNG). The
@@ -360,16 +416,77 @@ class Trainer:
             opt._index_update_count[i] = t
         pdatas = [self._params[i].data()._data for i in dense_is]
         gdatas = [self._params[i].grad()._data for i in dense_is]
-        sdatas = [tuple(s._data for s in _flatten_state(self._states[i]))
-                  for i in dense_is]
+
+        if n_rep > 1:
+            # an elastic restart can re-home replica 0 onto a different
+            # device than the states were created on (the killed chip
+            # WAS device 0): migrate each single-device state buffer to
+            # its param's device — jit refuses mixed placements. Same
+            # -device (the steady state) is an identity; the single
+            # -replica path below never pays this scan.
+            def _colocated_state(i, pd):
+                out = []
+                for s in _flatten_state(self._states[i]):
+                    d = s._data
+                    try:
+                        devs = d.devices()
+                    except AttributeError:
+                        devs = None
+                    if devs is not None and len(devs) == 1 \
+                            and pd is not None \
+                            and next(iter(devs)) != pd:
+                        import jax as _jx0
+
+                        d = _jx0.device_put(d, pd)
+                    out.append(d)
+                return tuple(out)
+
+            pdevs = [next(iter(pd.devices())) if len(pd.devices()) == 1
+                     else None for pd in pdatas]
+            sdatas = [_colocated_state(i, pdev)
+                      for i, pdev in zip(dense_is, pdevs)]
+        else:
+            sdatas = [tuple(s._data
+                            for s in _flatten_state(self._states[i]))
+                      for i in dense_is]
         lrs = [opt._get_lr(i) for i in dense_is]
         wds = [opt._get_wd(i) for i in dense_is]
+        # replicas 1..R-1 step through the SAME fused executable on their
+        # own devices with their own (identical, post-allreduce) grads —
+        # the classic per-device update, so replicas stay bitwise in sync
+        # and a corrupted replica drifts honestly instead of being
+        # papered over by a broadcast. Inputs are staged BEFORE the
+        # replica-0 call: that call donates the state buffers, and the
+        # other replicas need the PRE-update state values (each computes
+        # the identical new state on its own device). The optimizer
+        # state is deliberately re-staged from the replica-0 copy every
+        # step rather than cached per replica: the canonical copy is the
+        # single source of truth that checkpoint rewind/resume restores,
+        # and a per-replica cache going stale after such a restore would
+        # desync the replicas through their states — the exact failure
+        # the desync audit exists to catch. (R-1) small transfers per
+        # step is the price of that invariant.
+        rep_inputs = []
+        if n_rep > 1:
+            import jax as _jx
+            for j in range(1, n_rep):
+                pj = [self._params[i].list_data()[j]._data for i in dense_is]
+                gj = [self._params[i].list_grad()[j]._data for i in dense_is]
+                dev_j = next(iter(pj[0].devices())) if pj else None
+                sj = [tuple(_jx.device_put(s._data, dev_j)
+                            for s in _flatten_state(self._states[i]))
+                      for i in dense_is]
+                rep_inputs.append((j, pj, gj, sj))
         new_p, new_s = self._fused(pdatas, gdatas, sdatas, lrs, wds, t)
         for i, np_ in zip(dense_is, new_p):
             self._params[i].data()._set_data_internal(np_)
         for i, ns in zip(dense_is, new_s):
             for s, nsd in zip(_flatten_state(self._states[i]), ns):
                 s._set_data_internal(nsd)
+        for j, pj, gj, sj in rep_inputs:
+            new_pj, _ = self._fused(pj, gj, sj, lrs, wds, t)
+            for i, np_ in zip(dense_is, new_pj):
+                self._params[i].list_data()[j]._set_data_internal(np_)
 
     # -- persistence ------------------------------------------------------
     # the byte-level pair below is THE states format: save_states /

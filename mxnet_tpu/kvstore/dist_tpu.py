@@ -35,6 +35,11 @@ from .kvstore_local import KVStoreLocal, _normalize_grouped
 # ops/registry.py): None until a plan installs
 _FAULTS = None
 
+# straggler-monitor hot-state (resilience.elastic.StragglerMonitor slot,
+# same discipline): None until a monitor installs; when set, collective
+# call sites report per-replica arrival lag to it
+_STRAGGLER = None
+
 
 def _jax():
     import jax
@@ -88,9 +93,17 @@ class KVStoreDistTPUSync(KVStoreLocal):
             raise MXNetError(
                 f"MXNET_NAN_QUARANTINE_MODE must be 'skip' or 'drop', "
                 f"got {self._nan_quarantine_mode!r}")
+        # elastic mesh-loss classification (resilience.elastic): resolved
+        # once like the knobs above. Off (default): a lost chip degrades
+        # to the eager fallback exactly like any fatal fast-path failure
+        # (the PR-2 semantics, regression-pinned); on: it raises
+        # MeshDegraded so an ElasticTrainingHandler can shrink the mesh
+        # and resume from checkpoint instead of training through a
+        # half-dead collective.
+        self._elastic = bool(_config.get("MXNET_ELASTIC"))
         self._stats = {"allreduce_calls": 0, "collective": 0, "eager": 0,
                        "degradations": 0, "breaker_skips": 0,
-                       "quarantined": 0}
+                       "quarantined": 0, "mesh_losses": 0}
 
     def collective_stats(self):
         """Resilience/degradation telemetry for this store (the
@@ -106,6 +119,63 @@ class KVStoreDistTPUSync(KVStoreLocal):
         # state behind the fast path — operator signal, not noise)
         out["watchdog_orphans"] = _retry.watchdog_orphans()
         return out
+
+    def _classify_mesh_loss(self, exc, op="allreduce"):
+        """Elastic classification (``MXNET_ELASTIC=1`` only): is this
+        collective failure a *lost device group* rather than a transient?
+        Returns a ready-to-raise :class:`~..resilience.elastic.
+        MeshDegraded` (counted + traced) or ``None`` for everything
+        else (which then takes the PR-2 degrade-to-eager path)."""
+        from ..resilience import elastic as _elastic
+
+        if not _elastic.is_mesh_loss(exc):
+            return None
+        lost = getattr(exc, "replica", None)
+        lost = [int(lost)] if lost is not None else None
+        return self._mesh_degraded(
+            lost, f"{type(exc).__name__}: {exc}", op)
+
+    def _mesh_degraded(self, lost, cause, op):
+        """Count + trace + warn one mesh-loss event and build the
+        :class:`MeshDegraded` to raise (shared by exception
+        classification and the breaker-open device probe)."""
+        from ..resilience import elastic as _elastic
+
+        self._stats["mesh_losses"] += 1
+        _res_counters.incr("resilience.mesh_losses")
+        if _prof.ENABLED:
+            _prof.record_instant(f"resilience::mesh_loss({op})",
+                                 "resilience",
+                                 args={"lost": lost,
+                                       "error": str(cause)[:200]})
+        warnings.warn(
+            f"kvstore {op}: collective failure classified as MESH LOSS "
+            f"(lost replica(s) {lost if lost is not None else 'unknown'}): "
+            f"{cause} — raising MeshDegraded for elastic recovery",
+            RuntimeWarning, stacklevel=4)
+        return _elastic.MeshDegraded(
+            f"{op} lost part of the mesh: {cause}",
+            lost_replicas=lost,
+            mesh_size=self._mesh.size if self._mesh is not None else None)
+
+    def _probe_lost_devices(self):
+        """Tiny device_put + blocking read against every mesh device;
+        returns the indices that FAILED. Runs only on the elastic
+        breaker-open path — while the breaker skips the fast path there
+        is no collective attempt to throw a classifiable error, and a
+        chip that dies during the cooldown would otherwise be summed as
+        a stale buffer by the eager fallback, silently, forever."""
+        import jax
+        import jax.numpy as jnp
+
+        lost = []
+        for i, dev in enumerate(self._mesh_devices()):
+            try:
+                jax.device_put(jnp.ones((1,), jnp.float32),
+                               dev).block_until_ready()
+            except Exception:  # noqa: BLE001 — any failure = dead
+                lost.append(i)
+        return lost
 
     def _record_degradation(self, exc, op="allreduce"):
         """Satellite fix: the fast path must not degrade silently — keep
@@ -124,7 +194,7 @@ class KVStoreDistTPUSync(KVStoreLocal):
                                  "resilience",
                                  args={"error": f"{type(exc).__name__}: "
                                                 f"{exc}"[:200]})
-        if n in (1, 10) or (n % 100 == 0):
+        if _res_counters.should_warn(n):
             warnings.warn(
                 f"kvstore {op} collective fast path degraded to the eager "
                 f"fallback ({n}x so far): {type(exc).__name__}: {exc} — "
@@ -149,14 +219,37 @@ class KVStoreDistTPUSync(KVStoreLocal):
         return self.NAME
 
     def barrier(self):
-        """Reference: ps-lite Barrier. Here: a tiny psum over the mesh."""
+        """Reference: ps-lite Barrier. Here: a tiny psum over the mesh.
+
+        Runs under the ``MXNET_COLLECTIVE_TIMEOUT`` watchdog and fires the
+        ``collective:barrier`` fault site — a barrier is the one
+        collective every worker blocks on unconditionally, so a hung one
+        (dead peer, partitioned ring) used to be the one place the
+        runtime could still wait forever un-instrumented. A timeout
+        surfaces as :class:`~..resilience.retry.CollectiveTimeoutError`
+        with the usual orphan accounting."""
+        if self._mesh is None:
+            return
+        flt = _FAULTS
+        if flt is not None:
+            # a 'delay' rule here + MXNET_COLLECTIVE_TIMEOUT exercises the
+            # hung-barrier watchdog deterministically; the sleep must be
+            # INSIDE the watched body or the watchdog would never see it
+            def body(mesh=self._mesh):
+                flt.check("collective:barrier", {"size": mesh.size})
+                return self._barrier_psum(mesh)
+        else:
+            def body(mesh=self._mesh):
+                return self._barrier_psum(mesh)
+        _retry.run_with_watchdog(body, self._watchdog_timeout,
+                                 site="kvstore::barrier")
+
+    @staticmethod
+    def _barrier_psum(mesh):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        if self._mesh is None:
-            return
-        mesh = self._mesh
         x = jax.device_put(
             jnp.ones((mesh.size,), jnp.int32),
             NamedSharding(mesh, P(mesh.axis_names)))
@@ -280,8 +373,19 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if flt is not None:
             # per-ATTEMPT injection point: a 'transient' rule here is what
             # the retry wrapper in allreduce() recovers from; a 'delay'
-            # rule simulates the stuck collective the watchdog bounds
-            flt.check("kvstore:allreduce", {"n": len(datas)})
+            # rule simulates the stuck collective the watchdog bounds; a
+            # 'chip_loss' rule raises ChipLostError (a dead device group
+            # — classified as mesh loss by allreduce() when elastic is
+            # on); a 'replica_delay' rule models one replica arriving
+            # late at the collective — the lag is reported to the
+            # straggler monitor below
+            mk = flt.check("kvstore:allreduce", {"n": len(datas)})
+            if isinstance(mk, dict) and mk.get("kind") == "replica_delay":
+                mon = _STRAGGLER
+                if mon is not None:
+                    mon.observe(int(mk.get("replica", 0)),
+                                float(mk.get("seconds", 0.0)),
+                                site="kvstore:allreduce")
         devs = self._mesh_devices()
         if len(datas) != len(devs) or len(devs) < 2:
             return None
@@ -369,6 +473,14 @@ class KVStoreDistTPUSync(KVStoreLocal):
                 # or real collective failures)
                 fast = None
                 self._breaker.record_failure()
+                if self._elastic:
+                    # mesh loss is NOT degradable: the eager fallback
+                    # would keep summing a dead replica's stale buffer —
+                    # silent divergence. Classify and raise so the
+                    # elastic handler can shrink the mesh and resume.
+                    mesh_err = self._classify_mesh_loss(exc)
+                    if mesh_err is not None:
+                        raise mesh_err from exc
                 self._record_degradation(exc)
             except BaseException:
                 # KeyboardInterrupt / SimulatedWorkerDeath mid-probe: the
@@ -387,6 +499,17 @@ class KVStoreDistTPUSync(KVStoreLocal):
                     self._breaker.release_probe()
         else:
             self._stats["breaker_skips"] += 1
+            if self._elastic:
+                # the breaker never attempts the collective, so a chip
+                # that dies DURING the cooldown throws no classifiable
+                # error — probe the devices directly before letting the
+                # eager fallback sum what might be a dead replica's
+                # stale buffer
+                lost = self._probe_lost_devices()
+                if lost:
+                    raise self._mesh_degraded(
+                        lost, "device probe failed while the collective "
+                        "breaker was open", "allreduce")
         if fast is not None:
             self._stats["collective"] += 1
             self.last_path = "collective"

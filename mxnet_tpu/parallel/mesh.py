@@ -69,6 +69,63 @@ def get_mesh(create=False):
     return mesh
 
 
+def shrink_mesh(mesh, lost, axis="dp", power_of_two=True):
+    """Rebuild ``mesh`` without the ``lost`` index(es) along ``axis`` —
+    the elastic-restart primitive (``resilience.elastic``): a chip loss
+    takes its whole slice of the named axis (its ICI ring segment), and
+    the surviving devices form a smaller mesh of the same axis names.
+
+    ``power_of_two=True`` (default) additionally truncates the surviving
+    axis to the largest power of two — collectives on TPU meshes are
+    ring-scheduled over power-of-two groups, and dp8→dp4 keeps per-shape
+    executables reusable where dp7 would not. Returns the new Mesh (the
+    caller decides whether to :func:`set_mesh` it).
+    """
+    from jax.sharding import Mesh
+
+    if axis not in mesh.axis_names:
+        raise MXNetError(
+            f"shrink_mesh: axis {axis!r} not in mesh axes {mesh.axis_names}")
+    ax = mesh.axis_names.index(axis)
+    lost = sorted({int(i) for i in (lost if hasattr(lost, "__iter__")
+                                    else [lost])})
+    size = mesh.devices.shape[ax]
+    bad = [i for i in lost if not 0 <= i < size]
+    if bad:
+        raise MXNetError(
+            f"shrink_mesh: lost indices {bad} out of range for axis "
+            f"{axis!r} of size {size}")
+    keep = [i for i in range(size) if i not in lost]
+    if power_of_two and len(keep) > 1:
+        target = 1 << (len(keep).bit_length() - 1)
+        keep = keep[:target]
+    if not keep:
+        raise MXNetError(
+            f"shrink_mesh: no surviving devices on axis {axis!r} "
+            f"(lost {lost} of {size})")
+    arr = _onp.take(mesh.devices, keep, axis=ax)
+    return Mesh(arr, mesh.axis_names)
+
+
+def mesh_contexts(mesh, axis="dp"):
+    """The :class:`~..device.Context` list matching ``mesh``'s slots along
+    ``axis`` (one context per axis index, resolved via the device at the
+    zero position of every other axis) — what a data-parallel training
+    loop initializes parameter replicas on."""
+    from ..device import from_jax_device
+
+    if axis not in mesh.axis_names:
+        raise MXNetError(
+            f"mesh_contexts: axis {axis!r} not in {mesh.axis_names}")
+    ax = mesh.axis_names.index(axis)
+    sel = [0] * mesh.devices.ndim
+    out = []
+    for i in range(mesh.devices.shape[ax]):
+        sel[ax] = i
+        out.append(from_jax_device(mesh.devices[tuple(sel)]))
+    return out
+
+
 class mesh_scope:
     """``with mesh_scope({'dp': 4, 'tp': 2}):`` — set + restore global mesh."""
 

@@ -22,6 +22,13 @@ the runtime *survive* them. Three cooperating layers:
   EWMA+z-score loss-spike detection, and the
   :class:`~.guardrails.GuardrailHandler` skip-step → rewind-and-skip →
   :class:`~.guardrails.DivergenceError` recovery policy.
+* :mod:`.elastic` — mesh-level failure: mesh-loss classification
+  (:class:`~.elastic.MeshDegraded`) + elastic dp-shrink restart from
+  reshard-on-resume sharded checkpoints
+  (:class:`~.elastic.ElasticTrainingHandler`), the cross-replica
+  parameter-fingerprint desync audit
+  (:class:`~.elastic.DesyncAuditHandler`), and per-replica straggler
+  detection (:class:`~.elastic.StragglerMonitor`).
 
 Everything emits ``resilience::*`` events/counters on the PR-1 profiler
 bus; :func:`resilience_stats` snapshots them for bench/BENCH rows.
@@ -47,6 +54,11 @@ _GUARDRAIL_NAMES = (
     "NonFiniteGradError", "SpikeDetector", "all_finite",
     "attribute_nonfinite", "clip_by_global_norm", "nonfinite_count",
 )
+_ELASTIC_NAMES = (
+    "elastic", "MeshDegraded", "ElasticTrainingHandler",
+    "ElasticBatchProcessor", "DesyncAuditHandler", "StragglerMonitor",
+    "is_mesh_loss", "probe_contexts", "replica_fingerprints",
+)
 
 
 def __getattr__(name):
@@ -67,6 +79,14 @@ def __getattr__(name):
         globals()["guardrails"] = _gr
         for n in _GUARDRAIL_NAMES[1:]:
             globals()[n] = getattr(_gr, n)
+        return globals()[name]
+    if name in _ELASTIC_NAMES:
+        import importlib
+
+        _el = importlib.import_module(__name__ + ".elastic")
+        globals()["elastic"] = _el
+        for n in _ELASTIC_NAMES[1:]:
+            globals()[n] = getattr(_el, n)
         return globals()[name]
     raise AttributeError(
         f"module 'mxnet_tpu.resilience' has no attribute {name!r}")
@@ -95,6 +115,15 @@ def resilience_stats():
         "resilience.guardrail_rewinds",
         "resilience.nan_quarantined",
         "resilience.loss_scale_overflows",
+        # elastic multichip training (resilience.elastic)
+        "resilience.mesh_losses",
+        "resilience.elastic_restarts",
+        "resilience.reshard_resumes",
+        "resilience.desync_trips",
+        "resilience.desync_resyncs",
+        "resilience.desync_rewinds",
+        "resilience.stragglers",
+        "resilience.checkpoints_quarantined",
     )
     out = {k.split(".", 1)[1]: _counters.get(k) for k in keys}
     out["fault_plan_active"] = faults._active is not None

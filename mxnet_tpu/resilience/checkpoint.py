@@ -24,6 +24,21 @@ truncation of the temp file by a copy tool, a partially-synced disk).
 (and quarantining as ``.corrupt``) any file whose magic/CRC fails —
 rollback to last-good instead of refusing to start.
 
+**Sharded (reshard-on-resume) checkpoints** (``save(...,
+sharded=True)``): the manifest keeps the ``<prefix>-<step>.ckpt`` name
+(so ``load_latest`` walks it unchanged) and holds the trainer blob plus a
+JSON shard table recording the saving mesh/axis layout
+(``{"dp": 8}``) and every shard's CRC32; the parameters are
+round-robin-partitioned by name across ``num_shards`` sibling files
+(``<name>.ckpt.shard00-of08`` …), each itself a full ``MXTPUCKPT1``
+container with its own CRC. Because each shard carries whole tensors
+(the ZeRO-style name partition, not a tensor split), a load reassembles
+the full parameter dict from *however many* shards were written and
+restores it onto the **current** context list — a dp8 save resumes on a
+dp4 mesh (or any other size) with no conversion step. A corrupt/missing
+shard fails the whole step's load atomically and the manager quarantines
+the manifest *and* its shards together.
+
 :class:`ResilientCheckpointHandler` is the ``gluon.contrib.estimator``
 integration: periodic atomic snapshots of block parameters + Trainer
 state + progress meta, and a :meth:`~ResilientCheckpointHandler.resume`
@@ -159,15 +174,145 @@ def save_checkpoint(path, net=None, trainer=None, params=None, meta=None):
     return path
 
 
+def _shard_path(path, i, n):
+    return f"{path}.shard{i:02d}-of{n:02d}"
+
+
+def save_sharded_checkpoint(path, net=None, trainer=None, params=None,
+                            meta=None, num_shards=None, mesh_axes=None,
+                            axis="dp"):
+    """Write one *sharded* checkpoint: ``num_shards`` sibling containers
+    each holding a round-robin name-partition of the parameters (whole
+    tensors — a ZeRO-style ownership split, not a tensor split), plus a
+    manifest at ``path`` recording the saving mesh/axis layout and every
+    shard's CRC32, with the trainer blob inside the manifest.
+
+    Write order is shards-first, manifest-last (each write atomic): a
+    crash mid-sequence leaves shard files with no manifest — invisible to
+    ``CheckpointManager.load_latest``, cleaned by rotation — never a
+    manifest pointing at missing shards. Returns ``path``."""
+    from ..ndarray.utils import save_parameters_buffer
+
+    if net is None and params is None:
+        raise MXNetError("save_sharded_checkpoint needs a net or params")
+    if params is None:
+        params = net._params_data()
+    num_shards = int(num_shards or 1)
+    if num_shards < 1:
+        raise MXNetError(f"num_shards must be >= 1, got {num_shards}")
+    names = list(params)
+    t0 = _prof.begin()
+    shard_table = []
+    for i in range(num_shards):
+        own = names[i::num_shards]
+        blob = _pack([("params", save_parameters_buffer(
+            {n: params[n] for n in own}))],
+            {"shard": i, "num_shards": num_shards})
+        spath = _shard_path(path, i, num_shards)
+        _atomic_write(spath, blob)
+        shard_table.append({"name": os.path.basename(spath),
+                            "crc": zlib.crc32(blob), "params": own})
+    manifest = {"shards": shard_table, "num_shards": num_shards,
+                "mesh_axes": dict(mesh_axes or {axis: num_shards}),
+                "axis": axis}
+    mmeta = dict(meta or {})
+    mmeta.update({"sharded": True, "num_shards": num_shards,
+                  "mesh_axes": manifest["mesh_axes"], "axis": axis})
+    sections = [("manifest", json.dumps(manifest).encode())]
+    if trainer is not None:
+        sections.append(("trainer", _trainer_blob(trainer)))
+    _atomic_write(path, _pack(sections, mmeta))
+    _prof.record_duration("resilience::checkpoint_save", "resilience", t0,
+                          args={"path": os.path.basename(str(path)),
+                                "shards": num_shards})
+    _counters.incr("resilience.checkpoints_saved")
+    return path
+
+
+def _load_sharded(path, sections, meta, net=None, trainer=None):
+    """Manifest half of :func:`load_checkpoint`: validate every shard
+    (manifest CRC of the file bytes, then the shard's own container CRC),
+    reassemble the full parameter dict, and restore it onto the CURRENT
+    context list — the saving dp size in ``meta['mesh_axes']`` does not
+    have to match (reshard-on-resume)."""
+    from ..ndarray.utils import load_parameters_buffer
+
+    if trainer is not None and "trainer" not in sections:
+        raise MXNetError(f"{path}: sharded checkpoint has no trainer "
+                         "section")
+    try:
+        manifest = json.loads(sections["manifest"])
+    except (KeyError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: bad sharded manifest: {e}") from None
+    directory = os.path.dirname(os.path.abspath(path))
+    params = {}
+    for entry in manifest.get("shards", []):
+        spath = os.path.join(directory, entry["name"])
+        try:
+            with open(spath, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"{path}: missing shard {entry['name']} ({e})") from None
+        actual = zlib.crc32(raw)
+        if actual != entry["crc"]:
+            raise CheckpointCorruptError(
+                f"{path}: shard {entry['name']} CRC mismatch (manifest "
+                f"{entry['crc']:#010x}, actual {actual:#010x})")
+        ssec, _smeta = _unpack(raw, path=spath)
+        if "params" not in ssec:
+            raise CheckpointCorruptError(
+                f"{path}: shard {entry['name']} has no params section")
+        params.update(load_parameters_buffer(ssec["params"]))
+    if net is not None:
+        net_params = net.collect_params()
+        missing = set(net_params) - set(params)
+        if missing:
+            raise MXNetError(
+                f"{path}: sharded checkpoint missing parameters "
+                f"{sorted(missing)}")
+        saved_axes = meta.get("mesh_axes") or {}
+        axis = meta.get("axis", "dp")
+        saved_dp = int(saved_axes.get(axis, meta.get("num_shards", 1)))
+        cur_dp = max([len(p._data) for p in net_params.values()
+                      if p._data is not None] or [1])
+        if cur_dp != saved_dp:
+            # the reshard event itself — the whole point of the format,
+            # but operators must be able to see it happened
+            _counters.incr("resilience.reshard_resumes")
+            if _prof.ENABLED:
+                _prof.record_instant("resilience::reshard", "resilience",
+                                     args={"axis": axis, "from": saved_dp,
+                                           "to": cur_dp})
+            import warnings
+
+            warnings.warn(
+                f"resharding checkpoint {os.path.basename(str(path))}: "
+                f"saved at {axis}{saved_dp}, restoring onto {axis}"
+                f"{cur_dp} replicas", RuntimeWarning, stacklevel=3)
+        for name, p in net_params.items():
+            p.set_data(params[name])
+    if trainer is not None:
+        _restore_trainer(trainer, sections["trainer"])
+    return params, meta
+
+
 def load_checkpoint(path, net=None, trainer=None):
     """Load + validate one checkpoint; restores into ``net`` / ``trainer``
     when given. Raises :class:`CheckpointCorruptError` on a bad file
-    (nothing is restored in that case). Returns ``(params_dict, meta)``."""
+    (nothing is restored in that case). Sharded manifests (see
+    :func:`save_sharded_checkpoint`) reassemble from their shard files
+    and may restore onto a different replica count than they were saved
+    with. Returns ``(params_dict, meta)``."""
     from ..ndarray.utils import load_parameters_buffer
 
     with open(path, "rb") as f:
         raw = f.read()
     sections, meta = _unpack(raw, path=str(path))
+    if meta.get("sharded"):
+        return _load_sharded(path, sections, meta, net=net,
+                             trainer=trainer)
     if "params" not in sections:
         raise CheckpointCorruptError(f"{path}: no params section")
     if trainer is not None and "trainer" not in sections:
@@ -219,34 +364,84 @@ class CheckpointManager:
                     continue
         return sorted(steps)
 
-    def save(self, step, net=None, trainer=None, params=None, meta=None):
+    def save(self, step, net=None, trainer=None, params=None, meta=None,
+             sharded=False, num_shards=None, mesh_axes=None, axis="dp"):
         meta = dict(meta or {})
         meta["step"] = int(step)
-        path = save_checkpoint(self._path(step), net=net, trainer=trainer,
-                               params=params, meta=meta)
+        if sharded:
+            path = save_sharded_checkpoint(
+                self._path(step), net=net, trainer=trainer, params=params,
+                meta=meta, num_shards=num_shards, mesh_axes=mesh_axes,
+                axis=axis)
+        else:
+            path = save_checkpoint(self._path(step), net=net,
+                                   trainer=trainer, params=params,
+                                   meta=meta)
         self._rotate()
         return path
+
+    def _shard_files(self, step):
+        """LIVE shard siblings of step's manifest (present only for
+        sharded saves). Anchored to the ``shardII-ofNN`` suffix so
+        already-quarantined ``.corrupt``/``.poisoned`` siblings are never
+        swept back up — rotation must not delete quarantined evidence,
+        and quarantine must not double-rename it."""
+        import re
+
+        want = os.path.basename(self._path(step)) + ".shard"
+        live = re.compile(r"\.shard\d+-of\d+$")
+        return sorted(os.path.join(self.directory, n)
+                      for n in os.listdir(self.directory)
+                      if n.startswith(want) and live.search(n))
 
     def _rotate(self):
         steps = self.list_steps()
         while len(steps) > self.max_keep:
             old = steps.pop(0)
-            try:
-                os.remove(self._path(old))
-            except OSError:
-                pass
+            for path in [self._path(old)] + self._shard_files(old):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
     def quarantine(self, step, suffix=".corrupt"):
-        """Move one checkpoint out of the rotation by renaming it with
-        ``suffix`` (``.corrupt`` for CRC/structure failures, ``.poisoned``
-        when the guardrails find non-finite parameters in a CRC-valid
-        file). Returns True if the file was moved."""
+        """Move one checkpoint out of the rotation by renaming it (and,
+        for sharded checkpoints, every shard sibling) with ``suffix``
+        (``.corrupt`` for CRC/structure failures, ``.poisoned`` when the
+        guardrails find non-finite parameters in a CRC-valid file).
+        Returns True if the manifest/container was moved.
+
+        Every quarantine is counted (``resilience.checkpoints_quarantined``)
+        and warned about by file name, rate-limited to powers of ten — an
+        operator watching a fleet must be able to see corruption
+        *frequency*, not just the per-run rollback."""
         path = self._path(step)
         try:
             os.replace(path, path + suffix)
-            return True
         except OSError:
             return False
+        for spath in self._shard_files(step):
+            try:
+                os.replace(spath, spath + suffix)
+            except OSError:
+                pass  # manifest is gone from rotation either way
+        _counters.incr("resilience.checkpoints_quarantined")
+        n = _counters.get("resilience.checkpoints_quarantined")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::checkpoint_quarantine",
+                                 "resilience",
+                                 args={"file": os.path.basename(path),
+                                       "suffix": suffix})
+        if _counters.should_warn(n):
+            import warnings
+
+            warnings.warn(
+                f"checkpoint quarantined: {os.path.basename(path)} -> "
+                f"*{suffix} ({n} quarantine(s) so far this process) — "
+                "rising counts mean recurring corruption (disk, copy "
+                "tool, or a poisoning bug), not one-off bit rot",
+                RuntimeWarning, stacklevel=3)
+        return True
 
     def load_latest(self, net=None, trainer=None):
         """Restore the newest valid checkpoint; corrupt files roll back to

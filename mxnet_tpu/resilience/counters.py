@@ -34,3 +34,12 @@ def reset():
     """Zero the resilience counters (tests; NOT called by profiler.reset)."""
     with _lock:
         _counts.clear()
+
+
+def should_warn(n) -> bool:
+    """The resilience layer's shared warning rate-limit: warn on the 1st
+    and 10th occurrence, then every 100th — loud enough that the first
+    few incidents surface, quiet enough that a degraded steady state
+    doesn't emit one warning per step. One predicate, every site
+    (degradations, watchdog orphans, quarantines, stragglers)."""
+    return n in (1, 10) or n % 100 == 0

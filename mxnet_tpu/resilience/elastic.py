@@ -1,0 +1,792 @@
+"""Elastic multichip training: mesh-loss recovery, cross-replica desync
+audit, and straggler detection.
+
+PRs 2–3 made a single process hard to kill; this module makes a *mesh*
+hard to kill. The three production failure modes of data-parallel
+training over an ICI-connected device mesh, and what this module does
+about each:
+
+* **A chip dies mid-collective.** ``dist_tpu`` (with ``MXNET_ELASTIC=1``)
+  classifies the collective failure as mesh loss — injected
+  :class:`~.faults.ChipLostError` or a runtime error matching
+  :data:`MESH_LOSS_MARKERS` — and raises :class:`MeshDegraded` instead of
+  degrading to the eager fallback (which would keep summing a dead
+  replica's stale buffer: silent divergence). An
+  :class:`ElasticTrainingHandler` on the estimator catches it, shrinks
+  the mesh to the surviving size (:func:`~..parallel.mesh.shrink_mesh`,
+  power-of-two by default: dp8 → dp4), rebinds the trainer to a fresh
+  ``KVStoreDistTPUSync`` on the new mesh, re-homes the parameters onto
+  the surviving contexts, and resumes from its own **sharded** checkpoint
+  (:func:`~.checkpoint.save_sharded_checkpoint` — the format that
+  restores a dp8 save onto a dp4 mesh).
+* **A replica silently diverges.** Bit flips, a bad HBM bank, or a buggy
+  kernel can corrupt ONE replica's parameter copies while the collective
+  keeps "working" — every loss stays finite, and the run quietly trains
+  an ensemble of one wrong model. The :class:`DesyncAuditHandler` runs a
+  cheap periodic parameter-fingerprint collective (two fused reductions
+  per replica, cadence ``MXNET_DESYNC_CHECK_STEPS``), blames the
+  minority replica(s) by majority vote, and escalates through the
+  guardrail ladder: **resync-from-peer** (copy a majority replica's
+  parameters over) → **rewind** to the checkpoint manager's last good
+  snapshot → :class:`~.guardrails.DivergenceError`. The ``param_corrupt``
+  fault kind (site ``trainer:param``) injects exactly this drift.
+* **A replica straggles.** One slow chip drags every collective down to
+  its pace. The :class:`StragglerMonitor` keeps per-replica step-time and
+  collective-arrival-lag EWMAs on the profiler bus
+  (``resilience.replica_step_ms[r]`` gauges), counts
+  ``resilience.stragglers``, and warns — rate-limited — when one
+  replica's lag exceeds ``MXNET_STRAGGLER_THRESHOLD_MS``. The
+  ``replica_delay`` fault kind lags exactly one replica deterministically
+  (site ``trainer:replica_step`` per-replica, or ``kvstore:allreduce``).
+
+Everything here defaults OFF: without ``MXNET_ELASTIC`` /
+``MXNET_DESYNC_CHECK_STEPS`` / ``MXNET_STRAGGLER_THRESHOLD_MS`` (or the
+matching constructor arguments) the training path is bitwise the PR-6
+semantics, and the only costs are an ``is None`` slot test per
+collective and an int compare per batch.
+
+``tools/elastic_soak.py`` drives seeded kill/lag/corrupt plans through a
+dp8 training loop and asserts the closed recovery taxonomy;
+``tests/test_elastic.py`` pins the dp8-kill → dp4-resume loss parity.
+"""
+from __future__ import annotations
+
+import time
+import warnings
+
+from ..base import MXNetError
+from ..gluon.contrib.estimator.batch_processor import BatchProcessor
+from ..gluon.contrib.estimator.event_handler import (BatchEnd, EpochEnd,
+                                                     PreStep, TrainBegin)
+from ..profiler import core as _prof
+from . import counters as _counters
+from .faults import ChipLostError
+from .guardrails import DivergenceError
+
+# message fragments marking a LOST DEVICE GROUP (vs a transient flake the
+# retry layer handles): jaxlib/PJRT surface dead-peer conditions with
+# these grpc-status/ICI phrasings. Deliberately NARROW — a false mesh-loss
+# classification shrinks a healthy mesh, the one mistake worse than a
+# missed one (a miss just keeps the PR-2 degrade path). Generic
+# retryable-looking texts (EBUSY's "Device or resource busy", a bare
+# "heartbeat") stay out; the handler additionally probes the devices and
+# refuses to restart when every context turns out healthy.
+MESH_LOSS_MARKERS = (
+    "chip loss",
+    "device group",
+    "DEVICE_LOST",
+    "device not found",
+    "peer down",
+    "NCCL communicator",
+    "ICI failure",
+    "missed heartbeat",
+    "heartbeat timeout",
+    "slice health",
+)
+
+
+class MeshDegraded(MXNetError):
+    """A collective lost part of its device mesh (a dead chip, not a
+    transient flake). Raised by ``dist_tpu`` when ``MXNET_ELASTIC=1``;
+    caught by :class:`ElasticTrainingHandler`, which shrinks the mesh and
+    resumes from checkpoint.
+
+    ``lost_replicas``: indices of the lost device group(s) along the data
+    -parallel axis, or ``None`` when the failure didn't identify one (the
+    handler then probes each device). ``mesh_size``: the mesh size at the
+    time of the failure."""
+
+    def __init__(self, msg, lost_replicas=None, mesh_size=None):
+        super().__init__(msg)
+        self.lost_replicas = (None if lost_replicas is None
+                              else [int(i) for i in lost_replicas])
+        self.mesh_size = mesh_size
+
+
+def is_mesh_loss(exc) -> bool:
+    """Is this collective failure a lost device group? Injected
+    :class:`~.faults.ChipLostError` yes; runtime errors by message
+    category (:data:`MESH_LOSS_MARKERS`); everything else — transients,
+    shape errors, user bugs — no (those keep the PR-2 degrade/retry
+    semantics)."""
+    if isinstance(exc, ChipLostError):
+        return True
+    if isinstance(exc, MeshDegraded):
+        return True
+    msg = str(exc)
+    return any(m in msg for m in MESH_LOSS_MARKERS)
+
+
+def probe_contexts(ctxs, payload=8):
+    """Health-probe each context with a tiny device_put + blocking read;
+    returns the list of indices that FAILED. The fallback path for a
+    :class:`MeshDegraded` that couldn't name its lost replica."""
+    import jax
+    import jax.numpy as jnp
+
+    lost = []
+    for i, ctx in enumerate(ctxs):
+        try:
+            x = jax.device_put(jnp.ones((payload,), jnp.float32),
+                               ctx.jax_device())
+            x.block_until_ready()
+        except Exception:  # noqa: BLE001 — any failure = unhealthy
+            lost.append(i)
+    return lost
+
+
+def _flag(name):
+    from .. import config
+
+    return config.get(name)
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+# module slot mirrored into dist_tpu._STRAGGLER by install()
+_active_monitor = None
+
+
+class StragglerMonitor:
+    """Per-replica step-time / collective-arrival-lag tracking.
+
+    ``observe_step_times([t0..tR-1])`` feeds one batch's per-replica
+    forward+backward wall times (the elastic batch processor measures
+    them); each replica's *lag* is its time minus the group median that
+    step. ``observe(replica, lag_s)`` feeds a directly-known lag (the
+    ``replica_delay`` fault at ``kvstore:allreduce`` reports its injected
+    sleep here). Both update a per-replica EWMA; when a replica's EWMA
+    lag exceeds ``threshold_ms`` (``MXNET_STRAGGLER_THRESHOLD_MS``; 0 =
+    tracking only, never flags) it is flagged: the
+    ``resilience.stragglers`` counter increments, a
+    ``resilience::straggler`` instant lands on the profiler bus, and a
+    rate-limited warning (1st/10th/every-100th) names the replica.
+
+    Per-replica gauges (live on the profiler counter bus):
+    ``resilience.replica_step_ms[r]`` and
+    ``resilience.replica_lag_ms[r]``.
+    """
+
+    def __init__(self, threshold_ms=None, alpha=0.4):
+        self.threshold_ms = float(
+            threshold_ms if threshold_ms is not None
+            else _flag("MXNET_STRAGGLER_THRESHOLD_MS"))
+        self.alpha = float(alpha)
+        self._lag_ewma = {}    # replica -> seconds
+        self._step_ewma = {}   # replica -> seconds
+        self.stats = {"flags": 0, "last_straggler": None,
+                      "observations": 0}
+
+    def install(self):
+        """Publish this monitor to the collective call sites (the
+        ``dist_tpu._STRAGGLER`` slot, same discipline as ``_FAULTS``)."""
+        global _active_monitor
+        import sys
+
+        _active_monitor = self
+        mod = sys.modules.get("mxnet_tpu.kvstore.dist_tpu")
+        if mod is None:
+            import importlib
+
+            mod = importlib.import_module("mxnet_tpu.kvstore.dist_tpu")
+        mod._STRAGGLER = self
+        return self
+
+    @staticmethod
+    def uninstall():
+        global _active_monitor
+        import sys
+
+        _active_monitor = None
+        mod = sys.modules.get("mxnet_tpu.kvstore.dist_tpu")
+        if mod is not None:
+            mod._STRAGGLER = None
+
+    def snapshot(self):
+        return {"threshold_ms": self.threshold_ms,
+                "lag_ms": {r: v * 1e3 for r, v in self._lag_ewma.items()},
+                "step_ms": {r: v * 1e3
+                            for r, v in self._step_ewma.items()},
+                **self.stats}
+
+    def observe_step_times(self, times_s):
+        """One batch's per-replica wall times; lag = time − group
+        median."""
+        if not times_s:
+            return
+        srt = sorted(times_s)
+        median = srt[len(srt) // 2]
+        for r, t in enumerate(times_s):
+            prev = self._step_ewma.get(r)
+            ew = t if prev is None else self.alpha * t \
+                + (1 - self.alpha) * prev
+            self._step_ewma[r] = ew
+            _prof.set_counter(f"resilience.replica_step_ms[{r}]",
+                              round(ew * 1e3, 3), cat="resilience")
+            self.observe(r, max(0.0, t - median), site="step")
+
+    def observe(self, replica, lag_s, site="collective"):
+        self.stats["observations"] += 1
+        prev = self._lag_ewma.get(replica)
+        ew = lag_s if prev is None else self.alpha * lag_s \
+            + (1 - self.alpha) * prev
+        self._lag_ewma[replica] = ew
+        _prof.set_counter(f"resilience.replica_lag_ms[{replica}]",
+                          round(ew * 1e3, 3), cat="resilience")
+        if self.threshold_ms and ew * 1e3 > self.threshold_ms:
+            self._flag_straggler(replica, ew, site)
+
+    def _flag_straggler(self, replica, ew_lag_s, site):
+        self.stats["flags"] += 1
+        self.stats["last_straggler"] = int(replica)
+        _counters.incr("resilience.stragglers")
+        n = _counters.get("resilience.stragglers")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::straggler", "resilience",
+                                 args={"replica": int(replica),
+                                       "lag_ms": round(ew_lag_s * 1e3, 3),
+                                       "site": site})
+        if _counters.should_warn(n):
+            warnings.warn(
+                f"straggler: replica {replica} collective-arrival lag "
+                f"EWMA {ew_lag_s * 1e3:.1f}ms exceeds "
+                f"MXNET_STRAGGLER_THRESHOLD_MS={self.threshold_ms:.0f} "
+                f"at {site} ({n} flag(s) so far) — one slow chip paces "
+                "every collective; check its host/HBM before it becomes "
+                "a mesh loss", RuntimeWarning, stacklevel=4)
+
+
+# ---------------------------------------------------------------------------
+# data-parallel batch processing (replica-aware fit_batch)
+# ---------------------------------------------------------------------------
+
+
+class ElasticBatchProcessor(BatchProcessor):
+    """``BatchProcessor`` for context-list (replicated) data parallelism.
+
+    ``fit_batch`` splits the batch across the parameters' CURRENT context
+    list (re-read every batch, so an elastic dp8 → dp4 restart re-splits
+    automatically), runs each slice's forward+backward inside a
+    :class:`~...gluon.parameter.replica_context` scope (so every
+    ``p.data()`` resolves to the co-located replica), scales each
+    replica's loss by its slice fraction (the summed post-allreduce
+    gradient is then the full-batch mean gradient, invariant to the dp
+    size up to fp reordering), and feeds per-replica wall times to the
+    installed :class:`StragglerMonitor`. The ``trainer:replica_step``
+    fault site fires once per replica with ``info={"replica": i}`` —
+    a ``replica_delay`` rule lags exactly one replica's step.
+
+    Single-context parameters delegate to the base processor unchanged.
+    """
+
+    def __init__(self, batch_axis=0):
+        self.batch_axis = batch_axis
+
+    def _ctxs(self, estimator):
+        for p in estimator.trainer._params:
+            if p._data is not None:
+                return p.list_ctx()
+        return None
+
+    def fit_batch(self, estimator, train_batch, batch_axis=None):
+        from .. import autograd
+        from ..gluon.parameter import replica_context
+        from ..gluon.utils import split_and_load
+        from .faults import get_plan
+
+        # the estimator never passes an axis — the constructor's wins
+        if batch_axis is None:
+            batch_axis = self.batch_axis
+        ctxs = self._ctxs(estimator)
+        if ctxs is None or len(ctxs) <= 1:
+            return super().fit_batch(estimator, train_batch, batch_axis)
+        data, label = self._get_data_and_label(
+            train_batch, estimator.device, batch_axis)
+        xs = split_and_load(data, ctxs, batch_axis=batch_axis,
+                            even_split=False)
+        ys = split_and_load(label, ctxs, batch_axis=batch_axis,
+                            even_split=False)
+        total = float(data.shape[batch_axis])
+        plan = get_plan()
+        mon = _active_monitor
+        scale = getattr(estimator.trainer, "scale_loss", None)
+        preds, loss_vals, times = [], [], []
+        for i, (ctx, x, y) in enumerate(zip(ctxs, xs, ys)):
+            if x.shape[batch_axis] == 0:
+                # a batch smaller than the replica count (the dataset's
+                # final partial batch) leaves this replica sliceless.
+                # Its grads still carry LAST batch's values, and the
+                # allreduce would sum them in — so zero them; the
+                # non-empty slices' weights already sum to 1, keeping
+                # the full-batch mean gradient exact. (A forward on the
+                # empty slice would be worse: mean() over zero rows is
+                # NaN, and backward would poison the whole mesh.)
+                import jax
+                import jax.numpy as jnp
+
+                for p in estimator.trainer._params:
+                    g = p.grad(ctx)
+                    # committed to THIS replica's device: the per-replica
+                    # fused update jits against colocated inputs
+                    g._set_data_internal(jax.device_put(
+                        jnp.zeros(g.shape, g._data.dtype),
+                        ctx.jax_device()))
+                times.append(0.0)
+                continue
+            t0 = time.perf_counter()
+            if plan is not None:
+                plan.check("trainer:replica_step", {"replica": i})
+            w = float(x.shape[batch_axis]) / total
+            with replica_context(ctx):
+                with autograd.record():
+                    pred = estimator.net(x)
+                    li = estimator.loss(pred, y).mean()
+                    lw = li * w
+                    scaled = lw if scale is None else scale(lw)
+                scaled.backward()
+                if mon is not None:
+                    # dispatch is async: the host-side clock alone would
+                    # time dispatch, not the device (a genuinely slow
+                    # chip finishes dispatch as fast as a healthy one).
+                    # Under monitoring, block on this replica's freshly
+                    # written gradient so the window covers its real
+                    # forward+backward execution. Unmonitored runs never
+                    # pay the sync.
+                    estimator.trainer._params[0].grad(ctx) \
+                        ._data.block_until_ready()
+            preds.append(pred)
+            loss_vals.append((w, li))
+            times.append(time.perf_counter() - t0)
+        if mon is not None and 0.0 not in times:
+            # a partial batch idles some replicas (time 0) — feeding that
+            # step would read as every loaded replica "straggling" behind
+            # an artificially-zero median
+            mon.observe_step_times(times)
+        check = getattr(estimator.trainer, "check_grad_faults", None)
+        if check is not None:
+            check()
+        # metric/guardrail views combine ON DEVICE (replica 0): R-1
+        # device-to-device moves and zero host syncs here — the metric
+        # layer fetches once, downstream. The base processor's contract
+        # (device arrays out) is preserved; training math never touches
+        # these.
+        loss_dev = None
+        for w, li in loss_vals:
+            t = li.as_in_context(ctxs[0]) * w
+            loss_dev = t if loss_dev is None else loss_dev + t
+        from .. import np as _mnp
+
+        pred_dev = _mnp.concatenate(
+            [p.as_in_context(ctxs[0]) for p in preds], axis=batch_axis)
+        return data, label, pred_dev, loss_dev
+
+
+# ---------------------------------------------------------------------------
+# elastic restart (mesh-loss recovery)
+# ---------------------------------------------------------------------------
+
+
+class ElasticTrainingHandler(TrainBegin, PreStep, BatchEnd, EpochEnd):
+    """Estimator handler: periodic SHARDED checkpoints + mesh-loss
+    recovery.
+
+    Wire-up (a dp8 run on an 8-device mesh)::
+
+        eh = ElasticTrainingHandler(dir, batch_period=1)
+        start = eh.resume(est)                    # 0 on a fresh run
+        est.fit(batches, batches=N, event_handlers=[eh])
+
+    It snapshots net + trainer as a sharded checkpoint (``num_shards`` =
+    the live replica count, mesh layout recorded in the manifest) every
+    ``epoch_period`` epochs (default 1) and/or every ``batch_period``
+    batches (default off — a full-parameter serialize per batch is soak
+    -harness cadence, not production cadence; a mesh loss can only
+    resume to the newest save, so pick the cadence by how many steps you
+    can afford to lose). When ``trainer.step`` raises :class:`MeshDegraded` (a
+    chip died mid-collective, ``MXNET_ELASTIC=1``), :meth:`step_error`:
+
+    1. identifies the lost replica(s) — from the error, or by probing
+       every context (:func:`probe_contexts`),
+    2. shrinks the mesh to the survivors via
+       :func:`~..parallel.mesh.shrink_mesh` (power-of-two by default:
+       dp8 − 1 chip → dp4) and installs it as the global mesh,
+    3. builds a fresh ``KVStoreDistTPUSync`` on the new mesh and
+       ``trainer.rebind_kvstore``\\ s it,
+    4. re-homes every parameter onto the surviving contexts
+       (``reset_ctx``) and restores the newest valid checkpoint — the
+       dp8-sharded save reshards onto the dp4 replica set,
+    5. absorbs the failed step as a skip (returns True): training
+       continues with the next batch at the smaller dp. The batch window
+       between the restored checkpoint and the failure is lost —
+       ``stats["steps_lost"]`` counts it, ``stats["last_recovery_s"]``
+       times the restart.
+
+    More than ``max_restarts`` mesh losses (``MXNET_ELASTIC_MAX_RESTARTS``)
+    or fewer than ``min_replicas`` survivors
+    (``MXNET_ELASTIC_MIN_REPLICAS``) re-raises: a mesh that keeps
+    shedding chips is a hardware incident, not a recoverable blip.
+    Compatible with ``GuardrailHandler(manager=...)`` — this handler
+    exposes ``.manager`` like ``ResilientCheckpointHandler`` does.
+    """
+
+    def __init__(self, model_dir, model_prefix="model", epoch_period=1,
+                 batch_period=None, max_keep=3, axis="dp",
+                 max_restarts=None, min_replicas=None, power_of_two=True,
+                 priority=-1400):
+        from .checkpoint import CheckpointManager
+
+        self.manager = CheckpointManager(model_dir, prefix=model_prefix,
+                                         max_keep=max_keep)
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.axis = axis
+        self.power_of_two = bool(power_of_two)
+        self.max_restarts = int(
+            max_restarts if max_restarts is not None
+            else _flag("MXNET_ELASTIC_MAX_RESTARTS"))
+        self.min_replicas = int(
+            min_replicas if min_replicas is not None
+            else _flag("MXNET_ELASTIC_MIN_REPLICAS"))
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stats = {"mesh_losses": 0, "restarts": 0, "steps_lost": 0,
+                      "last_recovery_s": None, "dp_history": []}
+        self._just_restarted = False
+
+    # -- checkpointing ----------------------------------------------------
+    def _replicas(self, estimator):
+        for p in estimator.trainer._params:
+            if p._data is not None:
+                return len(p._data)
+        return 1
+
+    def _save(self, estimator):
+        n = self._replicas(estimator)
+        self.manager.save(
+            self.current_batch, net=estimator.net,
+            trainer=estimator.trainer,
+            meta={"batch": self.current_batch,
+                  "epoch": self.current_epoch},
+            sharded=True, num_shards=n, mesh_axes={self.axis: n},
+            axis=self.axis)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self._just_restarted:
+            # the failed batch's end: its weights ARE the restored
+            # checkpoint — saving them again would shadow it under a new
+            # step number and skew the resume bookkeeping
+            self._just_restarted = False
+            return
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save(estimator)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save(estimator)
+
+    def resume(self, estimator):
+        """Restore the newest valid (sharded or plain) checkpoint into
+        the estimator's net + trainer — onto the CURRENT replica set,
+        whatever size it is. Returns the batch index to continue from."""
+        meta = self.manager.load_latest(net=estimator.net,
+                                        trainer=estimator.trainer)
+        if meta is None:
+            return 0
+        self.current_batch = int(meta.get("batch", meta.get("step", 0)))
+        self.current_epoch = int(meta.get("epoch", 0))
+        return self.current_batch
+
+    # -- recovery ---------------------------------------------------------
+    def step_error(self, estimator, exc):
+        if not isinstance(exc, MeshDegraded):
+            return False
+        self.stats["mesh_losses"] += 1
+        if self.stats["restarts"] >= self.max_restarts:
+            warnings.warn(
+                f"elastic restart budget exhausted "
+                f"({self.stats['restarts']}/{self.max_restarts}) — "
+                "re-raising MeshDegraded; a mesh shedding chips this "
+                "fast is a hardware incident", RuntimeWarning,
+                stacklevel=2)
+            return False
+        t0 = time.perf_counter()
+        trainer = estimator.trainer
+        params = trainer._params
+        ctxs = None
+        for p in params:
+            if p._data is not None:
+                ctxs = p.list_ctx()
+                break
+        if ctxs is None:
+            return False
+        lost = exc.lost_replicas
+        if lost is None:
+            lost = probe_contexts(ctxs)
+        lost = [i for i in lost if 0 <= i < len(ctxs)]
+        if not lost:
+            # the classification was spurious: nothing identified the
+            # lost replica AND every context probes healthy — shrinking
+            # a healthy mesh (or burning a restart on it) would turn a
+            # misclassified transient into a capacity loss. Re-raise.
+            warnings.warn(
+                "MeshDegraded with no identifiable lost replica and all "
+                "contexts probing healthy — refusing an elastic restart "
+                "for what looks like a misclassified transient",
+                RuntimeWarning, stacklevel=2)
+            return False
+        if len(ctxs) - len(lost) < max(1, self.min_replicas):
+            warnings.warn(
+                f"mesh loss left {len(ctxs) - len(lost)} replica(s), "
+                f"below MXNET_ELASTIC_MIN_REPLICAS={self.min_replicas} — "
+                "not recoverable", RuntimeWarning, stacklevel=2)
+            return False
+        if getattr(trainer, "_update_on_kvstore", False):
+            # the optimizer state lives on the store being replaced —
+            # rejected HERE, before any mutation, so the failure surfaces
+            # as the original MeshDegraded rather than a rebind error on
+            # a half-restarted process
+            warnings.warn(
+                "elastic restart is not supported with "
+                "update_on_kvstore=True (the optimizer state lives on "
+                "the store being replaced) — re-raising MeshDegraded",
+                RuntimeWarning, stacklevel=2)
+            return False
+        # validate that a restorable checkpoint EXISTS before touching
+        # anything: a dry load_latest (no net/trainer) walks + CRC-checks
+        # the newest valid file without mutating state. Without this, a
+        # chip loss before the first periodic save would shrink the mesh
+        # and rebind the kvstore, then fail to restore — leaving a
+        # half-restarted process behind the re-raised MeshDegraded.
+        if self.manager.load_latest() is None:
+            warnings.warn(
+                "mesh loss with NO valid checkpoint to resume from — "
+                "re-raising (enable periodic saves before injecting "
+                "chip loss)", RuntimeWarning, stacklevel=2)
+            return False
+
+        from ..kvstore.dist_tpu import KVStoreDistTPUSync
+        from ..parallel import mesh as mesh_mod
+
+        old_kv = getattr(trainer, "_kvstore", None)
+        old_mesh = getattr(old_kv, "_mesh", None) \
+            or mesh_mod.get_mesh(create=True)
+        new_mesh = mesh_mod.shrink_mesh(old_mesh, lost, axis=self.axis,
+                                        power_of_two=self.power_of_two)
+        new_ctxs = mesh_mod.mesh_contexts(new_mesh, axis=self.axis)
+        mesh_mod.set_mesh(new_mesh)
+        trainer.rebind_kvstore(KVStoreDistTPUSync(mesh=new_mesh,
+                                                  axis=self.axis))
+        estimator.net.collect_params().reset_ctx(new_ctxs)
+        meta = self.manager.load_latest(net=estimator.net, trainer=trainer)
+        if meta is None:
+            # the file validated a moment ago and vanished/corrupted
+            # since — nothing left to restore
+            warnings.warn(
+                "mesh loss: checkpoint disappeared between validation "
+                "and restore — re-raising", RuntimeWarning, stacklevel=2)
+            return False
+        restored = int(meta.get("batch", meta.get("step", 0)))
+        lost_steps = max(0, self.current_batch + 1 - restored)
+        dt = time.perf_counter() - t0
+        self.stats["restarts"] += 1
+        self.stats["steps_lost"] += lost_steps
+        self.stats["last_recovery_s"] = dt
+        self.stats["dp_history"].append((len(ctxs), len(new_ctxs)))
+        self._just_restarted = True
+        _counters.incr("resilience.elastic_restarts")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::elastic_restart",
+                                 "resilience",
+                                 args={"lost": lost,
+                                       "dp_from": len(ctxs),
+                                       "dp_to": len(new_ctxs),
+                                       "steps_lost": lost_steps,
+                                       "recovery_s": round(dt, 4)})
+        warnings.warn(
+            f"elastic restart: lost replica(s) {lost} of dp{len(ctxs)} — "
+            f"resumed at dp{len(new_ctxs)} from checkpoint batch "
+            f"{restored} ({lost_steps} step(s) lost, recovery "
+            f"{dt * 1e3:.0f}ms)", RuntimeWarning, stacklevel=2)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# cross-replica desync audit
+# ---------------------------------------------------------------------------
+
+
+def replica_fingerprints(params):
+    """Per-replica parameter fingerprint: ``[(sum, sum_sq), ...]`` — two
+    fused fp32 reductions per replica, one host sync each (the cheap
+    "collective" of the audit; on a real mesh this is an allgather of 2
+    floats per member). Healthy replicas are BITWISE identical (the
+    per-replica fused update guarantees it), so exact tuple equality is
+    the comparison — no tolerance to tune, no drift small enough to
+    hide."""
+    import jax.numpy as jnp
+
+    live = [p for p in params if p._data is not None]
+    if not live:
+        return []
+    ctxs = live[0].list_ctx()
+    out = []
+    for ctx in ctxs:
+        a1 = a2 = None
+        for p in live:
+            d = p._data.get(ctx)
+            if d is None:
+                continue
+            f = d._data.astype(jnp.float32)
+            s1 = jnp.sum(f)
+            s2 = jnp.sum(f * f)
+            a1 = s1 if a1 is None else a1 + s1
+            a2 = s2 if a2 is None else a2 + s2
+        out.append((float(a1), float(a2)) if a1 is not None else (0.0, 0.0))
+    return out
+
+
+class DesyncAuditHandler(TrainBegin, BatchEnd):
+    """Periodic cross-replica parameter-fingerprint audit.
+
+    Every ``check_steps`` batches (``MXNET_DESYNC_CHECK_STEPS``; 0 =
+    disabled — one int compare per batch), fingerprint every replica and
+    majority-vote: replicas whose fingerprint differs from the majority
+    are *desynced* — silently diverged from the group (injected via the
+    ``param_corrupt`` fault kind at ``trainer:param``). Escalation,
+    mirroring the guardrail ladder:
+
+    1. **resync-from-peer** (up to ``max_resyncs``,
+       ``MXNET_DESYNC_MAX_RESYNCS``): copy a majority replica's
+       parameters over the deviant's — one device-to-device transfer per
+       parameter, the cheap fix for transient corruption.
+    2. **rewind** (up to ``max_rewinds``): no majority (every replica
+       disagrees) or the resync budget is spent — restore the manager's
+       newest checkpoint into net + trainer (all replicas, consistent by
+       construction).
+    3. :class:`~.guardrails.DivergenceError` — no manager, no
+       checkpoint, or the rewind budget is spent.
+
+    Runs at ``priority=-1600`` — BEFORE checkpoint handlers save this
+    batch, so a drifted replica 0 is repaired before its values could be
+    snapshotted as truth.
+    """
+
+    def __init__(self, manager=None, check_steps=None, max_resyncs=None,
+                 max_rewinds=2, priority=-1600):
+        self.manager = getattr(manager, "manager", manager)
+        self.check_steps = int(
+            check_steps if check_steps is not None
+            else _flag("MXNET_DESYNC_CHECK_STEPS"))
+        self.max_resyncs = int(
+            max_resyncs if max_resyncs is not None
+            else _flag("MXNET_DESYNC_MAX_RESYNCS"))
+        self.max_rewinds = int(max_rewinds)
+        self.priority = priority
+        self.stats = {"audits": 0, "trips": 0, "resyncs": 0, "rewinds": 0,
+                      "last_blamed": None}
+        self._batch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self._batch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._batch += 1
+        if not self.check_steps or self._batch % self.check_steps:
+            return
+        params = estimator.trainer._params
+        fps = replica_fingerprints(params)
+        if len(fps) < 2:
+            return
+        self.stats["audits"] += 1
+        counts = {}
+        for fp in fps:
+            counts[fp] = counts.get(fp, 0) + 1
+        majority_fp, majority_n = max(counts.items(), key=lambda kv: kv[1])
+        if majority_n == len(fps):
+            return  # all replicas agree
+        deviants = [i for i, fp in enumerate(fps) if fp != majority_fp]
+        self._trip(estimator, params, fps, majority_fp, majority_n,
+                   deviants)
+
+    def _trip(self, estimator, params, fps, majority_fp, majority_n,
+              deviants):
+        self.stats["trips"] += 1
+        self.stats["last_blamed"] = list(deviants)
+        _counters.incr("resilience.desync_trips")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::desync", "resilience",
+                                 args={"blamed": deviants,
+                                       "majority": majority_n,
+                                       "of": len(fps)})
+        warnings.warn(
+            f"desync audit: replica(s) {deviants} drifted from the "
+            f"majority ({majority_n}/{len(fps)} agree) at batch "
+            f"{self._batch}", RuntimeWarning, stacklevel=3)
+        if majority_n > len(fps) // 2 \
+                and self.stats["resyncs"] < self.max_resyncs:
+            self._resync(params, fps, majority_fp, deviants)
+            return
+        self._rewind(estimator, deviants)
+
+    def _resync(self, params, fps, majority_fp, deviants):
+        import jax
+
+        src_idx = fps.index(majority_fp)
+        live = [p for p in params if p._data is not None]
+        ctxs = live[0].list_ctx()
+        for p in live:
+            src = p._data[ctxs[src_idx]]._data
+            for i in deviants:
+                dst = p._data.get(ctxs[i])
+                if dst is None:
+                    continue
+                dst._set_data_internal(
+                    jax.device_put(src, ctxs[i].jax_device()))
+        self.stats["resyncs"] += 1
+        _counters.incr("resilience.desync_resyncs")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::desync(resync)",
+                                 "resilience",
+                                 args={"from": src_idx, "to": deviants})
+        warnings.warn(
+            f"desync audit: resynced replica(s) {deviants} from majority "
+            f"replica {src_idx} ({self.stats['resyncs']}/"
+            f"{self.max_resyncs} resyncs used)", RuntimeWarning,
+            stacklevel=4)
+
+    def _rewind(self, estimator, deviants):
+        if self.stats["rewinds"] >= self.max_rewinds:
+            raise DivergenceError(
+                f"desync rewind budget exhausted "
+                f"({self.stats['rewinds']}/{self.max_rewinds}) with "
+                f"replica(s) {deviants} still drifting — recurring "
+                "single-replica corruption is a hardware incident "
+                "(HBM/interconnect), not recoverable software state.")
+        if self.manager is None:
+            raise DivergenceError(
+                f"desync audit: replica(s) {deviants} drifted, the "
+                "resync budget is spent, and no CheckpointManager was "
+                "given to rewind with — pass manager= (or an "
+                "ElasticTrainingHandler / ResilientCheckpointHandler).")
+        meta = self.manager.load_latest(net=estimator.net,
+                                        trainer=estimator.trainer)
+        if meta is None:
+            raise DivergenceError(
+                f"desync audit: replica(s) {deviants} drifted and no "
+                "valid checkpoint exists to rewind to.")
+        self.stats["rewinds"] += 1
+        _counters.incr("resilience.desync_rewinds")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::desync(rewind)",
+                                 "resilience",
+                                 args={"to_step": meta.get("step"),
+                                       "blamed": deviants})
+        warnings.warn(
+            f"desync audit: rewound to checkpoint step "
+            f"{meta.get('step')} (replica(s) {deviants} unrecoverable "
+            "by resync)", RuntimeWarning, stacklevel=4)

@@ -57,6 +57,34 @@ hit sequence). Kinds:
     on CPU. Sites that don't implement corruption ignore the return value,
     so a ``nan`` rule on e.g. ``engine:wait`` fires (and is counted) but
     has no effect.
+
+Per-replica kinds (elastic multichip training, ``resilience.elastic``) —
+each takes a ``"replica"`` field naming the device-group index it targets:
+
+``chip_loss``
+    raises :class:`ChipLostError` (carries ``.replica``) — the injected
+    analog of a dead chip taking its ICI ring down. Never retried; with
+    ``MXNET_ELASTIC=1`` the dist_tpu collective classifies it as mesh
+    loss and raises :class:`~.elastic.MeshDegraded` so an
+    :class:`~.elastic.ElasticTrainingHandler` can shrink the mesh and
+    resume; with elastic off it degrades to the eager fallback like any
+    fatal fast-path failure (PR-2 semantics, bitwise preserved).
+``replica_delay``
+    does not raise: sleeps ``seconds`` *only when the call site's current
+    replica matches the rule's* (sites pass ``info={"replica": i}``;
+    sites without replica info sleep unconditionally) and returns the
+    marker dict ``{"kind": "replica_delay", "replica", "seconds"}`` so
+    the site can report the lag to the straggler monitor.
+``param_corrupt``
+    does not raise: returns ``{"kind": "param_corrupt", "replica": r}``
+    and the call site (``trainer:param``) perturbs replica ``r``'s
+    parameter copies — the silent single-replica drift the desync audit
+    exists to catch.
+
+Replica matching: a rule with a ``"replica"`` field only *hits* when the
+site's ``info`` dict carries no ``"replica"`` key or carries the same
+value — so ``at`` indices count per-target-replica visits, not global
+site traffic.
 """
 from __future__ import annotations
 
@@ -96,6 +124,19 @@ KNOWN_SITES = (
                             # per T=1 decode step — kills a generation
                             # stream mid-decode (prefill is covered by
                             # serve:execute)
+    "collective:barrier",   # dist_tpu.barrier, before the psum — the one
+                            # collective that could previously hang
+                            # forever un-instrumented (now under the
+                            # MXNET_COLLECTIVE_TIMEOUT watchdog)
+    "trainer:param",        # gluon.Trainer.step, after the optimizer
+                            # update — implements 'param_corrupt' (drifts
+                            # one replica's parameter copies; the desync
+                            # audit's injection point)
+    "trainer:replica_step", # elastic.ElasticBatchProcessor, once per
+                            # replica per batch with info={"replica": i}
+                            # — 'replica_delay' here lags exactly one
+                            # replica's forward/backward (the straggler
+                            # the per-replica step clock must catch)
 )
 
 
@@ -105,6 +146,16 @@ class TransientFaultError(MXNetError):
 
 class InjectedFaultError(MXNetError):
     """Injected error classified fatal (never retried)."""
+
+
+class ChipLostError(MXNetError):
+    """Injected dead-chip analog: the device group ``replica`` dropped off
+    the mesh mid-collective. Never retried (the chip is gone, not busy);
+    ``dist_tpu`` classifies it as mesh loss when ``MXNET_ELASTIC=1``."""
+
+    def __init__(self, msg, replica=0):
+        super().__init__(msg)
+        self.replica = int(replica)
 
 
 class SimulatedWorkerDeath(BaseException):
@@ -140,7 +191,8 @@ class FaultPlan:
             kind = r.get("kind", "transient")
             if not site:
                 raise MXNetError(f"fault rule {i} missing 'site'")
-            if kind not in ("transient", "fatal", "delay", "die", "nan"):
+            if kind not in ("transient", "fatal", "delay", "die", "nan",
+                            "chip_loss", "replica_delay", "param_corrupt"):
                 raise MXNetError(f"fault rule {i}: unknown kind {kind!r}")
             triggers = [t for t in ("at", "times", "prob") if t in r]
             if len(triggers) != 1:
@@ -157,6 +209,7 @@ class FaultPlan:
                 "times": int(r["times"]) if "times" in r else None,
                 "prob": float(r["prob"]) if "prob" in r else None,
                 "seconds": float(r.get("seconds", 0.05)),
+                "replica": int(r["replica"]) if "replica" in r else None,
                 "message": r.get("message"),
                 # per-rule RNG: independent deterministic streams, immune
                 # to other rules' draw counts
@@ -192,6 +245,13 @@ class FaultPlan:
             for r in self._rules:
                 if r["site"] != site and r["site"] != "*":
                     continue
+                if r["replica"] is not None and isinstance(info, dict) \
+                        and "replica" in info \
+                        and int(info["replica"]) != r["replica"]:
+                    # replica-targeted rule at a per-replica site: other
+                    # replicas' visits don't hit (so `at` indices count
+                    # the TARGET replica's visits, deterministically)
+                    continue
                 idx = r["hits"]
                 r["hits"] += 1
                 fire = False
@@ -219,6 +279,23 @@ class FaultPlan:
             return
         if kind == "nan":
             return "nan"
+        if kind == "replica_delay":
+            # the replica filter above already scoped this hit to the
+            # target replica (or the site carries no replica info)
+            time.sleep(action["seconds"])
+            return {"kind": "replica_delay",
+                    "replica": action["replica"] or 0,
+                    "seconds": action["seconds"]}
+        if kind == "param_corrupt":
+            return {"kind": "param_corrupt",
+                    "replica": action["replica"] or 0}
+        if kind == "chip_loss":
+            raise ChipLostError(
+                action["message"] or
+                f"injected chip loss at {site}: device group "
+                f"{action['replica'] or 0} dropped off the mesh "
+                f"(plan seed {self.seed})",
+                replica=action["replica"] or 0)
         if kind == "transient":
             raise TransientFaultError(msg)
         if kind == "die":

@@ -41,8 +41,8 @@ import time
 from ..base import MXNetError
 from ..profiler import core as _prof
 from . import counters as _counters
-from .faults import InjectedFaultError, SimulatedWorkerDeath, \
-    TransientFaultError
+from .faults import ChipLostError, InjectedFaultError, \
+    SimulatedWorkerDeath, TransientFaultError
 
 
 class CollectiveTimeoutError(MXNetError):
@@ -71,7 +71,11 @@ def is_transient(exc) -> bool:
     errors by grpc-status message category."""
     if isinstance(exc, TransientFaultError):
         return True
-    if isinstance(exc, (InjectedFaultError, SimulatedWorkerDeath)):
+    if isinstance(exc, (InjectedFaultError, SimulatedWorkerDeath,
+                        ChipLostError)):
+        # a lost chip is gone, not busy — retrying the collective in
+        # place would just re-fail; mesh-loss recovery (resilience.
+        # elastic) is the correct continuation, not backoff
         return False
     if isinstance(exc, CollectiveTimeoutError):
         # a hung collective is not safely re-runnable in place: the hung
@@ -226,7 +230,7 @@ def run_with_watchdog(fn, timeout_s, site="collective"):
                 _prof.record_instant(
                     f"resilience::watchdog_timeout({site})", "resilience",
                     args={"timeout_s": timeout_s, "orphans": n})
-            if n in (1, 10) or n % 100 == 0:
+            if _counters.should_warn(n):
                 import warnings
 
                 warnings.warn(

@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""Elastic-training soak: seeded kill/lag/corrupt plans through a dp8
+training loop, asserting the closed recovery taxonomy.
+
+What it drives (mirroring ``tools/chaos_soak.py`` for the serving stack):
+a data-parallel training run on the virtual 8-device mesh — per-replica
+forward/backward through ``ElasticBatchProcessor``, compiled-collective
+gradient allreduce through ``dist_tpu``, per-replica fused optimizer
+updates — under three seeded fault legs:
+
+1. **kill** (``chip_loss`` at ``kvstore:allreduce``): a device group dies
+   mid-step; ``MXNET_ELASTIC=1`` classifies it as :class:`MeshDegraded`,
+   the :class:`ElasticTrainingHandler` shrinks dp8 → dp4 and resumes
+   from its own sharded checkpoint. Asserted: exactly one restart, one
+   step lost, the finished dp4 run matches — **bitwise** — a reference
+   dp4 run continued from the same checkpoint over the same remaining
+   batches (no silent divergence), and recovery wall-time is reported
+   (the MULTICHIP kill-and-reshard row).
+2. **lag** (``replica_delay`` at ``trainer:replica_step``): one replica
+   straggles deterministically; the :class:`StragglerMonitor` must blame
+   exactly that replica, and the final parameters must be bitwise equal
+   to an undelayed run (a straggler slows the mesh, never changes it).
+3. **corrupt** (``param_corrupt`` at ``trainer:param``): one replica's
+   parameters silently drift; the :class:`DesyncAuditHandler` must
+   detect it within its check cadence, blame the right replica, resync
+   it from a peer, and leave every replica fingerprint-identical.
+
+Outcome taxonomy is CLOSED: each leg either completes with its
+assertions holding or the soak fails with the violation — no hang (the
+run is bounded by construction: no retries on chip loss, watchdogged
+collectives) and no silent divergence (every leg ends with a
+cross-replica fingerprint agreement check and a finiteness check).
+
+Usage::
+
+    python tools/elastic_soak.py              # one-seed tier-1 smoke
+    python tools/elastic_soak.py --seeds 8    # full sweep (-m slow analog)
+"""
+import argparse
+import os
+import sys
+import time
+import warnings
+
+import numpy as np
+
+# env/jax setup happens ONLY on the script path (__main__ below):
+# importers (tests via conftest, bench.py on a real TPU) own their
+# platform/mesh setup, and mutating JAX_PLATFORMS/XLA_FLAGS at import
+# time would silently retarget every later benchmark to CPU.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DP = 8
+BATCH = 8
+DIM = 3
+
+
+def _make_batches(n, seed):
+    from mxnet_tpu import np as mnp
+
+    rng = np.random.RandomState(seed)
+    return [(mnp.array(rng.randn(BATCH, DIM).astype("float32")),
+             mnp.array(rng.randn(BATCH, 1).astype("float32")))
+            for _ in range(n)]
+
+
+def _fresh(ctxs, seed):
+    """Net + trainer + estimator on an explicit context list, with a
+    dist_tpu store on the matching mesh."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.kvstore.dist_tpu import KVStoreDistTPUSync
+    from mxnet_tpu.parallel import mesh as mesh_mod
+    from mxnet_tpu.resilience.elastic import ElasticBatchProcessor
+
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.Dense(1, in_units=DIM)
+    net.initialize(ctx=ctxs)
+    mesh = mesh_mod.make_mesh(
+        {"dp": len(ctxs)}, devices=[c.jax_device() for c in ctxs])
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore=KVStoreDistTPUSync(mesh=mesh))
+    est = Estimator(net, gluon.loss.L2Loss(), trainer=tr,
+                    train_metrics=[gluon.metric.MAE()],
+                    batch_processor=ElasticBatchProcessor())
+    return net, tr, est
+
+
+def _params_np(net):
+    return {k: v.data().asnumpy()
+            for k, v in net.collect_params().items()}
+
+
+def _agree_and_finite(tr, violations, leg):
+    from mxnet_tpu.resilience.elastic import replica_fingerprints
+    from mxnet_tpu.resilience.guardrails import all_finite
+
+    fps = replica_fingerprints(tr._params)
+    if len(set(fps)) != 1:
+        violations.append(f"{leg}: replicas ended desynced: {fps}")
+    if not all_finite([p.data() for p in tr._params]):
+        violations.append(f"{leg}: non-finite parameters at end")
+    if not all(np.isfinite(v).all() for fp in fps for v in fp):
+        violations.append(f"{leg}: non-finite fingerprint: {fps}")
+
+
+def run_kill_reshard(seed=7, n_batches=12, say=lambda m: None):
+    """The kill-and-reshard leg, importable (bench.py's MULTICHIP row):
+    returns ``(violations, row)`` where ``row`` carries ``steps_lost``
+    and ``recovery_wall_s``."""
+    # self-contained (bench.py calls this leg directly): the kvstore
+    # reads the flag at construction, so it must be set before _fresh()
+    prev_elastic = os.environ.get("MXNET_ELASTIC")
+    os.environ["MXNET_ELASTIC"] = "1"
+    try:
+        return _run_kill_reshard_inner(seed, n_batches, say)
+    finally:
+        if prev_elastic is None:
+            os.environ.pop("MXNET_ELASTIC", None)
+        else:
+            os.environ["MXNET_ELASTIC"] = prev_elastic
+
+
+def _run_kill_reshard_inner(seed, n_batches, say):
+    import tempfile
+
+    from mxnet_tpu.parallel import mesh as mesh_mod
+    from mxnet_tpu.resilience import checkpoint as ckpt, faults
+    from mxnet_tpu.resilience.elastic import ElasticTrainingHandler
+
+    violations = []
+    rng = np.random.RandomState(seed * 131 + 1)
+    kill_replica = int(rng.randint(0, DP))
+    kill_step = int(rng.randint(2, n_batches - 2))
+    # Dense(1) carries 2 reduced params (weight, bias): 2 allreduce
+    # calls per step, so hit index 2*k is the first reduce of step k —
+    # "killed mid-step", after backward, inside the collective
+    kill_hit = 2 * kill_step
+    say(f"kill leg: chip_loss replica {kill_replica} during batch "
+        f"{kill_step} (seed {seed})")
+
+    m8 = mesh_mod.make_mesh({"dp": DP})
+    ctxs8 = mesh_mod.mesh_contexts(m8)
+    prev_mesh = mesh_mod.get_mesh()
+    batches = _make_batches(n_batches, seed)
+    d = tempfile.mkdtemp(prefix="elastic_soak_")
+    t0 = time.perf_counter()
+    try:
+        net, tr, est = _fresh(ctxs8, seed)
+        eh = ElasticTrainingHandler(d, batch_period=1,
+                                    max_keep=n_batches + 2)
+        faults.install_plan({"seed": seed, "rules": [
+            {"site": "kvstore:allreduce", "kind": "chip_loss",
+             "replica": kill_replica, "at": [kill_hit]}]})
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            est.fit(batches, batches=n_batches, event_handlers=[eh])
+    except Exception as exc:  # noqa: BLE001 — taxonomy violation
+        violations.append(f"kill: training raised {type(exc).__name__}: "
+                          f"{exc}")
+        return violations, {}
+    finally:
+        faults.clear_plan()
+        mesh_mod.set_mesh(prev_mesh)
+    wall = time.perf_counter() - t0
+
+    if eh.stats["restarts"] != 1:
+        violations.append(f"kill: expected 1 restart, got {eh.stats}")
+        return violations, {}
+    if eh.stats["dp_history"] != [(DP, DP // 2)]:
+        violations.append(
+            f"kill: expected dp{DP}->dp{DP // 2}, got "
+            f"{eh.stats['dp_history']}")
+    _agree_and_finite(tr, violations, "kill")
+    p_elastic = _params_np(net)
+
+    # bitwise reference: dp4 on the SAME surviving devices, continued
+    # from the SAME checkpoint the elastic run restored, over the same
+    # remaining batches
+    m4 = mesh_mod.shrink_mesh(m8, [kill_replica], axis="dp")
+    ctxs4 = mesh_mod.mesh_contexts(m4)
+    try:
+        net2, tr2, est2 = _fresh(ctxs4, seed + 1000)  # init must not matter
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ckpt.load_checkpoint(eh.manager._path(kill_step), net=net2,
+                                 trainer=tr2)
+            est2.fit(batches[kill_step + 1:],
+                     batches=n_batches - kill_step - 1)
+    except Exception as exc:  # noqa: BLE001
+        violations.append(
+            f"kill: dp4 reference run raised {type(exc).__name__}: {exc}")
+        return violations, {}
+    finally:
+        mesh_mod.set_mesh(prev_mesh)
+    p_ref = _params_np(net2)
+    for k in p_elastic:
+        if not np.array_equal(p_elastic[k], p_ref[k]):
+            violations.append(
+                f"kill: param {k} differs from the uninterrupted dp4 "
+                "reference (silent divergence)")
+    row = {"steps_lost": eh.stats["steps_lost"],
+           "recovery_wall_s": eh.stats["last_recovery_s"],
+           "dp_from": DP, "dp_to": DP // 2,
+           "killed_replica": kill_replica, "killed_step": kill_step,
+           "leg_wall_s": wall}
+    say(f"kill leg: steps_lost={row['steps_lost']} "
+        f"recovery={row['recovery_wall_s'] * 1e3:.0f}ms parity=EXACT")
+    return violations, row
+
+
+def _run_lag_leg(seed, n_batches, say):
+    from mxnet_tpu.parallel import mesh as mesh_mod
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.resilience.elastic import StragglerMonitor
+
+    violations = []
+    rng = np.random.RandomState(seed * 131 + 2)
+    lag_replica = int(rng.randint(0, DP))
+    say(f"lag leg: replica_delay on replica {lag_replica}")
+    m8 = mesh_mod.make_mesh({"dp": DP})
+    ctxs8 = mesh_mod.mesh_contexts(m8)
+    batches = _make_batches(n_batches, seed)
+
+    def run(with_lag):
+        net, tr, est = _fresh(ctxs8, seed)
+        if with_lag:
+            faults.install_plan({"seed": seed, "rules": [
+                {"site": "trainer:replica_step", "kind": "replica_delay",
+                 "replica": lag_replica, "seconds": 0.02,
+                 "times": n_batches}]})
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                est.fit(batches, batches=n_batches)
+        finally:
+            faults.clear_plan()
+        return net, tr
+
+    mon = StragglerMonitor(threshold_ms=8.0).install()
+    try:
+        net_lag, tr_lag = run(with_lag=True)
+    except Exception as exc:  # noqa: BLE001
+        violations.append(f"lag: training raised {type(exc).__name__}: "
+                          f"{exc}")
+        StragglerMonitor.uninstall()
+        return violations, {}
+    StragglerMonitor.uninstall()
+    if mon.stats["flags"] < 1:
+        violations.append(
+            f"lag: straggler never flagged ({mon.snapshot()})")
+    elif mon.stats["last_straggler"] != lag_replica:
+        violations.append(
+            f"lag: blamed replica {mon.stats['last_straggler']}, "
+            f"injected lag on {lag_replica}")
+    _agree_and_finite(tr_lag, violations, "lag")
+    try:
+        net_ref, _tr_ref = run(with_lag=False)
+    except Exception as exc:  # noqa: BLE001
+        violations.append(f"lag: reference run raised "
+                          f"{type(exc).__name__}: {exc}")
+        return violations, {}
+    p_lag, p_ref = _params_np(net_lag), _params_np(net_ref)
+    for k in p_lag:
+        if not np.array_equal(p_lag[k], p_ref[k]):
+            violations.append(
+                f"lag: param {k} changed under pure delay faults — a "
+                "straggler must slow the mesh, never change it")
+    say(f"lag leg: flags={mon.stats['flags']} "
+        f"blamed={mon.stats['last_straggler']} numerics=EXACT")
+    return violations, {"flags": mon.stats["flags"],
+                        "blamed": mon.stats["last_straggler"]}
+
+
+def _run_corrupt_leg(seed, n_batches, say):
+    from mxnet_tpu.parallel import mesh as mesh_mod
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.resilience.elastic import DesyncAuditHandler
+
+    violations = []
+    rng = np.random.RandomState(seed * 131 + 3)
+    bad_replica = int(rng.randint(0, DP))
+    corrupt_step = int(rng.randint(1, n_batches // 2))
+    cadence = int(rng.randint(1, 4))
+    say(f"corrupt leg: param_corrupt replica {bad_replica} at step "
+        f"{corrupt_step}, audit cadence {cadence}")
+    m8 = mesh_mod.make_mesh({"dp": DP})
+    ctxs8 = mesh_mod.mesh_contexts(m8)
+    batches = _make_batches(n_batches, seed)
+    net, tr, est = _fresh(ctxs8, seed)
+    audit = DesyncAuditHandler(check_steps=cadence)
+    faults.install_plan({"seed": seed, "rules": [
+        {"site": "trainer:param", "kind": "param_corrupt",
+         "replica": bad_replica, "at": [corrupt_step]}]})
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            est.fit(batches, batches=n_batches, event_handlers=[audit])
+    except Exception as exc:  # noqa: BLE001
+        violations.append(f"corrupt: training raised "
+                          f"{type(exc).__name__}: {exc}")
+        return violations, {}
+    finally:
+        faults.clear_plan()
+    if audit.stats["trips"] < 1:
+        violations.append(
+            f"corrupt: audit never tripped (cadence {cadence}, stats "
+            f"{audit.stats}) — SILENT single-replica divergence")
+        return violations, {}
+    if audit.stats["last_blamed"] != [bad_replica]:
+        violations.append(
+            f"corrupt: blamed {audit.stats['last_blamed']}, corrupted "
+            f"{bad_replica}")
+    if audit.stats["resyncs"] < 1:
+        violations.append(
+            f"corrupt: no resync performed ({audit.stats})")
+    _agree_and_finite(tr, violations, "corrupt")
+    say(f"corrupt leg: detected within cadence, blamed="
+        f"{audit.stats['last_blamed']} resyncs={audit.stats['resyncs']}")
+    return violations, {"trips": audit.stats["trips"],
+                        "blamed": audit.stats["last_blamed"],
+                        "cadence": cadence}
+
+
+def run_soak(seed=7, n_batches=12, verbose=True):
+    """One full seeded kill/lag/corrupt sweep; returns a report dict with
+    ``ok``/``violations`` plus the per-leg numbers. Importable —
+    ``tests/test_elastic.py`` runs the same machinery."""
+    import mxnet_tpu as mx  # noqa: F401
+
+    def say(msg):
+        if verbose:
+            print(f"ELASTIC_SOAK {msg}", flush=True)
+
+    prev = os.environ.get("MXNET_ELASTIC")
+    os.environ["MXNET_ELASTIC"] = "1"
+    try:
+        violations, kill_row = run_kill_reshard(seed, n_batches, say)
+        v2, lag_row = _run_lag_leg(seed, n_batches, say)
+        v3, corrupt_row = _run_corrupt_leg(seed, n_batches, say)
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_ELASTIC", None)
+        else:
+            os.environ["MXNET_ELASTIC"] = prev
+    violations += v2 + v3
+    report = {"ok": not violations, "violations": violations,
+              "seed": seed, "kill": kill_row, "lag": lag_row,
+              "corrupt": corrupt_row}
+    say(f"seed {seed}: {'PASS' if report['ok'] else 'FAIL'} "
+        f"kill={kill_row} corrupt={corrupt_row}")
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="sweep seed..seed+N-1 (tier-1 smoke: 1; "
+                         "full sweep: 8)")
+    ap.add_argument("--batches", type=int, default=12)
+    args = ap.parse_args(argv)
+
+    failures = []
+    for s in range(args.seed, args.seed + args.seeds):
+        report = run_soak(seed=s, n_batches=args.batches)
+        if not report["ok"]:
+            failures.append((s, report["violations"]))
+        else:
+            k = report["kill"]
+            print(f"ELASTIC_SOAK=PASS seed={s} "
+                  f"steps_lost={k.get('steps_lost')} "
+                  f"recovery_ms={(k.get('recovery_wall_s') or 0) * 1e3:.0f} "
+                  f"dp={k.get('dp_from')}->{k.get('dp_to')}")
+    if failures:
+        for s, v in failures:
+            for msg in v:
+                print(f"ELASTIC_SOAK=FAIL seed={s} {msg}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _FLAG = "--xla_force_host_platform_device_count=8"
+    if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " " + _FLAG).strip()
+    sys.exit(main())

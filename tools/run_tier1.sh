@@ -68,4 +68,17 @@ if [[ "${TIER1_CHAOS:-1}" != "0" ]]; then
         rc=$chaos_rc
     fi
 fi
+# Elastic soak smoke (TIER1_ELASTIC=0 to skip): one seeded
+# kill/lag/corrupt sweep through a dp8 training loop — asserts the
+# chip-loss dp8->dp4 resume lands bitwise on the dp4 reference run,
+# straggler blame, and desync detection within the audit cadence. The
+# full 8-seed sweep lives in tests/test_elastic.py behind -m slow.
+if [[ "${TIER1_ELASTIC:-1}" != "0" ]]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python tools/elastic_soak.py --seeds "${TIER1_ELASTIC_SEEDS:-1}"
+    elastic_rc=$?
+    if [[ "$rc" -eq 0 && "$elastic_rc" -ne 0 ]]; then
+        rc=$elastic_rc
+    fi
+fi
 exit "$rc"
