@@ -32,6 +32,7 @@ from __future__ import annotations
 import threading
 import time
 import warnings
+import weakref
 from typing import List, Sequence
 
 from . import autograd
@@ -45,6 +46,25 @@ _trace_state = threading.local()
 # fault-injection hot-state (resilience.faults.FaultPlan slot, see
 # ops/registry.py): None until a plan installs
 _FAULTS = None
+
+# live CachedOp instances, for the process-wide cache_stats() aggregate
+# (profiler.export pulls it); weak so the registry never pins an executor
+_instances: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def cache_stats():
+    """Process-wide signature-cache telemetry: the per-instance
+    :meth:`CachedOp.cache_stats` fields summed over every live CachedOp
+    (plus the instance count)."""
+    agg = {"instances": 0, "hits": 0, "misses": 0, "signatures": 0,
+           "serve_hits": 0, "compile_ms": 0.0}
+    for op in list(_instances):
+        s = op.cache_stats()
+        agg["instances"] += 1
+        for k in ("hits", "misses", "signatures", "serve_hits",
+                  "compile_ms"):
+            agg[k] += s[k]
+    return agg
 
 # sentinel marking a traced (array) position in a CachedOp call signature
 _TRACED = object()
@@ -123,6 +143,7 @@ class CachedOp:
         self._storm_warned = False
         self._serve_hits = 0
         self._call_tls = threading.local()
+        _instances.add(self)
 
     def cache_stats(self):
         """Signature-cache telemetry: hits/misses/signatures/compile time
@@ -197,6 +218,11 @@ class CachedOp:
             # the silent perf failure this counter exists to surface
             self._storm_warned = True
             _prof.incr_counter("cachedop.recompile_storms", cat="cachedop")
+            from .profiler import recorder as _recorder
+
+            _recorder.note("warn", "cachedop.recompile_storm",
+                           {"block": str(blk), "signatures": nsig,
+                            "limit": limit})
             warnings.warn(
                 f"CachedOp({blk}) compiled {nsig} distinct signatures "
                 f"(> MXNET_CACHEDOP_SIG_LIMIT={limit}); likely a recompile "

@@ -303,3 +303,36 @@ register_flag(
     "MXNET_LOSS_SCALE_MAX", 2.0 ** 24,
     "Upper clamp for the dynamic LossScaler: a long overflow-free run "
     "can never drive the scale to inf.", float)
+register_flag(
+    "MXNET_TRACE", False,
+    "Enable request-scoped tracing (profiler.trace): serving submits and "
+    "training steps get per-request Trace ids whose spans are emitted as "
+    "chrome async/flow events when the profiler bus records. Off: one "
+    "bool check per instrumented site.", _bool)
+register_flag(
+    "MXNET_TRACE_MAX", 1024,
+    "Bounded in-process trace registry size (oldest traces evicted); the "
+    "profiler.trace.summary(trace_id) lookback window.", int)
+register_flag(
+    "MXNET_FLIGHT_RECORDER", True,
+    "Always-on flight recorder (profiler.recorder): a bounded ring of "
+    "recent warnings/faults/escalations dumped to JSON automatically at "
+    "DivergenceError / MeshDegraded / checkpoint quarantine / "
+    "breaker-open / watchdog timeout. 0 disables (ring writes become one "
+    "bool check).", _bool)
+register_flag(
+    "MXNET_FLIGHT_RECORDER_SIZE", 512,
+    "Flight-recorder ring capacity (most recent N notes kept).", int)
+register_flag(
+    "MXNET_FLIGHT_RECORDER_DIR", None,
+    "Directory for automatic flight-recorder dumps "
+    "(flightrec-<utc>-<reason>.json). Default: the system tempdir.")
+register_flag(
+    "MXNET_FLIGHT_RECORDER_MAX_DUMPS", 16,
+    "Per-process cap on automatic flight-recorder dump files (first "
+    "escalations win; later ones only land in the ring).", int)
+register_flag(
+    "MXNET_METRICS_PORT", 0,
+    "Serve the unified telemetry surface (profiler.export) over stdlib "
+    "HTTP on this port: /metrics (Prometheus text), /healthz (serving "
+    "health JSON), /snapshot (full JSON). 0 (default): no server.", int)

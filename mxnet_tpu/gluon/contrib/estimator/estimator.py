@@ -64,6 +64,15 @@ class Estimator:
         train_begin, epoch_begin, batch_begin, pre_step, batch_end, \
             epoch_end, train_end = self._categorize(handlers)
 
+        from ....profiler import trace as _trace
+
+        # request-scoped tracing (MXNET_TRACE=1): the whole fit is one
+        # trace whose train::step spans carry the global step id —
+        # dist_tpu tags its collective events with the same id, so a
+        # dumped trace correlates a slow step with its collectives
+        fit_trace = _trace.start_trace(
+            f"train.fit[{type(self.net).__name__}]")
+        step_n = 0
         for h in train_begin:
             h.train_begin(self)
         stop = False
@@ -71,29 +80,35 @@ class Estimator:
             for h in epoch_begin:
                 h.epoch_begin(self)
             for batch in train_data:
-                for h in batch_begin:
-                    h.batch_begin(self, batch=batch)
-                _data, label, pred, l = \
-                    self.batch_processor.fit_batch(self, batch)
-                # pre-step vetting (numerical guardrails): any PreStep
-                # handler returning False vetoes the optimizer update for
-                # this batch — the weights never see it
-                step_ok = True
-                for h in pre_step:
-                    if h.pre_step(self, batch=batch, loss=l) is False:
-                        step_ok = False
-                if step_ok:
-                    try:
-                        self.trainer.step(1)
-                    except MXNetError as e:
-                        # e.g. the dist_tpu pre-collective NaN quarantine:
-                        # a PreStep handler may absorb it as a skip-step
-                        if not any(h.step_error(self, e)
-                                   for h in pre_step):
-                            raise
-                for h in batch_end:
-                    h.batch_end(self, batch=batch, pred=pred, label=label,
-                                loss=l)
+                if fit_trace is not None:
+                    step_n += 1
+                    _trace.set_step(step_n)
+                with _trace.activate(fit_trace), \
+                        _trace.span("train::step", {"step": step_n}):
+                    for h in batch_begin:
+                        h.batch_begin(self, batch=batch)
+                    _data, label, pred, l = \
+                        self.batch_processor.fit_batch(self, batch)
+                    # pre-step vetting (numerical guardrails): any PreStep
+                    # handler returning False vetoes the optimizer update
+                    # for this batch — the weights never see it
+                    step_ok = True
+                    for h in pre_step:
+                        if h.pre_step(self, batch=batch, loss=l) is False:
+                            step_ok = False
+                    if step_ok:
+                        try:
+                            self.trainer.step(1)
+                        except MXNetError as e:
+                            # e.g. the dist_tpu pre-collective NaN
+                            # quarantine: a PreStep handler may absorb it
+                            # as a skip-step
+                            if not any(h.step_error(self, e)
+                                       for h in pre_step):
+                                raise
+                    for h in batch_end:
+                        h.batch_end(self, batch=batch, pred=pred,
+                                    label=label, loss=l)
                 stop = any(getattr(h, "stop_training", False)
                            for h in handlers)
                 if stop:
@@ -104,6 +119,8 @@ class Estimator:
                                for h in handlers)
         for h in train_end:
             h.train_end(self)
+        if fit_trace is not None:
+            fit_trace.finish()
 
     def _init_handlers(self, val_data, event_handlers, epochs, batches):
         handlers = list(event_handlers or [])

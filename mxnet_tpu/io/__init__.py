@@ -363,6 +363,9 @@ class PrefetchIter(DataIter):
         self._error = None
 
         def run():
+            from ..profiler import core as _prof
+
+            _prof.register_thread_name()
             try:
                 for batch in self.data_iter:
                     if self._stop.is_set():

@@ -22,10 +22,13 @@ Two operating modes:
 from __future__ import annotations
 
 import warnings
+import weakref
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..profiler import core as _prof
+from ..profiler import recorder as _recorder
+from ..profiler import trace as _trace
 from ..resilience import counters as _res_counters
 from ..resilience import retry as _retry
 from .base import KVStoreBase
@@ -39,6 +42,39 @@ _FAULTS = None
 # same discipline): None until a monitor installs; when set, collective
 # call sites report per-replica arrival lag to it
 _STRAGGLER = None
+
+# live stores, for the process-wide collective_stats() aggregate
+# (profiler.export pulls it); weak so the registry never pins a store
+_stores: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _tag_step(args):
+    """Attach the current training-step id (profiler.trace.set_step) to a
+    collective event's args so a dumped trace correlates collectives with
+    the estimator's train::step spans."""
+    if _trace.ENABLED:
+        args["step"] = _trace.current_step()
+    return args
+
+
+def collective_stats():
+    """Process-wide collective telemetry: per-instance ``_stats`` fields
+    summed over every live store, plus the worst breaker state ('open' >
+    'half_open' > 'closed') and the shared retry/watchdog counters."""
+    rank = {"closed": 0, "half_open": 1, "open": 2}
+    agg = {"stores": 0, "breaker_state": "closed"}
+    for kv in list(_stores):
+        agg["stores"] += 1
+        for k, v in kv._stats.items():
+            agg[k] = agg.get(k, 0) + v
+        state = kv._breaker.snapshot().get("state", "closed")
+        if rank.get(state, 0) > rank[agg["breaker_state"]]:
+            agg["breaker_state"] = state
+    agg["retries"] = _res_counters.get("resilience.retries")
+    agg["watchdog_timeouts"] = _res_counters.get(
+        "resilience.watchdog_timeouts")
+    agg["watchdog_orphans"] = _retry.watchdog_orphans()
+    return agg
 
 
 def _jax():
@@ -104,6 +140,7 @@ class KVStoreDistTPUSync(KVStoreLocal):
         self._stats = {"allreduce_calls": 0, "collective": 0, "eager": 0,
                        "degradations": 0, "breaker_skips": 0,
                        "quarantined": 0, "mesh_losses": 0}
+        _stores.add(self)
 
     def collective_stats(self):
         """Resilience/degradation telemetry for this store (the
@@ -148,6 +185,11 @@ class KVStoreDistTPUSync(KVStoreLocal):
                                  "resilience",
                                  args={"lost": lost,
                                        "error": str(cause)[:200]})
+        # crash forensics: the moments before a mesh loss, on disk
+        _recorder.dump("mesh_degraded",
+                       args={"op": op, "lost": lost,
+                             "cause": str(cause)[:500],
+                             "step": _trace.current_step()})
         warnings.warn(
             f"kvstore {op}: collective failure classified as MESH LOSS "
             f"(lost replica(s) {lost if lost is not None else 'unknown'}): "
@@ -516,9 +558,10 @@ class KVStoreDistTPUSync(KVStoreLocal):
             if t0:
                 _prof.record_duration(
                     "kvstore::allreduce", "kvstore", t0,
-                    args={"path": "collective",
-                          "shape": list(datas[0].shape),
-                          "bytes": sum(int(d.nbytes) for d in datas)})
+                    args=_tag_step({
+                        "path": "collective",
+                        "shape": list(datas[0].shape),
+                        "bytes": sum(int(d.nbytes) for d in datas)}))
             return [NDArray(d) for d in fast]
         self.last_path = "eager"
         self._stats["eager"] += 1
@@ -535,8 +578,9 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if t0:
             _prof.record_duration(
                 "kvstore::allreduce", "kvstore", t0,
-                args={"path": "eager", "shape": list(datas[0].shape),
-                      "bytes": sum(int(d.nbytes) for d in datas)})
+                args=_tag_step({
+                    "path": "eager", "shape": list(datas[0].shape),
+                    "bytes": sum(int(d.nbytes) for d in datas)}))
         return out
 
     def _cross_process_sum(self, nd):
@@ -600,11 +644,12 @@ class KVStoreDistTPUSync(KVStoreLocal):
         if tpp:
             _prof.record_duration(
                 "kvstore::pushpull", "kvstore", tpp,
-                args={"keys": len(keys),
-                      # None-tolerant like the skip-guard above: skipped
-                      # keys/entries contribute 0 bytes, not a crash
-                      "bytes": sum(v.nbytes for vs in values if vs
-                                   for v in vs if v is not None)})
+                args=_tag_step({
+                    "keys": len(keys),
+                    # None-tolerant like the skip-guard above: skipped
+                    # keys/entries contribute 0 bytes, not a crash
+                    "bytes": sum(v.nbytes for vs in values if vs
+                                 for v in vs if v is not None)}))
 
     def broadcast(self, key, value, out, priority=0):
         """Replicate rank-0 value to all devices (reference Broadcast)."""
@@ -637,7 +682,7 @@ class KVStoreDistTPUSync(KVStoreLocal):
                 d._set_data_internal(buf)
         if tbc:
             _prof.record_duration("kvstore::broadcast", "kvstore", tbc,
-                                  args={"keys": len(keys)})
+                                  args=_tag_step({"keys": len(keys)}))
 
     # -- sharded-native helpers -------------------------------------------
     def shard(self, array: NDArray, spec):

@@ -23,6 +23,19 @@ Three layers:
   ``set_config(profile_xla=True)``) and the per-op DEVICE-time tables
   :func:`device_op_stats` / :func:`device_op_table`.
 
+Plus three observability layers over the bus (OBSERVABILITY.md):
+
+* ``trace``    — request-scoped tracing (``MXNET_TRACE=1``): serving
+  submits and training steps become chrome async/flow lanes connected by
+  trace id across threads; ``trace.summary(trace_id)`` in-process.
+* ``recorder`` — the always-on flight recorder (``MXNET_FLIGHT_RECORDER``,
+  default on): a bounded ring of recent faults/sheds/warnings dumped to
+  JSON automatically at escalation points (DivergenceError, MeshDegraded,
+  quarantine, breaker-open, watchdog timeout).
+* ``export``   — one ``snapshot()`` merging every subsystem's telemetry,
+  rendered as Prometheus text and optionally served over HTTP
+  (``MXNET_METRICS_PORT``): /metrics, /healthz, /snapshot.
+
 Env vars (registered in ``mx.config``): ``MXNET_PROFILER_AUTOSTART=1``
 starts the bus at import, ``MXNET_PROFILER_IMPERATIVE=1`` opts into per-op
 dispatch counters, ``MXNET_CACHEDOP_SIG_LIMIT`` sets the recompile-storm
@@ -34,8 +47,9 @@ import contextlib
 import time
 
 from ..base import MXNetError
-from . import core, metrics, xla
-from .core import aggregate_stats, reset, snapshot_events
+from . import core, export, metrics, recorder, trace, xla
+from .core import (aggregate_stats, register_thread_name, reset,
+                   snapshot_events)
 from .metrics import (
     TrainingMetrics,
     chip_peak,
@@ -241,3 +255,11 @@ if _cfg.get("MXNET_PROFILER_AUTOSTART"):
     set_state("run")
 elif _cfg.get("MXNET_PROFILER_IMPERATIVE"):
     set_config(profile_imperative=True)
+
+# MXNET_TRACE=1: request-scoped tracing on from import (spans only land
+# as chrome events while the bus records, but summaries work regardless)
+if _cfg.get("MXNET_TRACE"):
+    trace.enable(max_traces=_cfg.get("MXNET_TRACE_MAX"))
+
+# MXNET_METRICS_PORT=<p>: unified /metrics + /healthz endpoint at import
+export.maybe_start_from_env()

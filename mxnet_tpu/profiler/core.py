@@ -30,13 +30,14 @@ IMPERATIVE = False   # per-op dispatch counters (set_config(profile_imperative=T
 
 _MAX_EVENTS = 2_000_000  # hard cap; beyond it events are counted as dropped
 
-_lock = threading.Lock()             # guards aggregates (events append via GIL)
+_lock = threading.Lock()             # guards events, aggregates and counters
 _events: list = []                   # chrome trace event dicts
 _dropped = 0
 _epoch_ns = time.perf_counter_ns()   # ts origin for the whole process
 _agg = collections.defaultdict(lambda: [0, 0.0])  # name -> [calls, total_s]
 _op_counts: collections.Counter = collections.Counter()  # imperative op calls
 _counters: dict = {}                 # counter name -> last value
+_thread_names: dict = {}             # tid -> human name ('M' metadata events)
 
 
 def begin() -> int:
@@ -74,6 +75,33 @@ def reset():
         _dropped = 0
 
 
+def register_thread_name(name=None, tid=None):
+    """Name the calling thread (or ``tid``) in dumped traces via a chrome
+    'M' ``thread_name`` metadata event. Long-lived worker threads (batcher
+    flusher, prefetch worker) call this once at startup; registration is
+    kept across ``reset()`` so a later dump still labels them."""
+    if tid is None:
+        tid = threading.get_ident() & 0xFFFFFFFF
+    if name is None:
+        name = threading.current_thread().name
+    with _lock:
+        _thread_names[int(tid)] = str(name)
+
+
+def append_event(ev):
+    """Append a pre-built chrome event dict (trace/flow emitters). Honors
+    the same ``ENABLED`` gate and event cap as the record_* helpers."""
+    global _dropped
+    if not ENABLED:
+        return False
+    with _lock:
+        if len(_events) >= _MAX_EVENTS:
+            _dropped += 1
+            return False
+        _events.append(ev)
+    return True
+
+
 def record_duration(name, cat, t0_ns, t1_ns=None, args=None):
     """One completed range: aggregates always, a chrome 'X' event when the
     bus is running (so ``profiler.scope`` keeps feeding ``dumps()`` even
@@ -82,22 +110,23 @@ def record_duration(name, cat, t0_ns, t1_ns=None, args=None):
     if t1_ns is None:
         t1_ns = time.perf_counter_ns()
     dur_s = (t1_ns - t0_ns) / 1e9
+    enabled = ENABLED
     with _lock:
         row = _agg[name]
         row[0] += 1
         row[1] += dur_s
-    if not ENABLED:
-        return
-    if len(_events) >= _MAX_EVENTS:
-        _dropped += 1
-        return
-    ev = {"ph": "X", "name": name, "cat": cat or "host",
-          "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFFFFFF,
-          "ts": round(_ts_us(t0_ns), 3),
-          "dur": round((t1_ns - t0_ns) / 1e3, 3)}
-    if args:
-        ev["args"] = args
-    _events.append(ev)
+        if not enabled:
+            return
+        if len(_events) >= _MAX_EVENTS:
+            _dropped += 1
+            return
+        ev = {"ph": "X", "name": name, "cat": cat or "host",
+              "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFFFFFF,
+              "ts": round(_ts_us(t0_ns), 3),
+              "dur": round((t1_ns - t0_ns) / 1e3, 3)}
+        if args:
+            ev["args"] = args
+        _events.append(ev)
 
 
 def record_instant(name, cat="host", args=None):
@@ -105,23 +134,21 @@ def record_instant(name, cat="host", args=None):
     global _dropped
     if not ENABLED:
         return
-    if len(_events) >= _MAX_EVENTS:
-        _dropped += 1
-        return
     ev = {"ph": "i", "s": "t", "name": name, "cat": cat,
           "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFFFFFF,
           "ts": round(_ts_us(time.perf_counter_ns()), 3)}
     if args:
         ev["args"] = args
-    _events.append(ev)
+    with _lock:
+        if len(_events) >= _MAX_EVENTS:
+            _dropped += 1
+            return
+        _events.append(ev)
 
 
-def set_counter(name, value, cat="counters"):
-    """Record a gauge value (chrome 'C' event when running)."""
+def _counter_event(name, value, cat):
+    """Append the chrome 'C' gauge event. Caller holds ``_lock``."""
     global _dropped
-    _counters[name] = value
-    if not ENABLED:
-        return
     if len(_events) >= _MAX_EVENTS:
         _dropped += 1
         return
@@ -131,12 +158,34 @@ def set_counter(name, value, cat="counters"):
                     "args": {"value": value}})
 
 
+def set_counter(name, value, cat="counters"):
+    """Record a gauge value (chrome 'C' event when running)."""
+    with _lock:
+        _counters[name] = value
+        if ENABLED:
+            _counter_event(name, value, cat)
+
+
 def incr_counter(name, delta=1, cat="counters"):
-    set_counter(name, _counters.get(name, 0) + delta, cat=cat)
+    """Atomic counter bump: the read-modify-write happens under ``_lock``
+    so concurrent increments from batcher/flusher/engine threads never
+    lose counts."""
+    with _lock:
+        value = _counters.get(name, 0) + delta
+        _counters[name] = value
+        if ENABLED:
+            _counter_event(name, value, cat)
+    return value
 
 
 def get_counter(name, default=0):
     return _counters.get(name, default)
+
+
+def counters_snapshot():
+    """Consistent copy of every counter gauge."""
+    with _lock:
+        return dict(_counters)
 
 
 def count_op(name):
@@ -191,17 +240,38 @@ def dumps_table(reset_after=False):
 
 def snapshot_events():
     """Copy of the recorded chrome events (tests / tooling)."""
-    return list(_events)
+    with _lock:
+        return list(_events)
+
+
+def _meta_events():
+    """Chrome 'M' metadata: process name plus a ``thread_name`` row for
+    every registered worker thread and every currently-live thread, so
+    Perfetto lanes read "mxtpu-serve-batcher[x]" instead of bare tids."""
+    pid = os.getpid()
+    names = dict(_thread_names)
+    for t in threading.enumerate():
+        if t.ident is not None:
+            names.setdefault(t.ident & 0xFFFFFFFF, t.name)
+    meta = [{"ph": "M", "pid": pid, "name": "process_name",
+             "args": {"name": "mxnet_tpu host"}}]
+    for tid in sorted(names):
+        meta.append({"ph": "M", "pid": pid, "tid": tid,
+                     "name": "thread_name", "args": {"name": names[tid]}})
+    return meta
 
 
 def dump(path):
     """Write the chrome://tracing JSON (reference ``dump()`` contract:
-    load the file in chrome://tracing or Perfetto). Returns ``path``."""
-    meta = [{"ph": "M", "pid": os.getpid(), "name": "process_name",
-             "args": {"name": "mxnet_tpu host"}}]
-    doc = {"traceEvents": meta + _events, "displayTimeUnit": "ms"}
-    if _dropped:
-        doc["mxnet_tpu_dropped_events"] = _dropped
+    load the file in chrome://tracing or Perfetto). Returns ``path``.
+    The event-list copy happens under ``_lock`` so a dump racing live
+    appends can't serialize a half-written list."""
+    with _lock:
+        events = list(_events)
+        dropped = _dropped
+    doc = {"traceEvents": _meta_events() + events, "displayTimeUnit": "ms"}
+    if dropped:
+        doc["mxnet_tpu_dropped_events"] = dropped
     with open(path, "w") as f:
         json.dump(doc, f)
     return path

@@ -432,6 +432,11 @@ class CheckpointManager:
                                  "resilience",
                                  args={"file": os.path.basename(path),
                                        "suffix": suffix})
+        from ..profiler import recorder as _recorder
+
+        _recorder.dump("checkpoint_quarantine",
+                       args={"file": os.path.basename(path),
+                             "suffix": suffix, "count": n})
         if _counters.should_warn(n):
             import warnings
 

@@ -14,6 +14,7 @@ import collections
 import threading
 
 from ..profiler import core as _prof
+from ..profiler import recorder as _recorder
 
 _lock = threading.Lock()
 _counts: collections.Counter = collections.Counter()
@@ -22,12 +23,22 @@ _counts: collections.Counter = collections.Counter()
 def incr(name, delta=1):
     with _lock:
         _counts[name] += delta
+        value = _counts[name]
     _prof.incr_counter(name, delta, cat="resilience")
+    # every resilience bump is flight-recorder-worthy: the ring of recent
+    # retries/degradations/trips is what a crash dump reads back
+    _recorder.note("counter", name, {"value": value})
 
 
 def get(name, default=0):
     with _lock:
         return _counts.get(name, default)
+
+
+def snapshot():
+    """Consistent copy of every resilience counter."""
+    with _lock:
+        return dict(_counts)
 
 
 def reset():

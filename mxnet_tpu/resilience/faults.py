@@ -94,6 +94,7 @@ import time
 
 from ..base import MXNetError
 from ..profiler import core as _prof
+from ..profiler import recorder as _recorder
 from . import counters as _counters
 
 # Sites wired in this PR (documented; fault_point accepts any name so new
@@ -271,6 +272,9 @@ class FaultPlan:
             f"injected {kind} fault at {site} "
             f"(plan seed {self.seed})")
         _counters.incr("resilience.faults_injected")
+        # the failing SITE lands in the flight-recorder ring: a later
+        # escalation dump (breaker-open, watchdog) names what fired here
+        _recorder.note("fault", site, {"kind": kind})
         if _prof.ENABLED:
             _prof.record_instant(f"resilience::fault({site})", "resilience",
                                  args={"kind": kind})

@@ -60,7 +60,18 @@ class NonFiniteGradError(MXNetError):
 
 class DivergenceError(MXNetError):
     """Guardrail escalation exhausted: no clean checkpoint to rewind to,
-    or the skip/rewind budget ran out. The run cannot self-heal."""
+    or the skip/rewind budget ran out. The run cannot self-heal.
+
+    Constructing one dumps the flight recorder (``profiler.recorder``):
+    the ring of skips/rewinds/warnings leading up to the divergence is
+    exactly the forensic record an unattended run loses otherwise."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        from ..profiler import recorder as _recorder
+
+        _recorder.dump("divergence",
+                       args={"message": str(self)[:500]})
 
 
 # -- sentinels (jit-friendly fused reductions) ------------------------------

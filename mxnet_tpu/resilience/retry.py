@@ -40,6 +40,7 @@ import time
 
 from ..base import MXNetError
 from ..profiler import core as _prof
+from ..profiler import recorder as _recorder
 from . import counters as _counters
 from .faults import ChipLostError, InjectedFaultError, \
     SimulatedWorkerDeath, TransientFaultError
@@ -230,6 +231,9 @@ def run_with_watchdog(fn, timeout_s, site="collective"):
                 _prof.record_instant(
                     f"resilience::watchdog_timeout({site})", "resilience",
                     args={"timeout_s": timeout_s, "orphans": n})
+            _recorder.dump("watchdog_timeout",
+                           args={"site": site, "timeout_s": timeout_s,
+                                 "orphans": n})
             if _counters.should_warn(n):
                 import warnings
 
@@ -281,6 +285,14 @@ class CircuitBreaker:
         if _prof.ENABLED:
             _prof.record_instant(f"resilience::breaker({self.name})",
                                  "resilience", args={"state": state})
+        _recorder.note("breaker", self.name, {"state": state})
+        if state == "open":
+            # a tripped breaker is an incident: dump the flight recorder
+            # (the ring carries the failures that tripped it)
+            _recorder.dump("breaker_open",
+                           args={"breaker": self.name,
+                                 "failures": self.consecutive_failures,
+                                 "trips": self.trips})
 
     def allow(self) -> bool:
         """May the protected path run now? (also advances the cooldown)"""

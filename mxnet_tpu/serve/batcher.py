@@ -53,6 +53,8 @@ import time
 import warnings
 from concurrent.futures import Future, InvalidStateError
 
+from ..profiler import core as _prof
+from ..profiler import trace as _trace
 from ..resilience import faults as _faults
 from .engine import DeadlineExceeded, ServeError, ServiceUnavailable
 from .metrics import ServeMetrics
@@ -89,7 +91,7 @@ class TokenBucket:
 
 class _Pending:
     __slots__ = ("payload", "future", "t_enq", "t_dispatch", "priority",
-                 "deadline")
+                 "deadline", "trace", "flow", "t_enq_ns", "t_dispatch_ns")
 
     def __init__(self, payload, priority="interactive", deadline=None):
         self.payload = payload
@@ -98,6 +100,28 @@ class _Pending:
         self.t_dispatch = None
         self.priority = priority
         self.deadline = deadline  # absolute time.monotonic() or None
+        # request-scoped tracing (profiler.trace); None when tracing is
+        # off. t_*_ns are perf_counter_ns stamps for retro span emission
+        # (t_enq/t_dispatch above are monotonic() — a different clock).
+        self.trace = None
+        self.flow = None
+        self.t_enq_ns = None
+        self.t_dispatch_ns = None
+
+
+def _retire_traced(p, stage, error=None):
+    """Close out a pending entry's trace on a non-settle exit path (shed
+    / expired / shutdown): the enqueue flow arrow must land somewhere
+    (no orphan 's' events) and the trace must read as finished. An entry
+    that already dispatched (``t_dispatch_ns`` set) had its arrow and
+    queue span emitted by the flusher — only the finish applies."""
+    if p.trace is None:
+        return
+    if p.t_dispatch_ns is None:
+        p.trace.flow_in(p.flow, "serve::enqueue")
+        p.trace.span_at("serve::queue", p.t_enq_ns,
+                        time.perf_counter_ns(), {"outcome": stage})
+    p.trace.finish(error=error or stage)
 
 
 def _settle_future(fut, result=None, error=None):
@@ -208,8 +232,10 @@ class DynamicBatcher:
                 "request(s) with 503 instead of leaking them",
                 RuntimeWarning, stacklevel=2)
         for p in stuck + leftovers:
-            _settle_future(p.future, error=ServiceUnavailable(
-                f"batcher {self.name!r} shut down before dispatch"))
+            err = ServiceUnavailable(
+                f"batcher {self.name!r} shut down before dispatch")
+            _retire_traced(p, "shutdown", err)
+            _settle_future(p.future, error=err)
 
     def drain(self, timeout=30.0):
         """Stop admission and wait until the queue AND the in-flight batch
@@ -265,6 +291,7 @@ class DynamicBatcher:
         if priority not in _PRIORITY_RANK:
             raise ServeError(
                 f"unknown priority {priority!r}; use one of {PRIORITIES}")
+        t_sub_ns = time.perf_counter_ns() if _trace.ENABLED else 0
         # admission fault site OUTSIDE the lock: an injected delay models
         # a slow admission path, not a queue-lock convoy
         _faults.fault_point("serve:queue", {"batcher": self.name,
@@ -331,14 +358,27 @@ class DynamicBatcher:
                     f"empty (MXNET_SERVE_RATE_LIMIT="
                     f"{self.rate_limiter.rate:g}/s); shed")
             p = _Pending(payload, priority=priority, deadline=deadline)
+            if t_sub_ns:
+                # trace set up BEFORE the entry is visible to the flusher
+                # (a half-traced entry would leak an unclosed flow arrow)
+                tr = _trace.start_trace(f"serve.request[{self.name}]",
+                                        args={"priority": priority})
+                if tr is not None:
+                    p.trace = tr
+                    p.t_enq_ns = time.perf_counter_ns()
+                    tr.span_at("serve::admit", t_sub_ns, p.t_enq_ns,
+                               {"priority": priority})
+                    p.flow = tr.flow_out("serve::enqueue")
             self._queue.append(p)
             self.metrics.set_queue_depth(len(self._queue))
             self._cond.notify()
         if shed is not None:
             self.metrics.observe_shed(shed.priority, reason="pressure")
-            _settle_future(shed.future, error=ServiceUnavailable(
+            err = ServiceUnavailable(
                 f"batcher {self.name!r}: shed under queue pressure to "
-                "admit higher-priority work"))
+                "admit higher-priority work")
+            _retire_traced(shed, "shed", err)
+            _settle_future(shed.future, error=err)
         return p.future
 
     def queue_depth(self):
@@ -407,6 +447,7 @@ class DynamicBatcher:
                     self._cond.wait(0.5)
 
     def _flush_loop(self):
+        _prof.register_thread_name()
         while True:
             batch, expired = self._take_batch()
             if expired:
@@ -416,9 +457,11 @@ class DynamicBatcher:
                     self.metrics.observe_request(
                         (now - p.t_enq) * 1e3, 0.0, ok=False,
                         priority=p.priority)
-                    _settle_future(p.future, error=DeadlineExceeded(
+                    err = DeadlineExceeded(
                         f"batcher {self.name!r}: deadline expired after "
-                        f"{(now - p.t_enq) * 1e3:.1f}ms in queue"))
+                        f"{(now - p.t_enq) * 1e3:.1f}ms in queue")
+                    _retire_traced(p, "expired", err)
+                    _settle_future(p.future, error=err)
                 with self._cond:
                     # the sweep may have emptied the queue: wake drain()
                     # waiters now, not at their timeout
@@ -429,11 +472,23 @@ class DynamicBatcher:
                     return
                 continue
             now = time.monotonic()
+            rep = None  # one traced request represents the batch downstream
             for p in batch:
                 p.t_dispatch = now
+                if p.trace is not None:
+                    # land the enqueue arrow on THIS thread + emit the
+                    # queue span retroactively from the stored ns stamps
+                    p.t_dispatch_ns = time.perf_counter_ns()
+                    p.trace.flow_in(p.flow, "serve::enqueue")
+                    p.trace.span_at("serve::queue", p.t_enq_ns,
+                                    p.t_dispatch_ns,
+                                    {"batch_size": len(batch)})
+                    if rep is None:
+                        rep = p.trace
             self.metrics.observe_batch(len(batch), self.max_batch_size)
             try:
-                results = self.runner([p.payload for p in batch])
+                with _trace.activate(rep):
+                    results = self.runner([p.payload for p in batch])
                 if len(results) != len(batch):
                     raise ServiceUnavailable(
                         f"batcher runner returned {len(results)} results "
@@ -451,6 +506,7 @@ class DynamicBatcher:
 
     def _settle(self, batch, results=None, error=None):
         done = time.monotonic()
+        done_ns = time.perf_counter_ns()
         for i, p in enumerate(batch):
             queue_ms = (p.t_dispatch - p.t_enq) * 1e3
             exec_ms = (done - p.t_dispatch) * 1e3
@@ -476,6 +532,11 @@ class DynamicBatcher:
                                          ok=exc is None,
                                          priority=p.priority,
                                          deadline_ok=deadline_ok)
+            if p.trace is not None:
+                p.trace.span_at("serve::execute", p.t_dispatch_ns, done_ns,
+                                {"exec_ms": round(exec_ms, 3),
+                                 "ok": exc is None})
+                p.trace.finish(error=exc)
             _settle_future(p.future, result=out, error=exc)
         with self._cond:
             self._inflight = []

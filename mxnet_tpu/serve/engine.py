@@ -33,6 +33,8 @@ import numpy as _onp
 from ..base import MXNetError
 from ..cachedop import CachedOpThreadSafe
 from ..profiler import core as _prof
+from ..profiler import export as _export
+from ..profiler import trace as _trace
 from ..resilience import faults as _faults
 from ..resilience.retry import CircuitBreaker, CollectiveTimeoutError, \
     run_with_watchdog
@@ -140,6 +142,8 @@ class InferenceSession:
         self._inflight = 0
         self._draining = False
         self._bypass = threading.local()
+        # unified export surface: /healthz wraps this session's probes
+        _export.register_health_provider(self)
 
     # -- raw protected execution -------------------------------------------
     def _timeout_s(self):
@@ -197,8 +201,12 @@ class InferenceSession:
                     with autograd.predict_mode():
                         return self._op(*args)
 
-                out = run_with_watchdog(body, self._timeout_s(),
-                                        site=f"serve:{self.name}")
+                # ambient-trace span: when the batcher activated a
+                # request trace on this thread, the session execution
+                # shows up inside that request's lane
+                with _trace.span(f"serve::session_run({self.name})"):
+                    out = run_with_watchdog(body, self._timeout_s(),
+                                            site=f"serve:{self.name}")
             except CollectiveTimeoutError as exc:
                 self.breaker.record_failure()
                 raise ServiceUnavailable(

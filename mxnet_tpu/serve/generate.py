@@ -29,6 +29,7 @@ import numpy as _onp
 
 from .. import random as _rng
 from ..base import MXNetError
+from ..profiler import trace as _trace
 from ..gluon.block import HybridBlock
 from ..ops import nn as _ops
 from ..resilience import faults as _faults
@@ -280,6 +281,31 @@ class Generator:
 
     def generate(self, prompts, max_new_tokens=32, temperature=0.0,
                  top_k=None, stop_ids=(), deadlines=None):
+        """Traced entry point: when request tracing is on and no ambient
+        trace is active (a direct ``generate()`` call, not one under a
+        traced batcher runner), open a ``serve.generate[<name>]`` lane so
+        the prefill/decode-step spans land somewhere; under a batcher the
+        representative request's lane is already active and is used
+        instead. See :meth:`_generate` for the actual semantics."""
+        own = None
+        if _trace.ENABLED and _trace.current() is None:
+            own = _trace.start_trace(f"serve.generate[{self.session.name}]",
+                                     args={"prompts": len(prompts)})
+        try:
+            with _trace.activate(own):
+                out = self._generate(prompts, max_new_tokens=max_new_tokens,
+                                     temperature=temperature, top_k=top_k,
+                                     stop_ids=stop_ids, deadlines=deadlines)
+        except Exception as exc:
+            if own is not None:
+                own.finish(error=exc)
+            raise
+        if own is not None:
+            own.finish()
+        return out
+
+    def _generate(self, prompts, max_new_tokens=32, temperature=0.0,
+                  top_k=None, stop_ids=(), deadlines=None):
         """Generate continuations for a batch of prompts (lists of ids).
 
         ``deadlines`` (optional) carries absolute ``time.monotonic()``
@@ -314,7 +340,8 @@ class Generator:
                     f"generate() got {len(deadlines)} deadlines for "
                     f"{n_real} prompts")
         cache = self._fresh_cache(b_bucket)
-        logits, cache = self.prefill(toks, lens, cache)
+        with _trace.span("serve::prefill", {"batch": n_real}):
+            logits, cache = self.prefill(toks, lens, cache)
         t_prefill = time.perf_counter()
 
         out = [[] for _ in range(n_real)]
@@ -348,7 +375,9 @@ class Generator:
                 # the last sampled token needs no successor logits —
                 # running decode_step here would be a discarded T=1 pass
                 break
-            logits, cache = self.decode_step(next_ids, positions, cache)
+            with _trace.span("serve::decode_step", {"step": step}):
+                logits, cache = self.decode_step(next_ids, positions,
+                                                 cache)
             positions = positions + 1
             n_decoded += 1
         t_done = time.perf_counter()

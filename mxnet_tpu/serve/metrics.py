@@ -14,8 +14,22 @@ from __future__ import annotations
 import collections
 import math
 import threading
+import weakref
 
 from ..profiler import core as _prof
+from ..profiler import recorder as _recorder
+
+# live ServeMetrics instances, for the process-wide all_snapshots()
+# aggregate (profiler.export pulls it); weak so the registry never pins
+# a retired server's accumulator
+_instances: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def all_snapshots():
+    """``{instance_name: snapshot()}`` over every live ServeMetrics.
+    Same-named instances merge last-writer-wins (deployments that share
+    one accumulator across session+batcher see exactly one entry)."""
+    return {m.name: m.snapshot() for m in list(_instances)}
 
 
 def percentile(samples, pct):
@@ -65,6 +79,7 @@ class ServeMetrics:
         self.late_completions = 0  # delivered past deadline (inside grace)
         self.rate_limited = 0
         self.swaps = 0
+        _instances.add(self)
 
     # -- observations -------------------------------------------------------
     def observe_request(self, queue_ms=0.0, exec_ms=0.0, ok=True,
@@ -117,6 +132,7 @@ class ServeMetrics:
         """One fast-rejected submission (queue full / breaker open)."""
         with self._lock:
             self.rejects += 1
+        _recorder.note("reject", f"serve.reject({self.name})")
         if _prof.ENABLED:
             _prof.record_instant(f"serve::reject({self.name})", "serve")
 
@@ -129,6 +145,8 @@ class ServeMetrics:
             self.sheds[priority] += 1
             if reason == "rate":
                 self.rate_limited += 1
+        _recorder.note("shed", f"serve.shed({self.name})",
+                       {"priority": priority, "reason": reason})
         if _prof.ENABLED:
             _prof.record_instant(f"serve::shed({self.name})", "serve",
                                  args={"priority": priority,
@@ -139,6 +157,8 @@ class ServeMetrics:
         passed (``admit`` / ``queue`` / ``execute`` / ``decode``)."""
         with self._lock:
             self.deadline_expired[stage] += 1
+        _recorder.note("deadline", f"serve.deadline({self.name})",
+                       {"stage": stage, "priority": priority})
         if _prof.ENABLED:
             _prof.record_instant(f"serve::deadline({self.name})", "serve",
                                  args={"stage": stage,

@@ -68,6 +68,27 @@ if [[ "${TIER1_CHAOS:-1}" != "0" ]]; then
         rc=$chaos_rc
     fi
 fi
+# Trace pass (TIER1_TRACE=1 to enable): re-run the serve smoke with
+# request tracing + the flight recorder on. Asserts (a) the injected
+# serve:execute fault leaves a recorder dump naming the failing site
+# (serve_smoke --trace-out exits nonzero otherwise) and (b) the dumped
+# chrome trace is well-formed with one connected per-request lane
+# (tools/trace_check.py --expect-lane).
+if [[ "${TIER1_TRACE:-0}" != "0" ]]; then
+    TRACE_DIR="$(mktemp -d /tmp/_t1_trace.XXXXXX)"
+    timeout -k 10 120 env JAX_PLATFORMS=cpu \
+        MXNET_TRACE=1 MXNET_FLIGHT_RECORDER=1 \
+        MXNET_FLIGHT_RECORDER_DIR="$TRACE_DIR" \
+        python tools/serve_smoke.py --trace-out "$TRACE_DIR/trace.json"
+    trace_rc=$?
+    if [[ "$trace_rc" -eq 0 ]]; then
+        python tools/trace_check.py --expect-lane "$TRACE_DIR/trace.json"
+        trace_rc=$?
+    fi
+    if [[ "$rc" -eq 0 && "$trace_rc" -ne 0 ]]; then
+        rc=$trace_rc
+    fi
+fi
 # Elastic soak smoke (TIER1_ELASTIC=0 to skip): one seeded
 # kill/lag/corrupt sweep through a dp8 training loop — asserts the
 # chip-loss dp8->dp4 resume lands bitwise on the dp4 reference run,

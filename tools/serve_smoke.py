@@ -12,6 +12,15 @@ client requests, and assert the serving SLO surface end to end:
 * the batcher shuts down cleanly (flusher thread joins, late submits
   are fast-rejected with 503).
 
+With ``--trace-out PATH`` (the ``TIER1_TRACE=1`` pass) the same smoke
+runs with request tracing + the flight recorder on, then additionally:
+
+* injects fatal ``serve:execute`` faults until the session breaker
+  opens and asserts a non-empty flight-recorder dump whose ring names
+  the failing site,
+* dumps the chrome trace to PATH for ``tools/trace_check.py``
+  (``--expect-lane`` asserts one connected per-request lane there).
+
 Exit status 0 on pass; nonzero with a one-line reason otherwise.
 """
 import os
@@ -24,12 +33,66 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def _trace_epilogue(sess, batcher_cls, runner, x, trace_out):
+    """Injected-fault forensics + trace dump (the --trace-out half)."""
+    import json
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.profiler import recorder
+    from mxnet_tpu.resilience import faults
+
+    faults.install_plan({"rules": [
+        {"site": "serve:execute", "kind": "fatal", "times": 8}]})
+    try:
+        with batcher_cls(runner, max_batch_size=8, timeout_ms=2.0,
+                         max_queue=64, metrics=sess.metrics,
+                         name="smoke-fault") as fb:
+            # sequential submits: each is its own failing batch, so the
+            # session breaker sees consecutive failures and trips open
+            for _ in range(5):
+                try:
+                    fb.submit(x).result(timeout=30)
+                except Exception:  # noqa: BLE001 (the injected fault)
+                    pass
+    finally:
+        faults.clear_plan()
+    dump_path = recorder.last_dump_path()
+    if not dump_path or not os.path.exists(dump_path):
+        print("SERVE_SMOKE=FAIL injected serve:execute fault left no "
+              "flight-recorder dump")
+        return 1
+    doc = json.load(open(dump_path))
+    ring_names = {e.get("name") for e in doc.get("ring", [])}
+    if "serve:execute" not in ring_names:
+        print(f"SERVE_SMOKE=FAIL flight-recorder dump {dump_path} does "
+              f"not name the failing site (ring: {sorted(ring_names)})")
+        return 1
+    profiler.set_state("stop")
+    profiler.core.dump(trace_out)
+    print(f"SERVE_SMOKE_TRACE=PASS trace={trace_out} "
+          f"flightrec={dump_path} reason={doc.get('reason')}")
+    return 0
+
+
 def main():
+    trace_out = None
+    if "--trace-out" in sys.argv:
+        trace_out = sys.argv[sys.argv.index("--trace-out") + 1]
+        os.environ.setdefault("MXNET_TRACE", "1")
+        os.environ.setdefault("MXNET_FLIGHT_RECORDER", "1")
+    return _run(trace_out)
+
+
+def _run(trace_out=None):
     import mxnet_tpu as mx  # noqa: F401  (framework init)
     from mxnet_tpu import autograd, gluon
     from mxnet_tpu import numpy as mnp
     from mxnet_tpu.serve import (DynamicBatcher, InferenceSession,
                                  ServiceUnavailable)
+
+    if trace_out is not None:
+        from mxnet_tpu import profiler
+        profiler.set_state("run")
 
     p99_bound_ms = float(os.environ.get("SERVE_SMOKE_P99_MS", "5000"))
     n_clients = 32
@@ -103,6 +166,9 @@ def main():
           f"occupancy={snap['batch_occupancy']:.2f} "
           f"signatures={sess.signature_count()} "
           f"serve_hits={sess.cache_stats()['serve_hits']}")
+    if trace_out is not None:
+        return _trace_epilogue(sess, DynamicBatcher, runner, xs[0],
+                               trace_out)
     return 0
 
 

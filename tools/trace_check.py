@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Validate a dumped chrome://tracing JSON (tools/run_tier1.sh
+``TIER1_TRACE`` pass, and importable from tests).
+
+Checks, in order:
+
+* the file is valid JSON with a non-empty ``traceEvents`` list;
+* every event carries a ``ph`` and (except metadata) a numeric,
+  non-negative ``ts``; complete ('X') events carry ``name``/``dur``/
+  ``pid``/``tid`` with ``dur >= 0``;
+* per-thread 'X' end-times are monotonic (events append in completion
+  order — a violation means a torn dump);
+* async begin/end match: per (cat, id, name) the 'b' and 'e' counts are
+  equal and, walked in ts order, the open-depth never goes negative;
+* no orphan flow ids: every flow id has exactly one start ('s') and one
+  finish ('f'), with ``f.ts >= s.ts``;
+* ``--expect-lane``: at least one async id forms a connected per-request
+  lane — >= min-span distinct span names across >= min-threads threads
+  (the serving submit -> flush -> settle handoff made visible).
+
+Exit 0 on pass; 1 with one reason line per failure.
+"""
+import argparse
+import collections
+import json
+import sys
+
+
+def check_trace(path, expect_lane=False, min_spans=3, min_threads=2):
+    """Returns a list of failure strings (empty = pass)."""
+    failures = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable trace JSON: {e}"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return ["traceEvents missing or empty"]
+
+    by_tid_end = collections.defaultdict(list)
+    async_evs = collections.defaultdict(list)   # (cat,id,name) -> [(ts,ph)]
+    async_by_id = collections.defaultdict(list)  # id -> events
+    flow_s = collections.defaultdict(list)
+    flow_f = collections.defaultdict(list)
+
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        if ph is None:
+            failures.append(f"event #{i} has no ph: {ev}")
+            continue
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            failures.append(f"event #{i} ({ph} {ev.get('name')!r}) has "
+                            f"bad ts {ts!r}")
+            continue
+        if ph == "X":
+            missing = {"name", "dur", "pid", "tid"} - set(ev)
+            if missing:
+                failures.append(f"X event #{i} missing {sorted(missing)}")
+                continue
+            if not isinstance(ev["dur"], (int, float)) or ev["dur"] < 0:
+                failures.append(f"X event #{i} ({ev['name']!r}) has bad "
+                                f"dur {ev['dur']!r}")
+                continue
+            by_tid_end[ev["tid"]].append((i, ts + ev["dur"], ev["name"]))
+        elif ph in ("b", "e"):
+            key = (ev.get("cat"), ev.get("id"), ev.get("name"))
+            if key[1] is None:
+                failures.append(f"async event #{i} ({ev.get('name')!r}) "
+                                "has no id")
+                continue
+            async_evs[key].append((ts, ph))
+            async_by_id[key[1]].append(ev)
+        elif ph == "s":
+            flow_s[ev.get("id")].append(ts)
+        elif ph == "f":
+            flow_f[ev.get("id")].append(ts)
+
+    # per-thread monotonic completion order ('X' events append at range
+    # end; ts rounds to 3 decimals -> tolerate that quantum)
+    for tid, rows in by_tid_end.items():
+        last_end, last_i, last_name = -1.0, None, None
+        for i, end, name in rows:
+            if end < last_end - 0.002:
+                failures.append(
+                    f"tid {tid}: X event #{i} ({name!r}) ends at "
+                    f"{end:.3f}us, before #{last_i} ({last_name!r}) at "
+                    f"{last_end:.3f}us — non-monotonic dump")
+                break
+            last_end, last_i, last_name = end, i, name
+
+    # matched async begin/end
+    for (cat, aid, name), rows in sorted(async_evs.items(),
+                                         key=lambda kv: str(kv[0])):
+        n_b = sum(1 for _, ph in rows if ph == "b")
+        n_e = len(rows) - n_b
+        if n_b != n_e:
+            failures.append(f"async {cat}/{aid}/{name}: {n_b} begin vs "
+                            f"{n_e} end events")
+            continue
+        depth = 0
+        for _, ph in sorted(rows):
+            depth += 1 if ph == "b" else -1
+            if depth < 0:
+                failures.append(f"async {cat}/{aid}/{name}: end before "
+                                "begin (ts order)")
+                break
+
+    # orphan flow ids
+    for fid in sorted(set(flow_s) | set(flow_f), key=str):
+        ns, nf = len(flow_s.get(fid, ())), len(flow_f.get(fid, ()))
+        if ns != 1 or nf != 1:
+            failures.append(f"flow id {fid}: {ns} start / {nf} finish "
+                            "(want exactly 1/1)")
+        elif flow_f[fid][0] < flow_s[fid][0]:
+            failures.append(f"flow id {fid}: finish at "
+                            f"{flow_f[fid][0]:.3f}us precedes start at "
+                            f"{flow_s[fid][0]:.3f}us")
+
+    if expect_lane:
+        best = (0, 0, None)
+        for aid, evs in async_by_id.items():
+            names = {e.get("name") for e in evs}
+            tids = {e.get("tid") for e in evs}
+            if len(names) >= min_spans and len(tids) >= min_threads:
+                best = (len(names), len(tids), aid)
+                break
+            if (len(names), len(tids)) > best[:2]:
+                best = (len(names), len(tids), aid)
+        if best[0] < min_spans or best[1] < min_threads:
+            failures.append(
+                f"no connected per-request lane: best async id "
+                f"{best[2]!r} has {best[0]} span name(s) across "
+                f"{best[1]} thread(s); want >= {min_spans} spans on "
+                f">= {min_threads} threads")
+    return failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="chrome trace JSON to validate")
+    ap.add_argument("--expect-lane", action="store_true",
+                    help="require one connected per-request async lane")
+    ap.add_argument("--min-spans", type=int, default=3)
+    ap.add_argument("--min-threads", type=int, default=2)
+    args = ap.parse_args(argv)
+    failures = check_trace(args.trace, expect_lane=args.expect_lane,
+                           min_spans=args.min_spans,
+                           min_threads=args.min_threads)
+    if failures:
+        for f in failures:
+            print(f"TRACE_CHECK=FAIL {f}")
+        return 1
+    print(f"TRACE_CHECK=PASS {args.trace}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
