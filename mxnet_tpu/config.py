@@ -264,6 +264,32 @@ register_flag(
     "Token-bucket capacity for MXNET_SERVE_RATE_LIMIT: the batch-class "
     "burst admitted from an idle bucket before the rate applies.", int)
 register_flag(
+    "MXNET_SERVE_STRICT_PARITY", False,
+    "Pin serve.Generator to the PR-5 strict decode path: shape-stable "
+    "mul+reduce ops on the deterministic runtime, bitwise prefill/decode "
+    "parity, overriding any decode_path argument or "
+    "MXNET_SERVE_DECODE_PATH. Off (default): the fast rungs carry a "
+    "tolerance-based parity contract instead.", _bool)
+register_flag(
+    "MXNET_SERVE_DECODE_PATH", "auto",
+    "Default decode rung for serve.Generator when the constructor passes "
+    "none: auto (= pallas), baseline (strict PR-5 ops), pallas (fused "
+    "decode-attention kernel), int8 (pallas + int8 KV-cache rings and "
+    "weights).", str)
+register_flag(
+    "MXNET_SERVE_DECODE_INT8_WEIGHTS", "auto",
+    "On the int8 decode rung, also pre-quantize the model's serving "
+    "projection weights to per-channel int8 (ops.nn.quantized_dense). "
+    "auto (default): only on backends with int8 matrix units (tpu/axon) "
+    "— on CPU the per-step int8->f32 weight convert costs more than the "
+    "f32 gemm saves, so auto keeps weights f32 there. 1/0 force it "
+    "on/off; the KV-cache rings stay int8 either way.", str)
+register_flag(
+    "MXNET_SERVE_SPEC_TOKENS", 4,
+    "Draft tokens proposed per speculative-decoding round "
+    "(serve.SpeculativeGenerator's default k): each round costs k draft "
+    "steps plus one k+1-wide target verify step.", int)
+register_flag(
     "MXNET_ELASTIC", False,
     "Elastic multichip training (resilience.elastic): dist_tpu classifies "
     "collective failures that look like a LOST DEVICE GROUP (injected "

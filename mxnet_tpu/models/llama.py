@@ -49,6 +49,19 @@ def apply_rope(x, cos, sin):
     return stacked.reshape(*x.shape)
 
 
+def _serving_dense(x, proj, cache):
+    """Projection on the serving fast rungs: the int8 rung looks the
+    parameter up in the cache's pre-quantized side table
+    (``ops.nn.quantized_dense``); otherwise the plain gemm Dense — never
+    ``stable_dense``, whose mul+reduce formulation is the baseline rung's
+    bitwise-parity tax."""
+    qw = getattr(cache, "quant_weights", None)
+    entry = qw.get(id(proj.weight)) if qw else None
+    if entry is not None:
+        return _ops.quantized_dense(x, entry[0], entry[1])
+    return proj(x)
+
+
 class LlamaAttention(HybridBlock):
     """Causal GQA attention with RoPE."""
 
@@ -115,6 +128,9 @@ class LlamaAttention(HybridBlock):
             if start_pos is None:
                 raise MXNetError("cache= requires start_pos (the (B,) "
                                  "absolute position of x[:, 0])")
+            path = getattr(cache, "path", "baseline")
+            if path != "baseline":
+                return self._forward_cached_fast(x, cache, start_pos, path)
             # stable_dense, not Dense: the whole cache path must be
             # shape-stable so T=1 decode bitwise-matches T=bucket prefill
             q = self._heads_split(
@@ -144,6 +160,43 @@ class LlamaAttention(HybridBlock):
         out = out.transpose(0, 2, 1, 3).reshape(b, t, self._units)
         return self.o_proj(out)
 
+    def _forward_cached_fast(self, x, cache, start_pos, path):
+        """Serving fast rungs ("pallas"/"int8"): gemm (or int8) projections
+        and the fused decode-attention kernel, which consumes the GQA K/V
+        rings *unexpanded* — tolerance parity, not the bitwise contract."""
+        from .. import numpy as mnp
+
+        b, t, _ = x.shape
+        q = self._heads_split(_serving_dense(x, self.q_proj, cache),
+                              self._heads)
+        k = self._heads_split(_serving_dense(x, self.k_proj, cache),
+                              self._kv_heads)
+        v = self._heads_split(_serving_dense(x, self.v_proj, cache),
+                              self._kv_heads)
+        cos_t, sin_t = _rope_tables(cache.max_seq, self._head_dim,
+                                    self._theta)
+        cos, sin = _ops.rope_positions(mnp.array(cos_t), mnp.array(sin_t),
+                                       start_pos, t)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if getattr(cache, "quant", None) == "int8":
+            k_all, k_s = _ops.kv_cache_write_q(cache.k, cache.k_scale, k,
+                                               start_pos)
+            v_all, v_s = _ops.kv_cache_write_q(cache.v, cache.v_scale, v,
+                                               start_pos)
+            cache.update(k_all, v_all, k_s, v_s)
+            out = _ops.cached_attention(q, k_all, v_all, start_pos,
+                                        path=path, k_scale=k_s,
+                                        v_scale=v_s)
+        else:
+            k_all = _ops.kv_cache_write(cache.k, k, start_pos)
+            v_all = _ops.kv_cache_write(cache.v, v, start_pos)
+            cache.update(k_all, v_all)
+            out = _ops.cached_attention(q, k_all, v_all, start_pos,
+                                        path=path)
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, self._units)
+        return _serving_dense(out, self.o_proj, cache)
+
 
 class LlamaFFN(HybridBlock):
     """SwiGLU: down(silu(gate(x)) * up(x))."""
@@ -157,7 +210,7 @@ class LlamaFFN(HybridBlock):
         self.down_proj = nn.Dense(units, flatten=False, use_bias=False,
                                   in_units=hidden_size)
 
-    def forward(self, x, stable=False):
+    def forward(self, x, stable=False, cache=None):
         if stable:
             # serving decode path: shape-stable projections (see
             # ops.nn.stable_dense) keep T=1 bitwise equal to T=bucket
@@ -166,6 +219,14 @@ class LlamaFFN(HybridBlock):
             return _ops.stable_dense(
                 g * _ops.stable_dense(x, self.up_proj.weight.data()),
                 self.down_proj.weight.data())
+        if cache is not None:
+            # serving fast rungs: gemm / int8 projections via the cache's
+            # quant side table
+            g = _ops.activation(_serving_dense(x, self.gate_proj, cache),
+                                "silu")
+            return _serving_dense(g * _serving_dense(x, self.up_proj,
+                                                     cache),
+                                  self.down_proj, cache)
         g = _ops.activation(self.gate_proj(x), "silu")
         return self.down_proj(g * self.up_proj(x))
 
@@ -182,7 +243,12 @@ class LlamaBlock(HybridBlock):
     def forward(self, x, cache=None, start_pos=None):
         x = x + self.attention(self.attn_norm(x), cache=cache,
                                start_pos=start_pos)
-        x = x + self.ffn(self.ffn_norm(x), stable=cache is not None)
+        fast = (cache is not None
+                and getattr(cache, "path", "baseline") != "baseline")
+        if fast:
+            x = x + self.ffn(self.ffn_norm(x), cache=cache)
+        else:
+            x = x + self.ffn(self.ffn_norm(x), stable=cache is not None)
         return x
 
 
@@ -246,13 +312,26 @@ class LlamaModel(HybridBlock):
             # T=bucket prefill executable (the serve parity contract);
             # the fusion_fence additionally pins each layer boundary so
             # the contract can't regress via cross-layer fusion choices
+            fast = getattr(cache, "path", "baseline") != "baseline"
             for i, blk in enumerate(self._blocks):
                 x = blk(x, cache=cache.layer(i), start_pos=start_pos)
-                x = _ops.fusion_fence(x)
+                if not fast:
+                    # the fence exists for the bitwise contract; the fast
+                    # rungs want cross-layer fusion
+                    x = _ops.fusion_fence(x)
             x = self.norm(x)
-            w = (self.embed.weight.data() if self._tie
-                 else self.lm_head.weight.data())
-            return _ops.stable_dense(x, w)
+            w_param = (self.embed.weight if self._tie
+                       else self.lm_head.weight)
+            if fast:
+                qw = getattr(cache, "quant_weights", None)
+                entry = qw.get(id(w_param)) if qw else None
+                if entry is not None:
+                    return _ops.quantized_dense(x, entry[0], entry[1])
+                w = w_param.data()
+                return _ops.fully_connected(x, w, None,
+                                            num_hidden=w.shape[0],
+                                            no_bias=True, flatten=False)
+            return _ops.stable_dense(x, w_param.data())
         if self._remat and in_trace():
             # only under a functionalized trace (ShardedTrainer/CachedOp):
             # the eager tape records per-op and cannot see through

@@ -896,7 +896,87 @@ def kv_cache_write(cache, new, start_pos):
     return _apply(f, (cache, new, start_pos), name="kv_cache_write")
 
 
-def cached_attention(query, key, value, start_pos, scale=None):
+def kv_cache_write_q(cache_q, cache_scale, new, start_pos):
+    """Quantize-on-write into an int8 KV ring: ``new`` (B, H, T, D) f32 is
+    symmetric-quantized per token per head (scale = max|row| / 127 over D)
+    and written into ``cache_q`` (B, H, S, D) int8 with its scale row into
+    ``cache_scale`` (B, H, S) f32, at positions ``start_pos[b] + [0..T)``.
+
+    Same gather+select window as ``kv_cache_write`` — untouched ring slots
+    are copied, not merged. Returns ``(new_cache_q, new_cache_scale)``;
+    dequantization happens inside ``cached_attention``'s fast path.
+    """
+
+    def f(cq, cs, n, sp):
+        jnp = _jnp()
+        s_len = cq.shape[2]
+        t_len = n.shape[2]
+        amax = jnp.max(jnp.abs(n), axis=-1)                      # (B, H, T)
+        scale = jnp.maximum(amax / 127.0, 1e-8)
+        nq = jnp.clip(jnp.round(n / scale[..., None]),
+                      -127, 127).astype(jnp.int8)
+        s_idx = jnp.arange(s_len, dtype=jnp.int32)[None, :]      # (1, S)
+        sp_ = sp.astype(jnp.int32)[:, None]                      # (B, 1)
+        in_window = (s_idx >= sp_) & (s_idx < sp_ + t_len)       # (B, S)
+        src = jnp.clip(s_idx - sp_, 0, t_len - 1)                # (B, S)
+        gq = jnp.take_along_axis(nq, src[:, None, :, None], axis=2)
+        gs = jnp.take_along_axis(scale, src[:, None, :], axis=2)
+        return (jnp.where(in_window[:, None, :, None], gq, cq),
+                jnp.where(in_window[:, None, :], gs, cs))
+
+    return _apply(f, (cache_q, cache_scale, new, start_pos),
+                  name="kv_cache_write_q")
+
+
+def quantized_dense(data, qweight, scale, bias=None):
+    """int8 fully-connected: ``data`` (..., U) f32 against a pre-quantized
+    ``qweight`` (O, U) int8 with per-output-channel ``scale`` (O,) f32.
+
+    On TPU, activations are quantized dynamically per row (symmetric,
+    max|x|/127 over U) so the inner product runs int8 x int8 -> int32 on
+    the MXU's 394 TOP/s int8 units, then rescales to f32. XLA CPU has no
+    int8 gemm worth using (the s8 dot lowers to a scalar loop — measured
+    slower than the f32 path it replaces), so there the op is weight-only
+    quantization: dequantize ``qweight`` inline and run the f32 gemm —
+    weights still live at half size, activations stay f32. Serving
+    fast-path only: ~1e-2 relative error vs the f32 gemm, covered by the
+    tolerance parity suite, never by the bitwise contract.
+    """
+    import jax
+
+    int8_dot = jax.default_backend() in ("tpu", "axon")
+
+    def f(x, w, s, *b):
+        import jax
+
+        jnp = _jnp()
+        if int8_dot:
+            amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+            sx = jnp.maximum(amax / 127.0, 1e-8)
+            xq = jnp.clip(jnp.round(x / sx), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, w, (((x.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * sx * s
+        else:
+            # per-output-channel scale is a column scale of the gemm, so
+            # it commutes to the output: scaling (..., O) activations is
+            # U-times cheaper than scaling the (O, U) weight, and the
+            # int8->f32 convert fuses into the gemm's weight read
+            out = jax.lax.dot_general(
+                x, w.astype(jnp.float32),
+                (((x.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * s
+        return out + b[0] if b else out
+
+    args = (data, qweight, scale)
+    if bias is not None:
+        args = args + (bias,)
+    return _apply(f, args, name="quantized_dense")
+
+
+def cached_attention(query, key, value, start_pos, scale=None,
+                     path="baseline", k_scale=None, v_scale=None):
     """Causal attention of ``query`` (B, H, T, D) — absolute positions
     ``start_pos[b] + t`` — over a KV ring (B, H, S, D).
 
@@ -905,9 +985,35 @@ def cached_attention(query, key, value, start_pos, scale=None):
     probabilities are exactly 0.0, so ring garbage contributes exact zeros
     to the value sum. See the section comment for why this is a
     mul+reduce, not a dot.
+
+    ``path`` selects the formulation: "baseline" is the shape-stable
+    mul+reduce above (the bitwise prefill/decode contract); any other
+    value routes to the fused decode-attention kernel
+    (``ops/pallas/decode_attention``), which takes *unexpanded* GQA K/V of
+    shape (B, KV, S, D) — optionally int8 with (B, KV, S)
+    ``k_scale``/``v_scale`` rings dequantized in-kernel — and carries a
+    tolerance (not bitwise) parity contract.
     """
     d = query.shape[-1]
     sc = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+
+    if path != "baseline":
+        from .pallas import decode_attention as da
+
+        # routing globals must live in f's closure (see attention())
+        routing = (da._FORCE_PATH, da._INTERPRET)
+        has_scales = k_scale is not None
+
+        def f(q, k, v, sp, *extra):
+            assert routing == (da._FORCE_PATH, da._INTERPRET)
+            ks, vs = (extra[0], extra[1]) if has_scales else (None, None)
+            return da.decode_attention(q, k, v, sp, scale=sc,
+                                       k_scale=ks, v_scale=vs)
+
+        args = (query, key, value, start_pos)
+        if has_scales:
+            args = args + (k_scale, v_scale)
+        return _apply(f, args, name="cached_attention_fast")
 
     def f(q, k, v, sp):
         jnp = _jnp()

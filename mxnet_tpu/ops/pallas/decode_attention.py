@@ -1,0 +1,300 @@
+"""Fused decode attention: single-query-timestep attention over the KV ring.
+
+The serving decode step (``Generator``'s T=1 call) spends its time in
+``ops/nn.cached_attention`` — the PR-5 mul+reduce formulation that buys
+bitwise prefill/decode parity by materializing a (B, H, T, S, D) broadcast.
+This module is the fast rung behind it: a flash-style Pallas kernel that
+streams the KV ring through VMEM in 128-wide blocks with the valid-length
+mask (``position <= start_pos``) applied in-kernel, plus a fused-einsum XLA
+fallback for shapes/platforms the kernel does not cover (T>1 verify blocks,
+CPU without interpret mode).
+
+Layout: GQA is handled natively — the kernel takes *unexpanded* K/V of
+shape (B, KV, S, D) and puts the G = H // KV query heads of each KV group
+on the sublane axis, so head_dim 64/128 models run full (8, 128) f32 tiles
+without materializing the head-repeated K/V that the baseline path needs.
+
+int8 KV rings dequantize in-kernel: pass ``k_scale``/``v_scale`` of shape
+(B, KV, S) (per-token-per-head scales from ``ops/nn.kv_cache_write_q``) and
+the kernel widens int8 blocks to f32 right next to the MXU dot, so the ring
+stays half-size in HBM end to end.
+
+Introspection follows ``flash_attention``'s conventions: ``last_path()``
+reports which implementation the last call traced ("pallas" | "xla"),
+``force_path()`` overrides routing, ``use_interpret(True)`` runs the kernel
+through the Pallas interpreter on CPU. Decode-shaped calls (T == 1) that
+land on the XLA fallback additionally record a flight-recorder note and
+bump the ``serve.decode_fallbacks`` counter so silent slow-path serving is
+diagnosable from ``/metrics``.
+"""
+from __future__ import annotations
+
+import math
+
+_NEG_INF = -1e30  # finite "minus infinity": keeps fully-masked rows NaN-free
+_BLOCK = 128      # lane width / KV stream block size
+
+# trace-time record of which implementation the last call chose
+# ("pallas" | "xla"); tests and bench assert the kernel actually ran.
+_LAST_PATH = None
+
+_INTERPRET = False
+
+
+def use_interpret(flag: bool) -> None:
+    """Force Pallas interpreter mode (CPU testing of the TPU kernel)."""
+    global _INTERPRET
+    _INTERPRET = bool(flag)
+
+
+_FORCE_PATH = None
+
+
+def force_path(path) -> None:
+    """Override decode-attention path selection: None | 'xla' | 'pallas'."""
+    global _FORCE_PATH
+    if path not in (None, "xla", "pallas"):
+        raise ValueError(f"force_path: {path!r} not in (None,'xla','pallas')")
+    _FORCE_PATH = path
+
+
+def last_path():
+    return _LAST_PATH
+
+
+# Cumulative count of decode-shaped (T == 1) calls that fell back to the
+# XLA path. Trace-time, so steady-state serving bumps it once per compiled
+# signature, not once per step — a nonzero value after warmup means the
+# fast rung is not actually serving from the kernel.
+_FALLBACKS = 0
+
+
+def fallback_count() -> int:
+    return _FALLBACKS
+
+
+def _record_fallback(reason, shape):
+    global _FALLBACKS
+    _FALLBACKS += 1
+    from ...profiler import core as _prof
+    from ...profiler import recorder as _recorder
+
+    args = {"reason": reason, "shape": "x".join(str(d) for d in shape)}
+    _recorder.note("fallback", "serve.decode_fallback", args)
+    _prof.incr_counter("serve.decode_fallbacks", cat="serve")
+    _prof.record_instant("serve.decode_fallback", cat="serve", args=args)
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+def _platform_of(x):
+    try:
+        return list(x.devices())[0].platform
+    except Exception:
+        import jax
+        return jax.default_backend()
+
+
+def _supports_pallas(q, k):
+    """Kernel coverage: one query timestep, lane-width-bounded head_dim,
+    grouped heads, and a TPU (or interpreter) underneath."""
+    if q.ndim != 4 or k.ndim != 4:
+        return False
+    b, h, t, d = q.shape
+    if t != 1 or d > 256:
+        return False
+    if h % k.shape[1] != 0:
+        return False
+    if _INTERPRET:
+        return True
+    return _platform_of(q) in ("tpu", "axon")
+
+
+def _xla_decode(q, k, v, start_pos, scale, k_scale, v_scale):
+    """Fused-einsum fallback: grouped-heads attention over the ring with
+    the same ``position <= start_pos + t`` mask as the kernel. Handles any
+    T (the speculative verify block reuses it at T = k+1) and dequantizes
+    int8 rings inline."""
+    import jax
+    import jax.numpy as jnp
+
+    b, h, t, d = q.shape
+    kv, s = k.shape[1], k.shape[2]
+    g = h // kv
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if k_scale is not None:
+        kf = kf * k_scale[..., None].astype(jnp.float32)
+    if v_scale is not None:
+        vf = vf * v_scale[..., None].astype(jnp.float32)
+    qg = q.astype(jnp.float32).reshape(b, kv, g, t, d)
+    scores = jnp.einsum("bngtd,bnsd->bngts", qg, kf) * scale
+    pos = start_pos.astype(jnp.int32)[:, None] + jnp.arange(t, dtype=jnp.int32)
+    valid = jnp.arange(s, dtype=jnp.int32)[None, None, :] <= pos[:, :, None]
+    scores = jnp.where(valid[:, None, None, :, :], scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bngts,bnsd->bngtd", w, vf)
+    return out.reshape(b, h, t, d).astype(q.dtype)
+
+
+def _decode_kernel(quant, kv, g, d, bk, n_k, scale,
+                   sp_ref, q_ref, k_ref, v_ref, *rest):
+    """One (batch·kv_head) program: stream S in ``bk`` blocks with flash
+    running-max/sum accumulators; the G grouped query heads sit on the
+    sublane axis so the whole group shares each K/V block load."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if quant:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+
+    si = pl.program_id(1)
+    sp = sp_ref[jax.lax.div(pl.program_id(0), jnp.int32(kv))]
+
+    @pl.when(si == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # block needed iff its first position is still <= start_pos
+    run = si * bk <= sp
+
+    @pl.when(run)
+    def _body():
+        qb = q_ref[0].astype(jnp.float32)          # (Gp, Dp)
+        kb = k_ref[0].astype(jnp.float32)          # (bk, Dp)
+        vb = v_ref[0].astype(jnp.float32)
+        if quant:
+            kb = kb * ks_ref[0, 0][:, None]
+            vb = vb * vs_ref[0, 0][:, None]
+        sc = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (Gp, bk)
+        kpos = si * bk + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+        sc = jnp.where(kpos <= sp, sc, _NEG_INF)
+
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(sc, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(sc - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        pv = jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(si == n_k - 1)
+    def _finish():
+        l = l_ref[...]
+        l = jnp.where(l == 0.0, 1.0, l)  # padded sublane rows: emit zeros
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _pallas_decode(q, k, v, start_pos, scale, k_scale, v_scale):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, _, d = q.shape
+    kv, s = k.shape[1], k.shape[2]
+    g = h // kv
+    quant = k_scale is not None
+
+    bk = _BLOCK
+    sp_len = _round_up(s, bk)
+    dp = _round_up(d, _BLOCK)
+    gp = _round_up(g, 8)  # f32 sublane tile
+
+    q4 = q.reshape(b, kv, g, d).reshape(b * kv, g, d)
+    q4 = jnp.pad(q4, ((0, 0), (0, gp - g), (0, dp - d)))
+    k3 = k.reshape(b * kv, s, d)
+    v3 = v.reshape(b * kv, s, d)
+    k3 = jnp.pad(k3, ((0, 0), (0, sp_len - s), (0, dp - d)))
+    v3 = jnp.pad(v3, ((0, 0), (0, sp_len - s), (0, dp - d)))
+    n_k = sp_len // bk
+
+    in_specs = [
+        pl.BlockSpec((b,), lambda i, j: (jnp.int32(0),),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, gp, dp), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, bk, dp), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bk, dp), lambda i, j: (i, j, 0)),
+    ]
+    args = [start_pos.astype(jnp.int32), q4, k3, v3]
+    if quant:
+        ks3 = k_scale.astype(jnp.float32).reshape(b * kv, 1, s)
+        vs3 = v_scale.astype(jnp.float32).reshape(b * kv, 1, s)
+        ks3 = jnp.pad(ks3, ((0, 0), (0, 0), (0, sp_len - s)))
+        vs3 = jnp.pad(vs3, ((0, 0), (0, 0), (0, sp_len - s)))
+        # (1, 1, bk) block over the 3D scale array — same shape trick as
+        # the flash kernel's lse output: TPU rejects a 2D (1, bk) block.
+        in_specs += [pl.BlockSpec((1, 1, bk), lambda i, j: (i, 0, j)),
+                     pl.BlockSpec((1, 1, bk), lambda i, j: (i, 0, j))]
+        args += [ks3, vs3]
+
+    kernel = functools.partial(_decode_kernel, quant, kv, g, d, bk, n_k,
+                               scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * kv, n_k),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, gp, dp), lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * kv, gp, dp), q.dtype),
+        scratch_shapes=[pltpu.VMEM((gp, 1), jnp.float32),
+                        pltpu.VMEM((gp, 1), jnp.float32),
+                        pltpu.VMEM((gp, dp), jnp.float32)],
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(*args)
+    out = out[:, :g, :d].reshape(b, kv, g, d).reshape(b, h, 1, d)
+    return out
+
+
+def decode_attention(q, k, v, start_pos, scale=None,
+                     k_scale=None, v_scale=None):
+    """Attention for the serving decode step.
+
+    q: (B, H, T, D); k/v: (B, KV, S, D) *unexpanded* GQA rings (f32, or
+    int8 with (B, KV, S) ``k_scale``/``v_scale``); start_pos: (B,) int32.
+    Position ``s`` attends iff ``s <= start_pos[b] + t``. Returns
+    (B, H, T, D).
+    """
+    global _LAST_PATH
+    d = q.shape[-1]
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    use_pallas = _supports_pallas(q, k)
+    if _FORCE_PATH == "xla":
+        use_pallas = False
+    elif _FORCE_PATH == "pallas":
+        if not use_pallas:
+            raise ValueError(
+                f"force_path('pallas'): unsupported decode shape "
+                f"q={q.shape} k={k.shape} on {_platform_of(q)}")
+        use_pallas = True
+
+    if use_pallas:
+        _LAST_PATH = "pallas"
+        return _pallas_decode(q, k, v, start_pos, sc, k_scale, v_scale)
+    _LAST_PATH = "xla"
+    if q.shape[2] == 1:  # decode-shaped call missed the kernel: diagnose
+        reason = "interpret_off_cpu" if _platform_of(q) not in (
+            "tpu", "axon") else "unsupported_shape"
+        if _FORCE_PATH == "xla":
+            reason = "forced_xla"
+        _record_fallback(reason, q.shape)
+    return _xla_decode(q, k, v, start_pos, sc, k_scale, v_scale)

@@ -14,7 +14,14 @@ framework's own substrate:
   when full, per-request failure isolation.
 * :class:`Generator` / :class:`KVCache` (``generate``) — autoregressive
   decode for the llama-family models with preallocated per-layer KV
-  rings; per-token logits bitwise-match a full re-prefill.
+  rings. The decode path is a per-generator rung: "baseline" (bitwise
+  prefill/decode parity, the PR-5 contract — pinned process-wide by
+  ``MXNET_SERVE_STRICT_PARITY=1``), "pallas" (fused decode-attention
+  kernel), or "int8" (pallas + int8 KV rings/weights), each with
+  tolerance parity.
+* :class:`SpeculativeGenerator` (``generate``) — draft-propose-k /
+  target-verify-one-step decoding over the same bucketed sessions;
+  greedy acceptance is token-identical to non-speculative greedy.
 * :class:`ServeMetrics` (``metrics``) — p50/p95/p99 latency, queue
   depth, batch occupancy, tokens/s; emitted as ``serve::*`` events on
   the profiler bus.
@@ -27,12 +34,13 @@ from __future__ import annotations
 from .batcher import PRIORITIES, DynamicBatcher, TokenBucket
 from .engine import DeadlineExceeded, InferenceSession, ServeError, \
     ServiceUnavailable, pick_bucket
-from .generate import Generator, KVCache, sample_tokens
+from .generate import Generator, KVCache, SpeculativeGenerator, \
+    resolve_decode_path, sample_tokens
 from .metrics import ServeMetrics, percentile
 
 __all__ = [
     "InferenceSession", "DynamicBatcher", "Generator", "KVCache",
-    "ServeMetrics", "ServeError", "ServiceUnavailable", "DeadlineExceeded",
-    "TokenBucket", "PRIORITIES", "sample_tokens", "pick_bucket",
-    "percentile",
+    "SpeculativeGenerator", "ServeMetrics", "ServeError",
+    "ServiceUnavailable", "DeadlineExceeded", "TokenBucket", "PRIORITIES",
+    "sample_tokens", "pick_bucket", "percentile", "resolve_decode_path",
 ]

@@ -112,10 +112,16 @@ class InferenceSession:
         (token arrays). ``None`` disables seq padding.
     pad_value : scalar
         Fill for padded sequence positions (token id 0 by default).
+    deterministic : bool
+        Compile with the pinned shape-stable runtime options (the PR-5
+        bitwise contract; default). ``False`` compiles with the backend's
+        default options — the serving fast rungs select this per-CachedOp
+        because the pinned CPU legacy runtime is itself a large decode-
+        throughput tax.
     """
 
     def __init__(self, block, batch_buckets=(1, 2, 4, 8), seq_buckets=None,
-                 pad_value=0, name=None):
+                 pad_value=0, name=None, deterministic=True):
         from .. import config
 
         self.block = block
@@ -124,8 +130,10 @@ class InferenceSession:
                             if seq_buckets else None)
         self.pad_value = pad_value
         self.name = name or type(block).__name__
+        self.deterministic = bool(deterministic)
         self._op = CachedOpThreadSafe(
-            block, compiler_options=_deterministic_compiler_options())
+            block, compiler_options=(_deterministic_compiler_options()
+                                     if self.deterministic else None))
         self.metrics = ServeMetrics(self.name)
         self.breaker = CircuitBreaker(
             failure_threshold=config.get("MXNET_SERVE_BREAKER_THRESHOLD"),
@@ -444,7 +452,8 @@ class InferenceSession:
                 self.block = new_block
                 self._op = CachedOpThreadSafe(
                     new_block,
-                    compiler_options=_deterministic_compiler_options())
+                    compiler_options=(_deterministic_compiler_options()
+                                      if self.deterministic else None))
                 self._warm_signatures = None
                 self._shapes_ready = False
                 if example is not None:

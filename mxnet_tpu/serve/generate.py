@@ -37,8 +37,9 @@ from .engine import InferenceSession, pick_bucket
 
 
 class _LayerKV:
-    """One layer's view of the cache: read k/v, write back the updated
-    rings (functional update — inside a trace these are tracers)."""
+    """One layer's view of the cache: read k/v (plus int8 scale rings when
+    quantized), write back the updated rings (functional update — inside a
+    trace these are tracers)."""
 
     __slots__ = ("_cache", "_idx")
 
@@ -55,43 +56,96 @@ class _LayerKV:
         return self._cache._v[self._idx]
 
     @property
+    def k_scale(self):
+        return self._cache._ks[self._idx]
+
+    @property
+    def v_scale(self):
+        return self._cache._vs[self._idx]
+
+    @property
     def max_seq(self):
         return self._cache.max_seq
 
-    def update(self, new_k, new_v):
+    @property
+    def quant(self):
+        return self._cache.quant
+
+    @property
+    def path(self):
+        return self._cache.path
+
+    @property
+    def quant_weights(self):
+        return self._cache.quant_weights
+
+    def update(self, new_k, new_v, new_k_scale=None, new_v_scale=None):
         self._cache._k[self._idx] = new_k
         self._cache._v[self._idx] = new_v
+        if new_k_scale is not None:
+            self._cache._ks[self._idx] = new_k_scale
+        if new_v_scale is not None:
+            self._cache._vs[self._idx] = new_v_scale
 
 
 class KVCache:
     """Preallocated per-layer K/V rings for autoregressive decode.
 
     Layout: ``num_layers`` pairs of (batch, kv_heads, max_seq, head_dim)
-    NDArrays, zero-initialized. Position accounting lives with the caller
-    (per-row ``start_pos`` vectors) — the cache itself is pure storage, so
-    one compiled executable serves every decode step.
+    NDArrays, zero-initialized. With ``quant="int8"`` the rings are int8
+    and each carries a (batch, kv_heads, max_seq) f32 scale ring
+    (per-token-per-head symmetric quantization, written by
+    ``ops.nn.kv_cache_write_q``) — half the HBM of the f32 rings.
+    Position accounting lives with the caller (per-row ``start_pos``
+    vectors) — the cache itself is pure storage, so one compiled
+    executable serves every decode step.
+
+    ``path`` / ``quant_weights`` are trace-time routing attributes set by
+    the serving step before the model forward: which ``cached_attention``
+    formulation the layers should compile, and (int8 rung) the
+    ``{id(param): (int8_weight, scale)}`` side table for
+    ``ops.nn.quantized_dense``.
     """
 
-    def __init__(self, keys, values, max_seq):
+    def __init__(self, keys, values, max_seq, key_scales=None,
+                 value_scales=None, quant=None):
         if len(keys) != len(values):
             raise MXNetError("KVCache needs one value ring per key ring")
         self._k = list(keys)
         self._v = list(values)
+        self._ks = list(key_scales) if key_scales is not None else None
+        self._vs = list(value_scales) if value_scales is not None else None
+        if quant is not None and (self._ks is None or self._vs is None):
+            raise MXNetError("quantized KVCache needs scale rings")
+        self.quant = quant
         self.max_seq = int(max_seq)
+        self.path = "baseline"
+        self.quant_weights = None
 
     @classmethod
-    def alloc(cls, model, batch, max_seq, dtype="float32"):
+    def alloc(cls, model, batch, max_seq, dtype="float32", quant=None):
         """Zeroed rings sized from the model's attention geometry."""
         from .. import numpy as mnp
 
         keys, values = [], []
+        kscales, vscales = [], []
         for blk in model._blocks:
             attn = blk.attention
             shape = (int(batch), attn._kv_heads, int(max_seq),
                      attn._head_dim)
-            keys.append(mnp.zeros(shape, dtype=dtype))
-            values.append(mnp.zeros(shape, dtype=dtype))
-        return cls(keys, values, max_seq)
+            if quant == "int8":
+                keys.append(mnp.zeros(shape, dtype="int8"))
+                values.append(mnp.zeros(shape, dtype="int8"))
+                kscales.append(mnp.zeros(shape[:3], dtype="float32"))
+                vscales.append(mnp.zeros(shape[:3], dtype="float32"))
+            elif quant is None:
+                keys.append(mnp.zeros(shape, dtype=dtype))
+                values.append(mnp.zeros(shape, dtype=dtype))
+            else:
+                raise MXNetError(f"unknown KVCache quant {quant!r}")
+        if quant is None:
+            return cls(keys, values, max_seq)
+        return cls(keys, values, max_seq, kscales, vscales, quant)
 
     @property
     def num_layers(self):
@@ -106,22 +160,36 @@ class KVCache:
 
     def flat(self):
         """Interleaved [k0, v0, k1, v1, ...] — the executable's calling
-        convention for cache state."""
+        convention for cache state. Quantized caches interleave
+        [k0, ks0, v0, vs0, ...] (scale ring right after its int8 ring)."""
         out = []
+        if self.quant is not None:
+            for k, ks, v, vs in zip(self._k, self._ks, self._v, self._vs):
+                out.extend((k, ks, v, vs))
+            return out
         for k, v in zip(self._k, self._v):
             out.extend((k, v))
         return out
 
     @classmethod
-    def from_flat(cls, arrays, max_seq):
+    def from_flat(cls, arrays, max_seq, quant=None):
         arrays = list(arrays)
+        if quant is not None:
+            if len(arrays) % 4:
+                raise MXNetError(
+                    "flat quantized KVCache needs 4 arrays per layer")
+            return cls(arrays[0::4], arrays[2::4], max_seq,
+                       arrays[1::4], arrays[3::4], quant)
         if len(arrays) % 2:
             raise MXNetError("flat KVCache needs an even array count")
         return cls(arrays[0::2], arrays[1::2], max_seq)
 
     def nbytes(self):
+        arrays = self._k + self._v
+        if self.quant is not None:
+            arrays = arrays + self._ks + self._vs
         return sum(int(_onp.prod(a.shape)) * _onp.dtype(a.dtype).itemsize
-                   for a in self._k + self._v)
+                   for a in arrays)
 
 
 class _CacheForward(HybridBlock):
@@ -135,14 +203,42 @@ class _CacheForward(HybridBlock):
     path is what makes the bitwise decode-vs-prefill parity hold.
     """
 
-    def __init__(self, model, max_seq, **kwargs):
+    def __init__(self, model, max_seq, path="baseline", quant=None,
+                 qindex=(), all_logits=False, **kwargs):
         super().__init__(**kwargs)
         self.model = model  # child registration shares the params
         self._max_seq = int(max_seq)
+        self._path = path
+        self._quant = quant
+        self._qindex = list(qindex)
+        self._all_logits = bool(all_logits)
+        n_layers = len(model._blocks)
+        self._n_cache = n_layers * (4 if quant else 2)
 
-    def forward(self, tokens, start_pos, last_idx, *flat_cache):
-        cache = KVCache.from_flat(flat_cache, self._max_seq)
+    def forward(self, tokens, start_pos, last_idx, *rest):
+        flat_cache = rest[:self._n_cache]
+        qflat = rest[self._n_cache:]
+        cache = KVCache.from_flat(flat_cache, self._max_seq,
+                                  quant=self._quant)
+        cache.path = self._path
+        if qflat:
+            # int8 weight side table: quantized weights enter as two packed
+            # traced call args (appended after the rings by Generator._run),
+            # so they are neither jit-captured constants nor extra
+            # Parameters; reslice them by the static qindex offsets
+            packed_w, packed_s = qflat
+            table, woff, soff = {}, 0, 0
+            for pid, (o, u) in self._qindex:
+                table[pid] = (packed_w[woff:woff + o * u].reshape(o, u),
+                              packed_s[soff:soff + o])
+                woff += o * u
+                soff += o
+            cache.quant_weights = table
         logits = self.model(tokens, cache=cache, start_pos=start_pos)
+        if self._all_logits:
+            # speculative verify step: the caller scores every position of
+            # the (k+1)-token block, not just the last real one
+            return (logits,) + tuple(cache.flat())
         last = _ops.gather_positions(logits, last_idx)
         return (last,) + tuple(cache.flat())
 
@@ -173,6 +269,94 @@ def sample_tokens(logits, temperature=0.0, top_k=None):
         jax.random.categorical(key, scaled, axis=-1)).astype(_onp.int32)
 
 
+_DECODE_PATHS = ("baseline", "pallas", "int8")
+
+
+def resolve_decode_path(decode_path=None):
+    """The decode rung a Generator compiles. ``MXNET_SERVE_STRICT_PARITY``
+    pins "baseline" (the PR-5 bitwise contract) over everything; otherwise
+    an explicit ``decode_path`` argument wins over the
+    ``MXNET_SERVE_DECODE_PATH`` flag, and "auto" means the fused-kernel
+    "pallas" rung."""
+    from .. import config
+
+    if config.get("MXNET_SERVE_STRICT_PARITY"):
+        return "baseline"
+    path = decode_path
+    if path is None:
+        path = config.get("MXNET_SERVE_DECODE_PATH")
+    if path in (None, "auto"):
+        path = "pallas"
+    if path not in _DECODE_PATHS:
+        raise MXNetError(
+            f"decode_path {path!r} not in {_DECODE_PATHS} "
+            "(speculative decoding is serve.SpeculativeGenerator, not a "
+            "KV-cache path)")
+    return path
+
+
+def _int8_weights_enabled():
+    """Resolve MXNET_SERVE_DECODE_INT8_WEIGHTS for the int8 rung. "auto"
+    enables int8 weights only where the backend has int8 matrix units
+    (tpu/axon — the 394 TOP/s path); on CPU the per-step int8->f32 weight
+    convert costs more than the f32 gemm saves, so auto keeps weights f32
+    there and the rung's win is the halved KV-ring traffic."""
+    import jax
+
+    from .. import config
+
+    flag = str(config.get("MXNET_SERVE_DECODE_INT8_WEIGHTS")).strip().lower()
+    if flag == "auto":
+        return jax.default_backend() in ("tpu", "axon")
+    return flag in ("1", "true", "yes", "on")
+
+
+def _quantize_serving_weights(model):
+    """Pre-quantize the model's serving projections to per-output-channel
+    int8 for ``ops.nn.quantized_dense``: returns ``(qindex, qflat)`` — an
+    ordered ``(id(param), shape)`` list and exactly two packed NDArrays
+    (all int8 weights concatenated flat, all scales concatenated flat)
+    that the serving step threads through as call args. Packing keeps the
+    per-step call-arg count flat in depth (2, not 2 x 8 x layers); the
+    step reslices by the static offsets ``qindex`` implies, which XLA
+    fuses away. Models without the llama projection layout fall back to
+    KV-only quantization (with a flight-recorder note, so the silent-f32
+    case is diagnosable)."""
+    from .. import numpy as mnp
+    from ..profiler import core as _prof
+    from ..profiler import recorder as _recorder
+
+    try:
+        params = []
+        for blk in model._blocks:
+            attn, ffn = blk.attention, blk.ffn
+            params += [attn.q_proj.weight, attn.k_proj.weight,
+                       attn.v_proj.weight, attn.o_proj.weight,
+                       ffn.gate_proj.weight, ffn.up_proj.weight,
+                       ffn.down_proj.weight]
+        params.append(model.embed.weight if model._tie
+                      else model.lm_head.weight)
+    except AttributeError:
+        _recorder.note("fallback", "serve.decode_fallback",
+                       {"reason": "quant_weights_unsupported_model",
+                        "model": type(model).__name__})
+        _prof.incr_counter("serve.decode_fallbacks", cat="serve")
+        return [], []
+    qindex, wchunks, schunks = [], [], []
+    for p in params:
+        w = p.data().asnumpy()
+        scale = _onp.maximum(_onp.abs(w).max(axis=1) / 127.0,
+                             1e-8).astype(_onp.float32)
+        qw = _onp.clip(_onp.round(w / scale[:, None]),
+                       -127, 127).astype(_onp.int8)
+        qindex.append((id(p), qw.shape))
+        wchunks.append(qw.reshape(-1))
+        schunks.append(scale)
+    qflat = [mnp.array(_onp.concatenate(wchunks)),
+             mnp.array(_onp.concatenate(schunks))]
+    return qindex, qflat
+
+
 class Generator:
     """Bucketed KV-cache generation server for decoder LMs.
 
@@ -186,10 +370,19 @@ class Generator:
         geometry and a ``cache=``/``start_pos=`` forward).
     max_seq : ring length — prompt + generated tokens must fit.
     batch_buckets / prompt_buckets : the compiled shape lattice.
+    decode_path : which rung this generator compiles (see
+        :func:`resolve_decode_path`): "baseline" keeps the PR-5 bitwise
+        prefill/decode contract on the deterministic runtime; "pallas"
+        routes attention through the fused decode kernel on the default
+        runtime (tolerance parity); "int8" adds int8 KV rings and (by
+        default) int8 projection weights.
     """
 
     def __init__(self, model, max_seq=128, batch_buckets=(1, 2, 4),
-                 prompt_buckets=None, pad_id=0, name="llama_decode"):
+                 prompt_buckets=None, pad_id=0, name="llama_decode",
+                 decode_path=None):
+        from .. import config
+
         self.model = model
         self.max_seq = int(max_seq)
         self.batch_buckets = tuple(sorted(int(b) for b in batch_buckets))
@@ -204,14 +397,25 @@ class Generator:
         if self.prompt_buckets[-1] > self.max_seq:
             raise MXNetError("prompt bucket exceeds max_seq")
         self.pad_id = int(pad_id)
-        self._step = _CacheForward(model, self.max_seq)
+        self.decode_path = resolve_decode_path(decode_path)
+        self._quant = "int8" if self.decode_path == "int8" else None
+        self._qindex, self._qflat = [], []
+        if self._quant and _int8_weights_enabled():
+            self._qindex, self._qflat = _quantize_serving_weights(model)
+        self._step = _CacheForward(model, self.max_seq,
+                                   path=self.decode_path,
+                                   quant=self._quant, qindex=self._qindex)
         # bucketing is done here (cache shapes are part of the lattice);
-        # the session provides the protected raw-run path
+        # the session provides the protected raw-run path. Only the strict
+        # baseline rung pins the deterministic compiler options — the
+        # pinned CPU legacy runtime is itself a decode-throughput tax the
+        # fast rungs exist to remove.
         self.session = InferenceSession(
             self._step, batch_buckets=self.batch_buckets,
             seq_buckets=self.prompt_buckets, pad_value=self.pad_id,
-            name=name)
+            name=name, deterministic=(self.decode_path == "baseline"))
         self.metrics = self.session.metrics
+        self.metrics.set_decode_path(self.decode_path)
         self._zero_caches = {}  # batch bucket -> shared zeroed rings
 
     def _fresh_cache(self, batch_bucket):
@@ -224,7 +428,10 @@ class Generator:
         if cache is None:
             cache = self._zero_caches.setdefault(
                 batch_bucket,
-                KVCache.alloc(self.model, batch_bucket, self.max_seq))
+                KVCache.alloc(self.model, batch_bucket, self.max_seq,
+                              quant=self._quant))
+            self.metrics.set_kv_cache_bytes(
+                sum(c.nbytes() for c in self._zero_caches.values()))
         return cache
 
     # -- phase helpers (also the parity-test surface) -----------------------
@@ -235,9 +442,10 @@ class Generator:
             mnp.array(_onp.asarray(tokens, _onp.int32)),
             mnp.array(_onp.asarray(start_pos, _onp.int32)),
             mnp.array(_onp.asarray(last_idx, _onp.int32)),
-            *cache.flat())
+            *cache.flat(), *self._qflat)
         logits, flat = out[0], out[1:]
-        return logits, KVCache.from_flat(flat, self.max_seq)
+        return logits, KVCache.from_flat(flat, self.max_seq,
+                                         quant=self._quant)
 
     def prefill(self, prompts, prompt_lens, cache):
         """Run the prompt block through the cache path. ``prompts`` is a
@@ -418,3 +626,228 @@ class Generator:
 
     def stats(self):
         return self.session.stats()
+
+
+class SpeculativeGenerator:
+    """Speculative decoding (Leviathan et al.): a cheap draft model
+    proposes ``k`` tokens per round, the target model scores the whole
+    block in ONE (k+1)-wide step, and the longest proposal prefix that
+    matches the target's greedy choices is accepted plus one
+    correction/bonus token — so each target pass emits between 1 and k+1
+    tokens instead of exactly 1.
+
+    Greedy-only by construction: with argmax acceptance the emitted
+    sequence is **token-identical** to non-speculative greedy decoding for
+    *any* draft model (a bad draft only costs speed, never output). The
+    proof is inductive: the accepted prefix always equals the target's own
+    greedy chain, and the correction token is the target's argmax
+    conditioned on exactly that chain.
+
+    No cache rollback is needed on rejection: ``cached_attention`` masks
+    ring positions ``> start_pos + t``, so the K/V of rejected proposals
+    is dead weight that the next round's writes overwrite before any read
+    reaches it. Everything reuses the bucketed session machinery — the
+    target and draft are plain :class:`Generator` s, the verify step is a
+    third :class:`InferenceSession` compiled at T = k+1, and
+    :meth:`assert_no_recompiles` spans all three.
+    """
+
+    def __init__(self, model, draft_model, k=None, max_seq=128,
+                 batch_buckets=(1, 2, 4), prompt_buckets=None, pad_id=0,
+                 name="llama_spec", decode_path=None):
+        from .. import config
+
+        self.k = int(k) if k is not None else int(
+            config.get("MXNET_SERVE_SPEC_TOKENS"))
+        if self.k < 1:
+            raise MXNetError("speculative decoding needs k >= 1")
+        self.target = Generator(
+            model, max_seq=max_seq, batch_buckets=batch_buckets,
+            prompt_buckets=prompt_buckets, pad_id=pad_id, name=name,
+            decode_path=decode_path)
+        self.draft = Generator(
+            draft_model, max_seq=max_seq, batch_buckets=batch_buckets,
+            prompt_buckets=prompt_buckets, pad_id=pad_id,
+            name=f"{name}_draft", decode_path=decode_path)
+        self.decode_path = self.target.decode_path
+        self.max_seq = self.target.max_seq
+        self.batch_buckets = self.target.batch_buckets
+        self.pad_id = self.target.pad_id
+        self._verify_step = _CacheForward(
+            model, self.max_seq, path=self.decode_path,
+            quant=self.target._quant, qindex=self.target._qindex,
+            all_logits=True)
+        self._verify = InferenceSession(
+            self._verify_step, batch_buckets=self.batch_buckets,
+            seq_buckets=(self.k + 1,), pad_value=self.pad_id,
+            name=f"{name}_verify",
+            deterministic=(self.decode_path == "baseline"))
+        self.metrics = self.target.metrics
+
+    def _verify_run(self, tokens_blk, start_pos, cache):
+        """One target pass over the (B, k+1) block [pending, d_1..d_k] at
+        per-row ``start_pos``; returns the full (B, k+1, vocab) logits and
+        the updated target cache."""
+        from .. import numpy as mnp
+
+        blk = _onp.asarray(tokens_blk, _onp.int32)
+        out = self._verify.run(
+            mnp.array(blk),
+            mnp.array(_onp.asarray(start_pos, _onp.int32)),
+            mnp.array(_onp.zeros(len(blk), _onp.int32)),
+            *cache.flat(), *self.target._qflat)
+        logits, flat = out[0], out[1:]
+        return logits, KVCache.from_flat(flat, self.max_seq,
+                                         quant=self.target._quant)
+
+    def generate(self, prompts, max_new_tokens=32, temperature=0.0,
+                 top_k=None, stop_ids=(), deadlines=None):
+        """Same contract as :meth:`Generator.generate` (greedy only):
+        per-prompt generated id lists plus a stats dict — with
+        ``rounds``, ``draft_steps``, ``verify_steps`` and the measured
+        ``acceptance_rate`` added."""
+        if temperature is not None and temperature > 0.0:
+            raise MXNetError(
+                "SpeculativeGenerator is greedy-only: sampled acceptance "
+                "needs the rejection-sampling correction this build does "
+                "not implement (temperature must be 0)")
+        t_start = time.perf_counter()
+        toks, lens, b_bucket = self.target._pad_prompts(prompts)
+        n_real = len(prompts)
+        max_new = int(max_new_tokens)
+        # +k+1 headroom: the last round's verify block writes k+1 ring
+        # positions past the accepted prefix
+        if int(lens.max()) + max_new + self.k + 1 > self.max_seq:
+            raise MXNetError(
+                f"prompt ({int(lens.max())}) + max_new_tokens ({max_new}) "
+                f"+ speculative headroom ({self.k + 1}) exceeds max_seq "
+                f"({self.max_seq})")
+        if deadlines is not None:
+            try:
+                deadlines = [float(d) for d in deadlines]
+            except TypeError:
+                deadlines = [float(deadlines)] * n_real
+            if len(deadlines) != n_real:
+                raise MXNetError(
+                    f"generate() got {len(deadlines)} deadlines for "
+                    f"{n_real} prompts")
+        tcache = self.target._fresh_cache(b_bucket)
+        dcache = self.draft._fresh_cache(b_bucket)
+        with _trace.span("serve::prefill", {"batch": n_real}):
+            logits, tcache = self.target.prefill(toks, lens, tcache)
+            _, dcache = self.draft.prefill(toks, lens, dcache)
+        t_prefill = time.perf_counter()
+
+        pending = sample_tokens(logits)  # (b_bucket,) greedy
+        out = [[] for _ in range(n_real)]
+        stopped = [False] * b_bucket
+        for i in range(n_real, b_bucket):
+            stopped[i] = True  # dead padding lanes ride along frozen
+        expired = [False] * n_real
+        stop = set(int(s) for s in stop_ids)
+        # the prefill-sampled token is the first emission (exactly like
+        # Generator._generate's step-0 sample)
+        for i in range(n_real):
+            tid = int(pending[i])
+            if tid in stop:
+                stopped[i] = True
+            else:
+                out[i].append(tid)
+                if len(out[i]) >= max_new:
+                    stopped[i] = True
+        positions = lens.copy()  # write position of each row's `pending`
+        rounds = draft_steps = verify_steps = 0
+        proposed = accepted = 0
+        proposals = _onp.zeros((b_bucket, self.k), _onp.int32)
+        while not all(stopped):
+            rounds += 1
+            # draft proposes d_1..d_k; the extra (k+1)-th step writes
+            # d_k's K/V into the draft ring so a fully-accepted round
+            # leaves no hole at position + k
+            cur = pending.copy()
+            dpos = positions.copy()
+            for j in range(self.k + 1):
+                with _trace.span("serve::draft_step", {"j": j}):
+                    dlog, dcache = self.draft.decode_step(cur, dpos,
+                                                          dcache)
+                dpos = dpos + 1
+                draft_steps += 1
+                if j < self.k:
+                    cur = sample_tokens(dlog)
+                    proposals[:, j] = cur
+            blk = _onp.concatenate(
+                [_onp.asarray(pending).reshape(-1, 1), proposals], axis=1)
+            with _trace.span("serve::verify_step", {"k": self.k}):
+                vlogits, tcache = self._verify_run(blk, positions, tcache)
+            verify_steps += 1
+            greedy = sample_tokens(vlogits.reshape(-1, vlogits.shape[-1]))
+            greedy = greedy.reshape(b_bucket, self.k + 1)
+            for i in range(b_bucket):
+                if stopped[i]:
+                    continue
+                a = 0
+                while a < self.k and proposals[i, a] == greedy[i, a]:
+                    a += 1
+                proposed += self.k
+                accepted += a
+                emit = [int(t) for t in proposals[i, :a]]
+                emit.append(int(greedy[i, a]))
+                for tid in emit:
+                    if tid in stop:
+                        stopped[i] = True
+                        break
+                    out[i].append(tid)
+                    if len(out[i]) >= max_new:
+                        stopped[i] = True
+                        break
+                pending[i] = greedy[i, a]
+                positions[i] += a + 1
+            if deadlines is not None:
+                now = time.monotonic()
+                for i in range(n_real):
+                    if not stopped[i] and now >= deadlines[i]:
+                        stopped[i] = True
+                        expired[i] = True
+                        self.metrics.observe_deadline("decode")
+        t_done = time.perf_counter()
+        decode_s = t_done - t_prefill
+        n_tokens = sum(len(o) for o in out)
+        self.metrics.observe_tokens(n_tokens, decode_s)
+        info = {
+            "prefill_ms": (t_prefill - t_start) * 1e3,
+            "decode_ms": decode_s * 1e3,
+            "rounds": rounds,
+            "draft_steps": draft_steps,
+            "verify_steps": verify_steps,
+            "acceptance_rate": accepted / proposed if proposed else 0.0,
+            "tokens_s": n_tokens / decode_s if decode_s > 0 else 0.0,
+            "total_ms": (t_done - t_start) * 1e3,
+            "deadline_expired": [i for i in range(n_real) if expired[i]],
+        }
+        return out, info
+
+    # -- warmup / invariants -------------------------------------------------
+    def warmup(self):
+        """Warm all three sessions: the target and draft lattices plus one
+        verify signature per batch bucket."""
+        t0 = time.perf_counter()
+        self.target.warmup()
+        self.draft.warmup()
+        for bb in self.batch_buckets:
+            cache = self.target._fresh_cache(bb)
+            blk = _onp.zeros((bb, self.k + 1), _onp.int32)
+            self._verify_run(blk, _onp.zeros(bb, _onp.int32), cache)
+        self._verify.freeze_signatures()
+        return {"signatures": (self.target.session.signature_count()
+                               + self.draft.session.signature_count()
+                               + self._verify.signature_count()),
+                "wall_s": time.perf_counter() - t0}
+
+    def assert_no_recompiles(self):
+        self.target.assert_no_recompiles()
+        self.draft.assert_no_recompiles()
+        self._verify.assert_no_recompiles()
+
+    def stats(self):
+        return {"target": self.target.stats(), "draft": self.draft.stats(),
+                "verify": self._verify.stats()}

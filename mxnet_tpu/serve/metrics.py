@@ -79,6 +79,10 @@ class ServeMetrics:
         self.late_completions = 0  # delivered past deadline (inside grace)
         self.rate_limited = 0
         self.swaps = 0
+        # decode-rung gauges (tentpole PR 10): footprint of the pooled KV
+        # rings and which decode path this generator traced
+        self.kv_cache_bytes = 0
+        self.decode_path = None
         _instances.add(self)
 
     # -- observations -------------------------------------------------------
@@ -189,6 +193,22 @@ class ServeMetrics:
             _prof.set_counter(f"serve.queue_depth({self.name})", int(depth),
                               cat="serve")
 
+    def set_kv_cache_bytes(self, nbytes):
+        """Gauge: total bytes of the generator's pooled KV-cache rings
+        (``KVCache.nbytes()`` summed over the warm batch buckets)."""
+        self.kv_cache_bytes = int(nbytes)
+        if _prof.ENABLED:
+            _prof.set_counter(f"serve.kv_cache_bytes({self.name})",
+                              int(nbytes), cat="serve")
+
+    def set_decode_path(self, path):
+        """Gauge: the decode rung this generator compiled
+        ("baseline" | "pallas" | "int8")."""
+        self.decode_path = str(path)
+        if _prof.ENABLED:
+            _prof.record_instant(f"serve::decode_path({self.name})", "serve",
+                                 args={"path": str(path)})
+
     # -- readout ------------------------------------------------------------
     def latency_percentiles(self):
         with self._lock:
@@ -235,6 +255,8 @@ class ServeMetrics:
                 "late_completions": self.late_completions,
                 "rate_limited": self.rate_limited,
                 "swaps": self.swaps,
+                "kv_cache_bytes": self.kv_cache_bytes,
+                "decode_path": self.decode_path,
             }
         out["class_percentiles"] = self.class_percentiles()
         out["p50_ms"] = percentile(lat, 50)

@@ -89,6 +89,22 @@ if [[ "${TIER1_TRACE:-0}" != "0" ]]; then
         rc=$trace_rc
     fi
 fi
+# Decode-rung pass (TIER1_DECODE=1 to enable): run the serve smoke's
+# --decode-path mode over every rung of the decode ladder — baseline
+# (strict PR-5 ops), pallas (fused decode-attention), int8 (int8 KV
+# rings), spec (speculative decoding). Each rung drives 8 concurrent
+# generate() clients and asserts identical greedy output, zero
+# recompiles, and the 503 (drain/resume) + 504 (past-deadline) taxonomy.
+if [[ "${TIER1_DECODE:-0}" != "0" ]]; then
+    for dp in baseline pallas int8 spec; do
+        timeout -k 10 180 env JAX_PLATFORMS=cpu \
+            python tools/serve_smoke.py --decode-path "$dp"
+        decode_rc=$?
+        if [[ "$rc" -eq 0 && "$decode_rc" -ne 0 ]]; then
+            rc=$decode_rc
+        fi
+    done
+fi
 # Elastic soak smoke (TIER1_ELASTIC=0 to skip): one seeded
 # kill/lag/corrupt sweep through a dp8 training loop — asserts the
 # chip-loss dp8->dp4 resume lands bitwise on the dp4 reference run,

@@ -21,6 +21,20 @@ runs with request tracing + the flight recorder on, then additionally:
 * dumps the chrome trace to PATH for ``tools/trace_check.py``
   (``--expect-lane`` asserts one connected per-request lane there).
 
+With ``--decode-path {baseline,pallas,int8,spec}`` (the
+``TIER1_DECODE=1`` pass) the smoke instead exercises one decode rung of
+the llama generation stack under concurrent clients:
+
+* 8 threads drive ``generate()`` on a shared Generator (spec =
+  SpeculativeGenerator over a 1-layer draft); every thread must get the
+  same greedy continuation as an unthreaded reference call,
+* zero recompiles across the whole run (``assert_no_recompiles``),
+* 503 taxonomy: ``drain()`` makes the next generate fast-reject with
+  ``ServiceUnavailable``; ``resume()`` serves again,
+* 504 taxonomy: already-passed deadlines retire every row between
+  decode steps and land in ``info["deadline_expired"]`` plus the
+  ``deadline_expired["decode"]`` metric.
+
 Exit status 0 on pass; nonzero with a one-line reason otherwise.
 """
 import os
@@ -75,12 +89,110 @@ def _trace_epilogue(sess, batcher_cls, runner, x, trace_out):
 
 
 def main():
+    if "--decode-path" in sys.argv:
+        path = sys.argv[sys.argv.index("--decode-path") + 1]
+        return _run_decode(path)
     trace_out = None
     if "--trace-out" in sys.argv:
         trace_out = sys.argv[sys.argv.index("--trace-out") + 1]
         os.environ.setdefault("MXNET_TRACE", "1")
         os.environ.setdefault("MXNET_FLIGHT_RECORDER", "1")
     return _run(trace_out)
+
+
+def _run_decode(path):
+    import time
+
+    import mxnet_tpu as mx  # noqa: F401  (framework init)
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.serve import (Generator, ServiceUnavailable,
+                                 SpeculativeGenerator)
+
+    mx.random.seed(0)
+    model = get_llama("llama_tiny_test")
+    model.initialize()
+    if path == "spec":
+        draft = get_llama("llama_tiny_test", num_layers=1)
+        draft.initialize()
+        gen = SpeculativeGenerator(model, draft, k=2, max_seq=48,
+                                   batch_buckets=(2,), prompt_buckets=(8,),
+                                   name="smoke_spec")
+        sess = gen.target.session
+    else:
+        gen = Generator(model, max_seq=48, batch_buckets=(2,),
+                        prompt_buckets=(8,), name=f"smoke_{path}",
+                        decode_path=path)
+        sess = gen.session
+    gen.warmup()
+    prompts = [[5, 9, 2], [7, 3, 3, 1]]
+    ref, _ = gen.generate(prompts, max_new_tokens=8)
+
+    n_clients = 8
+    outs = [None] * n_clients
+    errors = []
+
+    def client(i):
+        try:
+            outs[i], _ = gen.generate(prompts, max_new_tokens=8)
+        except Exception as exc:  # noqa: BLE001
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    if errors:
+        i, exc = errors[0]
+        print(f"SERVE_SMOKE_DECODE=FAIL path={path} client {i}: "
+              f"{type(exc).__name__}: {exc}")
+        return 1
+    for i, o in enumerate(outs):
+        if o != ref:
+            print(f"SERVE_SMOKE_DECODE=FAIL path={path} client {i} "
+                  f"diverged from the unthreaded reference: {o} != {ref}")
+            return 1
+    try:
+        gen.assert_no_recompiles()
+    except Exception as exc:  # noqa: BLE001
+        print(f"SERVE_SMOKE_DECODE=FAIL path={path} {exc}")
+        return 1
+
+    # 503 taxonomy: a drained session fast-rejects, resume() reopens
+    sess.drain()
+    try:
+        gen.generate(prompts, max_new_tokens=4)
+        print(f"SERVE_SMOKE_DECODE=FAIL path={path} drained session "
+              f"accepted a generate()")
+        return 1
+    except ServiceUnavailable:
+        pass
+    finally:
+        sess.resume()
+    again, _ = gen.generate(prompts, max_new_tokens=8)
+    if again != ref:
+        print(f"SERVE_SMOKE_DECODE=FAIL path={path} post-resume output "
+              f"diverged: {again} != {ref}")
+        return 1
+
+    # 504 taxonomy: already-passed deadlines retire every row and count
+    # as decode-stage deadline_expired
+    _, info = gen.generate(prompts, max_new_tokens=8,
+                           deadlines=time.monotonic() - 1.0)
+    expired = info["deadline_expired"]
+    snap = gen.metrics.snapshot()
+    if sorted(expired) != [0, 1] or not snap["deadline_expired"].get(
+            "decode"):
+        print(f"SERVE_SMOKE_DECODE=FAIL path={path} past deadlines did "
+              f"not expire rows (info={expired}, "
+              f"metric={snap['deadline_expired']})")
+        return 1
+    print(f"SERVE_SMOKE_DECODE=PASS path={path} "
+          f"decode_path={snap['decode_path']} clients={n_clients} "
+          f"kv_cache_bytes={snap['kv_cache_bytes']} "
+          f"deadline_expired={dict(snap['deadline_expired'])}")
+    return 0
 
 
 def _run(trace_out=None):
