@@ -290,6 +290,44 @@ register_flag(
     "(serve.SpeculativeGenerator's default k): each round costs k draft "
     "steps plus one k+1-wide target verify step.", int)
 register_flag(
+    "MXNET_FLEET_HEDGE_MS", 0.0,
+    "Hedged-retry delay for serve.fleet.Router: an *interactive* request "
+    "dispatched to a replica flagged straggling gets a second (hedge) "
+    "dispatch to the next-best replica after this many ms unless it has "
+    "already settled; first settle wins, the loser is cancelled and "
+    "counted. Batch-class requests are never hedged, and a request is "
+    "never hedged twice. 0 (default) disables hedging.", float)
+register_flag(
+    "MXNET_FLEET_STRAGGLER_MS", 150.0,
+    "Per-replica latency-lag EWMA (vs the fleet median, "
+    "resilience.elastic.StragglerMonitor) above which the Router flags a "
+    "replica as straggling — the precondition for arming a hedge timer. "
+    "0: track only, never flag (hedging never fires).", float)
+register_flag(
+    "MXNET_FLEET_MAX_FAILOVERS", 2,
+    "Times the Router will re-dispatch one request to a surviving "
+    "replica after replica deaths/quarantines before failing it with "
+    "ServiceUnavailable (bounds the work a poisonous request can burn "
+    "while the fleet is melting).", int)
+register_flag(
+    "MXNET_FLEET_PROBE_MS", 25.0,
+    "Router supervisor probe interval: how often each replica's "
+    "liveness (flusher thread) and session breaker are checked so a "
+    "replica that died *between* dispatches is still detected and its "
+    "in-flight work failed over. 0 disables the supervisor thread "
+    "(detection then only happens at dispatch boundaries).", float)
+register_flag(
+    "MXNET_FLEET_BREAKER_THRESHOLD", 2,
+    "Consecutive replica-attributed dispatch/settle failures that "
+    "quarantine a replica behind the Router's per-replica circuit "
+    "breaker (dispatch routes around it until a half-open probe "
+    "heals it).", int)
+register_flag(
+    "MXNET_FLEET_BREAKER_COOLDOWN", 8,
+    "Dispatch picks a quarantined replica sits out before the Router's "
+    "per-replica breaker goes half-open and routes one probe request "
+    "through it.", int)
+register_flag(
     "MXNET_ELASTIC", False,
     "Elastic multichip training (resilience.elastic): dist_tpu classifies "
     "collective failures that look like a LOST DEVICE GROUP (injected "
@@ -361,4 +399,7 @@ register_flag(
     "MXNET_METRICS_PORT", 0,
     "Serve the unified telemetry surface (profiler.export) over stdlib "
     "HTTP on this port: /metrics (Prometheus text), /healthz (serving "
-    "health JSON), /snapshot (full JSON). 0 (default): no server.", int)
+    "health JSON), /snapshot (full JSON). Unset (default): no server. "
+    "Explicitly set to 0: bind an EPHEMERAL port (no CI port-collision "
+    "flakes) and report it back via a MXNET_METRICS_PORT_BOUND=<port> "
+    "line on stderr + profiler.export.server_port().", int)

@@ -48,6 +48,14 @@ def register_health_provider(obj):
     _health_providers.add(obj)
 
 
+def unregister_health_provider(obj):
+    """Remove ``obj`` from the ``/healthz`` roll. The fleet Router calls
+    this for each replica-owned session it adopts: the Router itself is
+    the fleet's single health provider, so one dead (and routed-around)
+    replica doesn't wedge the whole process's /healthz at 503."""
+    _health_providers.discard(obj)
+
+
 def _flatten(prefix, value, out):
     if isinstance(value, dict):
         for k, v in value.items():
@@ -101,11 +109,21 @@ def snapshot(include_aggregates=True):
         _flatten("resilience.straggler",
                  elastic._active_monitor.snapshot(), out)
 
+    retry = sys.modules.get("mxnet_tpu.resilience.retry")
+    if retry is not None:
+        for name, bstate in retry.breaker_states().items():
+            _flatten(f"resilience.breaker.{name}", bstate, out)
+
     smet = sys.modules.get("mxnet_tpu.serve.metrics")
     if smet is not None:
         for name, snap in smet.all_snapshots().items():
             snap.pop("name", None)
             _flatten(f"serve.{name}", snap, out)
+
+    fleet = sys.modules.get("mxnet_tpu.serve.fleet")
+    if fleet is not None:
+        for name, snap in fleet.fleet_stats().items():
+            _flatten(f"fleet.{name}", snap, out)
 
     out["recorder.enabled"] = int(_recorder.ENABLED)
     out["recorder.notes"] = _recorder._seq
@@ -246,13 +264,29 @@ def server_port():
 
 def maybe_start_from_env():
     """``MXNET_METRICS_PORT=<p>`` starts the endpoint at import (called
-    from ``profiler.__init__``); 0 (the default) does nothing."""
-    port = int(_cfg.get("MXNET_METRICS_PORT") or 0)
-    if port:
-        try:
-            start_http(port)
-        except OSError as e:
-            import warnings
+    from ``profiler.__init__``). Unset: nothing. Explicitly set to
+    ``0``: bind an EPHEMERAL port — the bound port is reported back via
+    a ``MXNET_METRICS_PORT_BOUND=<port>`` line on stderr (greppable by
+    the harness that launched the process) and :func:`server_port`."""
+    import os
 
-            warnings.warn(f"MXNET_METRICS_PORT={port}: could not start "
-                          f"metrics endpoint: {e}", RuntimeWarning)
+    raw = os.environ.get("MXNET_METRICS_PORT")
+    if raw is None or not raw.strip():
+        return
+    try:
+        port = int(raw)
+    except ValueError:
+        return
+    if port < 0:
+        return
+    try:
+        bound = start_http(port)
+    except OSError as e:
+        import warnings
+
+        warnings.warn(f"MXNET_METRICS_PORT={port}: could not start "
+                      f"metrics endpoint: {e}", RuntimeWarning)
+        return
+    if port == 0:
+        print(f"MXNET_METRICS_PORT_BOUND={bound}", file=sys.stderr,
+              flush=True)

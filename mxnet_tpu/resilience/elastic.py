@@ -211,6 +211,19 @@ class StragglerMonitor:
                             for r, v in self._step_ewma.items()},
                 **self.stats}
 
+    def flagged(self, replica):
+        """Is ``replica``'s lag EWMA over the threshold *right now*? (the
+        serving Router's hedge predicate — threshold 0 never flags)"""
+        if not self.threshold_ms:
+            return False
+        return self._lag_ewma.get(replica, 0.0) * 1e3 > self.threshold_ms
+
+    def clear(self, replica):
+        """Forget ``replica``'s EWMAs (a replaced/restarted replica starts
+        with a clean slate instead of inheriting its predecessor's lag)."""
+        self._lag_ewma.pop(replica, None)
+        self._step_ewma.pop(replica, None)
+
     def observe_step_times(self, times_s):
         """One batch's per-replica wall times; lag = time − group
         median."""

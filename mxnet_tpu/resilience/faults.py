@@ -138,6 +138,13 @@ KNOWN_SITES = (
                             # — 'replica_delay' here lags exactly one
                             # replica's forward/backward (the straggler
                             # the per-replica step clock must catch)
+    "replica:dispatch",     # serve.replica.Replica.submit, before the
+                            # request enters the replica's batcher, with
+                            # info={"replica": i} — a 'die' here is a
+                            # serving-replica death at dispatch time (the
+                            # Router marks the replica dead and fails the
+                            # request over to a survivor); 'transient'/
+                            # 'fatal' model flaky dispatch RPCs
 )
 
 

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 
 from ..base import MXNetError
 from ..profiler import core as _prof
@@ -259,6 +260,41 @@ def run_with_watchdog(fn, timeout_s, site="collective"):
 
 # -- circuit breaker --------------------------------------------------------
 
+# live breakers, for the unified export surface (profiler.export pulls
+# breaker_states() so a breaker's state is a scrapeable gauge instead of
+# something only observable by provoking a call); weak so the registry
+# never pins a retired session's breaker
+_breakers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class BreakerState(str):
+    """The breaker's state as a string (``== "closed"`` comparisons keep
+    working) that is *also callable*: ``breaker.state()`` returns the
+    structured form ``{"state", "cooldown_remaining", "trips",
+    "consecutive_failures"}`` — ``cooldown_remaining`` is how many more
+    denied calls an open breaker sits out before half-open re-probe."""
+
+    def __new__(cls, state, cooldown_remaining=0, trips=0,
+                consecutive_failures=0):
+        obj = super().__new__(cls, state)
+        obj.cooldown_remaining = int(cooldown_remaining)
+        obj.trips = int(trips)
+        obj.consecutive_failures = int(consecutive_failures)
+        return obj
+
+    def __call__(self):
+        return {"state": str(self),
+                "cooldown_remaining": self.cooldown_remaining,
+                "trips": self.trips,
+                "consecutive_failures": self.consecutive_failures}
+
+
+def breaker_states():
+    """``{breaker_name: state()}`` over every live CircuitBreaker (the
+    per-breaker gauge surface behind ``profiler.export.snapshot()``).
+    Same-named breakers merge last-writer-wins."""
+    return {b.name: b.state() for b in list(_breakers)}
+
 
 class CircuitBreaker:
     """Consecutive-failure circuit breaker with a call-count cooldown.
@@ -274,14 +310,28 @@ class CircuitBreaker:
         self.cooldown_calls = int(cooldown_calls)
         self.name = name
         self._lock = threading.Lock()
-        self.state = "closed"
+        self._state = "closed"
         self.consecutive_failures = 0
         self.trips = 0
         self._denied = 0          # denials since the breaker opened
         self._probe_out = False   # a half-open probe is in flight
+        _breakers.add(self)
+
+    @property
+    def state(self):
+        """Current state as a :class:`BreakerState`: compares as the plain
+        string (``breaker.state == "open"``) and calls as the structured
+        readout (``breaker.state()`` -> dict with cooldown_remaining)."""
+        with self._lock:
+            cooldown = (max(0, self.cooldown_calls - self._denied)
+                        if self._state == "open" else 0)
+            return BreakerState(self._state, cooldown_remaining=cooldown,
+                                trips=self.trips,
+                                consecutive_failures=self
+                                .consecutive_failures)
 
     def _transition(self, state):
-        self.state = state
+        self._state = state
         if _prof.ENABLED:
             _prof.record_instant(f"resilience::breaker({self.name})",
                                  "resilience", args={"state": state})
@@ -297,9 +347,9 @@ class CircuitBreaker:
     def allow(self) -> bool:
         """May the protected path run now? (also advances the cooldown)"""
         with self._lock:
-            if self.state == "closed":
+            if self._state == "closed":
                 return True
-            if self.state == "open":
+            if self._state == "open":
                 self._denied += 1
                 if self._denied >= self.cooldown_calls:
                     self._transition("half_open")
@@ -322,20 +372,20 @@ class CircuitBreaker:
         with self._lock:
             self.consecutive_failures = 0
             self._probe_out = False
-            if self.state != "closed":
+            if self._state != "closed":
                 self._transition("closed")
 
     def record_failure(self):
         with self._lock:
             self._probe_out = False
-            if self.state == "half_open":
+            if self._state == "half_open":
                 self._denied = 0
                 self.trips += 1
                 _counters.incr("resilience.breaker_trips")
                 self._transition("open")
                 return
             self.consecutive_failures += 1
-            if self.state == "closed" \
+            if self._state == "closed" \
                     and self.consecutive_failures >= self.failure_threshold:
                 self._denied = 0
                 self.trips += 1
@@ -344,5 +394,8 @@ class CircuitBreaker:
 
     def snapshot(self):
         with self._lock:
-            return {"state": self.state, "trips": self.trips,
-                    "consecutive_failures": self.consecutive_failures}
+            return {"state": self._state, "trips": self.trips,
+                    "consecutive_failures": self.consecutive_failures,
+                    "cooldown_remaining": (
+                        max(0, self.cooldown_calls - self._denied)
+                        if self._state == "open" else 0)}

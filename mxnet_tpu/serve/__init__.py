@@ -25,6 +25,12 @@ framework's own substrate:
 * :class:`ServeMetrics` (``metrics``) — p50/p95/p99 latency, queue
   depth, batch occupancy, tokens/s; emitted as ``serve::*`` events on
   the profiler bus.
+* :class:`Router` / :class:`Replica` (``fleet``, ``replica``) — the
+  fleet layer: health-aware least-loaded dispatch over N replicas,
+  replica failover with exactly-once settlement (idempotency keys +
+  generation fencing), hedged retries for straggler-flagged
+  interactive traffic, zero-downtime rollout via per-replica hot swap,
+  and graceful-drain autoscaling hooks.
 
 See SERVING.md for architecture, bucket policy, and the env knobs
 (``MXNET_SERVE_*``).
@@ -34,13 +40,16 @@ from __future__ import annotations
 from .batcher import PRIORITIES, DynamicBatcher, TokenBucket
 from .engine import DeadlineExceeded, InferenceSession, ServeError, \
     ServiceUnavailable, pick_bucket
+from .fleet import QueueDepthPolicy, Router, fleet_stats
 from .generate import Generator, KVCache, SpeculativeGenerator, \
     resolve_decode_path, sample_tokens
 from .metrics import ServeMetrics, percentile
+from .replica import Replica
 
 __all__ = [
     "InferenceSession", "DynamicBatcher", "Generator", "KVCache",
     "SpeculativeGenerator", "ServeMetrics", "ServeError",
     "ServiceUnavailable", "DeadlineExceeded", "TokenBucket", "PRIORITIES",
+    "Router", "Replica", "QueueDepthPolicy", "fleet_stats",
     "sample_tokens", "pick_bucket", "percentile", "resolve_decode_path",
 ]

@@ -36,7 +36,17 @@ Admission control is a hard queue-depth cap (``MXNET_SERVE_MAX_QUEUE``):
 beyond it :meth:`submit` fast-rejects with
 :class:`~mxnet_tpu.serve.engine.ServiceUnavailable` *synchronously* — the
 overloaded server sheds load in O(1) instead of growing a backlog whose
-every entry will miss its SLO anyway.
+every entry will miss its SLO anyway. Overload-shaped 503s (full queue,
+batch share, rate limit, drain, shed) carry a ``retry_after_ms`` hint
+derived from the queue drain rate (depth x per-request service-time
+EWMA); structural 503s (shutdown) carry ``None`` so callers can tell
+"busy, come back" from "gone, fail over".
+
+Exactly-once admission: ``submit(key=...)`` attaches an idempotency key.
+A duplicate submit — same key while the original is queued, in flight,
+or recently settled — returns the ORIGINAL future instead of enqueuing
+a second copy, so a client (or the fleet Router's failover path)
+retrying an ambiguous failure can never double-execute a request.
 
 Failure isolation: a runner exception fails the *requests of that batch*
 (each future carries the error) and the flusher thread keeps serving —
@@ -48,6 +58,7 @@ deadline retirement). The ``serve:queue`` fault site fires inside
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 import warnings
@@ -91,15 +102,18 @@ class TokenBucket:
 
 class _Pending:
     __slots__ = ("payload", "future", "t_enq", "t_dispatch", "priority",
-                 "deadline", "trace", "flow", "t_enq_ns", "t_dispatch_ns")
+                 "deadline", "key", "trace", "flow", "t_enq_ns",
+                 "t_dispatch_ns")
 
-    def __init__(self, payload, priority="interactive", deadline=None):
+    def __init__(self, payload, priority="interactive", deadline=None,
+                 key=None):
         self.payload = payload
         self.future = Future()
         self.t_enq = time.monotonic()
         self.t_dispatch = None
         self.priority = priority
         self.deadline = deadline  # absolute time.monotonic() or None
+        self.key = key            # idempotency key or None
         # request-scoped tracing (profiler.trace); None when tracing is
         # off. t_*_ns are perf_counter_ns stamps for retro span emission
         # (t_enq/t_dispatch above are monotonic() — a different clock).
@@ -192,6 +206,18 @@ class DynamicBatcher:
         self._closed = False
         self._draining = False
         self._thread = None
+        # idempotency keys (exactly-once admission): key -> live future
+        # while unsettled, then retained in a bounded settled map so a
+        # duplicate submit AFTER settlement returns the same outcome
+        # instead of recomputing it (the Router's failover/hedge paths
+        # depend on duplicate-submits never double-executing)
+        self._keyed = {}
+        self._settled_keys = collections.OrderedDict()
+        self._settled_cap = 2048
+        self.duplicate_submits = 0
+        # per-request amortized service time EWMA (ms) — the drain-rate
+        # estimate behind the retry_after_ms hint on overload 503s
+        self._svc_ms = None
         if start:
             self.start()
 
@@ -216,15 +242,19 @@ class DynamicBatcher:
             self._closed = True
             self._cond.notify_all()
         stuck = []
+        wedged = False
         if self._thread is not None:
             self._thread.join(timeout)
-            if self._thread.is_alive():
-                with self._cond:
-                    stuck = list(self._inflight)
+            wedged = self._thread.is_alive()
         with self._cond:
+            # a wedged flusher (runner hung) OR a dead one (a `die` fault
+            # is a BaseException — it kills the thread without running
+            # _settle) both strand the in-flight batch; rescue it either
+            # way. A cleanly-exited flusher left _inflight empty.
+            stuck, self._inflight = self._inflight, []
             leftovers, self._queue = self._queue, []
             self.metrics.set_queue_depth(0)
-        if stuck:
+        if stuck and wedged:
             warnings.warn(
                 f"batcher {self.name!r}: flusher did not join within "
                 f"{timeout}s (runner wedged mid-batch); failing its "
@@ -236,6 +266,7 @@ class DynamicBatcher:
                 f"batcher {self.name!r} shut down before dispatch")
             _retire_traced(p, "shutdown", err)
             _settle_future(p.future, error=err)
+            self._key_done(p)
 
     def drain(self, timeout=30.0):
         """Stop admission and wait until the queue AND the in-flight batch
@@ -275,13 +306,26 @@ class DynamicBatcher:
             return time.monotonic() + self.default_deadline_s
         return None
 
-    def submit(self, payload, priority="interactive", deadline_ms=None):
+    def _dedupe_locked(self, key):
+        """Return the existing future for ``key`` (live or settled) or
+        None. Caller holds ``_cond``."""
+        fut = self._keyed.get(key)
+        if fut is None:
+            fut = self._settled_keys.get(key)
+        return fut
+
+    def submit(self, payload, priority="interactive", deadline_ms=None,
+               key=None):
         """Admit one request; returns a :class:`concurrent.futures.Future`.
 
         ``priority`` is ``"interactive"`` (default — never shed in favor
         of batch work) or ``"batch"`` (sheds first under pressure).
         ``deadline_ms`` attaches a relative deadline (<= 0 disables even
         when ``MXNET_SERVE_DEADLINE_MS`` sets a default).
+        ``key`` is an optional idempotency key: a resubmit of a key that
+        is already queued, in flight, or recently settled returns the
+        ORIGINAL request's future — it never enqueues a second copy, so a
+        retry after an ambiguous failure cannot double-execute.
 
         Raises synchronously: :class:`ServiceUnavailable` when the queue
         is full of equal-or-higher-priority work, the batch-class share or
@@ -291,6 +335,15 @@ class DynamicBatcher:
         if priority not in _PRIORITY_RANK:
             raise ServeError(
                 f"unknown priority {priority!r}; use one of {PRIORITIES}")
+        if key is not None:
+            # dedupe BEFORE the fault site and deadline check: a duplicate
+            # must resolve to the original outcome, not inject a second
+            # fault or 504 against a deadline the first copy already beat
+            with self._cond:
+                fut = self._dedupe_locked(key)
+                if fut is not None:
+                    self.duplicate_submits += 1
+                    return fut
         t_sub_ns = time.perf_counter_ns() if _trace.ENABLED else 0
         # admission fault site OUTSIDE the lock: an injected delay models
         # a slow admission path, not a queue-lock convoy
@@ -304,24 +357,33 @@ class DynamicBatcher:
                 f"batcher {self.name!r}: request deadline expired "
                 "before admission")
         shed = None
+        shed_hint = None
         with self._cond:
+            if key is not None:
+                # authoritative re-check under the admission lock (two
+                # racing duplicates may both pass the pre-check above)
+                fut = self._dedupe_locked(key)
+                if fut is not None:
+                    self.duplicate_submits += 1
+                    return fut
             if self._closed:
+                # structural: no retry_after_ms — waiting won't help
                 raise ServiceUnavailable(
                     f"batcher {self.name!r} is shut down")
             if self._draining:
                 self.metrics.observe_reject()
-                raise ServiceUnavailable(
+                raise self._shed_503(
                     f"batcher {self.name!r} is draining; no new work "
-                    "admitted until resume()")
+                    "admitted until resume()", self._drain_eta_ms_locked())
             if priority == "batch" and self.batch_queue_cap < self.max_queue:
                 n_batch = sum(1 for p in self._queue
                               if p.priority == "batch")
                 if n_batch >= self.batch_queue_cap:
                     self.metrics.observe_shed("batch", reason="share")
-                    raise ServiceUnavailable(
+                    raise self._shed_503(
                         f"batcher {self.name!r}: batch-class queue share "
                         f"({self.batch_queue_cap} of {self.max_queue}) is "
-                        "full; shed")
+                        "full; shed", self._drain_eta_ms_locked())
             if len(self._queue) >= self.max_queue:
                 # shed-lowest-first: an interactive arrival displaces the
                 # NEWEST queued lower-priority request (newest: it has
@@ -339,10 +401,12 @@ class DynamicBatcher:
                     if priority == "batch":
                         self.metrics.observe_shed("batch",
                                                   reason="pressure")
-                    raise ServiceUnavailable(
+                    raise self._shed_503(
                         f"batcher {self.name!r} queue is full "
-                        f"({self.max_queue} waiting); shed load upstream")
+                        f"({self.max_queue} waiting); shed load upstream",
+                        self._drain_eta_ms_locked())
                 shed = self._queue.pop(victim_idx)
+                shed_hint = self._drain_eta_ms_locked()
             # rate-limit LAST, after every other reject: a token must only
             # be spent on a request that is actually admitted — otherwise
             # retries against a full/draining batcher drain the bucket and
@@ -353,11 +417,13 @@ class DynamicBatcher:
                     # lose a popped victim
                     self._queue.append(shed)
                 self.metrics.observe_shed("batch", reason="rate")
-                raise ServiceUnavailable(
+                raise self._shed_503(
                     f"batcher {self.name!r}: batch-class token bucket "
                     f"empty (MXNET_SERVE_RATE_LIMIT="
-                    f"{self.rate_limiter.rate:g}/s); shed")
-            p = _Pending(payload, priority=priority, deadline=deadline)
+                    f"{self.rate_limiter.rate:g}/s); shed",
+                    1e3 / self.rate_limiter.rate)
+            p = _Pending(payload, priority=priority, deadline=deadline,
+                         key=key)
             if t_sub_ns:
                 # trace set up BEFORE the entry is visible to the flusher
                 # (a half-traced entry would leak an unclosed flow arrow)
@@ -370,20 +436,55 @@ class DynamicBatcher:
                                {"priority": priority})
                     p.flow = tr.flow_out("serve::enqueue")
             self._queue.append(p)
+            if key is not None:
+                self._keyed[key] = p.future
             self.metrics.set_queue_depth(len(self._queue))
             self._cond.notify()
         if shed is not None:
             self.metrics.observe_shed(shed.priority, reason="pressure")
-            err = ServiceUnavailable(
+            err = self._shed_503(
                 f"batcher {self.name!r}: shed under queue pressure to "
-                "admit higher-priority work")
+                "admit higher-priority work", shed_hint)
             _retire_traced(shed, "shed", err)
             _settle_future(shed.future, error=err)
+            self._key_done(shed)
         return p.future
 
     def queue_depth(self):
         with self._cond:
             return len(self._queue)
+
+    def _drain_eta_ms_locked(self):
+        """Estimate (ms) how long the current backlog takes to drain:
+        queue depth x amortized per-request service time (EWMA from real
+        settles), plus one batch-assembly window. Before the first settle
+        the timeout alone stands in. Caller holds ``_cond``."""
+        svc = self._svc_ms if self._svc_ms is not None \
+            else self.timeout_s * 1e3
+        return len(self._queue) * svc + self.timeout_s * 1e3
+
+    @staticmethod
+    def _shed_503(msg, retry_after_ms):
+        """An overload-shaped 503: carries a ``retry_after_ms`` hint
+        derived from the queue drain rate, so a client (or the fleet
+        Router) backs off just long enough instead of guessing.
+        Structural 503s — shutdown — deliberately carry None."""
+        err = ServiceUnavailable(msg)
+        err.retry_after_ms = max(1.0, float(retry_after_ms))
+        return err
+
+    def _key_done(self, p):
+        """Retire a settled entry's idempotency key: drop the live
+        mapping and retain the settled future in a bounded LRU so a
+        late duplicate still gets the original outcome."""
+        if p.key is None:
+            return
+        with self._cond:
+            self._keyed.pop(p.key, None)
+            self._settled_keys[p.key] = p.future
+            self._settled_keys.move_to_end(p.key)
+            while len(self._settled_keys) > self._settled_cap:
+                self._settled_keys.popitem(last=False)
 
     # -- flusher ------------------------------------------------------------
     def _sweep_expired_locked(self, now):
@@ -462,6 +563,7 @@ class DynamicBatcher:
                         f"{(now - p.t_enq) * 1e3:.1f}ms in queue")
                     _retire_traced(p, "expired", err)
                     _settle_future(p.future, error=err)
+                    self._key_done(p)
                 with self._cond:
                     # the sweep may have emptied the queue: wake drain()
                     # waiters now, not at their timeout
@@ -507,6 +609,12 @@ class DynamicBatcher:
     def _settle(self, batch, results=None, error=None):
         done = time.monotonic()
         done_ns = time.perf_counter_ns()
+        if error is None and batch:
+            # feed the drain-rate estimator only from real completions:
+            # failed batches say nothing about healthy service time
+            per_req = (done - batch[0].t_dispatch) * 1e3 / len(batch)
+            self._svc_ms = per_req if self._svc_ms is None \
+                else 0.7 * self._svc_ms + 0.3 * per_req
         for i, p in enumerate(batch):
             queue_ms = (p.t_dispatch - p.t_enq) * 1e3
             exec_ms = (done - p.t_dispatch) * 1e3
@@ -538,6 +646,7 @@ class DynamicBatcher:
                                  "ok": exc is None})
                 p.trace.finish(error=exc)
             _settle_future(p.future, result=out, error=exc)
+            self._key_done(p)
         with self._cond:
             self._inflight = []
             self._cond.notify_all()
@@ -545,4 +654,5 @@ class DynamicBatcher:
     def stats(self):
         out = self.metrics.snapshot()
         out["queue_depth"] = self.queue_depth()
+        out["duplicate_submits"] = self.duplicate_submits
         return out

@@ -45,6 +45,15 @@ class ServeError(MXNetError):
     """Base class for serving-path errors; carries an HTTP-style status."""
 
     status = 500
+    #: back-off hint (ms) for overload-shaped rejects: when set, the
+    #: server expects capacity to free up after roughly this long (the
+    #: batcher derives it from queue depth x its drain rate), so a client
+    #: or router can back off intelligently instead of hammering.
+    #: ``None`` on structural failures a retry won't fix (shutdown,
+    #: breaker open) — the Router uses exactly this distinction to tell
+    #: "loaded replica, pass the 503 through" from "broken replica,
+    #: quarantine it".
+    retry_after_ms = None
 
 
 class ServiceUnavailable(ServeError):

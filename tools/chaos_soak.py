@@ -367,6 +367,302 @@ def _decode_leg(seed, violations, say):
     return report
 
 
+def _build_fleet_replica(index, name_prefix="fleet"):
+    """One fleet replica: its own tiny Dense session behind its own
+    batcher (independent flusher thread + metrics window)."""
+    from mxnet_tpu.serve import InferenceSession
+    from mxnet_tpu.serve.replica import Replica
+
+    net, _ = _fleet_net()
+    sess = InferenceSession(net, batch_buckets=(1, 2, 4),
+                            name=f"{name_prefix}_r{index}")
+    sess.warmup(np.zeros((1, 16), np.float32))
+
+    def runner(payloads):
+        out = sess.predict(np.stack(payloads)).asnumpy()
+        return [out[i] for i in range(len(payloads))]
+
+    rep = Replica(runner, index=index, session=sess, max_batch_size=4,
+                  timeout_ms=3.0, max_queue=32,
+                  name=f"{name_prefix}_r{index}")
+    # the same pressure valves run_soak uses, per replica
+    rep.batcher.batch_queue_cap = 16
+    rep.batcher.rate_limiter.rate = 400.0
+    rep.batcher.rate_limiter.burst = 32.0
+    return rep
+
+
+def _fleet_net():
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(8))
+    net.initialize()
+    return net, 16
+
+
+def run_fleet_soak(duration_s=10.0, clients=64, replicas=3, seed=11,
+                   p99_factor=4.0, p99_floor_ms=600.0, grace_ms=50.0,
+                   interactive_deadline_ms=4000.0, batch_deadline_ms=150.0,
+                   verbose=True):
+    """Fleet-level chaos soak: 64+ mixed-priority clients over a Router
+    of N replicas, a seeded FaultPlan on the dispatch/admission/execute
+    sites, and one deterministic replica kill mid-traffic. Asserts:
+
+    1. exactly-once settlement FLEET-WIDE — client books balance; the
+       killed replica's in-flight work is requeued to survivors, its
+       dying settles are fenced, and no request is delivered twice;
+    2. the outcome taxonomy stays closed (ok / 503 / 504 / injected);
+    3. sheds land only on the batch class on every replica;
+    4. interactive p99 stays bounded vs the uncontended fleet baseline;
+    5. the fleet recovers: the survivors keep serving after the kill,
+       a zero-downtime rollout (all-warm swaps, zero recompiles, zero
+       dropped requests) succeeds, and scale up/down drains gracefully.
+
+    Importable — ``tests/test_fleet.py`` sweeps it over seeds."""
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.resilience.faults import (InjectedFaultError,
+                                             TransientFaultError)
+    from mxnet_tpu.serve import DeadlineExceeded, ServiceUnavailable
+    from mxnet_tpu.serve.fleet import Router
+
+    def say(msg):
+        if verbose:
+            print(f"FLEET_SOAK {msg}", flush=True)
+
+    violations = []
+    grace_s = grace_ms / 1e3
+    say(f"building {replicas} replicas")
+    reps = [_build_fleet_replica(i) for i in range(int(replicas))]
+    sessions = [r.session for r in reps]
+    router = Router(reps, factory=_build_fleet_replica, name="fleet",
+                    probe_ms=10.0, hedge_ms=50.0, straggler_ms=100.0)
+
+    say("measuring uncontended fleet interactive p99 (no faults)")
+    lat = []
+    x = np.zeros(16, np.float32)
+    for _ in range(48):
+        t0 = time.monotonic()
+        router.submit(x, priority="interactive",
+                      deadline_ms=4000.0).result(timeout=30)
+        lat.append((time.monotonic() - t0) * 1e3)
+    base_p99 = _percentile(lat, 99)
+    say(f"uncontended fleet p99 = {base_p99:.1f}ms")
+
+    plan = faults.install_plan({"seed": int(seed), "rules": [
+        {"site": "serve:queue", "kind": "transient", "prob": 0.01},
+        {"site": "serve:execute", "kind": "transient", "prob": 0.01},
+        {"site": "serve:execute", "kind": "delay", "seconds": 0.1,
+         "prob": 0.005},
+        {"site": "replica:dispatch", "kind": "transient", "prob": 0.005},
+    ]})
+
+    stats = _ClientStats()
+    stop_at = time.monotonic() + float(duration_s)
+    n_interactive = max(2, clients // 4)
+    n_batch = clients - n_interactive
+    barrier = threading.Barrier(clients + 1)
+    kseq = threading.Lock()
+    kill_done = {"ok_after": 0, "killed": None}
+
+    def classify(exc):
+        if isinstance(exc, DeadlineExceeded):
+            return "deadline_504"
+        if isinstance(exc, ServiceUnavailable):
+            return "shed_503"
+        if isinstance(exc, (TransientFaultError, InjectedFaultError)):
+            return "injected"
+        return "unexpected"
+
+    def client(cid, priority, deadline_ms, pause_s):
+        barrier.wait(timeout=30)
+        n = 0
+        while time.monotonic() < stop_at:
+            n += 1
+            t0 = time.monotonic()
+            deadline = t0 + deadline_ms / 1e3
+            try:
+                fut = router.submit(x, priority=priority,
+                                    deadline_ms=deadline_ms,
+                                    key=f"c{cid}-{n}")
+            except Exception as exc:  # noqa: BLE001 — sync rejects
+                stats.record(priority, t0, deadline, grace_s,
+                             classify(exc), exc)
+                time.sleep(max(pause_s, 0.003))
+                continue
+            with stats.lock:
+                stats.admitted += 1
+            try:
+                fut.result(timeout=60)
+                lat_ms = (time.monotonic() - t0) * 1e3
+                stats.record(priority, t0, deadline, grace_s, "ok",
+                             lat_ms=lat_ms)
+                if kill_done["killed"] is not None:
+                    with kseq:
+                        kill_done["ok_after"] += 1
+            except Exception as exc:  # noqa: BLE001
+                stats.record(priority, t0, deadline, grace_s,
+                             classify(exc), exc)
+            time.sleep(pause_s)
+
+    def killer():
+        """Deterministic mid-traffic replica kill."""
+        barrier.wait(timeout=30)
+        time.sleep(duration_s / 2.0)
+        with router._lock:
+            live = sorted(st.index for st in router._states.values()
+                          if not st.dead)
+        if live:
+            victim = live[int(seed) % len(live)]
+            say(f"killing replica {victim} mid-traffic")
+            router.kill_replica(victim, reason="soak_kill")
+            kill_done["killed"] = victim
+
+    threads = [threading.Thread(
+        target=client, args=(i, "interactive", interactive_deadline_ms,
+                             0.01),
+        daemon=True, name=f"fleet-hi-{i}") for i in range(n_interactive)]
+    threads += [threading.Thread(
+        target=client, args=(n_interactive + i, "batch",
+                             batch_deadline_ms, 0.001),
+        daemon=True, name=f"fleet-lo-{i}") for i in range(n_batch)]
+    threads.append(threading.Thread(target=killer, daemon=True,
+                                    name="fleet-killer"))
+    say(f"soaking: {n_interactive} interactive + {n_batch} batch clients "
+        f"over {replicas} replicas for {duration_s:.0f}s (seed={seed})")
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + 90)
+        if t.is_alive():
+            violations.append(f"thread {t.name} wedged (deadlock?)")
+    faults.clear_plan()
+
+    # -- invariants ----------------------------------------------------------
+    total_seen = sum(stats.outcomes.values())
+    if stats.unexpected:
+        violations.append(
+            f"{len(stats.unexpected)} unexpected outcome(s), e.g. "
+            f"{stats.unexpected[:3]}")
+    if stats.settled != total_seen:
+        violations.append(
+            f"settle books don't balance: {stats.settled} settles vs "
+            f"{total_seen} outcomes")
+    if stats.late_completions:
+        violations.append(
+            f"{stats.late_completions} silent late completion(s)")
+    if stats.outcomes["ok"] == 0:
+        violations.append("zero successful requests — fleet served nothing")
+    if kill_done["killed"] is None:
+        violations.append("the mid-traffic replica kill never happened")
+    if router.counters["kills"] < 1:
+        violations.append("router recorded no replica kill")
+    if kill_done["ok_after"] == 0:
+        violations.append(
+            "no successful request after the replica kill — no recovery")
+    for rep in reps:
+        sheds = rep.metrics.snapshot()["sheds"]
+        if any(k != "batch" for k in sheds):
+            violations.append(
+                f"replica {rep.index}: sheds outside batch class: {sheds}")
+    hi_p99 = _percentile(stats.interactive_lat, 99)
+    bound = max(p99_factor * base_p99, p99_floor_ms)
+    if hi_p99 > bound:
+        violations.append(
+            f"interactive p99 {hi_p99:.1f}ms exceeds bound {bound:.1f}ms "
+            f"({p99_factor}x uncontended {base_p99:.1f}ms)")
+
+    # -- zero-downtime rollout under live traffic ---------------------------
+    say("rollout: walking live replicas through warm swaps under traffic")
+    roll_stats = _ClientStats()
+    roll_stop = {"at": time.monotonic() + 60.0}
+
+    def roll_client(cid):
+        n = 0
+        while time.monotonic() < roll_stop["at"]:
+            n += 1
+            t0 = time.monotonic()
+            try:
+                router.submit(x, priority="interactive", deadline_ms=4000.0,
+                              key=f"roll{cid}-{n}").result(timeout=30)
+                roll_stats.record("interactive", t0, None, grace_s, "ok")
+            except Exception as exc:  # noqa: BLE001
+                roll_stats.record("interactive", t0, None, grace_s,
+                                  classify(exc), exc)
+            time.sleep(0.005)
+
+    roll_threads = [threading.Thread(target=roll_client, args=(i,),
+                                     daemon=True) for i in range(8)]
+    for t in roll_threads:
+        t.start()
+    new_net, _ = _fleet_net()
+    modes = router.rollout(new_net, example=np.zeros((1, 16), np.float32),
+                           timeout=30.0)
+    roll_stop["at"] = time.monotonic()
+    for t in roll_threads:
+        t.join(30)
+    live_modes = [m for m in modes if m != "dead"]
+    if not live_modes or any(m != "warm" for m in live_modes):
+        violations.append(
+            f"rollout was not all-warm across live replicas: {modes}")
+    dropped = sum(v for k, v in roll_stats.outcomes.items() if k != "ok")
+    if dropped:
+        violations.append(
+            f"rollout dropped {dropped} request(s): "
+            f"{roll_stats.outcomes} e.g. {roll_stats.unexpected[:2]}")
+    for st in list(router._states.values()):
+        if st.dead:
+            continue
+        try:
+            st.replica.session.assert_no_recompiles()
+        except Exception as exc:  # noqa: BLE001
+            violations.append(
+                f"replica {st.index} recompiled during rollout: {exc}")
+
+    # -- autoscaling: grow through the factory, shrink by graceful drain ----
+    n_before = router.replica_count()
+    say(f"scale: {n_before} -> {n_before + 1} -> 2")
+    router.scale_to(n_before + 1)
+    if router.replica_count() != n_before + 1:
+        violations.append(
+            f"scale up failed: {router.replica_count()} != {n_before + 1}")
+    router.scale_to(2)
+    if router.replica_count() != 2:
+        violations.append(
+            f"scale down failed: {router.replica_count()} != 2")
+    try:
+        router.submit(x, priority="interactive",
+                      deadline_ms=4000.0).result(timeout=30)
+    except Exception as exc:  # noqa: BLE001
+        violations.append(f"post-scale serving failed: {exc!r}")
+
+    counters = dict(router.counters)
+    router.close()
+    report = {
+        "ok": not violations,
+        "violations": violations,
+        "outcomes": dict(stats.outcomes),
+        "admitted": stats.admitted,
+        "uncontended_p99_ms": base_p99,
+        "interactive_p99_ms": hi_p99,
+        "p99_bound_ms": bound,
+        "killed_replica": kill_done["killed"],
+        "ok_after_kill": kill_done["ok_after"],
+        "rollout_modes": modes,
+        "rollout_outcomes": dict(roll_stats.outcomes),
+        "counters": counters,
+        "faults_fired": plan.fired_total(),
+    }
+    say(f"outcomes={report['outcomes']} counters(failovers="
+        f"{counters['failovers']}, requeued={counters['requeued']}, "
+        f"hedges={counters['hedges']}, fenced={counters['fenced_results']}"
+        f", dup_settles={counters['duplicate_settles']}) "
+        f"p99={hi_p99:.1f}ms (bound {bound:.1f}ms) rollout={modes}")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--duration", type=float, default=10.0,
@@ -381,7 +677,31 @@ def main(argv=None):
                     help="absolute floor for the p99 bound (CI jitter)")
     ap.add_argument("--no-decode", action="store_true",
                     help="skip the Generator/serve:decode leg")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet soak (Router over N replicas + "
+                         "mid-traffic replica kill) instead of the "
+                         "single-server soak")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="fleet soak: number of replicas (default 3)")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        report = run_fleet_soak(
+            duration_s=args.duration, clients=args.clients,
+            replicas=args.replicas, seed=args.seed,
+            p99_factor=max(args.p99_factor, 4.0),
+            p99_floor_ms=max(args.p99_floor_ms, 600.0))
+        if report["ok"]:
+            print(f"FLEET_SOAK=PASS outcomes={report['outcomes']} "
+                  f"killed=r{report['killed_replica']} "
+                  f"failovers={report['counters']['failovers']} "
+                  f"requeued={report['counters']['requeued']} "
+                  f"p99={report['interactive_p99_ms']:.1f}ms "
+                  f"rollout={report['rollout_modes']}")
+            return 0
+        for v in report["violations"]:
+            print(f"FLEET_SOAK=FAIL {v}")
+        return 1
 
     report = run_soak(duration_s=args.duration, clients=args.clients,
                       seed=args.seed, p99_factor=args.p99_factor,

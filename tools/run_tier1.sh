@@ -105,6 +105,22 @@ if [[ "${TIER1_DECODE:-0}" != "0" ]]; then
         fi
     done
 fi
+# Fleet soak smoke (TIER1_FLEET=0 to skip): ~8s of 64 mixed-priority
+# clients through a Router over 3 replicas under a seeded fault plan,
+# with one deterministic replica kill mid-traffic — asserts fleet-wide
+# exactly-once settlement (failover requeue + generation fencing), a
+# closed outcome taxonomy, batch-only sheds, bounded interactive p99,
+# an all-warm zero-drop rollout, and graceful-drain scale down. The
+# 8-seed kill-phase sweep lives in tests/test_fleet.py behind -m slow.
+if [[ "${TIER1_FLEET:-1}" != "0" ]]; then
+    timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        python tools/chaos_soak.py --fleet \
+        --duration "${TIER1_FLEET_S:-6}" --clients 64
+    fleet_rc=$?
+    if [[ "$rc" -eq 0 && "$fleet_rc" -ne 0 ]]; then
+        rc=$fleet_rc
+    fi
+fi
 # Elastic soak smoke (TIER1_ELASTIC=0 to skip): one seeded
 # kill/lag/corrupt sweep through a dp8 training loop — asserts the
 # chip-loss dp8->dp4 resume lands bitwise on the dp4 reference run,

@@ -206,6 +206,15 @@ def _run(trace_out=None):
         from mxnet_tpu import profiler
         profiler.set_state("run")
 
+    # MXNET_METRICS_PORT=<p> started the /metrics endpoint at import
+    # (=0 binds an ephemeral port); surface where it actually landed so
+    # the harness driving this smoke can scrape it.
+    from mxnet_tpu.profiler import export as _export
+    mport = _export.server_port()
+    if mport is not None:
+        print(f"SERVE_SMOKE metrics endpoint: "
+              f"http://127.0.0.1:{mport}/metrics", flush=True)
+
     p99_bound_ms = float(os.environ.get("SERVE_SMOKE_P99_MS", "5000"))
     n_clients = 32
 
