@@ -1267,6 +1267,125 @@ def bench_llama_decode(max_new=32, reps=3, batch=16, spec_k=4):
     })
 
 
+def bench_llama_continuous_batching(reps=2):
+    """Serving row (serve.scheduler): continuous batching vs the static
+    bucket ladder on the same 12L llama serve config and the same mixed
+    open-ended traffic — a burst of 32 requests interleaved
+    ``[long, short, short, short] x 8`` (8 batch-class 48-token decodes
+    among 24 interactive 4-token requests).
+
+    The static side is the PR-6/PR-10 stack at its best bucket: batches
+    of 8 in arrival order, each batch running until its LONGEST request
+    finishes — the interactive shorts ride out all 48 steps
+    (head-of-line blocking) and their lanes decode dead air after step 4.
+    The continuous side admits/retires between decode steps over 8 paged
+    slots, so a retired short's slot immediately decodes the next
+    request. Same decode-rung executables on both sides, per rung.
+
+    Reported per rung: aggregate USEFUL tokens/s (requested tokens only —
+    the static side gets no credit for dead-lane tokens) and client-side
+    interactive p99 from burst arrival. The row hard-fails unless
+    continuous batching beats static on BOTH metrics on every rung, and
+    every engine asserts zero recompiles."""
+    import threading
+
+    import numpy as onp
+
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.serve import ContinuousEngine, Generator, percentile
+
+    net = get_llama("llama_serve_12l_test")
+    net.initialize()
+
+    rng = onp.random.RandomState(0)
+    reqs = []  # (prompt, max_new, priority) in arrival order
+    for _ in range(8):
+        reqs.append((rng.randint(1, 500, size=8).tolist(), 48, "batch"))
+        for _ in range(3):
+            reqs.append((rng.randint(
+                1, 500, size=int(rng.randint(4, 13))).tolist(), 4,
+                "interactive"))
+    useful = sum(m for _, m, _ in reqs)
+
+    ladder = {}
+    for path in ("baseline", "pallas", "int8"):
+        gen = Generator(net, max_seq=64, batch_buckets=(8,),
+                        prompt_buckets=(16,), decode_path=path,
+                        name=f"cb_static_{path}")
+        gen.warmup()
+        st_rate, st_p99 = 0.0, None
+        for _ in range(reps):
+            t0 = time.monotonic()
+            lat = []
+            for g in range(0, len(reqs), 8):
+                grp = reqs[g:g + 8]
+                gen.generate([p for p, _, _ in grp],
+                             max_new_tokens=max(m for _, m, _ in grp))
+                done = (time.monotonic() - t0) * 1e3
+                lat += [done for _, _, pr in grp if pr == "interactive"]
+            rate = useful / (time.monotonic() - t0)
+            if rate > st_rate:
+                st_rate, st_p99 = rate, percentile(lat, 99)
+        gen.assert_no_recompiles()
+
+        eng = ContinuousEngine(net, max_seq=64, num_slots=8, page_size=16,
+                               prefill_chunk=16, decode_path=path,
+                               name=f"cb_engine_{path}", max_queue=64)
+        eng.start()
+        cb_rate, cb_p99 = 0.0, None
+        for _ in range(reps):
+            done_t, lock = {}, threading.Lock()
+
+            def stamp(i):
+                def cb(_f):
+                    with lock:
+                        done_t[i] = time.monotonic()
+                return cb
+
+            t0 = time.monotonic()
+            futs = []
+            for i, (p, m, pr) in enumerate(reqs):
+                f = eng.submit(p, max_new_tokens=m, priority=pr)
+                f.add_done_callback(stamp(i))
+                futs.append(f)
+            for f in futs:
+                f.result(timeout=600)
+            rate = useful / (time.monotonic() - t0)
+            lat = [(done_t[i] - t0) * 1e3
+                   for i, (_, _, pr) in enumerate(reqs)
+                   if pr == "interactive"]
+            if rate > cb_rate:
+                cb_rate, cb_p99 = rate, percentile(lat, 99)
+        eng.assert_no_recompiles()
+        eng.close()
+
+        if cb_rate <= st_rate or cb_p99 >= st_p99:
+            raise RuntimeError(
+                f"continuous batching lost to static buckets on the "
+                f"{path} rung: tokens/s {cb_rate:.1f} vs {st_rate:.1f}, "
+                f"interactive p99 {cb_p99:.0f}ms vs {st_p99:.0f}ms")
+        ladder[path] = {
+            "cb_tokens_s": round(cb_rate, 1),
+            "static_tokens_s": round(st_rate, 1),
+            "speedup": round(cb_rate / st_rate, 2),
+            "cb_interactive_p99_ms": round(cb_p99, 1),
+            "static_interactive_p99_ms": round(st_p99, 1),
+            "p99_improvement": round(st_p99 / cb_p99, 2),
+        }
+
+    best = ladder["int8"]
+    return _emit({
+        "metric": "llama_cb_tokens_s",
+        "value": best["cb_tokens_s"],
+        "unit": "tokens/s",
+        "vs_baseline": best["speedup"],
+        "ladder": ladder,
+        "traffic": "8x[48-tok batch] + 24x[4-tok interactive], burst",
+        "slots": 8,
+        "page_size": 16,
+    })
+
+
 def bench_bandwidth():
     """KVStore push/pull bandwidth (tools/bandwidth parity, perf.md:263).
 
@@ -1317,6 +1436,8 @@ def main():
                      ("bert", bench_bert_train),
                      ("bert_fused", bench_bert_train_fused),
                      ("llama_decode", bench_llama_decode),
+                     ("llama_continuous_batching",
+                      bench_llama_continuous_batching),
                      ("llama_long_seq", bench_llama_long_seq),
                      ("llama_long_seq4k",
                       lambda: bench_llama_long_seq(seq=4096, batch=2)),

@@ -477,6 +477,16 @@ class CachedOpThreadSafe(CachedOp):
     calls execute concurrently.
     """
 
+    # ONE process-wide trace lock. A first-call jit trace rebinds the
+    # SHARED Parameter NDArrays to tracers (_ParamBinding), so a
+    # concurrent param read from ANY op over the same block — not just
+    # this instance — leaks them (e.g. a live ContinuousEngine decode
+    # thread plus a fresh Generator tracing its first signature on the
+    # same model). Per-instance locks only close the same-op race, so
+    # trace windows and param snapshots serialize on this class lock;
+    # warm known-signature calls stay lock-free.
+    _TRACE_LOCK = threading.RLock()
+
     def __init__(self, block, static_alloc=False, static_shape=False,
                  flags=(), compiler_options=None):
         super().__init__(block, static_alloc=static_alloc,
@@ -513,7 +523,9 @@ class CachedOpThreadSafe(CachedOp):
         UnexpectedTracerError). Any call whose jax-level signature —
         shape/dtype AND weak_type, which the CachedOp cache key does NOT
         capture (jnp scalars are weak) — hasn't completed yet holds the
-        op lock; known-signature calls run lock-free."""
+        process-wide ``_TRACE_LOCK`` (the rebinding hits every op that
+        shares the params, not just this one); known-signature calls run
+        lock-free."""
         import jax
 
         raw = entry["fwd"]
@@ -529,7 +541,7 @@ class CachedOpThreadSafe(CachedOp):
             s = sig_of(a)
             if s in seen:
                 return raw(*a)
-            with self._lock:
+            with CachedOpThreadSafe._TRACE_LOCK:
                 out = raw(*a)
                 seen.add(s)
                 return out
@@ -537,9 +549,10 @@ class CachedOpThreadSafe(CachedOp):
         entry["fwd"] = guarded
 
     def _read_param_datas(self, entry):
-        # excluded from trace windows: the lock is held by any in-flight
-        # first-call trace (see _guard_first_call)
-        with self._lock:
+        # excluded from trace windows: the class trace lock is held by
+        # any in-flight first-call trace of ANY op over these params
+        # (see _guard_first_call)
+        with CachedOpThreadSafe._TRACE_LOCK:
             return super()._read_param_datas(entry)
 
     def _write_back_state(self, state_params, new_states):

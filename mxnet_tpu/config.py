@@ -285,6 +285,37 @@ register_flag(
     "f32 gemm saves, so auto keeps weights f32 there. 1/0 force it "
     "on/off; the KV-cache rings stay int8 either way.", str)
 register_flag(
+    "MXNET_SERVE_KV_PAGED", False,
+    "Back serve.Generator KV state with the paged block pool "
+    "(serve.kv_blocks.PagedKVPool, fully assigned) instead of contiguous "
+    "per-bucket rings. serve.scheduler.ContinuousEngine is always paged "
+    "regardless of this flag.", _bool)
+register_flag(
+    "MXNET_SERVE_KV_PAGE_SIZE", 0,
+    "KV page width in tokens for the paged block allocator. 0 (default): "
+    "the Pallas decode kernel's natural block (128) clamped to max_seq, "
+    "so the kernel's block-skip masking skips whole unreached pages. "
+    "max_seq must be a whole number of pages.", int)
+register_flag(
+    "MXNET_SERVE_KV_PAGES", 0,
+    "Paged-KV pool capacity in pages (including the reserved null page). "
+    "0 (default): auto-size to full capacity — every slot can hold "
+    "max_seq and exhaustion is impossible. Smaller values oversubscribe: "
+    "admission queues on PoolExhausted (503) until retirements recycle "
+    "pages.", int)
+register_flag(
+    "MXNET_SERVE_SLOTS", 8,
+    "Decode lanes for serve.scheduler.ContinuousEngine: the ONE compiled "
+    "decode width. Requests are admitted into free lanes and retired "
+    "from finished ones between decode steps; idle lanes ride along on "
+    "the null KV page.", int)
+register_flag(
+    "MXNET_SERVE_PREFILL_CHUNK", 0,
+    "Prompt tokens prefilled per continuous-batching scheduler iteration "
+    "at the fixed (1, chunk) signature. 0 (default): one KV page. "
+    "Bounds how long a long prompt can stall live decode streams (one "
+    "chunk per iteration).", int)
+register_flag(
     "MXNET_SERVE_SPEC_TOKENS", 4,
     "Draft tokens proposed per speculative-decoding round "
     "(serve.SpeculativeGenerator's default k): each round costs k draft "

@@ -17,6 +17,7 @@ ShardedTrainer shards the batch over ``dp``; long sequences shard over
 """
 from __future__ import annotations
 
+import functools
 import math
 
 from ..base import MXNetError
@@ -26,7 +27,12 @@ from ..gluon.parameter import Parameter
 from ..ops import nn as _ops
 
 
+@functools.lru_cache(maxsize=64)
 def _rope_tables(t, dim, theta=10000.0):
+    # cached: the serving hot loop recomputes the same (t, dim) table
+    # every decode step — one continuous-batching iteration calls this
+    # num_layers times with identical args. Callers must not mutate the
+    # returned arrays (they are shared across calls).
     import numpy as onp
 
     pos = onp.arange(t)[:, None]

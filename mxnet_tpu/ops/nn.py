@@ -1104,6 +1104,95 @@ def gather_positions(data, indices):
 
 
 # ---------------------------------------------------------------------------
+# Paged KV-cache ops (mxnet_tpu.serve.kv_blocks / serve.scheduler)
+#
+# The continuous-batching decode loop stores every request's KV rows in
+# one device-resident *page pool* per layer — (P, KV, page, D) for the
+# rings, (P, KV, page) for the int8 scale pools — instead of per-bucket
+# contiguous rings. A per-slot page table (B, N) of pool page ids maps
+# each slot's logical ring onto its owned pages; page id 0 is the
+# reserved NULL page (dead/idle slots point every entry at it).
+#
+# Both ops below are pure data movement (jnp.take / scatter-set — never
+# an arithmetic merge): gather(pool) -> kv_cache_write/cached_attention
+# -> scatter reads and writes exactly the bytes the contiguous path
+# would. The strict baseline rung runs them as standalone eager ops
+# around the unchanged ring executable, which keeps its bitwise decode
+# contract; compiled INTO the step (fast rungs), XLA partitions the
+# attention loops differently for a gather-fed ring than for an entry
+# parameter, which drifts ulps — tolerance parity only.
+# ---------------------------------------------------------------------------
+
+
+def paged_kv_gather(pool, page_table):
+    """Materialize per-slot contiguous KV rings from a paged pool.
+
+    ``pool`` is (P, KV, page, D) — or (P, KV, page) for a scale pool —
+    and ``page_table`` (B, N) int32 maps slot ``b``'s logical page ``i``
+    to a pool page id (0 = the reserved null page, which the serving
+    step keeps zeroed). Returns the (B, KV, N*page, D) ring — an exact
+    copy (``jnp.take``), bit-preserving by construction. Positions the
+    slot does not own read null-page zeros; the attention position mask
+    (``s <= start_pos + t``) guarantees they are never attended before
+    being overwritten.
+    """
+
+    def f(p, t):
+        jnp = _jnp()
+        g = jnp.take(p, t.astype(jnp.int32), axis=0)  # (B, N, KV, pg[, D])
+        if p.ndim == 4:
+            g = g.transpose(0, 2, 1, 3, 4)
+            b, kv, n, pg, d = g.shape
+            return g.reshape(b, kv, n * pg, d)
+        g = g.transpose(0, 2, 1, 3)
+        b, kv, n, pg = g.shape
+        return g.reshape(b, kv, n * pg)
+
+    return _apply(f, (pool, page_table), name="paged_kv_gather")
+
+
+def paged_kv_scatter(pool, page_table, ring, start_pos, length):
+    """Write the ``length`` freshly-written ring rows at positions
+    ``start_pos[b] + [0..length)`` of ``ring`` (B, KV, S, D) back into the
+    paged ``pool`` through ``page_table`` (B, N). 3-D scale rings
+    (B, KV, S) scatter into (P, KV, page) pools the same way.
+
+    Exact copy in both directions: rows are extracted with
+    ``take_along_axis`` and written with a scatter-``set`` (copied, not
+    merged). Slots whose table rows are all-null (dead/idle lanes of a
+    fixed-width decode step) land their writes on page 0; page 0 is
+    re-zeroed at the end of the op, so the null page reads as zeros on
+    every gather — dead lanes can never feed garbage back to themselves
+    across steps.
+    """
+
+    def f(p, t, r, sp):
+        jnp = _jnp()
+        page = p.shape[2]
+        n_pages = t.shape[1]
+        s_len = r.shape[2]
+        pos = sp.astype(jnp.int32)[:, None] \
+            + jnp.arange(length, dtype=jnp.int32)[None, :]          # (B, L)
+        pos = jnp.clip(pos, 0, s_len - 1)
+        pid = jnp.take_along_axis(
+            t.astype(jnp.int32),
+            jnp.clip(pos // page, 0, n_pages - 1), axis=1)          # (B, L)
+        off = pos % page                                            # (B, L)
+        if r.ndim == 4:
+            rows = jnp.take_along_axis(r, pos[:, None, :, None], axis=2)
+            vals = rows.transpose(0, 2, 1, 3)                # (B, L, KV, D)
+        else:
+            rows = jnp.take_along_axis(r, pos[:, None, :], axis=2)
+            vals = rows.transpose(0, 2, 1)                   # (B, L, KV)
+        out = p.at[pid, :, off].set(vals)
+        # keep the null-page invariant: page 0 always reads as zeros
+        return out.at[0].set(jnp.zeros_like(out[0]))
+
+    return _apply(f, (pool, page_table, ring, start_pos),
+                  name="paged_kv_scatter")
+
+
+# ---------------------------------------------------------------------------
 # misc framework extras
 # ---------------------------------------------------------------------------
 

@@ -34,6 +34,16 @@ import math
 _NEG_INF = -1e30  # finite "minus infinity": keeps fully-masked rows NaN-free
 _BLOCK = 128      # lane width / KV stream block size
 
+
+def natural_block() -> int:
+    """The kernel's KV stream block width (lane tile) — the natural page
+    size for the paged KV allocator (``serve.kv_blocks``): a pool page
+    that matches it means the kernel's block-skip mask
+    (``run = si * bk <= sp``) skips whole unreached pages, so a slot
+    only ever pays compute for pages its sequence has actually
+    reached."""
+    return _BLOCK
+
 # trace-time record of which implementation the last call chose
 # ("pallas" | "xla"); tests and bench assert the kernel actually ran.
 _LAST_PATH = None

@@ -25,6 +25,12 @@ framework's own substrate:
 * :class:`ServeMetrics` (``metrics``) — p50/p95/p99 latency, queue
   depth, batch occupancy, tokens/s; emitted as ``serve::*`` events on
   the profiler bus.
+* :class:`ContinuousEngine` / :class:`PagedKVPool` (``scheduler``,
+  ``kv_blocks``) — continuous batching: an iteration-level scheduler
+  that admits/retires requests *between decode steps* over a fixed slot
+  lattice (two compiled signatures total), with KV state in a paged
+  block pool (reserve-at-admit, recycle-on-retire, null-page masking
+  for idle lanes).
 * :class:`Router` / :class:`Replica` (``fleet``, ``replica``) — the
   fleet layer: health-aware least-loaded dispatch over N replicas,
   replica failover with exactly-once settlement (idempotency keys +
@@ -38,18 +44,22 @@ See SERVING.md for architecture, bucket policy, and the env knobs
 from __future__ import annotations
 
 from .batcher import PRIORITIES, DynamicBatcher, TokenBucket
-from .engine import DeadlineExceeded, InferenceSession, ServeError, \
-    ServiceUnavailable, pick_bucket
+from .engine import DeadlineExceeded, InferenceSession, PoolExhausted, \
+    ServeError, ServiceUnavailable, pick_bucket
 from .fleet import QueueDepthPolicy, Router, fleet_stats
 from .generate import Generator, KVCache, SpeculativeGenerator, \
     resolve_decode_path, sample_tokens
+from .kv_blocks import PagedKVPool, resolve_page_size
 from .metrics import ServeMetrics, percentile
 from .replica import Replica
+from .scheduler import ContinuousEngine
 
 __all__ = [
     "InferenceSession", "DynamicBatcher", "Generator", "KVCache",
     "SpeculativeGenerator", "ServeMetrics", "ServeError",
-    "ServiceUnavailable", "DeadlineExceeded", "TokenBucket", "PRIORITIES",
+    "ServiceUnavailable", "DeadlineExceeded", "PoolExhausted",
+    "TokenBucket", "PRIORITIES",
     "Router", "Replica", "QueueDepthPolicy", "fleet_stats",
+    "ContinuousEngine", "PagedKVPool", "resolve_page_size",
     "sample_tokens", "pick_bucket", "percentile", "resolve_decode_path",
 ]

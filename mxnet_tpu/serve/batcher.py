@@ -552,22 +552,7 @@ class DynamicBatcher:
         while True:
             batch, expired = self._take_batch()
             if expired:
-                now = time.monotonic()
-                for p in expired:
-                    self.metrics.observe_deadline("queue", p.priority)
-                    self.metrics.observe_request(
-                        (now - p.t_enq) * 1e3, 0.0, ok=False,
-                        priority=p.priority)
-                    err = DeadlineExceeded(
-                        f"batcher {self.name!r}: deadline expired after "
-                        f"{(now - p.t_enq) * 1e3:.1f}ms in queue")
-                    _retire_traced(p, "expired", err)
-                    _settle_future(p.future, error=err)
-                    self._key_done(p)
-                with self._cond:
-                    # the sweep may have emptied the queue: wake drain()
-                    # waiters now, not at their timeout
-                    self._cond.notify_all()
+                self.settle_expired(expired)
                 continue
             if not batch:
                 if self._closed:
@@ -649,6 +634,157 @@ class DynamicBatcher:
             self._key_done(p)
         with self._cond:
             self._inflight = []
+            self._cond.notify_all()
+
+    # -- iteration-level consumer API ---------------------------------------
+    # The continuous-batching scheduler (serve.scheduler.ContinuousEngine)
+    # consumes the queue directly between decode steps instead of through
+    # the flusher thread: construct with ``start=False`` and drive
+    # take() / settle_one() / settle_expired() / requeue(). Admission
+    # semantics (priority classes, deadlines, shedding, idempotency keys,
+    # retry_after_ms taxonomy) are byte-for-byte the same — only batch
+    # *assembly* moves from the flusher's size/timeout triggers to the
+    # scheduler's free-slot capacity between decode iterations.
+
+    def take(self, max_n, wait_s=0.0):
+        """Pop up to ``max_n`` queued requests, interactive-first (stable
+        within each class — identical ordering to :meth:`_take_batch`).
+        Returns ``(batch, expired)``: expired entries swept from the
+        queue must be settled by the caller via :meth:`settle_expired`
+        (and when any are returned the batch is empty — one concern per
+        call). Blocks up to ``wait_s`` for work; ``([], [])`` means
+        nothing was due or the batcher closed. Taken entries sit in the
+        in-flight set (visible to :meth:`drain` / :meth:`close`) until
+        :meth:`settle_one` or :meth:`requeue` removes them."""
+        deadline = time.monotonic() + max(0.0, float(wait_s))
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                expired = self._sweep_expired_locked(now)
+                if expired:
+                    self.metrics.set_queue_depth(len(self._queue))
+                    return [], expired
+                if self._queue and max_n > 0:
+                    ordered = sorted(
+                        self._queue,
+                        key=lambda p: _PRIORITY_RANK[p.priority])
+                    batch = ordered[:int(max_n)]
+                    taken = set(id(p) for p in batch)
+                    self._queue = [p for p in self._queue
+                                   if id(p) not in taken]
+                    self._inflight.extend(batch)
+                    self.metrics.set_queue_depth(len(self._queue))
+                    break
+                remaining = deadline - now
+                if remaining <= 0 or self._closed:
+                    return [], []
+                self._cond.wait(remaining)
+        now = time.monotonic()
+        for p in batch:
+            p.t_dispatch = now
+            if p.trace is not None and p.t_dispatch_ns is None:
+                # first dispatch only: a requeued entry already landed
+                # its enqueue arrow and queue span
+                p.t_dispatch_ns = time.perf_counter_ns()
+                p.trace.flow_in(p.flow, "serve::enqueue")
+                p.trace.span_at("serve::queue", p.t_enq_ns,
+                                p.t_dispatch_ns,
+                                {"batch_size": len(batch)})
+        self.metrics.observe_batch(len(batch), self.max_batch_size)
+        return batch, []
+
+    def requeue(self, p):
+        """Put an in-flight entry back at the FRONT of the queue — the
+        scheduler's answer to :class:`~.engine.PoolExhausted` at admit
+        time: the request keeps its place in line and is re-taken the
+        moment retirements free KV pages. On a closed batcher the entry
+        settles with a structural 503 instead of re-entering a queue
+        nobody will ever drain."""
+        closed = False
+        with self._cond:
+            try:
+                self._inflight.remove(p)
+            except ValueError:
+                pass
+            closed = self._closed
+            if not closed:
+                self._queue.insert(0, p)
+                self.metrics.set_queue_depth(len(self._queue))
+            self._cond.notify_all()
+        if closed:
+            err = ServiceUnavailable(
+                f"batcher {self.name!r} shut down before dispatch")
+            _retire_traced(p, "shutdown", err)
+            _settle_future(p.future, error=err)
+            self._key_done(p)
+
+    def settle_expired(self, expired):
+        """Settle queue-expired entries (the second element of
+        :meth:`take` / :meth:`_take_batch`) with the 504 taxonomy:
+        ``observe_deadline("queue")``, a failed-request sample, a
+        :class:`DeadlineExceeded` on the future."""
+        now = time.monotonic()
+        for p in expired:
+            self.metrics.observe_deadline("queue", p.priority)
+            self.metrics.observe_request(
+                (now - p.t_enq) * 1e3, 0.0, ok=False,
+                priority=p.priority)
+            err = DeadlineExceeded(
+                f"batcher {self.name!r}: deadline expired after "
+                f"{(now - p.t_enq) * 1e3:.1f}ms in queue")
+            _retire_traced(p, "expired", err)
+            _settle_future(p.future, error=err)
+            self._key_done(p)
+        with self._cond:
+            # the sweep may have emptied the queue: wake drain()
+            # waiters now, not at their timeout
+            self._cond.notify_all()
+
+    def settle_one(self, p, result=None, error=None):
+        """Per-request settle for iteration-level consumers — requests
+        retire one at a time as they finish, not as a batch. Applies the
+        same deadline+grace recheck, metrics, tracing, exactly-once
+        future semantics, and service-time EWMA feed as the flusher's
+        :meth:`_settle`, then removes the entry from the in-flight set
+        (waking :meth:`drain`)."""
+        done = time.monotonic()
+        done_ns = time.perf_counter_ns()
+        t_disp = p.t_dispatch if p.t_dispatch is not None else done
+        queue_ms = (t_disp - p.t_enq) * 1e3
+        exec_ms = (done - t_disp) * 1e3
+        exc = error
+        if exc is None:
+            self._svc_ms = exec_ms if self._svc_ms is None \
+                else 0.7 * self._svc_ms + 0.3 * exec_ms
+        deadline_ok = True
+        if exc is None and p.deadline is not None and done > p.deadline:
+            if done > p.deadline + self.deadline_grace_s:
+                self.metrics.observe_deadline("execute", p.priority)
+                exc = DeadlineExceeded(
+                    f"batcher {self.name!r}: completed "
+                    f"{(done - p.deadline) * 1e3:.1f}ms past deadline "
+                    f"(grace {self.deadline_grace_s * 1e3:.0f}ms)")
+            else:
+                deadline_ok = False  # delivered, but counted late
+        self.metrics.observe_request(queue_ms, exec_ms,
+                                     ok=exc is None,
+                                     priority=p.priority,
+                                     deadline_ok=deadline_ok)
+        if p.trace is not None:
+            p.trace.span_at("serve::execute",
+                            p.t_dispatch_ns or done_ns, done_ns,
+                            {"exec_ms": round(exec_ms, 3),
+                             "ok": exc is None})
+            p.trace.finish(error=exc)
+        _settle_future(p.future,
+                       result=result if exc is None else None,
+                       error=exc)
+        self._key_done(p)
+        with self._cond:
+            try:
+                self._inflight.remove(p)
+            except ValueError:
+                pass
             self._cond.notify_all()
 
     def stats(self):

@@ -62,6 +62,15 @@ class ServiceUnavailable(ServeError):
     status = 503
 
 
+class PoolExhausted(ServiceUnavailable):
+    """The paged KV block pool has no free pages for a new admission
+    (503-shaped: capacity frees as in-flight requests retire and their
+    pages recycle). Raised by :class:`~mxnet_tpu.serve.kv_blocks.
+    PagedKVPool`; the continuous-batching scheduler catches it at the
+    admission boundary and requeues the request — the pool being full is
+    backpressure, never a crash."""
+
+
 class DeadlineExceeded(ServeError):
     """The request's deadline passed before (or while) it was served (504).
 

@@ -201,10 +201,26 @@ class _CacheForward(HybridBlock):
     start_pos = per-row position, last_idx = 0). The phases differ only
     by shape, i.e. by CachedOp signature, never by code path: that shared
     path is what makes the bitwise decode-vs-prefill parity hold.
+
+    ``paged=True`` switches the cache-state calling convention from
+    contiguous rings to page pools: the call grows a ``page_table``
+    (B, N) arg after ``last_idx``, the per-layer arrays are
+    (P, KV, page, D) pools, and the step brackets the UNCHANGED model
+    cache path with ``ops.nn.paged_kv_gather`` (pool -> per-slot ring)
+    and ``ops.nn.paged_kv_scatter`` (freshly written rows -> pool).
+    The fused form is for the fast rungs (pallas/int8, tolerance
+    parity): fusing the brackets into the step lets XLA pick different
+    loop partitions for the model subgraph, which drifts ulps from the
+    ring executable. The strict baseline rung therefore never compiles
+    ``paged=True`` — its callers run the same brackets as standalone
+    exact-copy device ops around the unchanged *ring* executable, so
+    paged baseline decode is bitwise identical to ring decode because
+    it literally replays the same compiled step
+    (tests/test_kv_blocks.py asserts it).
     """
 
     def __init__(self, model, max_seq, path="baseline", quant=None,
-                 qindex=(), all_logits=False, **kwargs):
+                 qindex=(), all_logits=False, paged=False, **kwargs):
         super().__init__(**kwargs)
         self.model = model  # child registration shares the params
         self._max_seq = int(max_seq)
@@ -212,12 +228,21 @@ class _CacheForward(HybridBlock):
         self._quant = quant
         self._qindex = list(qindex)
         self._all_logits = bool(all_logits)
+        self._paged = bool(paged)
         n_layers = len(model._blocks)
         self._n_cache = n_layers * (4 if quant else 2)
 
     def forward(self, tokens, start_pos, last_idx, *rest):
+        page_table = None
+        if self._paged:
+            page_table, rest = rest[0], rest[1:]
         flat_cache = rest[:self._n_cache]
         qflat = rest[self._n_cache:]
+        pools = None
+        if self._paged:
+            pools = flat_cache
+            flat_cache = [_ops.paged_kv_gather(p, page_table)
+                          for p in pools]
         cache = KVCache.from_flat(flat_cache, self._max_seq,
                                   quant=self._quant)
         cache.path = self._path
@@ -235,12 +260,18 @@ class _CacheForward(HybridBlock):
                 soff += o
             cache.quant_weights = table
         logits = self.model(tokens, cache=cache, start_pos=start_pos)
+        updated = tuple(cache.flat())
+        if self._paged:
+            t_len = tokens.shape[1]
+            updated = tuple(
+                _ops.paged_kv_scatter(p, page_table, r, start_pos, t_len)
+                for p, r in zip(pools, updated))
         if self._all_logits:
             # speculative verify step: the caller scores every position of
             # the (k+1)-token block, not just the last real one
-            return (logits,) + tuple(cache.flat())
+            return (logits,) + updated
         last = _ops.gather_positions(logits, last_idx)
-        return (last,) + tuple(cache.flat())
+        return (last,) + updated
 
 
 def sample_tokens(logits, temperature=0.0, top_k=None):
@@ -376,11 +407,24 @@ class Generator:
         routes attention through the fused decode kernel on the default
         runtime (tolerance parity); "int8" adds int8 KV rings and (by
         default) int8 projection weights.
+    paged : back the KV state with a :class:`~.kv_blocks.PagedKVPool`
+        per batch bucket instead of contiguous rings (``None`` reads
+        ``MXNET_SERVE_KV_PAGED``). The pool is fully assigned
+        (exhaustion-free) with identity page tables and persists across
+        requests — stale pages need no zeroing (the attention position
+        mask plus prefill's exact overwrite make them unreadable), but
+        that persistence also means paged generates on one batch bucket
+        must not run concurrently. The baseline rung stays bitwise
+        identical to the ring path; dynamic tables, admission, and
+        recycling live in :class:`~.scheduler.ContinuousEngine`.
+    page_size / kv_pages : pool geometry overrides (see
+        :class:`~.kv_blocks.PagedKVPool`).
     """
 
     def __init__(self, model, max_seq=128, batch_buckets=(1, 2, 4),
                  prompt_buckets=None, pad_id=0, name="llama_decode",
-                 decode_path=None):
+                 decode_path=None, paged=None, page_size=None,
+                 kv_pages=None):
         from .. import config
 
         self.model = model
@@ -402,9 +446,19 @@ class Generator:
         self._qindex, self._qflat = [], []
         if self._quant and _int8_weights_enabled():
             self._qindex, self._qflat = _quantize_serving_weights(model)
+        self._paged = (bool(config.get("MXNET_SERVE_KV_PAGED"))
+                       if paged is None else bool(paged))
+        self._page_size = page_size
+        self._kv_pages = kv_pages
+        # fast rungs fuse the paging brackets into the step; the strict
+        # baseline rung keeps the RING executable and runs the brackets
+        # as standalone exact copies in _run — that's what makes paged
+        # baseline decode bitwise identical to ring decode
+        self._fused_paged = self._paged and self.decode_path != "baseline"
         self._step = _CacheForward(model, self.max_seq,
                                    path=self.decode_path,
-                                   quant=self._quant, qindex=self._qindex)
+                                   quant=self._quant, qindex=self._qindex,
+                                   paged=self._fused_paged)
         # bucketing is done here (cache shapes are part of the lattice);
         # the session provides the protected raw-run path. Only the strict
         # baseline rung pins the deterministic compiler options — the
@@ -423,7 +477,33 @@ class Generator:
         by every request: device arrays are immutable and prefill/decode
         return functionally-updated rings without touching their input
         cache, so reuse is safe — and the serving hot path skips
-        2 x num_layers allocations + zero-fills per request."""
+        2 x num_layers allocations + zero-fills per request.
+
+        Paged mode returns the bucket's persistent
+        :class:`~.kv_blocks.PagedKVPool` instead — fully assigned with
+        identity page tables (slot ``s`` owns pages ``[1 + s*N,
+        1 + (s+1)*N)``), mutated in place by :meth:`_run`. Stale page
+        contents between requests are safe for the same reason ring
+        garbage is: the attention mask only admits positions the current
+        request has actually written."""
+        if self._paged:
+            from .kv_blocks import PagedKVPool
+
+            pool = self._zero_caches.get(batch_bucket)
+            if pool is None:
+                pool = PagedKVPool(self.model, batch_bucket, self.max_seq,
+                                   page_size=self._page_size,
+                                   num_pages=self._kv_pages,
+                                   quant=self._quant)
+                for s in range(batch_bucket):
+                    pool.assign(s, self.max_seq)
+                self._zero_caches[batch_bucket] = pool
+                self.metrics.set_kv_cache_bytes(
+                    sum(c.nbytes()
+                        for c in self._zero_caches.values()))
+                self.metrics.set_kv_pages(pool.pages_used,
+                                          pool.pages_free)
+            return pool
         cache = self._zero_caches.get(batch_bucket)
         if cache is None:
             cache = self._zero_caches.setdefault(
@@ -438,6 +518,28 @@ class Generator:
     def _run(self, tokens, start_pos, last_idx, cache):
         from .. import numpy as mnp
 
+        if self._paged:
+            toks = mnp.array(_onp.asarray(tokens, _onp.int32))
+            sp = mnp.array(_onp.asarray(start_pos, _onp.int32))
+            li = mnp.array(_onp.asarray(last_idx, _onp.int32))
+            if not self._fused_paged:
+                # strict rung: run the paging brackets as standalone
+                # exact-copy device ops around the UNCHANGED ring
+                # executable -> bitwise identical to ring decode
+                table = cache.table_nd()
+                rings = [_ops.paged_kv_gather(p, table)
+                         for p in cache.flat()]
+                out = self.session.run(toks, sp, li, *rings,
+                                       *self._qflat)
+                t_len = _onp.asarray(tokens).shape[1]
+                cache.update_from_flat([
+                    _ops.paged_kv_scatter(p, table, r, sp, t_len)
+                    for p, r in zip(cache.flat(), out[1:])])
+                return out[0], cache
+            out = self.session.run(toks, sp, li, cache.table_nd(),
+                                   *cache.flat(), *self._qflat)
+            cache.update_from_flat(out[1:])
+            return out[0], cache
         out = self.session.run(
             mnp.array(_onp.asarray(tokens, _onp.int32)),
             mnp.array(_onp.asarray(start_pos, _onp.int32)),

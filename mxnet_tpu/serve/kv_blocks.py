@@ -1,0 +1,279 @@
+"""Paged KV block allocator: one device-resident page pool per layer.
+
+The vLLM-style substrate under continuous batching
+(``serve.scheduler``): instead of per-(batch-bucket) contiguous KV
+rings sized ``B x max_seq`` whether or not anyone uses them, every
+layer's K/V storage is ONE pool of fixed-width pages —
+``(P, KV, page, D)`` rings, plus ``(P, KV, page)`` f32 scale pools on
+the int8 rung (PR-10 quantize-on-write composes unchanged: the pages
+just hold int8 payloads and their scale rows). A per-slot *page table*
+maps each slot's logical ring onto the pages it owns; each step gathers
+the table into a contiguous ring, runs the unchanged model cache path,
+and scatters the freshly-written rows back — both directions exact
+copies (``ops.nn.paged_kv_gather`` / ``paged_kv_scatter``). Fast rungs
+fuse the brackets into the step executable
+(``serve.generate._CacheForward(paged=True)``); the strict baseline
+rung instead runs them as standalone eager device ops around the
+UNCHANGED ring executable, so its bitwise decode contract survives
+paging by construction (in-graph, XLA partitions the attention loops
+differently when they read a gather output vs an entry parameter, which
+drifts ulps).
+
+Page id 0 is the reserved **null page**: dead/idle slots of a
+fixed-width decode step point every table entry at it, their writes
+land there, and the scatter op re-zeros it each step — so one compiled
+executable serves every occupancy without masking inputs per slot.
+
+The allocator itself is host-side and O(1): a LIFO free list of page
+ids. ``assign()`` reserves a slot's whole token budget up front
+(prompt + max_new rounded up to pages) so a request can never die
+mid-decode from pool pressure — exhaustion surfaces exactly once, at
+the admission boundary, as :class:`~.engine.PoolExhausted` (503), and
+the scheduler's answer is to requeue, never to crash. ``release()``
+recycles the pages the moment a request retires — the memory win over
+bucket rings: a slot holds ``ceil((prompt+max_new)/page)`` pages, not
+``max_seq``, and holds them only while the request is live.
+
+Page size defaults to the Pallas decode kernel's natural block
+(``ops.pallas.decode_attention.natural_block()`` = 128, clamped to
+``max_seq``), so the kernel's block-skip masking skips whole unreached
+pages; ``MXNET_SERVE_KV_PAGE_SIZE`` / ``MXNET_SERVE_KV_PAGES``
+override (CPU tests run 16-wide pages).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _onp
+
+from ..base import MXNetError
+from .engine import PoolExhausted
+
+
+def resolve_page_size(page_size, max_seq):
+    """The pool's page width: an explicit argument wins, then
+    ``MXNET_SERVE_KV_PAGE_SIZE``, then the decode kernel's natural block
+    clamped to ``max_seq``. ``max_seq`` must divide into whole pages —
+    the gathered ring must have exactly the contiguous ring's S extent
+    or the paged executables would compile different shapes than the
+    ring ones (and the bitwise parity contract would be vacuous)."""
+    from .. import config
+
+    ps = page_size
+    if ps is None:
+        ps = int(config.get("MXNET_SERVE_KV_PAGE_SIZE"))
+    if ps <= 0:
+        from ..ops.pallas.decode_attention import natural_block
+
+        ps = min(natural_block(), int(max_seq))
+    ps = int(ps)
+    if int(max_seq) % ps:
+        raise MXNetError(
+            f"max_seq ({max_seq}) must be a multiple of the KV page size "
+            f"({ps}); pick a page size that divides it "
+            "(MXNET_SERVE_KV_PAGE_SIZE or the page_size argument)")
+    return ps
+
+
+class PagedKVPool:
+    """Device page pools + host free-list allocator + per-slot page tables.
+
+    Parameters
+    ----------
+    model : block with ``_blocks[i].attention`` KV geometry (same duck
+        type :class:`~.generate.KVCache.alloc` reads).
+    num_slots : fixed decode width — page-table rows (the trace-static
+        slot lattice of the continuous-batching step).
+    max_seq : logical ring length per slot (page table width =
+        ``max_seq // page_size``).
+    page_size : page width in tokens; ``None`` resolves via
+        :func:`resolve_page_size`.
+    num_pages : pool capacity in pages **including** the reserved null
+        page; ``None`` resolves ``MXNET_SERVE_KV_PAGES``, whose 0
+        default auto-sizes to full capacity
+        (``num_slots * pages_per_slot + 1`` — exhaustion impossible).
+        Size it smaller to oversubscribe: admission then queues on
+        :class:`~.engine.PoolExhausted` until retirements recycle pages.
+    quant : ``None`` (f32 pools) or ``"int8"`` (int8 ring pools + f32
+        scale pools — PR-10's quantize-on-write flavor).
+    """
+
+    def __init__(self, model, num_slots, max_seq, page_size=None,
+                 num_pages=None, quant=None):
+        from .. import config
+        from .. import numpy as mnp
+
+        self.num_slots = int(num_slots)
+        self.max_seq = int(max_seq)
+        self.page_size = resolve_page_size(page_size, self.max_seq)
+        self.pages_per_slot = self.max_seq // self.page_size
+        if num_pages is None:
+            num_pages = int(config.get("MXNET_SERVE_KV_PAGES"))
+        if num_pages <= 0:
+            num_pages = self.num_slots * self.pages_per_slot + 1
+        self.num_pages = int(num_pages)
+        if self.num_pages < 2:
+            raise MXNetError(
+                f"PagedKVPool needs >= 2 pages (1 null + 1 usable), got "
+                f"{self.num_pages}")
+        if quant not in (None, "int8"):
+            raise MXNetError(f"unknown PagedKVPool quant {quant!r}")
+        self.quant = quant
+        # one (P, KV, page, D) k/v pool pair per layer; int8 adds the
+        # (P, KV, page) f32 scale pools — interleaved in flat() exactly
+        # like KVCache.flat() so _CacheForward's calling convention is
+        # shared between ring and paged steps
+        self._arrays = []
+        for blk in model._blocks:
+            attn = blk.attention
+            shape = (self.num_pages, attn._kv_heads, self.page_size,
+                     attn._head_dim)
+            if quant == "int8":
+                self._arrays.extend((
+                    mnp.zeros(shape, dtype="int8"),
+                    mnp.zeros(shape[:3], dtype="float32"),
+                    mnp.zeros(shape, dtype="int8"),
+                    mnp.zeros(shape[:3], dtype="float32")))
+            else:
+                self._arrays.extend((mnp.zeros(shape, dtype="float32"),
+                                     mnp.zeros(shape, dtype="float32")))
+        # host allocator state: LIFO free list (hot pages recycle first),
+        # per-slot owned pages, the canonical page-table matrix
+        self._lock = threading.Lock()
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._owned = [[] for _ in range(self.num_slots)]
+        self._table = _onp.zeros((self.num_slots, self.pages_per_slot),
+                                 _onp.int32)
+        self._table_nd = None
+        self.high_water = 0
+        self.exhausted_count = 0
+
+    # -- executable calling convention --------------------------------------
+    def flat(self):
+        """The pool arrays in the step executable's calling convention
+        (interleaved per layer, like ``KVCache.flat()``)."""
+        return list(self._arrays)
+
+    def update_from_flat(self, arrays):
+        """Rebind the pool state to the executable's returned arrays.
+        In-place by design: pool state is the *persistent* serving
+        substrate (unlike per-request ring caches), and every slot's
+        live data rides in it between steps."""
+        arrays = list(arrays)
+        if len(arrays) != len(self._arrays):
+            raise MXNetError(
+                f"pool update: got {len(arrays)} arrays, expected "
+                f"{len(self._arrays)}")
+        self._arrays = arrays
+
+    def table(self):
+        """Copy of the canonical (num_slots, pages_per_slot) int32 page
+        table. Rows of released slots are all-null (0)."""
+        with self._lock:
+            return self._table.copy()
+
+    def table_nd(self):
+        """The canonical page table as a cached device NDArray — for
+        callers whose table never changes between calls (the
+        fully-assigned Generator paged mode). Invalidated by
+        assign/release."""
+        from .. import numpy as mnp
+
+        with self._lock:
+            if self._table_nd is None:
+                self._table_nd = mnp.array(self._table)
+            return self._table_nd
+
+    # -- allocator -----------------------------------------------------------
+    def pages_for(self, n_tokens):
+        """Pages needed to hold ``n_tokens`` ring positions."""
+        n = int(n_tokens)
+        return max(1, -(-n // self.page_size))
+
+    def assign(self, slot, n_tokens):
+        """Reserve ``pages_for(n_tokens)`` pages for ``slot`` and install
+        them in its page-table row (remaining row entries stay null).
+        Raises :class:`PoolExhausted` — atomically, nothing allocated —
+        when the free list is short; raises :class:`MXNetError` on a
+        slot that already owns pages (the scheduler must release first).
+        Returns the number of pages assigned."""
+        slot = int(slot)
+        need = self.pages_for(n_tokens)
+        if n_tokens > self.max_seq:
+            raise MXNetError(
+                f"slot budget {n_tokens} exceeds max_seq {self.max_seq}")
+        with self._lock:
+            if self._owned[slot]:
+                raise MXNetError(
+                    f"slot {slot} already owns {len(self._owned[slot])} "
+                    "pages; release() before re-assigning")
+            if need > len(self._free):
+                self.exhausted_count += 1
+                err = PoolExhausted(
+                    f"KV page pool exhausted: need {need} pages, "
+                    f"{len(self._free)} free of {self.num_pages - 1}")
+                # backpressure hint: pages free as requests retire; one
+                # slot's worth of decode is the natural retry horizon
+                err.retry_after_ms = 50.0
+                raise err
+            pages = [self._free.pop() for _ in range(need)]
+            self._owned[slot] = pages
+            self._table[slot] = 0
+            self._table[slot, :need] = pages
+            self._table_nd = None
+            used = self.pages_used
+            if used > self.high_water:
+                self.high_water = used
+            return need
+
+    def release(self, slot):
+        """Recycle every page ``slot`` owns back to the free list and
+        null its table row. Idempotent (releasing an empty slot is a
+        no-op). The pages' device contents are left stale on purpose:
+        the attention position mask plus prefill's exact overwrite make
+        stale pages unreadable before they are rewritten, so retirement
+        costs zero device work."""
+        slot = int(slot)
+        with self._lock:
+            pages, self._owned[slot] = self._owned[slot], []
+            if not pages:
+                return 0
+            if len(set(pages)) != len(pages) or 0 in pages:
+                raise MXNetError(
+                    f"corrupt page ownership for slot {slot}: {pages}")
+            self._free.extend(reversed(pages))
+            self._table[slot] = 0
+            self._table_nd = None
+            return len(pages)
+
+    # -- readout -------------------------------------------------------------
+    @property
+    def pages_total(self):
+        """Usable pages (the null page is bookkeeping, not capacity)."""
+        return self.num_pages - 1
+
+    @property
+    def pages_free(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def pages_used(self):
+        return self.pages_total - len(self._free)
+
+    def nbytes(self):
+        return sum(int(_onp.prod(a.shape)) * _onp.dtype(a.dtype).itemsize
+                   for a in self._arrays)
+
+    def stats(self):
+        with self._lock:
+            free = len(self._free)
+            owned = sum(len(o) for o in self._owned)
+        return {"page_size": self.page_size,
+                "pages_total": self.pages_total,
+                "pages_free": free,
+                "pages_used": self.pages_total - free,
+                "pages_owned": owned,
+                "high_water": self.high_water,
+                "exhausted_count": self.exhausted_count,
+                "nbytes": self.nbytes()}

@@ -83,6 +83,15 @@ class ServeMetrics:
         # rings and which decode path this generator traced
         self.kv_cache_bytes = 0
         self.decode_path = None
+        # continuous-batching telemetry (tentpole PR 12): streaming SLOs
+        # (time-to-first-token, inter-token latency) plus the paged-KV and
+        # slot-occupancy gauges the scheduler publishes between steps
+        self._ttft_ms = collections.deque(maxlen=int(window))
+        self._itl_ms = collections.deque(maxlen=int(window))
+        self.kv_pages_used = 0
+        self.kv_pages_free = 0
+        self.slots_live = 0
+        self.slots_total = 0
         _instances.add(self)
 
     # -- observations -------------------------------------------------------
@@ -187,6 +196,42 @@ class ServeMetrics:
             _prof.set_counter(f"serve.tokens_s({self.name})",
                               round(n / dt_s, 1), cat="serve")
 
+    def observe_ttft(self, ms, priority=None):
+        """Time-to-first-token for one request: admission to the first
+        sampled token (prefill completes). THE interactive-latency SLO
+        under continuous batching — admission waits show up here."""
+        with self._lock:
+            self._ttft_ms.append(float(ms))
+        if _prof.ENABLED:
+            _prof.record_instant(f"serve::ttft({self.name})", "serve",
+                                 args={"ms": round(float(ms), 3),
+                                       "priority": priority})
+
+    def observe_itl(self, ms):
+        """Inter-token latency: wall time of one decode iteration,
+        observed once per step for every live slot. Its p99 bounds how
+        long any request's token stream can stall — including stalls
+        caused by other requests' admissions/prefills."""
+        with self._lock:
+            self._itl_ms.append(float(ms))
+
+    def set_kv_pages(self, used, free):
+        """Gauge pair: paged-KV pool occupancy (null page excluded)."""
+        self.kv_pages_used = int(used)
+        self.kv_pages_free = int(free)
+        if _prof.ENABLED:
+            _prof.set_counter(f"serve.kv_pages_used({self.name})",
+                              int(used), cat="serve")
+
+    def set_slot_occupancy(self, live, total):
+        """Gauge pair: decode slots holding a live request vs the
+        trace-static slot count."""
+        self.slots_live = int(live)
+        self.slots_total = int(total)
+        if _prof.ENABLED:
+            _prof.set_counter(f"serve.slots_live({self.name})",
+                              int(live), cat="serve")
+
     def set_queue_depth(self, depth):
         self.queue_depth = int(depth)
         if _prof.ENABLED:
@@ -234,6 +279,8 @@ class ServeMetrics:
             lat = list(self._latency_ms)
             q = list(self._queue_ms)
             e = list(self._exec_ms)
+            ttft = list(self._ttft_ms)
+            itl = list(self._itl_ms)
             batches = self.batches
             out = {
                 "name": self.name,
@@ -257,7 +304,18 @@ class ServeMetrics:
                 "swaps": self.swaps,
                 "kv_cache_bytes": self.kv_cache_bytes,
                 "decode_path": self.decode_path,
+                "kv_pages_used": self.kv_pages_used,
+                "kv_pages_free": self.kv_pages_free,
+                "slots_live": self.slots_live,
+                "slots_total": self.slots_total,
+                "slot_occupancy": (self.slots_live / self.slots_total
+                                   if self.slots_total else 0.0),
             }
+        out["ttft_p50_ms"] = percentile(ttft, 50)
+        out["ttft_p95_ms"] = percentile(ttft, 95)
+        out["ttft_p99_ms"] = percentile(ttft, 99)
+        out["itl_p50_ms"] = percentile(itl, 50)
+        out["itl_p99_ms"] = percentile(itl, 99)
         out["class_percentiles"] = self.class_percentiles()
         out["p50_ms"] = percentile(lat, 50)
         out["p95_ms"] = percentile(lat, 95)

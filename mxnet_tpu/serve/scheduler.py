@@ -1,0 +1,509 @@
+"""Continuous batching: an iteration-level scheduler for the decode loop.
+
+The PR-6/PR-10 serving stack batches at *request* granularity: the
+``DynamicBatcher`` assembles a batch, ``Generator.generate`` runs it to
+completion, and every request in the batch holds its slot until the
+LONGEST one finishes — a 4-token interactive request admitted next to a
+256-token batch job waits out all 256 steps (head-of-line blocking), and
+each request's KV ring is sized ``max_seq`` whether it uses 6 positions
+or all of them.
+
+:class:`ContinuousEngine` rebatches at *iteration* granularity (Orca):
+the decode loop runs forever over a fixed lattice of ``num_slots`` decode
+lanes, and between any two decode steps it
+
+* **retires** finished/expired slots — their futures settle immediately
+  (an expired request keeps its partial output on the 504), their KV
+  pages recycle to the free list;
+* **admits** queued requests into the freed slots straight from the
+  :class:`~.batcher.DynamicBatcher` queue (``start=False`` — the
+  scheduler IS the consumer), interactive-first with the full PR-6
+  admission surface (deadlines, shedding, idempotency keys, 503/504
+  taxonomy) unchanged;
+* **prefills one chunk** of one admitted prompt at a fixed ``(1, chunk)``
+  signature, round-robin across prefilling slots — a long prompt streams
+  through without ever stalling live decodes for more than one chunk;
+* **decodes** every live slot in ONE fixed ``(num_slots, 1)`` step.
+
+KV state lives in a :class:`~.kv_blocks.PagedKVPool`: per-layer page
+pools plus a per-slot page table, gathered/scattered around the unchanged
+model cache path (fused into the step executable on the fast rungs,
+standalone exact-copy brackets around the ring executable on the strict
+baseline rung — see ``kv_blocks``). A request holds
+``ceil((prompt + max_new) / page_size)`` pages (reserved at admission —
+it can never die mid-decode from pool pressure), not a ``max_seq`` ring;
+a full pool rejects admission with :class:`~.engine.PoolExhausted` and
+the request is requeued at the front, never dropped.
+
+Trace-static by construction: occupancy changes only ever rewrite the
+page-table *values* and the token/position vectors — never a shape. The
+engine compiles exactly TWO signatures (one chunk prefill, one full-width
+decode); :meth:`ContinuousEngine.assert_no_recompiles` holds across any
+sequence of admits/retires after :meth:`warmup`. Idle slots point every
+page-table entry at the null page, so one executable serves every
+occupancy from empty to full.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..profiler import trace as _trace
+from ..resilience import faults as _faults
+from .batcher import DynamicBatcher
+from .engine import DeadlineExceeded, InferenceSession, PoolExhausted, \
+    ServeError, ServiceUnavailable
+from .generate import _CacheForward, _int8_weights_enabled, \
+    _quantize_serving_weights, resolve_decode_path, sample_tokens
+from ..ops import nn as _ops
+from .kv_blocks import PagedKVPool
+
+
+def _no_runner(_batch):  # pragma: no cover - the scheduler IS the consumer
+    raise ServeError("continuous-batching queue has no flusher runner")
+
+
+class _Slot:
+    """One decode lane's live request state (scheduler-thread private)."""
+
+    __slots__ = ("p", "prompt", "consumed", "pos", "decoding", "pending",
+                 "tokens", "max_new", "temperature", "top_k", "stop",
+                 "finished", "expired", "t_admit", "admit_wait_steps",
+                 "ttft_ms", "decode_steps")
+
+    def __init__(self, p, steps_now):
+        payload = p.payload
+        self.p = p
+        self.prompt = payload["prompt"]
+        self.consumed = 0          # prompt tokens already prefilled
+        self.pos = 0               # ring write position once decoding
+        self.decoding = False      # prefill complete, pending token live
+        self.pending = 0           # next token id to feed the decode step
+        self.tokens = []           # emitted output ids
+        self.max_new = payload["max_new"]
+        self.temperature = payload["temperature"]
+        self.top_k = payload["top_k"]
+        self.stop = payload["stop"]
+        self.finished = False
+        self.expired = False
+        self.t_admit = time.monotonic()
+        self.admit_wait_steps = steps_now - payload["enq_step"]
+        self.ttft_ms = None
+        self.decode_steps = 0
+
+    def emit(self, tid):
+        """Account one sampled token; flips ``finished`` on stop/budget."""
+        if tid in self.stop:
+            self.finished = True
+            return
+        self.tokens.append(tid)
+        if len(self.tokens) >= self.max_new:
+            self.finished = True
+        else:
+            self.pending = tid
+
+
+class ContinuousEngine:
+    """Iteration-level scheduler + paged-KV decode loop for one model.
+
+    Parameters
+    ----------
+    model : LlamaModel (same duck type :class:`~.generate.Generator`
+        serves).
+    max_seq : per-request logical ring length (prompt + generated tokens
+        must fit); must be a whole number of KV pages.
+    num_slots : decode lanes — the ONE compiled decode width
+        (``MXNET_SERVE_SLOTS`` default).
+    page_size / num_pages : pool geometry (see
+        :class:`~.kv_blocks.PagedKVPool`); undersize ``num_pages`` to
+        oversubscribe — admission then queues on pool pressure.
+    prefill_chunk : tokens prefilled per scheduler iteration at the fixed
+        ``(1, chunk)`` signature (``MXNET_SERVE_PREFILL_CHUNK``; 0 means
+        one KV page).
+    decode_path : serving rung ("baseline" | "pallas" | "int8", see
+        :func:`~.generate.resolve_decode_path`). The baseline rung keeps
+        the bitwise decode contract — paging brackets are exact copies.
+    batcher_kwargs : extra :class:`~.batcher.DynamicBatcher` constructor
+        overrides (``max_queue=``, ``timeout_ms=``, ...).
+    """
+
+    def __init__(self, model, max_seq=128, num_slots=None, page_size=None,
+                 num_pages=None, prefill_chunk=None, pad_id=0,
+                 name="llama_cb", decode_path=None, **batcher_kwargs):
+        from .. import config
+
+        self.model = model
+        self.max_seq = int(max_seq)
+        if num_slots is None:
+            num_slots = int(config.get("MXNET_SERVE_SLOTS"))
+        self.num_slots = int(num_slots)
+        if self.num_slots < 1:
+            raise ServeError(f"num_slots must be >= 1, got {num_slots}")
+        self.pad_id = int(pad_id)
+        self.decode_path = resolve_decode_path(decode_path)
+        self._quant = "int8" if self.decode_path == "int8" else None
+        self._qindex, self._qflat = [], []
+        if self._quant and _int8_weights_enabled():
+            self._qindex, self._qflat = _quantize_serving_weights(model)
+        self.pool = PagedKVPool(model, self.num_slots, self.max_seq,
+                                page_size=page_size, num_pages=num_pages,
+                                quant=self._quant)
+        if prefill_chunk is None:
+            prefill_chunk = int(config.get("MXNET_SERVE_PREFILL_CHUNK"))
+        self.prefill_chunk = (int(prefill_chunk) if prefill_chunk > 0
+                              else self.pool.page_size)
+        if self.prefill_chunk > self.max_seq:
+            self.prefill_chunk = self.max_seq
+        # fast rungs fuse the paging brackets into the step executable;
+        # the strict baseline rung keeps the RING executable and runs
+        # the brackets as standalone exact copies in _run_step, which is
+        # what makes its decode bitwise identical to the ring path
+        self._fused_paged = self.decode_path != "baseline"
+        self._step_block = _CacheForward(
+            model, self.max_seq, path=self.decode_path, quant=self._quant,
+            qindex=self._qindex, paged=self._fused_paged)
+        # exactly two live signatures: (1, chunk) chunked prefill and
+        # (num_slots, 1) decode — the whole point of the design
+        self.session = InferenceSession(
+            self._step_block,
+            batch_buckets=tuple(sorted({1, self.num_slots})),
+            seq_buckets=tuple(sorted({1, self.prefill_chunk})),
+            pad_value=self.pad_id, name=name,
+            deterministic=(self.decode_path == "baseline"))
+        self.metrics = self.session.metrics
+        self.metrics.set_decode_path(self.decode_path)
+        self.metrics.set_kv_cache_bytes(self.pool.nbytes())
+        # the admission queue: PR-6 semantics intact, flusher OFF — the
+        # scheduler consumes via take()/settle_one() between decode steps
+        self._batcher = DynamicBatcher(
+            _no_runner, start=False, max_batch_size=self.num_slots,
+            name=f"{name}_queue", metrics=self.metrics, **batcher_kwargs)
+        self._slots = [None] * self.num_slots
+        self._steps = 0            # completed scheduler iterations
+        self._pf_next = 0          # round-robin cursor over prefill slots
+        self._admit_wait_max = 0
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=32, temperature=0.0,
+               top_k=None, stop_ids=(), priority="interactive",
+               deadline_ms=None, key=None):
+        """Admit one generation request; returns a Future resolving to
+        ``{"tokens": [...], "ttft_ms": ..., "admit_wait_steps": ...,
+        "decode_steps": ...}``. The full PR-6 admission surface applies
+        (priority classes, deadlines -> 504, queue caps/sheds -> 503,
+        idempotency keys); a deadline that expires mid-decode settles
+        with :class:`DeadlineExceeded` whose ``.partial`` carries the
+        tokens generated so far."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise MXNetError("empty prompt (need >= 1 token)")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new > self.max_seq:
+            raise MXNetError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds max_seq ({self.max_seq})")
+        payload = {"prompt": prompt, "max_new": max_new,
+                   "temperature": temperature, "top_k": top_k,
+                   "stop": frozenset(int(s) for s in stop_ids),
+                   "enq_step": self._steps}
+        return self._batcher.submit(payload, priority=priority,
+                                    deadline_ms=deadline_ms, key=key)
+
+    # -- scheduler iteration -------------------------------------------------
+    def _live(self):
+        return [s for s in self._slots if s is not None]
+
+    def _free_idx(self):
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _settle_slot(self, i, error=None):
+        """Retire slot ``i``: settle its future, recycle its pages."""
+        s = self._slots[i]
+        self._slots[i] = None
+        self.pool.release(i)
+        if error is not None:
+            self._batcher.settle_one(s.p, error=error)
+            return
+        if s.expired:
+            err = DeadlineExceeded(
+                f"continuous engine {self.session.name!r}: deadline "
+                f"expired after {len(s.tokens)} of {s.max_new} tokens")
+            err.partial = list(s.tokens)
+            self._batcher.settle_one(s.p, error=err)
+            return
+        n = len(s.tokens)
+        self.metrics.observe_tokens(
+            n, max(time.monotonic() - s.t_admit, 1e-9))
+        self._batcher.settle_one(s.p, result={
+            "tokens": list(s.tokens),
+            "ttft_ms": s.ttft_ms,
+            "admit_wait_steps": s.admit_wait_steps,
+            "decode_steps": s.decode_steps,
+        })
+
+    def _retire(self):
+        now = time.monotonic()
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            if not s.finished and s.p.deadline is not None \
+                    and now >= s.p.deadline:
+                # the request's budget ran out between steps: stop burning
+                # decode work on output nobody will read
+                s.finished = s.expired = True
+                self.metrics.observe_deadline("decode", s.p.priority)
+            if s.finished:
+                self._settle_slot(i)
+
+    def _admit(self):
+        free = self._free_idx()
+        while free:
+            batch, sweep = self._batcher.take(1)
+            if sweep:
+                self._batcher.settle_expired(sweep)
+                continue
+            if not batch:
+                return
+            p = batch[0]
+            i = free[0]
+            need = len(p.payload["prompt"]) + p.payload["max_new"]
+            try:
+                self.pool.assign(i, min(need, self.max_seq))
+            except PoolExhausted:
+                # backpressure, not failure: the request keeps its place
+                # at the queue front and is re-taken as pages recycle
+                self._batcher.requeue(p)
+                return
+            free.pop(0)
+            slot = _Slot(p, self._steps)
+            self._slots[i] = slot
+            if slot.admit_wait_steps > self._admit_wait_max:
+                self._admit_wait_max = slot.admit_wait_steps
+
+    def _run_step(self, tokens, start_pos, last_idx, table):
+        from .. import numpy as mnp
+
+        toks = mnp.array(_onp.asarray(tokens, _onp.int32))
+        sp = mnp.array(_onp.asarray(start_pos, _onp.int32))
+        li = mnp.array(_onp.asarray(last_idx, _onp.int32))
+        tab = mnp.array(_onp.asarray(table, _onp.int32))
+        if not self._fused_paged:
+            # strict rung: paging brackets as standalone exact-copy ops
+            # around the unchanged ring executable (bitwise contract)
+            rings = [_ops.paged_kv_gather(p, tab)
+                     for p in self.pool.flat()]
+            out = self.session.run(toks, sp, li, *rings, *self._qflat)
+            t_len = _onp.asarray(tokens).shape[1]
+            self.pool.update_from_flat([
+                _ops.paged_kv_scatter(p, tab, r, sp, t_len)
+                for p, r in zip(self.pool.flat(), out[1:])])
+            return out[0]
+        out = self.session.run(toks, sp, li, tab,
+                               *self.pool.flat(), *self._qflat)
+        self.pool.update_from_flat(out[1:])
+        return out[0]
+
+    def _prefill_once(self):
+        """Advance ONE prefilling slot by one chunk (round-robin), at the
+        fixed (1, chunk) signature. The final chunk samples the first
+        token — that's the request's TTFT."""
+        waiting = [i for i, s in enumerate(self._slots)
+                   if s is not None and not s.decoding and not s.finished]
+        if not waiting:
+            return
+        i = min(waiting, key=lambda j: (j - self._pf_next) % self.num_slots)
+        self._pf_next = (i + 1) % self.num_slots
+        s = self._slots[i]
+        chunk = self.prefill_chunk
+        piece = s.prompt[s.consumed:s.consumed + chunk]
+        n = len(piece)
+        toks = _onp.full((1, chunk), self.pad_id, _onp.int32)
+        toks[0, :n] = piece
+        table = _onp.zeros((1, self.pool.pages_per_slot), _onp.int32)
+        table[0] = self.pool.table()[i]
+        try:
+            with _trace.span("serve::prefill_chunk", {"slot": i, "n": n}):
+                logits = self._run_step(toks, [s.consumed], [n - 1], table)
+        except Exception as exc:  # pylint: disable=broad-except
+            # only THIS slot was inside the failing call
+            self._settle_slot(i, error=exc)
+            return
+        s.consumed += n
+        if s.consumed < len(s.prompt):
+            return
+        # prompt fully written: sample the first token off the last real
+        # position's logits (exactly Generator._generate's step-0 sample)
+        s.decoding = True
+        s.pos = len(s.prompt)
+        tid = int(sample_tokens(logits, temperature=s.temperature,
+                                top_k=s.top_k)[0])
+        s.ttft_ms = (time.monotonic() - s.p.t_enq) * 1e3
+        self.metrics.observe_ttft(s.ttft_ms, s.p.priority)
+        s.emit(tid)
+
+    def _decode_once(self):
+        """One fixed-width decode step over every decoding slot. Slots
+        that are empty or still prefilling ride along as dead lanes:
+        all-null page-table rows route their writes to the null page
+        (re-zeroed in the scatter op), so they can neither corrupt live
+        state nor feed garbage back to themselves."""
+        decoding = [i for i, s in enumerate(self._slots)
+                    if s is not None and s.decoding and not s.finished]
+        if not decoding:
+            return
+        _faults.fault_point("serve:decode",
+                            {"session": self.session.name})
+        S = self.num_slots
+        toks = _onp.zeros((S, 1), _onp.int32)
+        pos = _onp.zeros(S, _onp.int32)
+        table = _onp.zeros((S, self.pool.pages_per_slot), _onp.int32)
+        live_table = self.pool.table()
+        for i in decoding:
+            s = self._slots[i]
+            toks[i, 0] = s.pending
+            pos[i] = s.pos
+            table[i] = live_table[i]
+        t0 = time.perf_counter()
+        with _trace.span("serve::decode_step", {"live": len(decoding)}):
+            logits = self._run_step(toks, pos, _onp.zeros(S, _onp.int32),
+                                    table)
+        self.metrics.observe_itl((time.perf_counter() - t0) * 1e3)
+        temps = [self._slots[i].temperature for i in decoding]
+        if all(t is None or t <= 0.0 for t in temps):
+            ids = sample_tokens(logits)  # one greedy argmax for all rows
+            sampled = {i: int(ids[i]) for i in decoding}
+        else:
+            arr = logits.asnumpy()
+            sampled = {}
+            for i in decoding:
+                s = self._slots[i]
+                sampled[i] = int(sample_tokens(
+                    arr[i:i + 1], temperature=s.temperature,
+                    top_k=s.top_k)[0])
+        for i in decoding:
+            s = self._slots[i]
+            s.pos += 1
+            s.decode_steps += 1
+            s.emit(sampled[i])
+
+    def step(self):
+        """One scheduler iteration: retire -> admit -> one prefill chunk
+        -> one decode step -> gauges. Execution failures (an injected
+        ``serve:execute``/``serve:decode`` fault, a watchdog timeout)
+        fail the requests that were inside the failing call — the
+        scheduler itself keeps serving, exactly like the batcher's
+        batch-failure isolation."""
+        self._retire()
+        self._admit()
+        self._prefill_once()
+        try:
+            self._decode_once()
+        except Exception as exc:  # pylint: disable=broad-except
+            for i, s in enumerate(self._slots):
+                if s is not None and s.decoding:
+                    self._settle_slot(i, error=exc)
+        self._steps += 1
+        self.metrics.set_kv_pages(self.pool.pages_used,
+                                  self.pool.pages_free)
+        self.metrics.set_slot_occupancy(len(self._live()), self.num_slots)
+
+    def _idle(self):
+        return not self._live() and self._batcher.queue_depth() == 0
+
+    def _run_loop(self):
+        from ..profiler import core as _prof
+
+        _prof.register_thread_name()
+        while not self._stop.is_set():
+            if self._idle():
+                with self._batcher._cond:
+                    if not self._batcher._queue and not self._stop.is_set():
+                        self._batcher._cond.wait(0.05)
+                continue
+            self.step()
+
+    # -- lifecycle -----------------------------------------------------------
+    def warmup(self):
+        """Compile BOTH live signatures — one (1, chunk) prefill chunk
+        and one (num_slots, 1) decode step, all-null tables — and freeze
+        the set: every later admit/retire/prefill/decode replays one of
+        these two executables (``assert_no_recompiles`` is the test)."""
+        t0 = time.perf_counter()
+        n = self.pool.pages_per_slot
+        self._run_step(
+            _onp.zeros((1, self.prefill_chunk), _onp.int32), [0], [0],
+            _onp.zeros((1, n), _onp.int32))
+        self._run_step(
+            _onp.zeros((self.num_slots, 1), _onp.int32),
+            _onp.zeros(self.num_slots, _onp.int32),
+            _onp.zeros(self.num_slots, _onp.int32),
+            _onp.zeros((self.num_slots, n), _onp.int32))
+        self.session.freeze_signatures()
+        return {"signatures": self.session.signature_count(),
+                "wall_s": time.perf_counter() - t0}
+
+    def start(self):
+        """Warm up (if not already) and start the scheduler thread."""
+        if self.session._warm_signatures is None:
+            self.warmup()
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run_loop, daemon=True,
+            name=f"mxtpu-serve-scheduler[{self.session.name}]")
+        self._thread.start()
+
+    def close(self, timeout=5.0):
+        """Stop the scheduler thread, fail live slots and queued work
+        with 503 (the batcher's close taxonomy), release every page."""
+        self._stop.set()
+        with self._batcher._cond:
+            self._batcher._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._settle_slot(i, error=ServiceUnavailable(
+                    f"continuous engine {self.session.name!r} shut down "
+                    f"mid-request ({len(s.tokens)} tokens generated)"))
+        self._batcher.close(timeout)
+
+    def drain(self, timeout=30.0):
+        """Stop admission and wait until every admitted request settles
+        (queue empty AND all slots retired). :meth:`resume` reopens."""
+        return self._batcher.drain(timeout)
+
+    def resume(self):
+        self._batcher.resume()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- invariants / readout ------------------------------------------------
+    def assert_no_recompiles(self):
+        self.session.assert_no_recompiles()
+
+    def stats(self):
+        out = self.session.stats()
+        out["pool"] = self.pool.stats()
+        out["steps"] = self._steps
+        out["slots_live"] = len(self._live())
+        out["slots_total"] = self.num_slots
+        out["admit_wait_steps_max"] = self._admit_wait_max
+        out["queue_depth"] = self._batcher.queue_depth()
+        out["duplicate_submits"] = self._batcher.duplicate_submits
+        return out

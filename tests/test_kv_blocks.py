@@ -1,0 +1,255 @@
+"""Conformance tests for the paged KV block allocator
+(``mxnet_tpu/serve/kv_blocks.py``) and the paged serving step: allocator
+invariants (alloc/retire/recycle, exhaustion, reserve-at-admit), the
+gather/scatter ops' exactness (null-page re-zeroing), and the headline
+contract — paged decode is **bitwise identical** to ring decode on the
+baseline rung with zero steady-state recompiles.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import numpy as mnp
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models.llama import get_llama
+from mxnet_tpu.ops import nn as ops
+from mxnet_tpu.serve import Generator, PagedKVPool, PoolExhausted, \
+    resolve_page_size
+
+
+def _tiny_llama(config="llama_tiny_test", **over):
+    net = get_llama(config, **over)
+    net.initialize()
+    return net
+
+
+# ---------------------------------------------------------------------------
+# allocator invariants
+# ---------------------------------------------------------------------------
+
+
+class TestAllocator:
+    def test_page_size_resolution(self):
+        # explicit argument wins; default = pallas natural block clamped
+        assert resolve_page_size(16, 64) == 16
+        from mxnet_tpu.ops.pallas.decode_attention import natural_block
+        assert resolve_page_size(None, 64) == min(natural_block(), 64)
+        assert resolve_page_size(None, 256) == min(natural_block(), 256)
+
+    def test_page_size_must_divide_max_seq(self):
+        with pytest.raises(MXNetError, match="multiple of the KV page"):
+            resolve_page_size(24, 64)
+
+    def test_assign_release_recycle(self):
+        net = _tiny_llama()
+        pool = PagedKVPool(net, num_slots=4, max_seq=64, page_size=16)
+        assert pool.pages_per_slot == 4
+        assert pool.pages_total == 4 * 4  # auto-sized: exhaustion-free
+        # reserve-at-admit: pages for the whole prompt+max_new budget
+        assert pool.assign(0, 5) == 1
+        assert pool.assign(1, 17) == 2
+        assert pool.pages_used == 3
+        tab = pool.table()
+        assert tab[0, 0] != 0 and (tab[0, 1:] == 0).all()
+        assert (tab[1, :2] != 0).all() and (tab[1, 2:] == 0).all()
+        # no page shared, null page never handed out
+        held = [p for row in tab for p in row if p != 0]
+        assert len(set(held)) == len(held) and 0 not in held
+        # release recycles LIFO: the next assign reuses the hot pages
+        freed = set(tab[1][:2])
+        assert pool.release(1) == 2
+        assert pool.release(1) == 0  # idempotent
+        assert pool.assign(2, 20) == 2
+        assert set(pool.table()[2][:2]) == freed
+        assert pool.high_water == 3
+
+    def test_double_assign_rejected(self):
+        net = _tiny_llama()
+        pool = PagedKVPool(net, num_slots=2, max_seq=64, page_size=16)
+        pool.assign(0, 10)
+        with pytest.raises(MXNetError, match="already owns"):
+            pool.assign(0, 10)
+
+    def test_budget_over_max_seq_rejected(self):
+        net = _tiny_llama()
+        pool = PagedKVPool(net, num_slots=2, max_seq=64, page_size=16)
+        with pytest.raises(MXNetError, match="exceeds max_seq"):
+            pool.assign(0, 65)
+
+    def test_exhaustion_is_503_and_atomic(self):
+        net = _tiny_llama()
+        # 4 usable pages for 2 slots of up to 4 pages each: oversubscribed
+        pool = PagedKVPool(net, num_slots=2, max_seq=64, page_size=16,
+                           num_pages=5)
+        pool.assign(0, 48)  # 3 pages
+        with pytest.raises(PoolExhausted) as ei:
+            pool.assign(1, 32)  # needs 2, only 1 free
+        assert ei.value.status == 503
+        assert ei.value.retry_after_ms is not None
+        # atomic: the failed assign allocated nothing
+        assert pool.pages_free == 1
+        assert pool.exhausted_count == 1
+        pool.release(0)
+        assert pool.assign(1, 32) == 2  # recycled pages admit it now
+
+    def test_int8_pool_interleave_matches_kvcache(self):
+        net = _tiny_llama()
+        pool = PagedKVPool(net, num_slots=2, max_seq=64, page_size=16,
+                           quant="int8")
+        flat = pool.flat()
+        n_layers = len(net._blocks)
+        assert len(flat) == 4 * n_layers
+        # [k, k_scale, v, v_scale] per layer, same as KVCache.flat()
+        assert str(flat[0].dtype) == "int8"
+        assert str(flat[1].dtype) == "float32"
+        assert flat[1].ndim == 3  # scale pool has no head_dim axis
+        assert pool.nbytes() == sum(
+            int(np.prod(a.shape)) * np.dtype(str(a.dtype)).itemsize
+            for a in flat)
+
+    def test_update_from_flat_count_checked(self):
+        net = _tiny_llama()
+        pool = PagedKVPool(net, num_slots=2, max_seq=64, page_size=16)
+        with pytest.raises(MXNetError, match="expected"):
+            pool.update_from_flat(pool.flat()[:-1])
+
+
+# ---------------------------------------------------------------------------
+# gather / scatter ops
+# ---------------------------------------------------------------------------
+
+
+class TestPagedOps:
+    def test_gather_scatter_roundtrip_exact(self):
+        rng = np.random.default_rng(0)
+        P, KV, PG, D = 7, 2, 4, 8
+        B, N = 2, 3  # 3 pages per slot -> ring length 12
+        pool = rng.standard_normal((P, KV, PG, D)).astype(np.float32)
+        table = np.array([[1, 2, 3], [4, 5, 6]], np.int32)
+        ring = ops.paged_kv_gather(mnp.array(pool),
+                                   mnp.array(table)).asnumpy()
+        assert ring.shape == (B, KV, N * PG, D)
+        for b in range(B):
+            for j, pid in enumerate(table[b]):
+                assert np.array_equal(ring[b, :, j * PG:(j + 1) * PG],
+                                      pool[pid])
+        # scatter two new rows at start_pos back into the pool: exact
+        new = ring.copy()
+        start = np.array([5, 9], np.int32)
+        t_len = 2
+        for b in range(B):
+            new[b, :, start[b]:start[b] + t_len] = rng.standard_normal(
+                (KV, t_len, D)).astype(np.float32)
+        out = ops.paged_kv_scatter(mnp.array(pool), mnp.array(table),
+                                   mnp.array(new), mnp.array(start),
+                                   t_len).asnumpy()
+        for b in range(B):
+            for t in range(start[b], start[b] + t_len):
+                pid, off = table[b][t // PG], t % PG
+                assert np.array_equal(out[pid, :, off], new[b, :, t])
+        # untouched pages are bitwise untouched
+        touched = {int(table[b][t // PG])
+                   for b in range(B)
+                   for t in range(start[b], start[b] + t_len)}
+        for pid in range(1, P):
+            if pid not in touched:
+                assert np.array_equal(out[pid], pool[pid])
+
+    def test_scatter_rezeros_null_page(self):
+        rng = np.random.default_rng(1)
+        pool = rng.standard_normal((4, 2, 4, 8)).astype(np.float32)
+        # all-null table: a dead slot's write lands on page 0 ...
+        table = np.zeros((1, 2), np.int32)
+        ring = rng.standard_normal((1, 2, 8, 8)).astype(np.float32)
+        out = ops.paged_kv_scatter(mnp.array(pool), mnp.array(table),
+                                   mnp.array(ring),
+                                   mnp.array(np.array([3], np.int32)),
+                                   1).asnumpy()
+        # ... and page 0 comes back zero: no cross-step feedback for
+        # dead slots, ever
+        assert np.array_equal(out[0], np.zeros_like(out[0]))
+        assert np.array_equal(out[1:], pool[1:])
+
+    def test_scale_pool_scatter_3d(self):
+        rng = np.random.default_rng(2)
+        pool = rng.standard_normal((4, 2, 4)).astype(np.float32)
+        table = np.array([[2, 3]], np.int32)
+        ring = ops.paged_kv_gather(mnp.array(pool),
+                                   mnp.array(table)).asnumpy()
+        assert ring.shape == (1, 2, 8)
+        new = ring.copy()
+        new[0, :, 6] = [9.0, -9.0]
+        out = ops.paged_kv_scatter(mnp.array(pool), mnp.array(table),
+                                   mnp.array(new),
+                                   mnp.array(np.array([6], np.int32)),
+                                   1).asnumpy()
+        assert np.array_equal(out[3, :, 2], np.float32([9.0, -9.0]))
+
+
+# ---------------------------------------------------------------------------
+# paged Generator: the bitwise contract + steady state
+# ---------------------------------------------------------------------------
+
+
+class TestPagedGenerator:
+    def test_paged_decode_bitwise_equals_ring_baseline(self):
+        """THE acceptance invariant: on the baseline rung the paged
+        step's logits are bitwise identical to the ring step's — prefill
+        and every decode step — because gather/scatter are exact copies
+        bracketing the identical fenced model subgraph."""
+        net = _tiny_llama("llama_serve_12l_test")
+        prompts = [[5, 6, 7], [9, 10, 11, 12, 13]]
+        ring = Generator(net, max_seq=64, batch_buckets=(2,),
+                         prompt_buckets=(16,), decode_path="baseline",
+                         name="kvb_ring")
+        paged = Generator(net, max_seq=64, batch_buckets=(2,),
+                          prompt_buckets=(16,), decode_path="baseline",
+                          paged=True, page_size=16, name="kvb_paged")
+        toks, lens, bb = ring._pad_prompts(prompts)
+        cr = ring._fresh_cache(bb)
+        cp = paged._fresh_cache(bb)
+        lr, cr = ring.prefill(toks, lens, cr)
+        lp, cp = paged.prefill(toks, lens, cp)
+        assert np.array_equal(lr.asnumpy(), lp.asnumpy())
+        ids = np.argmax(lr.asnumpy(), axis=-1).astype(np.int32)
+        pos = lens.copy()
+        for step in range(16):
+            lr, cr = ring.decode_step(ids, pos, cr)
+            lp, cp = paged.decode_step(ids, pos, cp)
+            a, b = lr.asnumpy(), lp.asnumpy()
+            assert np.array_equal(a, b), f"decode step {step} diverged"
+            ids = np.argmax(a, axis=-1).astype(np.int32)
+            pos = pos + 1
+
+    def test_paged_generate_matches_ring_tokens_int8(self):
+        net = _tiny_llama()
+        ring = Generator(net, max_seq=64, batch_buckets=(1,),
+                         prompt_buckets=(16,), decode_path="int8",
+                         name="kvb_ring8")
+        paged = Generator(net, max_seq=64, batch_buckets=(1,),
+                          prompt_buckets=(16,), decode_path="int8",
+                          paged=True, page_size=16, name="kvb_paged8")
+        out_r, _ = ring.generate([[5, 6, 7]], max_new_tokens=8)
+        out_p, _ = paged.generate([[5, 6, 7]], max_new_tokens=8)
+        assert out_r == out_p
+
+    def test_paged_generator_zero_recompiles(self):
+        net = _tiny_llama()
+        gen = Generator(net, max_seq=64, batch_buckets=(1, 2),
+                        prompt_buckets=(16,), decode_path="baseline",
+                        paged=True, page_size=16, name="kvb_warm")
+        gen.warmup()
+        for i in range(4):
+            gen.generate([[1 + i, 2]], max_new_tokens=4)
+            gen.generate([[3, 4], [5, 6, 7]], max_new_tokens=4)
+        gen.assert_no_recompiles()
+
+    def test_env_flag_turns_paging_on(self, monkeypatch):
+        monkeypatch.setenv("MXNET_SERVE_KV_PAGED", "1")
+        net = _tiny_llama()
+        gen = Generator(net, max_seq=64, batch_buckets=(1,),
+                        prompt_buckets=(16,), decode_path="baseline",
+                        page_size=16, name="kvb_flag")
+        assert gen._paged
+        out, _ = gen.generate([[5, 6, 7]], max_new_tokens=4)
+        assert len(out[0]) == 4

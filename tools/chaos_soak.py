@@ -36,6 +36,8 @@ Usage::
     python tools/chaos_soak.py                  # ~15s tier-1 smoke
     python tools/chaos_soak.py --duration 60 --clients 128   # full soak
     python tools/chaos_soak.py --no-decode      # skip the Generator leg
+    python tools/chaos_soak.py --fleet          # Router over N replicas
+    python tools/chaos_soak.py --cb             # continuous batching
 
 The run is deterministic per ``--seed`` up to thread scheduling: the
 fault plan's prob-rules draw from the seed, so the same faults fire at
@@ -663,6 +665,162 @@ def run_fleet_soak(duration_s=10.0, clients=64, replicas=3, seed=11,
     return report
 
 
+def run_cb_soak(duration_s=8.0, seed=13, num_slots=8, verbose=True):
+    """Continuous-batching chaos soak: a :class:`ContinuousEngine` under
+    sustained mixed-length traffic — long batch-class decodes resubmitted
+    the moment they finish, interactive shorts arriving the whole time —
+    plus a ``serve:decode`` fault sub-leg. Asserts:
+
+    1. **No head-of-line blocking** — with free slots available, no
+       interactive short ever waits more than ONE scheduler iteration
+       for admission while the long decodes run (the headline
+       iteration-level-scheduling property the static batcher cannot
+       provide);
+    2. **Exactly-once settlement** — client books balance, every future
+       settles exactly once, no wedged client thread;
+    3. **Trace-static steady state** — zero recompiles across the whole
+       soak (hundreds of admit/retire cycles);
+    4. **Pages recycle** — the pool owns zero pages after drain;
+    5. **Fault isolation** — an injected ``serve:decode`` fault fails
+       only the requests in flight at that step; the engine keeps
+       serving new submissions afterwards.
+
+    Importable — ``tests/test_serve_chaos.py`` can drive the same
+    machinery."""
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serve import ContinuousEngine
+
+    def say(msg):
+        if verbose:
+            print(f"CB_SOAK {msg}", flush=True)
+
+    violations = []
+    rng = np.random.default_rng(seed)
+    net = get_llama("llama_tiny_test")
+    net.initialize()
+    eng = ContinuousEngine(net, max_seq=64, num_slots=num_slots,
+                           page_size=16, prefill_chunk=16,
+                           decode_path="baseline", name="cb_soak",
+                           max_queue=256)
+    eng.start()
+
+    stop_at = time.monotonic() + float(duration_s)
+    books = {"long_ok": 0, "short_ok": 0, "errors": 0}
+    waits = []          # admit_wait_steps of every interactive short
+    lock = threading.Lock()
+
+    def long_feeder(fid):
+        """One lane of continuous long batch-class work: resubmit the
+        moment the previous long decode finishes, so long decodes are
+        ALWAYS in flight while the shorts arrive."""
+        while time.monotonic() < stop_at:
+            try:
+                r = eng.submit([7 + fid] * 8, max_new_tokens=48,
+                               priority="batch").result(timeout=120)
+                with lock:
+                    books["long_ok"] += 1
+                    assert len(r["tokens"]) == 48
+            except Exception:  # noqa: BLE001
+                with lock:
+                    books["errors"] += 1
+
+    def short_feeder(fid):
+        """Interactive shorts, one at a time per feeder — there are
+        always free slots for them next to the long lanes."""
+        while time.monotonic() < stop_at:
+            try:
+                r = eng.submit([int(rng.integers(2, 50)), 3 + fid],
+                               max_new_tokens=int(rng.integers(2, 5)),
+                               priority="interactive").result(timeout=60)
+                with lock:
+                    books["short_ok"] += 1
+                    waits.append(r["admit_wait_steps"])
+            except Exception:  # noqa: BLE001
+                with lock:
+                    books["errors"] += 1
+            time.sleep(float(rng.uniform(0.0, 0.01)))
+
+    threads = [threading.Thread(target=long_feeder, args=(i,),
+                                daemon=True, name=f"cb-long-{i}")
+               for i in range(2)]
+    threads += [threading.Thread(target=short_feeder, args=(i,),
+                                 daemon=True, name=f"cb-short-{i}")
+                for i in range(3)]
+    say(f"soaking: 2 long lanes (48-token decodes) + 3 interactive "
+        f"feeders over {num_slots} slots for {duration_s:.0f}s")
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + 180)
+        if t.is_alive():
+            violations.append(f"client thread {t.name} wedged (deadlock?)")
+
+    if not eng.drain(timeout=60.0):
+        violations.append("drain() failed with work still queued")
+    eng.resume()
+
+    # -- invariants ----------------------------------------------------------
+    if books["errors"]:
+        violations.append(f"{books['errors']} unexpected request "
+                          f"error(s) during the clean soak")
+    if books["short_ok"] == 0 or books["long_ok"] == 0:
+        violations.append(f"soak starved a class: {books}")
+    bad_waits = [w for w in waits if w > 1]
+    if bad_waits:
+        violations.append(
+            f"{len(bad_waits)}/{len(waits)} interactive shorts waited "
+            f"> 1 scheduler step for admission with free slots "
+            f"(head-of-line blocking): worst={max(bad_waits)}")
+    try:
+        eng.assert_no_recompiles()
+    except Exception as exc:  # noqa: BLE001
+        violations.append(f"soak recompiled: {exc}")
+    st = eng.stats()
+    if st["pool"]["pages_owned"] != 0:
+        violations.append(
+            f"pool leaked {st['pool']['pages_owned']} page(s) after drain")
+
+    # -- fault sub-leg: serve:decode kill is per-request, engine survives ---
+    say("fault sub-leg: one fatal serve:decode step")
+    faults.install_plan({"seed": int(seed) + 1, "rules": [
+        {"site": "serve:decode", "kind": "fatal", "times": 1}]})
+    try:
+        eng.submit([5, 6], max_new_tokens=8).result(timeout=60)
+        violations.append("serve:decode fault never surfaced")
+    except Exception:  # noqa: BLE001 — the injected kill
+        pass
+    finally:
+        faults.clear_plan()
+    try:
+        r = eng.submit([5, 6], max_new_tokens=4).result(timeout=60)
+        if len(r["tokens"]) != 4:
+            violations.append("post-fault request came back short")
+    except Exception as exc:  # noqa: BLE001
+        violations.append(f"engine did not survive the decode fault: "
+                          f"{exc!r}")
+    if eng.stats()["pool"]["pages_owned"] != 0:
+        violations.append("faulted request leaked its pages")
+
+    snap = eng.metrics.snapshot()
+    eng.close()
+    report = {
+        "ok": not violations,
+        "violations": violations,
+        "books": dict(books),
+        "admit_wait_max": max(waits) if waits else 0,
+        "ttft_p99_ms": snap.get("ttft_p99_ms", 0.0),
+        "itl_p99_ms": snap.get("itl_p99_ms", 0.0),
+        "steps": st["steps"],
+        "pool_high_water": st["pool"]["high_water"],
+    }
+    say(f"books={books} admit_wait_max={report['admit_wait_max']} "
+        f"steps={report['steps']} ttft_p99={report['ttft_p99_ms']:.1f}ms "
+        f"itl_p99={report['itl_p99_ms']:.2f}ms")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--duration", type=float, default=10.0,
@@ -683,7 +841,24 @@ def main(argv=None):
                          "single-server soak")
     ap.add_argument("--replicas", type=int, default=3,
                     help="fleet soak: number of replicas (default 3)")
+    ap.add_argument("--cb", action="store_true",
+                    help="run the continuous-batching soak "
+                         "(ContinuousEngine under mixed-length traffic) "
+                         "instead of the single-server soak")
     args = ap.parse_args(argv)
+
+    if args.cb:
+        report = run_cb_soak(duration_s=args.duration, seed=args.seed)
+        if report["ok"]:
+            print(f"CB_SOAK=PASS books={report['books']} "
+                  f"admit_wait_max={report['admit_wait_max']} "
+                  f"steps={report['steps']} "
+                  f"ttft_p99={report['ttft_p99_ms']:.1f}ms "
+                  f"itl_p99={report['itl_p99_ms']:.2f}ms")
+            return 0
+        for v in report["violations"]:
+            print(f"CB_SOAK=FAIL {v}")
+        return 1
 
     if args.fleet:
         report = run_fleet_soak(

@@ -121,6 +121,22 @@ if [[ "${TIER1_FLEET:-1}" != "0" ]]; then
         rc=$fleet_rc
     fi
 fi
+# Continuous-batching soak smoke (TIER1_CB=1 to enable): a
+# ContinuousEngine over 8 slots takes ~4s of mixed-length traffic (two
+# always-on 48-token batch-class decode lanes + interactive shorts) and
+# a fatal serve:decode sub-leg — asserts no interactive short ever waits
+# more than one scheduler iteration for admission (no head-of-line
+# blocking), exactly-once settlement, zero recompiles across hundreds of
+# admit/retire cycles, full KV-page recycling, and per-request fault
+# isolation. The assertion-level suite is tests/test_continuous_batching.py.
+if [[ "${TIER1_CB:-0}" != "0" ]]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python tools/chaos_soak.py --cb --duration "${TIER1_CB_S:-4}"
+    cb_rc=$?
+    if [[ "$rc" -eq 0 && "$cb_rc" -ne 0 ]]; then
+        rc=$cb_rc
+    fi
+fi
 # Elastic soak smoke (TIER1_ELASTIC=0 to skip): one seeded
 # kill/lag/corrupt sweep through a dp8 training loop — asserts the
 # chip-loss dp8->dp4 resume lands bitwise on the dp4 reference run,
