@@ -73,6 +73,17 @@ if _os.environ.get("MXNET_FAULT_PLAN"):
 
     _faults.get_plan()
 
+# MXNET_LOCKDEP: patch the threading factories at import so every lock
+# constructed from here on (sessions, batchers, routers — the instance
+# locks the acquisition-order graph is about) is instrumented. Locks
+# created before this point (module-level plumbing) stay raw, which
+# keeps the sanitizer out of its own bookkeeping.
+if _os.environ.get("MXNET_LOCKDEP", "0").strip().lower() not in (
+        "", "0", "false"):
+    from .resilience import lockdep as _lockdep
+
+    _lockdep.enable()
+
 
 def cpu_count():
     import os

@@ -121,9 +121,18 @@ register_flag(
     "MXNET_TPU_PROC_ID", None,
     "This process's rank (reference DMLC_WORKER_ID).", int)
 register_flag(
-    "MXNET_MODULE_SEED", None,
-    "Base RNG seed for the test suite's per-test seeding (reference "
-    "tests conftest.py reproduction flow).", int)
+    "MXNET_RNG_IMPL", "rbg",
+    "JAX PRNG implementation (rbg / unsafe_rbg / threefry2x32). rbg "
+    "drives the chip's hardware RNG for bulk bits (3x faster dropout "
+    "masks on v5e); threefry2x32 restores bitwise key-stream "
+    "reproducibility across backends. Read at import, before config "
+    "is loadable.")
+register_flag(
+    "MXNET_LOCKDEP", False,
+    "Runtime lock-order sanitizer (resilience.lockdep): instruments "
+    "threading.Lock/RLock/Condition, records the acquisition-order "
+    "graph, reports cycles and blocking-under-lock through the flight "
+    "recorder. Off = nothing is patched (zero overhead).", _bool)
 register_flag(
     "MXNET_PROFILER_AUTOSTART", False,
     "Start the telemetry event bus (mxnet_tpu.profiler) at import; "

@@ -76,7 +76,7 @@ def snapshot(include_aggregates=True):
     out = {}
 
     # profiler bus: counter gauges are already namespaced at the source
-    # (resilience.* / serve.* / cachedop.* / engine.*)
+    # (resilience.* / serve.* / cachedop.* / engine.* / registry.*)
     for k, v in _core.counters_snapshot().items():
         out[k] = v
     if include_aggregates:
@@ -239,7 +239,12 @@ def start_http(port=None, host="127.0.0.1"):
             port = int(_cfg.get("MXNET_METRICS_PORT"))
         srv = ThreadingHTTPServer((host, int(port)), _make_handler())
         srv.daemon_threads = True
-        th = threading.Thread(target=srv.serve_forever,
+
+        def _serve():
+            _core.register_thread_name()
+            srv.serve_forever()
+
+        th = threading.Thread(target=_serve,
                               name="mxtpu-metrics-http", daemon=True)
         th.start()
         _server, _server_thread = srv, th
@@ -251,10 +256,13 @@ def stop_http():
     with _server_lock:
         if _server is None:
             return
-        _server.shutdown()
-        _server.server_close()
-        _server_thread.join(5)
+        srv, th = _server, _server_thread
         _server = _server_thread = None
+    # shutdown + join outside _server_lock: joining the serve thread
+    # while holding the lock its handlers may want is an L002 hazard
+    srv.shutdown()
+    srv.server_close()
+    th.join(5)
 
 
 def server_port():

@@ -59,6 +59,7 @@ _ELASTIC_NAMES = (
     "ElasticBatchProcessor", "DesyncAuditHandler", "StragglerMonitor",
     "is_mesh_loss", "probe_contexts", "replica_fingerprints",
 )
+_LOCKDEP_NAMES = ("lockdep",)
 
 
 def __getattr__(name):
@@ -88,6 +89,12 @@ def __getattr__(name):
         for n in _ELASTIC_NAMES[1:]:
             globals()[n] = getattr(_el, n)
         return globals()[name]
+    if name in _LOCKDEP_NAMES:
+        import importlib
+
+        _ld = importlib.import_module(__name__ + ".lockdep")
+        globals()["lockdep"] = _ld
+        return _ld
     raise AttributeError(
         f"module 'mxnet_tpu.resilience' has no attribute {name!r}")
 

@@ -203,6 +203,7 @@ def run_with_watchdog(fn, timeout_s, site="collective"):
 
     def body():
         global _orphans_live
+        _prof.register_thread_name()
         try:
             box["out"] = fn()
         except BaseException as exc:  # rethrown on the caller thread
@@ -229,9 +230,13 @@ def run_with_watchdog(fn, timeout_s, site="collective"):
             _counters.incr("resilience.watchdog_orphans")
             n = _counters.get("resilience.watchdog_orphans")
             if _prof.ENABLED:
+                # body_alive distinguishes a genuinely hung body (the
+                # daemon thread is still running) from one that died
+                # between the timeout and this probe
                 _prof.record_instant(
                     f"resilience::watchdog_timeout({site})", "resilience",
-                    args={"timeout_s": timeout_s, "orphans": n})
+                    args={"timeout_s": timeout_s, "orphans": n,
+                          "body_alive": t.is_alive()})
             _recorder.dump("watchdog_timeout",
                            args={"site": site, "timeout_s": timeout_s,
                                  "orphans": n})

@@ -56,6 +56,7 @@ import time
 import weakref
 from concurrent.futures import CancelledError, Future
 
+from ..profiler import core as _prof
 from ..profiler import export as _export
 from ..resilience.elastic import StragglerMonitor
 from ..resilience.faults import SimulatedWorkerDeath
@@ -333,6 +334,7 @@ class Router:
                         f"fleet {self.name!r}: deadline expired after "
                         f"{req.failovers} failover(s)"))
                 return
+            settle = None
             with self._lock:
                 if req.settled:
                     return
@@ -346,14 +348,18 @@ class Router:
                         f"(tried {len(exclude)} of "
                         f"{len(self._states)}; fleet "
                         f"{'closed' if self._closed else 'degraded'})")
-                    self._finish_locked(req, error=err)
-                    return
-                gen = req.next_gen
-                req.next_gen += 1
-                req.valid_gens.add(gen)
-                if hedge:
-                    req.hedge_gen = gen
-                st.outstanding[req.key] = (req, gen)
+                    settle = self._finish_locked(req, error=err)
+                else:
+                    gen = req.next_gen
+                    req.next_gen += 1
+                    req.valid_gens.add(gen)
+                    if hedge:
+                        req.hedge_gen = gen
+                    st.outstanding[req.key] = (req, gen)
+            if st is None:
+                if settle is not None:
+                    settle()
+                return
             remaining_ms = None
             if req.deadline is not None:
                 remaining_ms = max(0.1, (req.deadline - now) * 1e3)
@@ -452,6 +458,8 @@ class Router:
         except BaseException as exc:  # noqa: BLE001 -- per-request error
             result, error = None, exc
         failover = False
+        record_fail = False
+        settle = None
         with self._lock:
             entry = st.outstanding.get(req.key)
             if entry is not None and entry[1] == gen:
@@ -471,18 +479,18 @@ class Router:
                         self.counters["hedge_wins"] += 1
                     else:
                         self.counters["hedge_losses"] += 1
-                self._finish_locked(req, result=result, winner_gen=gen)
-                return
-            if isinstance(error, DeadlineExceeded):
+                settle = self._finish_locked(req, result=result,
+                                             winner_gen=gen)
+            elif isinstance(error, DeadlineExceeded):
                 # the request's own budget, not the replica's health
-                self._finish_locked(req, error=error, winner_gen=gen)
-                return
-            if isinstance(error, ServeError) \
+                settle = self._finish_locked(req, error=error,
+                                             winner_gen=gen)
+            elif isinstance(error, ServeError) \
                     and getattr(error, "retry_after_ms", None) is not None:
                 # overload-shaped: pass the backpressure through
-                self._finish_locked(req, error=error, winner_gen=gen)
-                return
-            if isinstance(error, ServiceUnavailable):
+                settle = self._finish_locked(req, error=error,
+                                             winner_gen=gen)
+            elif isinstance(error, ServiceUnavailable):
                 # structural 503 at settle time (session breaker open,
                 # batcher shut under us): quarantine-worthy — fail over
                 req.valid_gens.discard(gen)
@@ -492,14 +500,18 @@ class Router:
                 # deterministic failure elsewhere just re-fails slower),
                 # but count it against the replica's breaker so a
                 # replica failing EVERY request still quarantines
-                self._finish_locked(req, error=error, winner_gen=gen)
+                settle = self._finish_locked(req, error=error,
+                                             winner_gen=gen)
+                record_fail = True
+        if settle is not None:
+            # client future settles OUTSIDE the Router lock: done-
+            # callbacks run on this thread and may re-enter the Router
+            settle()
         if failover:
             self._record_failure(st)
             if self._count_failover(req):
                 self._dispatch(req, exclude={st.index})
-        elif error is not None and not isinstance(
-                error, (DeadlineExceeded,)) \
-                and getattr(error, "retry_after_ms", None) is None:
+        elif record_fail:
             self._record_failure(st)
 
     def _observe_latency_locked(self, st, req):
@@ -514,12 +526,18 @@ class Router:
 
     def _finish_locked(self, req, result=None, error=None,
                        winner_gen=None):
-        """Settle the CLIENT future exactly once (caller holds the
-        lock); cancels the hedge timer and any still-pending losing
-        attempts."""
+        """Bookkeeping half of exactly-once settlement (caller holds
+        the lock): flip ``req.settled``, cancel the hedge timer, fence
+        and unregister the losing attempts. Returns a zero-arg action
+        that settles the CLIENT future and cancels the losers — the
+        caller MUST run it after releasing the lock (``set_result``
+        fires done-callbacks on this thread, and running arbitrary
+        client callbacks / loser cancellation under the Router lock is
+        a lock-order hazard the mxlint L002 gate flags). Returns None
+        on a duplicate settle."""
         if req.settled:
             self.counters["duplicate_settles"] += 1
-            return
+            return None
         req.settled = True
         if req.hedge_timer is not None:
             req.hedge_timer.cancel()
@@ -537,21 +555,26 @@ class Router:
         self._settled[req.key] = req.future
         while len(self._settled) > self._settled_cap:
             self._settled.popitem(last=False)
-        # settle + cancel outside any batcher lock concern: Future
-        # callbacks fire on this thread; batcher futures are never
-        # RUNNING, so cancel() wins unless the attempt already settled
-        # (in which case its _on_settle is fenced/duplicate-dropped)
-        if error is not None:
-            req.future.set_exception(error)
-        else:
-            req.future.set_result(result)
-        for _i, _g, f in losers:
-            f.cancel()
+
+        def settle():
+            # batcher futures are never RUNNING, so cancel() wins
+            # unless the attempt already settled (in which case its
+            # _on_settle is fenced/duplicate-dropped)
+            if error is not None:
+                req.future.set_exception(error)
+            else:
+                req.future.set_result(result)
+            for _i, _g, f in losers:
+                f.cancel()
+
+        return settle
 
     def _finish(self, req, result=None, error=None, winner_gen=None):
         with self._lock:
-            self._finish_locked(req, result=result, error=error,
-                                winner_gen=winner_gen)
+            settle = self._finish_locked(req, result=result, error=error,
+                                         winner_gen=winner_gen)
+        if settle is not None:
+            settle()
 
     # -- hedging ------------------------------------------------------------
     def _maybe_arm_hedge(self, req, st):
@@ -636,6 +659,7 @@ class Router:
         kills the thread without any dispatch-time signal) and walks
         quarantined sessions' breaker cooldowns so an idle-but-routed-
         around replica can still reach half-open."""
+        _prof.register_thread_name()
         while not self._closed:
             time.sleep(self.probe_ms / 1e3)
             if self._closed:

@@ -1,0 +1,435 @@
+"""mxlint conformance (``tools/mxlint``): each rule catches its known-bad
+fixture snippet and stays quiet on the known-good twin, the baseline
+suppression machinery round-trips, inline ``# mxlint: disable=`` works,
+and — the actual gate — a self-scan of the real tree reports zero
+non-baselined findings (the same invocation tier-1 runs via
+``TIER1_LINT=1``). Rule catalog lives in TOOLING.md.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.mxlint import engine as mxengine  # noqa: E402
+from tools.mxlint import hygiene, locks, registry  # noqa: E402
+
+
+def _scan(tmp_path, files, rules, baseline_path=None):
+    """Write ``files`` ({relpath: source}) under tmp_path and run the
+    given rule set; returns the non-suppressed findings."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    paths = sorted({rel.split("/", 1)[0] for rel in files})
+    findings, _sup, _unused = mxengine.run(
+        paths, str(tmp_path), baseline_path=baseline_path, rules=rules)
+    return findings
+
+
+def _keys(findings):
+    return {f.key for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# L001 lock-order cycles
+# ---------------------------------------------------------------------------
+
+_CYCLE_SRC = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.b = B()
+
+        def f(self):
+            with self._lock:
+                with self.b._other_lock:
+                    pass
+
+    class B:
+        def __init__(self):
+            self._other_lock = threading.Lock()
+            self.a = A()
+
+        def g(self):
+            with self._other_lock:
+                with self.a._lock:
+                    pass
+    """
+
+
+def test_l001_flags_ab_ba_cycle(tmp_path):
+    findings = _scan(tmp_path, {"pkg/mod.py": _CYCLE_SRC}, (locks.check,))
+    cycles = [f for f in findings if f.rule == "L001"]
+    assert len(cycles) == 1
+    assert cycles[0].key.startswith("cycle:")
+    assert "A._lock" in cycles[0].message
+    assert "B._other_lock" in cycles[0].message
+
+
+def test_l001_consistent_order_is_clean(tmp_path):
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    with self._inner_lock:
+                        pass
+
+            def g(self):
+                with self._lock:
+                    with self._inner_lock:
+                        pass
+        """
+    findings = _scan(tmp_path, {"pkg/mod.py": src}, (locks.check,))
+    assert [f for f in findings if f.rule == "L001"] == []
+
+
+def test_l001_reentrant_same_lock_is_not_a_cycle(tmp_path):
+    # two instances of one class taking each other's (same-named) RLock
+    # is self-edge territory, not a reportable cycle
+    src = """
+        import threading
+
+        class Node:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def link(self, other):
+                with self._lock:
+                    with other._lock:
+                        pass
+        """
+    findings = _scan(tmp_path, {"pkg/mod.py": src}, (locks.check,))
+    assert [f for f in findings if f.rule == "L001"] == []
+
+
+# ---------------------------------------------------------------------------
+# L002 blocking under a held lock
+# ---------------------------------------------------------------------------
+
+def test_l002_blocking_ops_under_lock(tmp_path):
+    src = """
+        import threading
+        import time
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def bad_result(self, fut):
+                with self._lock:
+                    return fut.result(timeout=5)
+
+            def bad_join(self, t):
+                with self._lock:
+                    t.join()
+
+            def bad_sync(self, arr):
+                with self._lock:
+                    return arr.asnumpy()
+
+            def bad_settle(self, fut):
+                with self._lock:
+                    fut.set_result(1)
+        """
+    keys = _keys(_scan(tmp_path, {"pkg/srv.py": src}, (locks.check,)))
+    assert "sleep:Srv.bad_sleep" in keys
+    assert "future-result:Srv.bad_result" in keys
+    assert "join:Srv.bad_join" in keys
+    assert "device-sync:asnumpy:Srv.bad_sync" in keys
+    assert "future-settle:Srv.bad_settle" in keys
+
+
+def test_l002_outside_lock_is_clean(tmp_path):
+    src = """
+        import threading
+        import time
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def ok(self, fut, arr):
+                with self._lock:
+                    pending = True
+                time.sleep(0.1)
+                fut.set_result(arr.asnumpy())
+
+            def ok_nonblocking_result(self, fut):
+                with self._lock:
+                    return fut.result(timeout=0)
+        """
+    findings = _scan(tmp_path, {"pkg/srv.py": src}, (locks.check,))
+    assert [f for f in findings if f.rule == "L002"] == []
+
+
+def test_l002_one_hop_interprocedural(tmp_path):
+    # the fleet pattern this PR fixed: the blocking op hides one call
+    # away — bookkeeping helper settles a future, caller holds the lock
+    src = """
+        import threading
+
+        class Router:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _finish(self, fut):
+                fut.set_result(1)
+
+            def dispatch(self, fut):
+                with self._lock:
+                    self._finish(fut)
+        """
+    keys = _keys(_scan(tmp_path, {"pkg/router.py": src}, (locks.check,)))
+    assert "via-future-settle:Router.dispatch->Router._finish" in keys
+
+
+# ---------------------------------------------------------------------------
+# L003 registry drift
+# ---------------------------------------------------------------------------
+
+_L003_FILES = {
+    "mxnet_tpu/config.py": """
+        def register_flag(name, default, doc, parse=None):
+            pass
+
+        register_flag("MXNET_USED_FLAG", 0, "documented and read")
+        register_flag("MXNET_DEAD_FLAG", 0, "registered but never read")
+        register_flag("MXNET_UNDOC_FLAG", 0, "read but not in any doc")
+        """,
+    "mxnet_tpu/resilience/faults.py": """
+        KNOWN_SITES = ("good:site",)
+        """,
+    "mxnet_tpu/user.py": """
+        import os
+        from . import config
+        from .resilience import fault_point
+        from .profiler import core as prof
+
+        def f():
+            config.get("MXNET_USED_FLAG")
+            config.get("MXNET_UNDOC_FLAG")
+            config.get("MXNET_NOT_REGISTERED")
+            os.environ.get("MXNET_RAW_READ")
+            fault_point("good:site")
+            fault_point("rogue:site")
+            prof.incr_counter("serve.requests")
+            prof.incr_counter("unnamespaced_counter")
+        """,
+}
+
+
+@pytest.fixture()
+def l003_root(tmp_path):
+    (tmp_path / "README.md").write_text(
+        "| `MXNET_USED_FLAG` | documented |\n")
+    (tmp_path / "RESILIENCE.md").write_text("`good:site` documented\n")
+    export = tmp_path / "mxnet_tpu" / "profiler"
+    export.mkdir(parents=True)
+    nss = " ".join("%s." % ns for ns in registry.COUNTER_NAMESPACES)
+    (export / "export.py").write_text('"""merges: %s"""\n' % nss)
+    return tmp_path
+
+
+def test_l003_drift_findings(tmp_path, l003_root):
+    keys = _keys(_scan(tmp_path, _L003_FILES, (registry.check,)))
+    assert "dead-flag:MXNET_DEAD_FLAG" in keys
+    assert "undocumented-flag:MXNET_UNDOC_FLAG" in keys
+    assert "unknown-flag:MXNET_NOT_REGISTERED" in keys
+    assert "unregistered-read:MXNET_RAW_READ" in keys
+    assert "undeclared-site:rogue:site" in keys
+    assert "bad-counter:unnamespaced_counter" in keys
+    # the good citizens stay quiet
+    assert "dead-flag:MXNET_USED_FLAG" not in keys
+    assert "undocumented-flag:MXNET_USED_FLAG" not in keys
+    assert "undeclared-site:good:site" not in keys
+    assert "undocumented-site:good:site" not in keys
+    assert not any(k.startswith("bad-counter:serve.") for k in keys)
+
+
+def test_l003_undocumented_site(tmp_path, l003_root):
+    files = dict(_L003_FILES)
+    files["mxnet_tpu/resilience/faults.py"] = """
+        KNOWN_SITES = ("good:site", "undoc:site")
+        """
+    files["mxnet_tpu/user.py"] += (
+        "\n        def g():\n"
+        "            fault_point(\"undoc:site\")\n")
+    keys = _keys(_scan(tmp_path, files, (registry.check,)))
+    assert "undocumented-site:undoc:site" in keys
+
+
+# ---------------------------------------------------------------------------
+# L004 thread hygiene
+# ---------------------------------------------------------------------------
+
+def test_l004_findings(tmp_path):
+    src = """
+        import threading
+
+        def swallow():
+            try:
+                work()
+            except BaseException:
+                pass
+
+        def rethrow_later():
+            try:
+                work()
+            except BaseException as exc:
+                record(exc)
+
+        def spawn():
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+        """
+    keys = _keys(_scan(tmp_path, {"mxnet_tpu/mod.py": src},
+                       (hygiene.check,)))
+    assert "baseexcept:swallow" in keys
+    assert "baseexcept:rethrow_later" not in keys
+    assert "unnamed-thread:spawn" in keys
+    assert "daemon-liveness:spawn" in keys
+
+
+def test_l004_good_module_is_clean(tmp_path):
+    src = """
+        import threading
+        from .profiler import register_thread_name
+
+        def spawn(stop):
+            def body():
+                register_thread_name()
+                loop()
+            t = threading.Thread(target=body, daemon=True)
+            t.start()
+            assert t.is_alive()
+        """
+    findings = _scan(tmp_path, {"mxnet_tpu/mod.py": src},
+                     (hygiene.check,))
+    assert [f for f in findings if f.rule == "L004"] == []
+
+
+def test_l004_only_applies_inside_mxnet_tpu(tmp_path):
+    src = """
+        def swallow():
+            try:
+                work()
+            except BaseException:
+                pass
+        """
+    findings = _scan(tmp_path, {"tools/helper.py": src}, (hygiene.check,))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics: inline disables, baseline round-trip
+# ---------------------------------------------------------------------------
+
+def test_inline_disable_suppresses_that_line(tmp_path):
+    src = """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    time.sleep(0.1)  # mxlint: disable=L002
+        """
+    findings = _scan(tmp_path, {"pkg/s.py": src}, (locks.check,))
+    assert [f for f in findings if f.rule == "L002"] == []
+
+
+def test_baseline_round_trip(tmp_path):
+    files = {"pkg/s.py": """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    time.sleep(0.1)
+        """}
+    # 1) unsuppressed: the finding is visible
+    findings = _scan(tmp_path, files, (locks.check,))
+    assert _keys(findings) == {"sleep:S.f"}
+    # 2) write a baseline from the finding; same scan is now clean
+    f = findings[0]
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"suppressions": [
+        {"rule": f.rule, "path": f.path, "key": f.key, "why": "fixture"}]}))
+    findings2, suppressed, unused = mxengine.run(
+        ["pkg"], str(tmp_path), baseline_path=str(bl),
+        rules=(locks.check,))
+    assert findings2 == []
+    assert len(suppressed) == 1 and unused == []
+    # 3) stale entries are reported as unused, not silently kept
+    bl.write_text(json.dumps({"suppressions": [
+        {"rule": "L002", "path": "pkg/s.py", "key": "sleep:S.gone",
+         "why": "stale"}]}))
+    findings3, _sup, unused3 = mxengine.run(
+        ["pkg"], str(tmp_path), baseline_path=str(bl),
+        rules=(locks.check,))
+    assert _keys(findings3) == {"sleep:S.f"}
+    assert len(unused3) == 1
+
+
+def test_baseline_entries_require_why(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"suppressions": [
+        {"rule": "L002", "path": "x.py", "key": "sleep:f"}]}))
+    with pytest.raises(ValueError, match="why"):
+        mxengine.load_baseline(str(bl))
+
+
+def test_syntax_error_reports_l000(tmp_path):
+    findings = _scan(tmp_path, {"pkg/broken.py": "def f(:\n"}, ())
+    assert _keys(findings) == {"syntax-error"}
+
+
+# ---------------------------------------------------------------------------
+# the actual gate: the real tree is clean
+# ---------------------------------------------------------------------------
+
+def test_repo_self_scan_is_clean():
+    findings, suppressed, unused = mxengine.run(
+        ["mxnet_tpu", "tools", "bench.py"], REPO)
+    assert findings == [], "non-baselined mxlint findings:\n" + "\n".join(
+        f.render() for f in findings)
+    assert unused == [], "stale baseline entries: %r" % unused
+    # the checked-in baseline stays small and justified
+    entries = mxengine.load_baseline(mxengine.DEFAULT_BASELINE)
+    assert len(entries) <= 10
+    assert all(e["why"].strip() for e in entries)
+
+
+def test_cli_exit_status():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint",
+         "mxnet_tpu", "tools", "bench.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "mxlint: clean" in proc.stderr

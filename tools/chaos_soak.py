@@ -894,4 +894,11 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    try:
+        from mxnet_tpu.resilience.lockdep import smoke_gate
+    except ImportError:
+        pass
+    else:
+        rc = smoke_gate(rc)
+    sys.exit(rc)

@@ -1,0 +1,30 @@
+"""mxlint — codebase-specific static analysis for mxnet-tpu.
+
+Rules (catalog in TOOLING.md):
+
+* **L001** lock-order cycles in the static acquisition graph
+* **L002** blocking calls (sleep / Future.result / join / device sync)
+  inside a held-lock region
+* **L003** registry drift (config flags vs reads vs docs; fault sites
+  vs KNOWN_SITES vs RESILIENCE.md; counter namespaces vs
+  export.snapshot())
+* **L004** thread hygiene (swallowing ``except BaseException``,
+  unnamed threads, unsupervised daemon loops)
+
+Usage::
+
+    python -m tools.mxlint mxnet_tpu tools bench.py
+
+Exit status 0 iff no non-baselined findings. Suppress per line with
+``# mxlint: disable=L002`` or per finding in
+``tools/mxlint/baseline.json``.
+"""
+from .engine import (  # noqa: F401
+    DEFAULT_BASELINE,
+    Finding,
+    Project,
+    collect,
+    load_baseline,
+    main,
+    run,
+)

@@ -1,0 +1,195 @@
+"""mxlint core: file collection, AST parsing, rule dispatch, inline
+``# mxlint: disable=LNNN`` comments, and the checked-in suppression
+baseline (``tools/mxlint/baseline.json``).
+
+A finding is identified by ``(rule, path, key)`` where ``key`` is a
+*symbolic* handle chosen by the rule (e.g. ``unregistered-read:
+MXNET_FOO`` or a cycle signature) rather than a line number, so
+baselines survive unrelated edits to the file.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+
+_DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable=([A-Z0-9,\s]+)")
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    key: str           # symbolic identity for baseline matching
+    message: str
+
+    @property
+    def ident(self):
+        return (self.rule, self.path, self.key)
+
+    def render(self):
+        return "%s %s:%d [%s] %s" % (
+            self.rule, self.path, self.line, self.key, self.message)
+
+
+class SourceFile:
+    """One parsed file: source text, AST, and per-line rule disables."""
+
+    def __init__(self, path, relpath):
+        self.path = path
+        self.relpath = relpath
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        try:
+            self.tree = ast.parse(self.source, filename=relpath)
+        except SyntaxError as exc:
+            self.tree = None
+            self.syntax_error = exc
+        self.disabled = {}  # lineno -> set of rule ids
+        for i, line in enumerate(self.lines, 1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                self.disabled[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    def is_disabled(self, rule, line):
+        return rule in self.disabled.get(line, ())
+
+
+class Project:
+    """The scanned file set plus the repo root (for reading docs and
+    registry files that live outside the scanned paths)."""
+
+    def __init__(self, root, files):
+        self.root = root
+        self.files = files  # relpath -> SourceFile
+
+    def read_doc(self, name):
+        """Text of a root-level doc file ('' when absent)."""
+        p = os.path.join(self.root, name)
+        if not os.path.exists(p):
+            return ""
+        with open(p, "r", encoding="utf-8", errors="replace") as f:
+            return f.read()
+
+
+def collect(paths, root):
+    """Expand ``paths`` (files or directories, relative to ``root``)
+    into a Project."""
+    files = {}
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if not d.startswith(".")
+                               and d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        fp = os.path.join(dirpath, fn)
+                        rel = os.path.relpath(fp, root).replace(os.sep, "/")
+                        files[rel] = SourceFile(fp, rel)
+        elif full.endswith(".py") and os.path.exists(full):
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            files[rel] = SourceFile(full, rel)
+    return Project(root, files)
+
+
+def load_baseline(path):
+    """[{rule, path, key, why}, ...]; missing file -> empty."""
+    if not path or not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("suppressions", [])
+    for e in entries:
+        for field in ("rule", "path", "key", "why"):
+            if field not in e:
+                raise ValueError(
+                    "baseline entry missing %r: %r" % (field, e))
+    return entries
+
+
+def run(paths, root, baseline_path=DEFAULT_BASELINE, rules=None):
+    """Run all rules. Returns (findings, suppressed, unused_baseline)
+    where ``findings`` are the non-suppressed ones."""
+    from . import locks, registry, hygiene
+
+    project = collect(paths, root)
+    all_rules = rules or (locks.check, registry.check, hygiene.check)
+    raw = []
+    for sf in project.files.values():
+        if sf.tree is None:
+            raw.append(Finding(
+                "L000", sf.relpath, sf.syntax_error.lineno or 0,
+                "syntax-error", "file does not parse: %s" % sf.syntax_error))
+    for rule in all_rules:
+        raw.extend(rule(project))
+    # inline disables + dedupe (one finding per (rule,path,key,line))
+    visible, seen = [], set()
+    for f in raw:
+        sf = project.files.get(f.path)
+        if sf is not None and sf.is_disabled(f.rule, f.line):
+            continue
+        if (f.ident, f.line) in seen:
+            continue
+        seen.add((f.ident, f.line))
+        visible.append(f)
+    # baseline
+    entries = load_baseline(baseline_path)
+    suppress = {(e["rule"], e["path"], e["key"]): e for e in entries}
+    used = set()
+    findings, suppressed = [], []
+    for f in visible:
+        if f.ident in suppress:
+            used.add(f.ident)
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    unused = [e for e in entries
+              if (e["rule"], e["path"], e["key"]) not in used]
+    findings.sort(key=lambda f: (f.rule, f.path, f.line, f.key))
+    return findings, suppressed, unused
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="mxlint",
+        description="mxnet-tpu codebase linter (rules L001-L004; see "
+                    "TOOLING.md)")
+    ap.add_argument("paths", nargs="+",
+                    help="files or directories to scan (repo-relative)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: cwd)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression baseline JSON (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings too")
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    baseline = None if args.no_baseline else args.baseline
+    findings, suppressed, unused = run(args.paths, root,
+                                       baseline_path=baseline)
+    for f in findings:
+        print(f.render())
+    if suppressed:
+        print("mxlint: %d finding(s) suppressed by baseline" %
+              len(suppressed), file=sys.stderr)
+    for e in unused:
+        print("mxlint: warning: unused baseline entry %s %s [%s]" %
+              (e["rule"], e["path"], e["key"]), file=sys.stderr)
+    if findings:
+        print("mxlint: %d finding(s)" % len(findings), file=sys.stderr)
+        return 1
+    print("mxlint: clean (%d file(s) scanned)" % len(
+        collect(args.paths, root).files), file=sys.stderr)
+    return 0

@@ -1,0 +1,118 @@
+"""L004 thread hygiene:
+
+* ``baseexcept:<func>[#n]`` — an ``except BaseException`` handler whose
+  body neither re-raises nor stores/uses the caught exception. Die-kind
+  fault injection raises ``SimulatedWorkerDeath`` (a ``BaseException``
+  precisely so ``except Exception`` can't swallow it); a silent
+  ``except BaseException: pass`` defeats that design. The
+  store-and-rethrow pattern (``box["exc"] = exc``) is allowed.
+* ``unnamed-thread:<func>`` — a ``threading.Thread`` created in a
+  ``mxnet_tpu/`` module that never calls
+  ``profiler.register_thread_name`` (flight-recorder entries and trace
+  lanes from that thread would be anonymous).
+* ``daemon-liveness:<func>`` — a ``daemon=True`` thread in a module
+  with no liveness probe at all (no ``is_alive``/``alive()`` check, no
+  ``join``, no ``register_health_provider``): a silently-dead daemon
+  loop is invisible until its work stops happening.
+"""
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding
+
+_LIVENESS_MARKERS = ("is_alive", ".alive(", "register_health_provider",
+                     ".join(")
+
+
+def _terminal(func):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _enclosing_functions(tree):
+    """Yield (qualname, node) for every function, with class prefix."""
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, prefix + child.name + ".")
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield prefix + child.name, child
+                yield from walk(child, prefix + child.name + ".")
+            else:
+                yield from walk(child, prefix)
+    yield "<module>", tree
+    yield from walk(tree, "")
+
+
+def _scope_nodes(node):
+    """Nodes belonging to this scope only: nested function bodies are
+    pruned (they are their own scopes), class bodies are not."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _handler_ok(handler):
+    """True when the BaseException handler re-raises or stores/uses
+    the caught exception (the deliberate rethrow-later pattern)."""
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if handler.name and isinstance(node, ast.Name) \
+                and node.id == handler.name:
+            return True
+    return False
+
+
+def check(project):
+    findings = []
+    for rel, sf in project.files.items():
+        if sf.tree is None or not rel.startswith("mxnet_tpu/"):
+            continue
+        has_thread_name = "register_thread_name" in sf.source
+        has_liveness = any(m in sf.source for m in _LIVENESS_MARKERS)
+        for qualname, fn in _enclosing_functions(sf.tree):
+            n_be = 0
+            for node in _scope_nodes(fn):
+                if isinstance(node, ast.ExceptHandler) \
+                        and node.type is not None \
+                        and _terminal(node.type) == "BaseException":
+                    if not _handler_ok(node):
+                        suffix = "" if n_be == 0 else "#%d" % n_be
+                        n_be += 1
+                        findings.append(Finding(
+                            "L004", rel, node.lineno,
+                            "baseexcept:%s%s" % (qualname, suffix),
+                            "except BaseException that neither re-raises "
+                            "nor stores the exception would swallow "
+                            "die-kind fault injection"))
+                elif isinstance(node, ast.Call) \
+                        and _terminal(node.func) == "Thread":
+                    if not has_thread_name:
+                        findings.append(Finding(
+                            "L004", rel, node.lineno,
+                            "unnamed-thread:%s" % qualname,
+                            "thread created in a module that never calls "
+                            "profiler.register_thread_name — its "
+                            "recorder/trace entries will be anonymous"))
+                    daemon = any(
+                        kw.arg == "daemon"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in node.keywords)
+                    if daemon and not has_liveness:
+                        findings.append(Finding(
+                            "L004", rel, node.lineno,
+                            "daemon-liveness:%s" % qualname,
+                            "daemon thread in a module with no liveness "
+                            "probe (is_alive/alive()/join/health "
+                            "provider) — a dead loop here is invisible"))
+    return findings

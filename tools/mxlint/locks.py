@@ -1,0 +1,434 @@
+"""L001 (static lock-order cycles) + L002 (blocking calls under a held
+lock). Both share one lexical lock-region analysis:
+
+* a *lock node* is identified by the class that owns the attribute
+  (``DynamicBatcher._cond``) — resolved through one hop of
+  ``self.x = ClassName(...)`` attribute-type inference — or by the
+  module for module-level locks (``mxnet_tpu/engine.py::_pending_lock``);
+* ``with <lockish>:`` items open a region; nesting records an
+  acquisition-order edge (nearest enclosing holder -> new lock);
+* one interprocedural hop: a call to a method whose body acquires locks
+  adds edges from the current holder to those locks;
+* nested ``def``/``lambda`` bodies are analyzed with an EMPTY held set
+  (closures run later, not necessarily under the enclosing lock).
+
+Lockish = the terminal name matches ``lock|cond|quiesce|mutex``
+(case-insensitive), which covers ``_lock``, ``_cond``, ``_quiesce``,
+``_slock``, ``_TRACE_LOCK``, ``_pending_lock`` etc.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import Finding
+
+_LOCKISH = re.compile(r"lock|cond|quiesce|mutex", re.I)
+
+_BLOCKING_SYNC_ATTRS = ("asnumpy", "wait_to_read", "block_until_ready")
+
+
+def _is_lockish(name):
+    return bool(_LOCKISH.search(name))
+
+
+def _terminal(expr):
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+class _FileIndex:
+    """Per-file symbol info: classes, their attr types, functions."""
+
+    def __init__(self, sf):
+        self.sf = sf
+        self.attr_type = {}   # (classname, attr) -> type name
+        self.functions = []   # (classname|None, funcname, node)
+        if sf.tree is None:
+            return
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._index_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.append((None, node.name, node))
+
+    def _index_class(self, cls):
+        for node in cls.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self.functions.append((cls.name, node.name, node))
+            for stmt in ast.walk(node):
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Attribute)
+                        and isinstance(stmt.targets[0].value, ast.Name)
+                        and stmt.targets[0].value.id == "self"
+                        and isinstance(stmt.value, ast.Call)):
+                    callee = _terminal(stmt.value.func)
+                    if callee and callee[:1].isupper():
+                        self.attr_type[(cls.name, stmt.targets[0].attr)] \
+                            = callee
+
+
+class _Analysis:
+    def __init__(self, project):
+        self.project = project
+        self.indexes = {rel: _FileIndex(sf)
+                        for rel, sf in project.files.items()}
+        # (classname|module, funcname) -> set of lock keys acquired
+        self.fn_locks = {}
+        # (classname|module, funcname) -> [(kind, line)] blocking ops
+        # performed OUTSIDE any lock region of their own (they become
+        # blocking-under-lock when a caller holds a lock — one hop)
+        self.fn_blocking = {}
+        # (a_key, b_key) -> (path, line, via)
+        self.edges = {}
+        self.findings = []
+
+    # -- lock-node resolution -------------------------------------------
+    def resolve(self, expr, rel, classname):
+        """Lock-node key for a lockish ``with`` context expr, or None."""
+        term = _terminal(expr)
+        if term is None or not _is_lockish(term):
+            return None
+        if isinstance(expr, ast.Name):
+            return "%s::%s" % (rel, term)
+        # attribute chain
+        parts = []
+        cur = expr
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        parts.reverse()
+        if isinstance(cur, ast.Name) and cur.id == "self" and classname:
+            # self.a.b...._lock: resolve first hop through attr types
+            idx = self.indexes[rel]
+            owner = classname
+            for hop in parts[:-1]:
+                owner_t = idx.attr_type.get((owner, hop))
+                if owner_t is None:
+                    owner = "%s.%s" % (owner, hop)
+                else:
+                    owner = owner_t
+                    # allow the next hop to resolve in the owning class's
+                    # file too (cross-module): merge is implicit since
+                    # attr_type is per-file; fall back to dotted name
+            return "%s.%s" % (owner, parts[-1])
+        if isinstance(cur, ast.Name):
+            return "%s::%s.%s" % (rel, cur.id, ".".join(parts))
+        return None
+
+    def _attr_type_any(self, classname, attr):
+        for idx in self.indexes.values():
+            t = idx.attr_type.get((classname, attr))
+            if t is not None:
+                return t
+        return None
+
+    # -- pass 1: per-function acquired-lock sets ------------------------
+    def build_fn_locks(self):
+        for rel, idx in self.indexes.items():
+            for classname, fname, node in idx.functions:
+                acquired = set()
+                for stmt in ast.walk(node):
+                    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                        for item in stmt.items:
+                            key = self.resolve(item.context_expr, rel,
+                                               classname)
+                            if key:
+                                acquired.add(key)
+                owner = classname or rel
+                self.fn_locks.setdefault((owner, fname), set()).update(
+                    acquired)
+                blocking = self._unlocked_blocking_ops(node.body)
+                if blocking:
+                    self.fn_blocking.setdefault(
+                        (owner, fname), []).extend(blocking)
+
+    def _unlocked_blocking_ops(self, stmts):
+        """Blocking ops in these statements that are NOT inside a
+        lockish ``with`` of their own (those are flagged in place)."""
+        out = []
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                if any(_is_lockish(_terminal(it.context_expr) or "")
+                       for it in stmt.items):
+                    continue  # its own region: analyzed lexically
+                out.extend(self._unlocked_blocking_ops(stmt.body))
+                continue
+            for call in self._iter_calls(stmt):
+                term = _terminal(call.func)
+                if term == "sleep":
+                    out.append(("sleep", call.lineno))
+                elif term == "result" and isinstance(call.func,
+                                                     ast.Attribute) \
+                        and not self._zero_timeout(call):
+                    out.append(("future-result", call.lineno))
+                elif term in ("set_result", "set_exception") \
+                        and isinstance(call.func, ast.Attribute):
+                    out.append(("future-settle", call.lineno))
+                elif term in _BLOCKING_SYNC_ATTRS:
+                    out.append(("device-sync", call.lineno))
+            for body in self._child_bodies(stmt):
+                out.extend(self._unlocked_blocking_ops(body))
+        return out
+
+    # -- pass 2: lexical walk with a held stack -------------------------
+    def analyze_all(self):
+        for rel, idx in self.indexes.items():
+            for classname, fname, node in idx.functions:
+                self._walk_stmts(node.body, [], rel, classname, fname)
+
+    def _walk_stmts(self, stmts, held, rel, classname, fname):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # closure body runs later: fresh held set, and it is
+                # already registered as its own function when at class/
+                # module level; nested defs get analyzed here
+                self._walk_stmts(stmt.body, [], rel, classname,
+                                 "%s.%s" % (fname, stmt.name))
+                continue
+            if held:
+                self._scan_blocking(stmt, held, rel, classname, fname)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in stmt.items:
+                    key = self.resolve(item.context_expr, rel, classname)
+                    if key:
+                        if held and key != held[-1][0]:
+                            self._edge(held[-1][0], key, rel,
+                                       stmt.lineno, via="with")
+                        acquired.append((key, stmt.lineno))
+                self._walk_stmts(stmt.body, held + acquired, rel,
+                                 classname, fname)
+                continue
+            for body in self._child_bodies(stmt):
+                self._walk_stmts(body, held, rel, classname, fname)
+        # interprocedural hop: calls made while holding a lock
+        # (handled inside _scan_blocking to share the call walk)
+
+    @staticmethod
+    def _child_bodies(stmt):
+        for field in ("body", "orelse", "finalbody"):
+            b = getattr(stmt, field, None)
+            if b:
+                yield b
+        for h in getattr(stmt, "handlers", ()) or ():
+            yield h.body
+
+    def _iter_exprs(self, node):
+        """Expression nodes belonging to this statement only: nested
+        functions and child statements are pruned (child statements are
+        visited by _walk_stmts with the right held set)."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if n is not node and isinstance(n, ast.stmt):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _iter_calls(self, node):
+        for n in self._iter_exprs(node):
+            if isinstance(n, ast.Call):
+                yield n
+
+    def _scan_blocking(self, stmt, held, rel, classname, fname):
+        where = "%s.%s" % (classname, fname) if classname else fname
+        holder = held[-1][0]
+        for node in self._iter_exprs(stmt):
+            # ._data loads force/inspect the device buffer — a sync
+            # hazard when the array is pending (ISSUE: device syncs
+            # under a held lock)
+            if isinstance(node, ast.Attribute) and node.attr == "_data" \
+                    and isinstance(node.ctx, ast.Load):
+                self._l002(rel, node.lineno, "data-sync:%s" % where,
+                           "._data access while holding %s" % holder)
+        for call in self._iter_calls(stmt):
+            func = call.func
+            term = _terminal(func)
+            if term is None:
+                continue
+            line = call.lineno
+            # ---- L002: blocking calls ------------------------------
+            if term == "sleep":
+                self._l002(rel, line, "sleep:%s" % where,
+                           "time.sleep() while holding %s" % holder)
+            elif term == "result" and isinstance(func, ast.Attribute):
+                if not self._zero_timeout(call):
+                    self._l002(rel, line, "future-result:%s" % where,
+                               "Future.result() while holding %s"
+                               % holder)
+            elif term == "join" and isinstance(func, ast.Attribute) \
+                    and isinstance(stmt, ast.Expr) and stmt.value is call:
+                self._l002(rel, line, "join:%s" % where,
+                           "Thread.join() while holding %s" % holder)
+            elif term in _BLOCKING_SYNC_ATTRS or term in ("wait_all",
+                                                          "waitall"):
+                self._l002(rel, line, "device-sync:%s:%s" % (term, where),
+                           "device sync %s() while holding %s"
+                           % (term, holder))
+            elif term in ("set_result", "set_exception") \
+                    and isinstance(func, ast.Attribute):
+                self._l002(rel, line, "future-settle:%s" % where,
+                           "future %s() while holding %s — done-"
+                           "callbacks run under the lock" % (term, holder))
+            elif term == "wait" and isinstance(func, ast.Attribute):
+                key = self.resolve(func.value, rel, classname)
+                held_keys = [k for k, _l in held]
+                if key is not None and key in held_keys \
+                        and len(held_keys) > 1:
+                    others = [k for k in held_keys if k != key]
+                    self._l002(rel, line, "wait-under-lock:%s" % where,
+                               "Condition.wait(%s) while holding %s"
+                               % (key, ", ".join(others)))
+            # ---- one-hop interprocedural: edges + blocking ---------
+            callee = self._callee_owner(func, rel, classname)
+            if callee is not None:
+                for lock in sorted(self.fn_locks.get(callee, ())):
+                    held_keys = [k for k, _l in held]
+                    if lock not in held_keys and lock != holder:
+                        self._edge(holder, lock, rel, line,
+                                   via="call %s.%s" % callee)
+                for kind, _bline in self.fn_blocking.get(callee, ()):
+                    self._l002(
+                        rel, line,
+                        "via-%s:%s->%s.%s" % (kind, where,
+                                              callee[0], callee[1]),
+                        "%s.%s() performs a %s and is called here "
+                        "while holding %s" % (callee[0], callee[1],
+                                              kind, holder))
+
+    @staticmethod
+    def _zero_timeout(call):
+        for kw in call.keywords:
+            if kw.arg == "timeout" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value == 0:
+                return True
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value == 0:
+            return True
+        return False
+
+    def _callee_owner(self, func, rel, classname):
+        """(owner, methodname) for self.m(...), self.x.m(...), or a
+        module-level f(...) — None when unresolvable."""
+        if isinstance(func, ast.Name):
+            key = (rel, func.id)
+            return key if key in self.fn_locks else None
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and classname:
+                key = (classname, func.attr)
+                return key if key in self.fn_locks else None
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self" and classname:
+                t = self._attr_type_any_local(rel, classname, base.attr)
+                if t is not None:
+                    key = (t, func.attr)
+                    return key if key in self.fn_locks else None
+        return None
+
+    def _attr_type_any_local(self, rel, classname, attr):
+        t = self.indexes[rel].attr_type.get((classname, attr))
+        if t is not None:
+            return t
+        return self._attr_type_any(classname, attr)
+
+    def _l002(self, rel, line, key, message):
+        self.findings.append(Finding("L002", rel, line, key, message))
+
+    def _edge(self, a, b, rel, line, via):
+        if a == b:
+            return
+        self.edges.setdefault((a, b), (rel, line, via))
+
+    # -- cycle reporting -------------------------------------------------
+    def report_cycles(self):
+        adj = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+        for scc in _sccs(adj):
+            if len(scc) < 2:
+                continue
+            nodes = sorted(scc)
+            involved = [(e, meta) for e, meta in self.edges.items()
+                        if e[0] in scc and e[1] in scc]
+            rel, line, _via = involved[0][1]
+            detail = "; ".join(
+                "%s->%s (%s:%d via %s)" % (a, b, r, ln, v)
+                for (a, b), (r, ln, v) in sorted(involved))
+            self.findings.append(Finding(
+                "L001", rel, line, "cycle:%s" % "->".join(nodes),
+                "lock-order cycle between {%s}: %s"
+                % (", ".join(nodes), detail)))
+
+
+def _sccs(adj):
+    """Tarjan SCCs (iterative) over a {node: set(node)} digraph."""
+    index = {}
+    low = {}
+    onstack = set()
+    stack = []
+    out = []
+    counter = [0]
+    nodes = set(adj)
+    for vs in adj.values():
+        nodes |= vs
+
+    for root in sorted(nodes):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        onstack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in onstack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.add(w)
+                    if w == v:
+                        break
+                out.append(scc)
+    return out
+
+
+def check(project):
+    an = _Analysis(project)
+    an.build_fn_locks()
+    an.analyze_all()
+    an.report_cycles()
+    return an.findings

@@ -1,0 +1,231 @@
+"""L003 registry drift: the string-keyed registries (config flags,
+fault sites, counter names) must agree with their read sites and docs.
+
+Checks:
+
+* ``unregistered-read:NAME`` — a direct ``os.environ`` /
+  ``os.getenv`` read of an ``MXNET_*`` variable inside ``mxnet_tpu/``
+  that is not registered in ``config.py``;
+* ``unknown-flag:NAME`` — ``config.get("NAME")`` / ``is_set`` of an
+  unregistered name (would ``KeyError`` at runtime);
+* ``dead-flag:NAME`` — a registered flag no scanned file reads;
+* ``undocumented-flag:NAME`` — a registered flag with no knob row in
+  any doc file (README.md / SERVING.md / RESILIENCE.md /
+  OBSERVABILITY.md / PERF.md / TRAINING.md / TOOLING.md);
+* ``undeclared-site:SITE`` — a fired fault site missing from
+  ``resilience/faults.py`` ``KNOWN_SITES``;
+* ``undocumented-site:SITE`` — a fired fault site absent from
+  RESILIENCE.md;
+* ``bad-counter:NAME`` — an ``incr_counter``/``counters.incr`` name
+  that is not namespaced, or whose namespace ``export.snapshot()``
+  does not merge;
+* ``export-namespace-drift:NS`` — the rule's namespace allow-list no
+  longer matches ``profiler/export.py`` (keeps this rule honest).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import Finding
+
+CONFIG_FILE = "mxnet_tpu/config.py"
+FAULTS_FILE = "mxnet_tpu/resilience/faults.py"
+EXPORT_FILE = "mxnet_tpu/profiler/export.py"
+
+DOC_FILES = ("README.md", "SERVING.md", "RESILIENCE.md",
+             "OBSERVABILITY.md", "PERF.md", "TRAINING.md", "TOOLING.md")
+
+# namespaces profiler/export.snapshot() merges into one surface; each
+# must literally appear (as "<ns>.") in export.py or we flag drift
+COUNTER_NAMESPACES = ("profiler", "engine", "cachedop", "kvstore",
+                      "resilience", "serve", "fleet", "recorder", "trace",
+                      "registry")
+
+_FLAG_TOKEN = re.compile(r"^MXNET_[A-Z0-9_]+$")
+
+
+def _str_arg(call, i=0):
+    if len(call.args) > i and isinstance(call.args[i], ast.Constant) \
+            and isinstance(call.args[i].value, str):
+        return call.args[i].value
+    return None
+
+
+def _fstr_prefix(call, i=0):
+    """Literal prefix of an f-string first arg ('' when none)."""
+    if len(call.args) > i and isinstance(call.args[i], ast.JoinedStr):
+        vals = call.args[i].values
+        if vals and isinstance(vals[0], ast.Constant):
+            return str(vals[0].value)
+        return ""
+    return None
+
+
+def _terminal(func):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _receiver(func):
+    if isinstance(func, ast.Attribute):
+        if isinstance(func.value, ast.Name):
+            return func.value.id
+        if isinstance(func.value, ast.Attribute):
+            return func.value.attr
+    return None
+
+
+def check(project):
+    findings = []
+
+    # -- registered flags -------------------------------------------------
+    registered = {}   # name -> lineno
+    cfg = project.files.get(CONFIG_FILE)
+    if cfg is not None and cfg.tree is not None:
+        for node in ast.walk(cfg.tree):
+            if isinstance(node, ast.Call) \
+                    and _terminal(node.func) == "register_flag":
+                name = _str_arg(node)
+                if name:
+                    registered[name] = node.lineno
+
+    # -- declared fault sites --------------------------------------------
+    declared_sites = set()
+    faults = project.files.get(FAULTS_FILE)
+    if faults is not None and faults.tree is not None:
+        for node in faults.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "KNOWN_SITES"
+                            for t in node.targets) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        declared_sites.add(elt.value)
+
+    # -- walk all scanned files ------------------------------------------
+    env_reads = {}     # NAME -> (path, line) first read via os.environ
+    flag_reads = set()  # names read via config.get/is_set or environ
+    fired_sites = {}   # SITE -> (path, line)
+    counter_uses = {}  # NAME-or-prefix -> (path, line, is_prefix)
+
+    for rel, sf in project.files.items():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            # any exact MXNET_* string literal outside config.py counts
+            # as a *use* for dead-flag purposes: reads route through
+            # helpers (`_flag("MXNET_X")`, `_env_policy("MXNET_X")`),
+            # and launcher-side environ writes are the producer half
+            if rel != CONFIG_FILE and isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and _FLAG_TOKEN.match(node.value):
+                flag_reads.add(node.value)
+            # environ["X"] subscript reads
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and _terminal(node.value) == "environ" \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str) \
+                    and node.slice.value.startswith("MXNET_"):
+                env_reads.setdefault(node.slice.value, (rel, node.lineno))
+                flag_reads.add(node.slice.value)
+            if not isinstance(node, ast.Call):
+                continue
+            term = _terminal(node.func)
+            recv = _receiver(node.func)
+            arg = _str_arg(node)
+            if term in ("get", "getenv") and recv in ("environ", "os",
+                                                      "_os"):
+                if arg and arg.startswith("MXNET_"):
+                    env_reads.setdefault(arg, (rel, node.lineno))
+                    flag_reads.add(arg)
+            elif term in ("get", "is_set") \
+                    and recv in ("config", "_cfg", "_config", "cfg"):
+                if arg and arg.startswith("MXNET_"):
+                    flag_reads.add(arg)
+                    if arg not in registered:
+                        findings.append(Finding(
+                            "L003", rel, node.lineno,
+                            "unknown-flag:%s" % arg,
+                            "config.%s(%r): flag is not registered in "
+                            "config.py" % (term, arg)))
+            elif term == "fault_point" or (
+                    term == "check" and recv
+                    and ("fault" in recv.lower() or recv == "plan")):
+                if arg and ":" in arg:
+                    fired_sites.setdefault(arg, (rel, node.lineno))
+            elif term in ("incr_counter", "set_counter") \
+                    or (term == "incr" and recv
+                        and "counter" in recv.lower()):
+                if arg is not None:
+                    counter_uses.setdefault(
+                        arg, (rel, node.lineno, False))
+                else:
+                    pre = _fstr_prefix(node)
+                    if pre is not None:
+                        counter_uses.setdefault(
+                            pre, (rel, node.lineno, True))
+
+    # -- flag checks ------------------------------------------------------
+    for name, (rel, line) in sorted(env_reads.items()):
+        if rel.startswith("mxnet_tpu/") and name not in registered:
+            findings.append(Finding(
+                "L003", rel, line, "unregistered-read:%s" % name,
+                "os.environ read of %s which is not registered in "
+                "config.py" % name))
+    docs = "\n".join(project.read_doc(d) for d in DOC_FILES)
+    for name, line in sorted(registered.items()):
+        if name not in flag_reads:
+            findings.append(Finding(
+                "L003", CONFIG_FILE, line, "dead-flag:%s" % name,
+                "registered flag %s is never read in the scanned tree"
+                % name))
+        if name not in docs:
+            findings.append(Finding(
+                "L003", CONFIG_FILE, line, "undocumented-flag:%s" % name,
+                "registered flag %s has no knob row in any of %s"
+                % (name, ", ".join(DOC_FILES))))
+
+    # -- fault-site checks ------------------------------------------------
+    resilience_md = project.read_doc("RESILIENCE.md")
+    for site, (rel, line) in sorted(fired_sites.items()):
+        if site not in declared_sites:
+            findings.append(Finding(
+                "L003", rel, line, "undeclared-site:%s" % site,
+                "fault site %r fired here is not in faults.KNOWN_SITES"
+                % site))
+        elif site not in resilience_md:
+            findings.append(Finding(
+                "L003", rel, line, "undocumented-site:%s" % site,
+                "fault site %r is not documented in RESILIENCE.md"
+                % site))
+
+    # -- counter-namespace checks ----------------------------------------
+    export_src = ""
+    exp = project.files.get(EXPORT_FILE)
+    if exp is not None:
+        export_src = exp.source
+    for ns in COUNTER_NAMESPACES:
+        if export_src and ("%s." % ns) not in export_src:
+            findings.append(Finding(
+                "L003", EXPORT_FILE, 1,
+                "export-namespace-drift:%s" % ns,
+                "namespace %r in the mxlint allow-list no longer "
+                "appears in export.py" % ns))
+    for name, (rel, line, is_prefix) in sorted(counter_uses.items()):
+        ns = name.split(".", 1)[0] if "." in name else None
+        if ns is None and is_prefix:
+            continue  # f-string with dynamic namespace: give up
+        if ns is None or ns not in COUNTER_NAMESPACES:
+            findings.append(Finding(
+                "L003", rel, line, "bad-counter:%s" % (name or "<dyn>"),
+                "counter %r is not namespaced under one of %s (the "
+                "namespaces profiler/export.snapshot() merges)"
+                % (name, "/".join(COUNTER_NAMESPACES))))
+    return findings

@@ -137,6 +137,48 @@ if [[ "${TIER1_CB:-0}" != "0" ]]; then
         rc=$cb_rc
     fi
 fi
+# Static-analysis gate (TIER1_LINT=0 to skip): tools/mxlint over the
+# whole tree — lock-order cycles (L001), blocking calls under held locks
+# (L002), flag/fault-site/counter registry drift (L003), and thread
+# hygiene (L004). Exits nonzero on any finding not covered by
+# tools/mxlint/baseline.json; see TOOLING.md for the rule catalog.
+if [[ "${TIER1_LINT:-1}" != "0" ]]; then
+    timeout -k 10 120 env JAX_PLATFORMS=cpu \
+        python -m tools.mxlint mxnet_tpu tools bench.py
+    lint_rc=$?
+    if [[ "$rc" -eq 0 && "$lint_rc" -ne 0 ]]; then
+        rc=$lint_rc
+    fi
+fi
+# Lockdep pass (TIER1_LOCKDEP=0 to skip): re-run the serve smoke and the
+# fleet + continuous-batching soaks with the runtime lock-order
+# sanitizer on (MXNET_LOCKDEP=1). Every threading.Lock/RLock/Condition
+# created after startup is wrapped; the sanitizer records the
+# acquisition-order graph, dumps any cycle or blocking-under-lock
+# violation through the flight recorder, and smoke_gate() escalates the
+# exit status on cycles (the LOCKDEP= summary line is printed either
+# way).
+if [[ "${TIER1_LOCKDEP:-1}" != "0" ]]; then
+    timeout -k 10 120 env JAX_PLATFORMS=cpu MXNET_LOCKDEP=1 \
+        python tools/serve_smoke.py
+    ld_rc=$?
+    if [[ "$rc" -eq 0 && "$ld_rc" -ne 0 ]]; then
+        rc=$ld_rc
+    fi
+    timeout -k 10 240 env JAX_PLATFORMS=cpu MXNET_LOCKDEP=1 \
+        python tools/chaos_soak.py --fleet \
+        --duration "${TIER1_FLEET_S:-6}" --clients 64
+    ld_rc=$?
+    if [[ "$rc" -eq 0 && "$ld_rc" -ne 0 ]]; then
+        rc=$ld_rc
+    fi
+    timeout -k 10 180 env JAX_PLATFORMS=cpu MXNET_LOCKDEP=1 \
+        python tools/chaos_soak.py --cb --duration "${TIER1_CB_S:-4}"
+    ld_rc=$?
+    if [[ "$rc" -eq 0 && "$ld_rc" -ne 0 ]]; then
+        rc=$ld_rc
+    fi
+fi
 # Elastic soak smoke (TIER1_ELASTIC=0 to skip): one seeded
 # kill/lag/corrupt sweep through a dp8 training loop — asserts the
 # chip-loss dp8->dp4 resume lands bitwise on the dp4 reference run,
