@@ -29,6 +29,8 @@ aux-state mutation semantics without mutation inside the compiled graph.
 """
 from __future__ import annotations
 
+import hashlib as _hashlib
+import json as _json
 import threading
 import time
 import warnings
@@ -55,7 +57,8 @@ _instances: "weakref.WeakSet" = weakref.WeakSet()
 def cache_stats():
     """Process-wide signature-cache telemetry: the per-instance
     :meth:`CachedOp.cache_stats` fields summed over every live CachedOp
-    (plus the instance count)."""
+    (plus the instance count and the persistent compile cache's
+    disk_hits/disk_misses — see :mod:`mxnet_tpu.compile_cache`)."""
     agg = {"instances": 0, "hits": 0, "misses": 0, "signatures": 0,
            "serve_hits": 0, "compile_ms": 0.0}
     for op in list(_instances):
@@ -64,10 +67,51 @@ def cache_stats():
         for k in ("hits", "misses", "signatures", "serve_hits",
                   "compile_ms"):
             agg[k] += s[k]
+    from . import compile_cache as _cc
+
+    agg["disk_hits"] = _cc.disk_hits()
+    agg["disk_misses"] = _cc.disk_misses()
     return agg
 
 # sentinel marking a traced (array) position in a CachedOp call signature
 _TRACED = object()
+
+
+def _stable_form(x):
+    """Recursively normalize one signature-key element to a
+    JSON-serializable, process-independent form. The sentinel and any
+    exotic hashable static arg map to type-tagged strings — never to
+    ``repr`` (which can leak ``0x...`` object ids)."""
+    if x is _TRACED:
+        return "<traced>"
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    if isinstance(x, (bytes, bytearray)):
+        return "bytes:" + bytes(x).hex()
+    if isinstance(x, (tuple, list)):
+        return [_stable_form(e) for e in x]
+    if isinstance(x, (frozenset, set)):
+        return sorted((_stable_form(e) for e in x), key=_json_sort_key)
+    if isinstance(x, dict):
+        return {str(k): _stable_form(v) for k, v in sorted(x.items())}
+    return f"<{type(x).__name__}>"
+
+
+def _json_sort_key(e):
+    return _json.dumps(e, sort_keys=True)
+
+
+def stable_signature_key(key, compiler_options=None):
+    """Process-independent serialized form of one CachedOp signature key:
+    canonical JSON of the normalized key (+ sorted compiler options),
+    SHA-256 hexdigest. Two processes tracing the same model over the
+    same bucket lattice produce identical digests — the contract disk-
+    level caches key on (regression-pinned in tests/test_compile_cache)."""
+    doc = {"key": _stable_form(key),
+           "compiler_options": _stable_form(
+               dict(compiler_options) if compiler_options else {})}
+    blob = _json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return _hashlib.sha256(blob.encode()).hexdigest()
 
 
 def _sig_limit():
@@ -170,6 +214,18 @@ class CachedOp:
         padded shapes are resident."""
         return list(self._cache.keys())
 
+    def signature_keys(self):
+        """Stable, process-independent serialized signature keys (sorted
+        SHA-256 hexdigests via :func:`stable_signature_key`, compiler
+        options folded in). Raw ``bucket_keys()`` contain the ``_TRACED``
+        sentinel — an object whose identity (and thus repr) differs per
+        process; these digests do not, so two processes warming the same
+        model over the same bucket lattice report identical keys (the
+        disk compile cache's keying contract)."""
+        return sorted(
+            stable_signature_key(k, self._compiler_options)
+            for k in self._cache)
+
     def record_serve_hit(self, n=1):
         """Count ``n`` warm serve-path executions into ``cache_stats()``.
         Called by ``serve.engine.InferenceSession`` after a call that hit
@@ -197,6 +253,12 @@ class CachedOp:
             return entry
         self._misses += 1
         self._call_tls.compiled = True
+        # every signature miss routes its jax.jit lowering through the
+        # persistent disk cache when MXNET_COMPILE_CACHE_DIR is set —
+        # enable() is an idempotent no-op otherwise
+        from . import compile_cache as _cc
+
+        _cc.enable()
         t0 = time.perf_counter_ns()
         entry = self._build_with_retry(key, grad_mode, args_tracked,
                                        static_args)

@@ -325,6 +325,32 @@ register_flag(
     "Bounds how long a long prompt can stall live decode streams (one "
     "chunk per iteration).", int)
 register_flag(
+    "MXNET_SERVE_PREFIX_CACHE", False,
+    "Cross-request KV prefix reuse (serve.prefix_cache.PrefixCache): a "
+    "radix trie over prompt token ids maps matched prefixes to "
+    "refcounted pages in the paged KV pool, so admission skips the "
+    "matched portion of chunked prefill. Shared pages are read-only "
+    "(copy-on-extend at page granularity); LRU eviction reclaims cached "
+    "prefixes only under pool pressure. Greedy outputs stay "
+    "token-identical to a cache-off run.", _bool)
+register_flag(
+    "MXNET_COMPILE_CACHE_DIR", "",
+    "Directory backing the persistent compile cache "
+    "(mxnet_tpu.compile_cache, JAX persistent compilation cache "
+    "substrate): executables keyed on the stable serialization of "
+    "CachedOp signature keys + compiler options land on disk, so "
+    "warmup() in a fresh process replays the bucket lattice from disk "
+    "instead of recompiling (cache_stats() grows disk_hits/disk_misses). "
+    "Empty (default) disables.", str)
+register_flag(
+    "MXNET_SERVE_MAX_MODELS", 4,
+    "Resident-model budget for serve.tenancy.ModelRegistry: at most "
+    "this many named models (executables + per-tenant KV pool + prefix "
+    "trie) stay loaded per process; loading past the budget LRU-evicts "
+    "the coldest idle tenant. Evicted models reload via load() — warm "
+    "from the disk compile cache when MXNET_COMPILE_CACHE_DIR is "
+    "set.", int)
+register_flag(
     "MXNET_SERVE_SPEC_TOKENS", 4,
     "Draft tokens proposed per speculative-decoding round "
     "(serve.SpeculativeGenerator's default k): each round costs k draft "

@@ -95,6 +95,15 @@ def snapshot(include_aggregates=True):
     if cop is not None:
         _flatten("cachedop", cop.cache_stats(), out)
 
+    cc = sys.modules.get("mxnet_tpu.compile_cache")
+    if cc is not None:
+        _flatten("compile_cache", cc.stats(), out)
+
+    tenancy = sys.modules.get("mxnet_tpu.serve.tenancy")
+    if tenancy is not None:
+        for name, snap in tenancy.registry_stats().items():
+            _flatten(f"tenancy.{name}", snap, out)
+
     kv = sys.modules.get("mxnet_tpu.kvstore.dist_tpu")
     if kv is not None:
         _flatten("kvstore", kv.collective_stats(), out)

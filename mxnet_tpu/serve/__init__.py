@@ -31,6 +31,16 @@ framework's own substrate:
   lattice (two compiled signatures total), with KV state in a paged
   block pool (reserve-at-admit, recycle-on-retire, null-page masking
   for idle lanes).
+* :class:`PrefixCache` (``prefix_cache``) — cross-request KV reuse: a
+  radix trie over prompt token ids maps matched prefixes to refcounted
+  pages in the paged pool (copy-on-extend sharing, LRU eviction only
+  under pool pressure), so admission skips the matched portion of
+  chunked prefill with token-identical greedy output.
+* :class:`ModelRegistry` (``tenancy``) — N named models per process,
+  each behind its own engine (per-tenant pool + prefix trie), LRU
+  eviction of cold tenants under ``MXNET_SERVE_MAX_MODELS``, reload
+  warm from the persistent compile cache, routed via
+  ``submit(model=...)``.
 * :class:`Router` / :class:`Replica` (``fleet``, ``replica``) — the
   fleet layer: health-aware least-loaded dispatch over N replicas,
   replica failover with exactly-once settlement (idempotency keys +
@@ -51,8 +61,10 @@ from .generate import Generator, KVCache, SpeculativeGenerator, \
     resolve_decode_path, sample_tokens
 from .kv_blocks import PagedKVPool, resolve_page_size
 from .metrics import ServeMetrics, percentile
+from .prefix_cache import PrefixCache
 from .replica import Replica
 from .scheduler import ContinuousEngine
+from .tenancy import ModelRegistry, registry_stats
 
 __all__ = [
     "InferenceSession", "DynamicBatcher", "Generator", "KVCache",
@@ -61,5 +73,6 @@ __all__ = [
     "TokenBucket", "PRIORITIES",
     "Router", "Replica", "QueueDepthPolicy", "fleet_stats",
     "ContinuousEngine", "PagedKVPool", "resolve_page_size",
+    "PrefixCache", "ModelRegistry", "registry_stats",
     "sample_tokens", "pick_bucket", "percentile", "resolve_decode_path",
 ]

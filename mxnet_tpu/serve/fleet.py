@@ -752,6 +752,14 @@ class Router:
         n = int(n)
         if n < 0:
             raise ServeError(f"scale_to({n}): target must be >= 0")
+        if self.replica_count() < n:
+            # scale-up warms from the persistent compile cache when
+            # MXNET_COMPILE_CACHE_DIR is set: the factory's session
+            # warmup replays the bucket lattice from disk instead of
+            # paying the XLA compile storm per new replica
+            from .. import compile_cache as _cc
+
+            _cc.enable()
         while self.replica_count() < n:
             if self.factory is None:
                 raise ServeError(

@@ -33,7 +33,7 @@ from ..profiler import trace as _trace
 from ..gluon.block import HybridBlock
 from ..ops import nn as _ops
 from ..resilience import faults as _faults
-from .engine import InferenceSession, pick_bucket
+from .engine import InferenceSession, PoolExhausted, pick_bucket
 
 
 class _LayerKV:
@@ -424,7 +424,7 @@ class Generator:
     def __init__(self, model, max_seq=128, batch_buckets=(1, 2, 4),
                  prompt_buckets=None, pad_id=0, name="llama_decode",
                  decode_path=None, paged=None, page_size=None,
-                 kv_pages=None):
+                 kv_pages=None, prefix_cache=None):
         from .. import config
 
         self.model = model
@@ -446,10 +446,23 @@ class Generator:
         self._qindex, self._qflat = [], []
         if self._quant and _int8_weights_enabled():
             self._qindex, self._qflat = _quantize_serving_weights(model)
+        if prefix_cache is None:
+            prefix_cache = bool(config.get("MXNET_SERVE_PREFIX_CACHE"))
+        self._prefix_on = bool(prefix_cache)
+        if self._prefix_on and paged is False:
+            raise MXNetError(
+                "prefix_cache requires the paged KV pool (prefix pages "
+                "are shared pool pages); don't pass paged=False with "
+                "prefix_cache on")
         self._paged = (bool(config.get("MXNET_SERVE_KV_PAGED"))
-                       if paged is None else bool(paged))
+                       if paged is None else bool(paged)) or self._prefix_on
         self._page_size = page_size
         self._kv_pages = kv_pages
+        self._prefix = {}  # batch bucket -> PrefixCache over its pool
+        # speculative decoding sets this to k+1: its verify/draft rounds
+        # write that many ring positions past the accepted prefix, so
+        # per-request page budgets must cover them
+        self._budget_headroom = 0
         # fast rungs fuse the paging brackets into the step; the strict
         # baseline rung keeps the RING executable and runs the brackets
         # as standalone exact copies in _run — that's what makes paged
@@ -488,6 +501,7 @@ class Generator:
         request has actually written."""
         if self._paged:
             from .kv_blocks import PagedKVPool
+            from .prefix_cache import PrefixCache
 
             pool = self._zero_caches.get(batch_bucket)
             if pool is None:
@@ -495,8 +509,16 @@ class Generator:
                                    page_size=self._page_size,
                                    num_pages=self._kv_pages,
                                    quant=self._quant)
-                for s in range(batch_bucket):
-                    pool.assign(s, self.max_seq)
+                if self._prefix_on:
+                    # prefix mode: slots are assigned per generate()
+                    # (per-request budgets + trie-matched prefix pages)
+                    # instead of pinned identity tables, and the bucket
+                    # gets its radix trie over this pool
+                    self._prefix[batch_bucket] = PrefixCache(
+                        pool, name=f"{self.session.name}_prefix")
+                else:
+                    for s in range(batch_bucket):
+                        pool.assign(s, self.max_seq)
                 self._zero_caches[batch_bucket] = pool
                 self.metrics.set_kv_cache_bytes(
                     sum(c.nbytes()
@@ -589,6 +611,84 @@ class Generator:
         toks[len(prompts):, 0] = toks[0, 0]
         return toks, full_lens, b_bucket
 
+    # -- prefix-cache plumbing (PR 14) --------------------------------------
+    def _prefix_begin(self, prompts, toks, lens, b_bucket, max_new):
+        """Reserve the batch's slots in the bucket's pool. With the
+        prefix trie on, each real row's longest cached prefix arrives as
+        shared (refcounted) pages at the front of its table row and its
+        ``matched`` count says how many prompt tokens skip prefill; pool
+        pressure LRU-evicts cached prefixes (never the pages just
+        matched) before surfacing :class:`PoolExhausted`. Returns
+        ``(cache, matched)``; non-prefix mode returns the persistent
+        fully-assigned pool and all-zero ``matched``."""
+        cache = self._fresh_cache(b_bucket)
+        matched = _onp.zeros(b_bucket, _onp.int32)
+        if not self._prefix_on:
+            return cache, matched
+        trie = self._prefix[b_bucket]
+        try:
+            for s in range(b_bucket):
+                if s < len(prompts):
+                    row = [int(t) for t in prompts[s]]
+                    m, pages = trie.match(row)
+                else:  # dead padding lane: 1-token prompt, never cached
+                    row, m, pages = [int(toks[s, 0])], 0, ()
+                budget = min(len(row) + int(max_new)
+                             + self._budget_headroom, self.max_seq)
+                try:
+                    cache.assign_with_prefix(s, budget, pages)
+                except PoolExhausted:
+                    shortfall = (cache.pages_for(budget) - len(pages)
+                                 - cache.pages_free)
+                    if trie.reclaim(max(shortfall, 1),
+                                    exclude=pages) == 0:
+                        raise
+                    cache.assign_with_prefix(s, budget, pages)
+                matched[s] = m
+                if s < len(prompts):
+                    self.metrics.observe_prefix(m)
+        except BaseException:
+            for s in range(b_bucket):
+                cache.release(s)
+            raise
+        return cache, matched
+
+    def _prefix_prefill(self, toks, lens, matched, cache):
+        """Prefill only each row's un-cached tail: row ``s``'s tokens
+        ``[matched[s]:lens[s]]`` at ``start_pos=matched[s]`` (per-row).
+        Chunked prefill at an arbitrary start_pos is bit-identical to
+        full prefill (the PR-5 parity contract), and the tail bucket
+        comes from the same prompt lattice warmup compiled — zero new
+        signatures. All-miss batches take the unchanged full path."""
+        if not matched.any():
+            return self.prefill(toks, lens, cache)
+        tail_lens = (_onp.asarray(lens, _onp.int32)
+                     - _onp.asarray(matched, _onp.int32))
+        t_bucket = pick_bucket(int(tail_lens.max()), self.prompt_buckets)
+        tails = _onp.full((len(lens), t_bucket), self.pad_id, _onp.int32)
+        for s in range(len(lens)):
+            tails[s, :tail_lens[s]] = toks[s, matched[s]:lens[s]]
+        return self._run(tails, matched, tail_lens - 1, cache)
+
+    def _prefix_release(self, prompts, b_bucket, cache, ok):
+        """Retire the batch's slots. On a clean run the trie first
+        adopts each real prompt's full pages (increfs while the slot
+        still pins them) so later requests sharing the prefix skip that
+        much prefill; then every slot's references drop — pages the trie
+        kept survive, the rest recycle."""
+        if not self._prefix_on:
+            return
+        trie = self._prefix[b_bucket]
+        if ok:
+            table = cache.table()
+            for s, p in enumerate(prompts):
+                trie.insert([int(t) for t in p], table[s])
+        for s in range(b_bucket):
+            cache.release(s)
+        self.metrics.set_prefix_gauges(cache.pages_shared,
+                                       trie.pages_held, trie.evictions)
+        self.metrics.set_kv_pages(cache.pages_used, cache.pages_free)
+
     def generate(self, prompts, max_new_tokens=32, temperature=0.0,
                  top_k=None, stop_ids=(), deadlines=None):
         """Traced entry point: when request tracing is on and no ambient
@@ -649,47 +749,56 @@ class Generator:
                 raise MXNetError(
                     f"generate() got {len(deadlines)} deadlines for "
                     f"{n_real} prompts")
-        cache = self._fresh_cache(b_bucket)
-        with _trace.span("serve::prefill", {"batch": n_real}):
-            logits, cache = self.prefill(toks, lens, cache)
-        t_prefill = time.perf_counter()
+        cache, matched = self._prefix_begin(prompts, toks, lens, b_bucket,
+                                            max_new)
+        run_ok = False
+        try:
+            with _trace.span("serve::prefill", {"batch": n_real}):
+                logits, cache = self._prefix_prefill(toks, lens, matched,
+                                                     cache)
+            t_prefill = time.perf_counter()
 
-        out = [[] for _ in range(n_real)]
-        stopped = [False] * n_real
-        expired = [False] * n_real
-        positions = lens.copy()  # next write position per row
-        stop = set(int(s) for s in stop_ids)
-        n_decoded = 0
-        for step in range(max_new):
-            next_ids = sample_tokens(logits, temperature=temperature,
-                                     top_k=top_k)
-            for i in range(n_real):
-                if stopped[i]:
-                    continue
-                tid = int(next_ids[i])
-                if tid in stop:
-                    stopped[i] = True
-                else:
-                    out[i].append(tid)
-            if deadlines is not None:
-                # retire expired rows at the step boundary: their decode
-                # budget is spent — burning further T=1 passes for output
-                # nobody will read is the overload failure mode
-                now = time.monotonic()
+            out = [[] for _ in range(n_real)]
+            stopped = [False] * n_real
+            expired = [False] * n_real
+            positions = lens.copy()  # next write position per row
+            stop = set(int(s) for s in stop_ids)
+            n_decoded = 0
+            for step in range(max_new):
+                next_ids = sample_tokens(logits, temperature=temperature,
+                                         top_k=top_k)
                 for i in range(n_real):
-                    if not stopped[i] and now >= deadlines[i]:
+                    if stopped[i]:
+                        continue
+                    tid = int(next_ids[i])
+                    if tid in stop:
                         stopped[i] = True
-                        expired[i] = True
-                        self.metrics.observe_deadline("decode")
-            if all(stopped) or step == max_new - 1:
-                # the last sampled token needs no successor logits —
-                # running decode_step here would be a discarded T=1 pass
-                break
-            with _trace.span("serve::decode_step", {"step": step}):
-                logits, cache = self.decode_step(next_ids, positions,
-                                                 cache)
-            positions = positions + 1
-            n_decoded += 1
+                    else:
+                        out[i].append(tid)
+                if deadlines is not None:
+                    # retire expired rows at the step boundary: their
+                    # decode budget is spent — burning further T=1 passes
+                    # for output nobody will read is the overload failure
+                    # mode
+                    now = time.monotonic()
+                    for i in range(n_real):
+                        if not stopped[i] and now >= deadlines[i]:
+                            stopped[i] = True
+                            expired[i] = True
+                            self.metrics.observe_deadline("decode")
+                if all(stopped) or step == max_new - 1:
+                    # the last sampled token needs no successor logits —
+                    # running decode_step here would be a discarded T=1
+                    # pass
+                    break
+                with _trace.span("serve::decode_step", {"step": step}):
+                    logits, cache = self.decode_step(next_ids, positions,
+                                                     cache)
+                positions = positions + 1
+                n_decoded += 1
+            run_ok = True
+        finally:
+            self._prefix_release(prompts, b_bucket, cache, run_ok)
         t_done = time.perf_counter()
         decode_s = t_done - t_prefill
         n_tokens = sum(len(o) for o in out)
@@ -756,7 +865,8 @@ class SpeculativeGenerator:
 
     def __init__(self, model, draft_model, k=None, max_seq=128,
                  batch_buckets=(1, 2, 4), prompt_buckets=None, pad_id=0,
-                 name="llama_spec", decode_path=None):
+                 name="llama_spec", decode_path=None, paged=None,
+                 page_size=None, kv_pages=None, prefix_cache=None):
         from .. import config
 
         self.k = int(k) if k is not None else int(
@@ -766,11 +876,19 @@ class SpeculativeGenerator:
         self.target = Generator(
             model, max_seq=max_seq, batch_buckets=batch_buckets,
             prompt_buckets=prompt_buckets, pad_id=pad_id, name=name,
-            decode_path=decode_path)
+            decode_path=decode_path, paged=paged, page_size=page_size,
+            kv_pages=kv_pages, prefix_cache=prefix_cache)
         self.draft = Generator(
             draft_model, max_seq=max_seq, batch_buckets=batch_buckets,
             prompt_buckets=prompt_buckets, pad_id=pad_id,
-            name=f"{name}_draft", decode_path=decode_path)
+            name=f"{name}_draft", decode_path=decode_path, paged=paged,
+            page_size=page_size, kv_pages=kv_pages,
+            prefix_cache=prefix_cache)
+        # draft rounds write k+1 positions past the accepted prefix and
+        # the verify block writes k+1 target positions — per-request
+        # page budgets in prefix mode must cover that overhang
+        self.target._budget_headroom = self.k + 1
+        self.draft._budget_headroom = self.k + 1
         self.decode_path = self.target.decode_path
         self.max_seq = self.target.max_seq
         self.batch_buckets = self.target.batch_buckets
@@ -789,15 +907,30 @@ class SpeculativeGenerator:
     def _verify_run(self, tokens_blk, start_pos, cache):
         """One target pass over the (B, k+1) block [pending, d_1..d_k] at
         per-row ``start_pos``; returns the full (B, k+1, vocab) logits and
-        the updated target cache."""
+        the updated target cache. A paged target pool is bracketed with
+        the standalone exact-copy gather/scatter ops around the
+        ring-shaped verify executable (the strict-rung pattern from
+        :meth:`Generator._run`), writing k+1 rows at per-row start_pos —
+        so draft and target share the same prefix pages the trie
+        handed out at admission."""
         from .. import numpy as mnp
 
         blk = _onp.asarray(tokens_blk, _onp.int32)
-        out = self._verify.run(
-            mnp.array(blk),
-            mnp.array(_onp.asarray(start_pos, _onp.int32)),
-            mnp.array(_onp.zeros(len(blk), _onp.int32)),
-            *cache.flat(), *self.target._qflat)
+        toks = mnp.array(blk)
+        sp = mnp.array(_onp.asarray(start_pos, _onp.int32))
+        li = mnp.array(_onp.zeros(len(blk), _onp.int32))
+        if self.target._paged:
+            table = cache.table_nd()
+            rings = [_ops.paged_kv_gather(p, table)
+                     for p in cache.flat()]
+            out = self._verify.run(toks, sp, li, *rings,
+                                   *self.target._qflat)
+            cache.update_from_flat([
+                _ops.paged_kv_scatter(p, table, r, sp, blk.shape[1])
+                for p, r in zip(cache.flat(), out[1:])])
+            return out[0], cache
+        out = self._verify.run(toks, sp, li, *cache.flat(),
+                               *self.target._qflat)
         logits, flat = out[0], out[1:]
         return logits, KVCache.from_flat(flat, self.max_seq,
                                          quant=self.target._quant)
@@ -833,84 +966,101 @@ class SpeculativeGenerator:
                 raise MXNetError(
                     f"generate() got {len(deadlines)} deadlines for "
                     f"{n_real} prompts")
-        tcache = self.target._fresh_cache(b_bucket)
-        dcache = self.draft._fresh_cache(b_bucket)
-        with _trace.span("serve::prefill", {"batch": n_real}):
-            logits, tcache = self.target.prefill(toks, lens, tcache)
-            _, dcache = self.draft.prefill(toks, lens, dcache)
-        t_prefill = time.perf_counter()
+        tcache, tmatched = self.target._prefix_begin(
+            prompts, toks, lens, b_bucket, max_new)
+        try:
+            dcache, dmatched = self.draft._prefix_begin(
+                prompts, toks, lens, b_bucket, max_new)
+        except BaseException:
+            self.target._prefix_release(prompts, b_bucket, tcache, False)
+            raise
+        run_ok = False
+        try:
+            with _trace.span("serve::prefill", {"batch": n_real}):
+                logits, tcache = self.target._prefix_prefill(
+                    toks, lens, tmatched, tcache)
+                _, dcache = self.draft._prefix_prefill(
+                    toks, lens, dmatched, dcache)
+            t_prefill = time.perf_counter()
 
-        pending = sample_tokens(logits)  # (b_bucket,) greedy
-        out = [[] for _ in range(n_real)]
-        stopped = [False] * b_bucket
-        for i in range(n_real, b_bucket):
-            stopped[i] = True  # dead padding lanes ride along frozen
-        expired = [False] * n_real
-        stop = set(int(s) for s in stop_ids)
-        # the prefill-sampled token is the first emission (exactly like
-        # Generator._generate's step-0 sample)
-        for i in range(n_real):
-            tid = int(pending[i])
-            if tid in stop:
-                stopped[i] = True
-            else:
-                out[i].append(tid)
-                if len(out[i]) >= max_new:
+            pending = sample_tokens(logits)  # (b_bucket,) greedy
+            out = [[] for _ in range(n_real)]
+            stopped = [False] * b_bucket
+            for i in range(n_real, b_bucket):
+                stopped[i] = True  # dead padding lanes ride along frozen
+            expired = [False] * n_real
+            stop = set(int(s) for s in stop_ids)
+            # the prefill-sampled token is the first emission (exactly
+            # like Generator._generate's step-0 sample)
+            for i in range(n_real):
+                tid = int(pending[i])
+                if tid in stop:
                     stopped[i] = True
-        positions = lens.copy()  # write position of each row's `pending`
-        rounds = draft_steps = verify_steps = 0
-        proposed = accepted = 0
-        proposals = _onp.zeros((b_bucket, self.k), _onp.int32)
-        while not all(stopped):
-            rounds += 1
-            # draft proposes d_1..d_k; the extra (k+1)-th step writes
-            # d_k's K/V into the draft ring so a fully-accepted round
-            # leaves no hole at position + k
-            cur = pending.copy()
-            dpos = positions.copy()
-            for j in range(self.k + 1):
-                with _trace.span("serve::draft_step", {"j": j}):
-                    dlog, dcache = self.draft.decode_step(cur, dpos,
-                                                          dcache)
-                dpos = dpos + 1
-                draft_steps += 1
-                if j < self.k:
-                    cur = sample_tokens(dlog)
-                    proposals[:, j] = cur
-            blk = _onp.concatenate(
-                [_onp.asarray(pending).reshape(-1, 1), proposals], axis=1)
-            with _trace.span("serve::verify_step", {"k": self.k}):
-                vlogits, tcache = self._verify_run(blk, positions, tcache)
-            verify_steps += 1
-            greedy = sample_tokens(vlogits.reshape(-1, vlogits.shape[-1]))
-            greedy = greedy.reshape(b_bucket, self.k + 1)
-            for i in range(b_bucket):
-                if stopped[i]:
-                    continue
-                a = 0
-                while a < self.k and proposals[i, a] == greedy[i, a]:
-                    a += 1
-                proposed += self.k
-                accepted += a
-                emit = [int(t) for t in proposals[i, :a]]
-                emit.append(int(greedy[i, a]))
-                for tid in emit:
-                    if tid in stop:
-                        stopped[i] = True
-                        break
+                else:
                     out[i].append(tid)
                     if len(out[i]) >= max_new:
                         stopped[i] = True
-                        break
-                pending[i] = greedy[i, a]
-                positions[i] += a + 1
-            if deadlines is not None:
-                now = time.monotonic()
-                for i in range(n_real):
-                    if not stopped[i] and now >= deadlines[i]:
-                        stopped[i] = True
-                        expired[i] = True
-                        self.metrics.observe_deadline("decode")
+            positions = lens.copy()  # write position of row's `pending`
+            rounds = draft_steps = verify_steps = 0
+            proposed = accepted = 0
+            proposals = _onp.zeros((b_bucket, self.k), _onp.int32)
+            while not all(stopped):
+                rounds += 1
+                # draft proposes d_1..d_k; the extra (k+1)-th step writes
+                # d_k's K/V into the draft ring so a fully-accepted round
+                # leaves no hole at position + k
+                cur = pending.copy()
+                dpos = positions.copy()
+                for j in range(self.k + 1):
+                    with _trace.span("serve::draft_step", {"j": j}):
+                        dlog, dcache = self.draft.decode_step(cur, dpos,
+                                                              dcache)
+                    dpos = dpos + 1
+                    draft_steps += 1
+                    if j < self.k:
+                        cur = sample_tokens(dlog)
+                        proposals[:, j] = cur
+                blk = _onp.concatenate(
+                    [_onp.asarray(pending).reshape(-1, 1), proposals],
+                    axis=1)
+                with _trace.span("serve::verify_step", {"k": self.k}):
+                    vlogits, tcache = self._verify_run(blk, positions,
+                                                       tcache)
+                verify_steps += 1
+                greedy = sample_tokens(
+                    vlogits.reshape(-1, vlogits.shape[-1]))
+                greedy = greedy.reshape(b_bucket, self.k + 1)
+                for i in range(b_bucket):
+                    if stopped[i]:
+                        continue
+                    a = 0
+                    while a < self.k and proposals[i, a] == greedy[i, a]:
+                        a += 1
+                    proposed += self.k
+                    accepted += a
+                    emit = [int(t) for t in proposals[i, :a]]
+                    emit.append(int(greedy[i, a]))
+                    for tid in emit:
+                        if tid in stop:
+                            stopped[i] = True
+                            break
+                        out[i].append(tid)
+                        if len(out[i]) >= max_new:
+                            stopped[i] = True
+                            break
+                    pending[i] = greedy[i, a]
+                    positions[i] += a + 1
+                if deadlines is not None:
+                    now = time.monotonic()
+                    for i in range(n_real):
+                        if not stopped[i] and now >= deadlines[i]:
+                            stopped[i] = True
+                            expired[i] = True
+                            self.metrics.observe_deadline("decode")
+            run_ok = True
+        finally:
+            self.target._prefix_release(prompts, b_bucket, tcache, run_ok)
+            self.draft._prefix_release(prompts, b_bucket, dcache, run_ok)
         t_done = time.perf_counter()
         decode_s = t_done - t_prefill
         n_tokens = sum(len(o) for o in out)

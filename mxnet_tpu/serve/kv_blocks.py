@@ -34,6 +34,19 @@ recycles the pages the moment a request retires — the memory win over
 bucket rings: a slot holds ``ceil((prompt+max_new)/page)`` pages, not
 ``max_seq``, and holds them only while the request is live.
 
+Every allocated page carries a **reference count** so pages can be
+shared across owners (PR-14 prefix caching, ``serve.prefix_cache``):
+``assign_with_prefix()`` installs already-written prefix pages at the
+front of a slot's table row and bumps their refcounts instead of
+copying them, ``incref()`` lets the prefix trie adopt a retiring
+request's prompt pages, and ``release()``/``decref()`` *decrement* —
+a page returns to the free list only when its last reference drops.
+Sharing is copy-on-extend at page granularity: shared pages are
+read-only by construction (``paged_kv_scatter`` only writes rows at
+``start_pos + [0, t_len)``, and a prefix-hit request's first write
+position starts past the shared boundary), so the first divergent
+token always lands in a slot-private page and no copy is ever needed.
+
 Page size defaults to the Pallas decode kernel's natural block
 (``ops.pallas.decode_attention.natural_block()`` = 128, clamped to
 ``max_seq``), so the kernel's block-skip masking skips whole unreached
@@ -142,6 +155,7 @@ class PagedKVPool:
         self._lock = threading.Lock()
         self._free = list(range(self.num_pages - 1, 0, -1))
         self._owned = [[] for _ in range(self.num_slots)]
+        self._refs = {}  # page id -> reference count (allocated pages)
         self._table = _onp.zeros((self.num_slots, self.pages_per_slot),
                                  _onp.int32)
         self._table_nd = None
@@ -197,26 +211,54 @@ class PagedKVPool:
         when the free list is short; raises :class:`MXNetError` on a
         slot that already owns pages (the scheduler must release first).
         Returns the number of pages assigned."""
+        return self.assign_with_prefix(slot, n_tokens, ())
+
+    def assign_with_prefix(self, slot, n_tokens, prefix_pages):
+        """Like :meth:`assign`, but the slot's table row *starts* with
+        ``prefix_pages`` — already-written pages (a prefix-trie match)
+        whose refcounts are bumped instead of allocating + rewriting
+        them. Only ``pages_for(n_tokens) - len(prefix_pages)`` fresh
+        pages come off the free list; exhaustion is still atomic
+        (nothing increffed, nothing allocated). Shared pages are
+        read-only for this slot by the copy-on-extend contract: its
+        first write position is at/after the shared-token boundary, so
+        every write lands in one of the slot-private pages."""
         slot = int(slot)
         need = self.pages_for(n_tokens)
+        shared = [int(p) for p in prefix_pages]
         if n_tokens > self.max_seq:
             raise MXNetError(
                 f"slot budget {n_tokens} exceeds max_seq {self.max_seq}")
+        if shared and len(shared) >= need:
+            raise MXNetError(
+                f"prefix ({len(shared)} pages) must leave >= 1 private "
+                f"page in a {need}-page budget (the divergent token "
+                "needs somewhere to land)")
+        fresh_need = need - len(shared)
         with self._lock:
             if self._owned[slot]:
                 raise MXNetError(
                     f"slot {slot} already owns {len(self._owned[slot])} "
                     "pages; release() before re-assigning")
-            if need > len(self._free):
+            if any(self._refs.get(p, 0) < 1 for p in shared):
+                raise MXNetError(
+                    f"prefix pages {shared} are not all live (evicted "
+                    "between match and assign?)")
+            if fresh_need > len(self._free):
                 self.exhausted_count += 1
                 err = PoolExhausted(
-                    f"KV page pool exhausted: need {need} pages, "
+                    f"KV page pool exhausted: need {fresh_need} pages, "
                     f"{len(self._free)} free of {self.num_pages - 1}")
                 # backpressure hint: pages free as requests retire; one
                 # slot's worth of decode is the natural retry horizon
                 err.retry_after_ms = 50.0
                 raise err
-            pages = [self._free.pop() for _ in range(need)]
+            fresh = [self._free.pop() for _ in range(fresh_need)]
+            for p in shared:
+                self._refs[p] += 1
+            for p in fresh:
+                self._refs[p] = 1
+            pages = shared + fresh
             self._owned[slot] = pages
             self._table[slot] = 0
             self._table[slot, :need] = pages
@@ -227,12 +269,14 @@ class PagedKVPool:
             return need
 
     def release(self, slot):
-        """Recycle every page ``slot`` owns back to the free list and
-        null its table row. Idempotent (releasing an empty slot is a
-        no-op). The pages' device contents are left stale on purpose:
-        the attention position mask plus prefill's exact overwrite make
-        stale pages unreadable before they are rewritten, so retirement
-        costs zero device work."""
+        """Drop ``slot``'s reference on every page it holds and null its
+        table row; pages whose refcount reaches zero recycle to the free
+        list (pages the prefix trie still references survive).
+        Idempotent (releasing an empty slot is a no-op). The pages'
+        device contents are left stale on purpose: the attention
+        position mask plus prefill's exact overwrite make stale pages
+        unreadable before they are rewritten, so retirement costs zero
+        device work."""
         slot = int(slot)
         with self._lock:
             pages, self._owned[slot] = self._owned[slot], []
@@ -241,10 +285,55 @@ class PagedKVPool:
             if len(set(pages)) != len(pages) or 0 in pages:
                 raise MXNetError(
                     f"corrupt page ownership for slot {slot}: {pages}")
-            self._free.extend(reversed(pages))
+            self._decref_locked(pages)
             self._table[slot] = 0
             self._table_nd = None
             return len(pages)
+
+    # -- reference counting (prefix-cache sharing) ---------------------------
+    def _decref_locked(self, pages):
+        freed = []
+        for p in pages:
+            n = self._refs.get(p, 0) - 1
+            if n > 0:
+                self._refs[p] = n
+            elif n == 0:
+                del self._refs[p]
+                freed.append(p)
+            else:
+                raise MXNetError(f"decref of free page {p}")
+        self._free.extend(reversed(freed))
+        return freed
+
+    def incref(self, pages):
+        """Add one reference to each of ``pages`` (the prefix trie
+        adopting a retiring slot's prompt pages). Pages must be live."""
+        pages = [int(p) for p in pages]
+        with self._lock:
+            for p in pages:
+                if self._refs.get(p, 0) < 1:
+                    raise MXNetError(f"incref of free page {p}")
+            for p in pages:
+                self._refs[p] += 1
+
+    def decref(self, pages):
+        """Drop one reference from each of ``pages``; returns the pages
+        that reached zero and recycled to the free list (the prefix
+        trie's eviction path)."""
+        with self._lock:
+            return self._decref_locked([int(p) for p in pages])
+
+    def refcount(self, page):
+        """Current reference count of ``page`` (0 = free)."""
+        with self._lock:
+            return self._refs.get(int(page), 0)
+
+    @property
+    def pages_shared(self):
+        """Pages currently held by more than one reference (a live slot
+        plus the trie, or several slots on one prefix)."""
+        with self._lock:
+            return sum(1 for n in self._refs.values() if n > 1)
 
     # -- readout -------------------------------------------------------------
     @property
@@ -269,11 +358,13 @@ class PagedKVPool:
         with self._lock:
             free = len(self._free)
             owned = sum(len(o) for o in self._owned)
+            shared = sum(1 for n in self._refs.values() if n > 1)
         return {"page_size": self.page_size,
                 "pages_total": self.pages_total,
                 "pages_free": free,
                 "pages_used": self.pages_total - free,
                 "pages_owned": owned,
+                "pages_shared": shared,
                 "high_water": self.high_water,
                 "exhausted_count": self.exhausted_count,
                 "nbytes": self.nbytes()}

@@ -92,6 +92,14 @@ class ServeMetrics:
         self.kv_pages_free = 0
         self.slots_live = 0
         self.slots_total = 0
+        # prefix-cache telemetry (tentpole PR 14): cross-request KV reuse
+        # through the radix trie over the paged pool
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_skipped = 0
+        self.prefix_pages_shared = 0
+        self.prefix_pages_held = 0
+        self.prefix_evictions = 0
         _instances.add(self)
 
     # -- observations -------------------------------------------------------
@@ -215,6 +223,31 @@ class ServeMetrics:
         with self._lock:
             self._itl_ms.append(float(ms))
 
+    def observe_prefix(self, matched_tokens):
+        """One admission consulted the prefix trie: ``matched_tokens``
+        prompt tokens (a whole number of KV pages) were already cached
+        and skip prefill entirely; 0 counts as a miss."""
+        with self._lock:
+            if matched_tokens > 0:
+                self.prefix_hits += 1
+                self.prefix_tokens_skipped += int(matched_tokens)
+            else:
+                self.prefix_misses += 1
+        if _prof.ENABLED:
+            _prof.record_instant(f"serve::prefix({self.name})", "serve",
+                                 args={"matched": int(matched_tokens)})
+
+    def set_prefix_gauges(self, pages_shared, pages_held, evictions):
+        """Gauge triple the scheduler publishes between steps: pool pages
+        referenced more than once, pages the trie holds, and cumulative
+        LRU evictions under pool pressure."""
+        self.prefix_pages_shared = int(pages_shared)
+        self.prefix_pages_held = int(pages_held)
+        self.prefix_evictions = int(evictions)
+        if _prof.ENABLED:
+            _prof.set_counter(f"serve.prefix_pages_shared({self.name})",
+                              int(pages_shared), cat="serve")
+
     def set_kv_pages(self, used, free):
         """Gauge pair: paged-KV pool occupancy (null page excluded)."""
         self.kv_pages_used = int(used)
@@ -310,6 +343,16 @@ class ServeMetrics:
                 "slots_total": self.slots_total,
                 "slot_occupancy": (self.slots_live / self.slots_total
                                    if self.slots_total else 0.0),
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_hit_rate": (
+                    self.prefix_hits
+                    / (self.prefix_hits + self.prefix_misses)
+                    if (self.prefix_hits + self.prefix_misses) else 0.0),
+                "prefix_tokens_skipped": self.prefix_tokens_skipped,
+                "prefix_pages_shared": self.prefix_pages_shared,
+                "prefix_pages_held": self.prefix_pages_held,
+                "prefix_evictions": self.prefix_evictions,
             }
         out["ttft_p50_ms"] = percentile(ttft, 50)
         out["ttft_p95_ms"] = percentile(ttft, 95)

@@ -60,6 +60,7 @@ from .generate import _CacheForward, _int8_weights_enabled, \
     _quantize_serving_weights, resolve_decode_path, sample_tokens
 from ..ops import nn as _ops
 from .kv_blocks import PagedKVPool
+from .prefix_cache import PrefixCache
 
 
 def _no_runner(_batch):  # pragma: no cover - the scheduler IS the consumer
@@ -132,7 +133,8 @@ class ContinuousEngine:
 
     def __init__(self, model, max_seq=128, num_slots=None, page_size=None,
                  num_pages=None, prefill_chunk=None, pad_id=0,
-                 name="llama_cb", decode_path=None, **batcher_kwargs):
+                 name="llama_cb", decode_path=None, prefix_cache=None,
+                 **batcher_kwargs):
         from .. import config
 
         self.model = model
@@ -157,6 +159,13 @@ class ContinuousEngine:
                               else self.pool.page_size)
         if self.prefill_chunk > self.max_seq:
             self.prefill_chunk = self.max_seq
+        # cross-request prefix reuse (PR-14): a radix trie over prompt
+        # token ids maps matched prefixes to refcounted pool pages, so
+        # _admit can skip the matched portion of chunked prefill
+        if prefix_cache is None:
+            prefix_cache = bool(config.get("MXNET_SERVE_PREFIX_CACHE"))
+        self.prefix = (PrefixCache(self.pool, name=f"{name}_prefix")
+                       if prefix_cache else None)
         # fast rungs fuse the paging brackets into the step executable;
         # the strict baseline rung keeps the RING executable and runs
         # the brackets as standalone exact copies in _run_step, which is
@@ -224,9 +233,14 @@ class ContinuousEngine:
         return [i for i, s in enumerate(self._slots) if s is None]
 
     def _settle_slot(self, i, error=None):
-        """Retire slot ``i``: settle its future, recycle its pages."""
+        """Retire slot ``i``: settle its future, recycle its pages. On a
+        clean retirement the prefix trie adopts the prompt's full pages
+        first (increfs while the slot still pins them), so the next
+        request sharing this prompt prefix skips that much prefill."""
         s = self._slots[i]
         self._slots[i] = None
+        if self.prefix is not None and error is None and s.decoding:
+            self.prefix.insert(s.prompt, self.pool.table()[i])
         self.pool.release(i)
         if error is not None:
             self._batcher.settle_one(s.p, error=error)
@@ -274,8 +288,12 @@ class ContinuousEngine:
             p = batch[0]
             i = free[0]
             need = len(p.payload["prompt"]) + p.payload["max_new"]
+            matched, pages = 0, ()
+            if self.prefix is not None:
+                matched, pages = self.prefix.match(p.payload["prompt"])
             try:
-                self.pool.assign(i, min(need, self.max_seq))
+                self._assign_with_reclaim(i, min(need, self.max_seq),
+                                          pages)
             except PoolExhausted:
                 # backpressure, not failure: the request keeps its place
                 # at the queue front and is re-taken as pages recycle
@@ -283,9 +301,31 @@ class ContinuousEngine:
                 return
             free.pop(0)
             slot = _Slot(p, self._steps)
+            # a prefix hit: the matched pages already hold these tokens'
+            # KV, so chunked prefill starts past them (consumed counts
+            # prompt tokens already written)
+            slot.consumed = matched
             self._slots[i] = slot
+            if self.prefix is not None:
+                self.metrics.observe_prefix(matched)
             if slot.admit_wait_steps > self._admit_wait_max:
                 self._admit_wait_max = slot.admit_wait_steps
+
+    def _assign_with_reclaim(self, i, budget, pages):
+        """``assign_with_prefix`` with one eviction retry: on pool
+        pressure the trie reclaims LRU cached prefixes (never the pages
+        just matched, never pages a live slot references) before the
+        PoolExhausted surfaces as backpressure."""
+        try:
+            return self.pool.assign_with_prefix(i, budget, pages)
+        except PoolExhausted:
+            if self.prefix is None:
+                raise
+            shortfall = (self.pool.pages_for(budget) - len(pages)
+                         - self.pool.pages_free)
+            if self.prefix.reclaim(max(shortfall, 1), exclude=pages) == 0:
+                raise
+            return self.pool.assign_with_prefix(i, budget, pages)
 
     def _run_step(self, tokens, start_pos, last_idx, table):
         from .. import numpy as mnp
@@ -413,6 +453,10 @@ class ContinuousEngine:
         self.metrics.set_kv_pages(self.pool.pages_used,
                                   self.pool.pages_free)
         self.metrics.set_slot_occupancy(len(self._live()), self.num_slots)
+        if self.prefix is not None:
+            self.metrics.set_prefix_gauges(self.pool.pages_shared,
+                                           self.prefix.pages_held,
+                                           self.prefix.evictions)
 
     def _idle(self):
         return not self._live() and self._batcher.queue_depth() == 0
@@ -506,4 +550,6 @@ class ContinuousEngine:
         out["admit_wait_steps_max"] = self._admit_wait_max
         out["queue_depth"] = self._batcher.queue_depth()
         out["duplicate_submits"] = self._batcher.duplicate_submits
+        if self.prefix is not None:
+            out["prefix"] = self.prefix.stats()
         return out

@@ -1,0 +1,278 @@
+"""Conformance tests for cross-request KV reuse (PR-14,
+``mxnet_tpu/serve/prefix_cache.py`` + the refcounted
+``PagedKVPool``): allocator refcount invariants (shared assign,
+incref/decref, atomic exhaustion, live pages never freed), radix-trie
+semantics (full-page matching capped one token short of the prompt,
+LRU reclaim that skips live and just-matched pages), and the headline
+contract — greedy decode with the prefix cache ON is **token
+identical** to cache-off on the ContinuousEngine, the paged Generator,
+and the speculative stack, including under pool-pressure eviction,
+with ``prefix_hit_rate > 0`` and zero recompiles.
+"""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models.llama import get_llama
+from mxnet_tpu.serve import ContinuousEngine, Generator, PagedKVPool, \
+    PoolExhausted, PrefixCache, SpeculativeGenerator
+
+
+def _tiny_llama(config="llama_tiny_test", **over):
+    net = get_llama(config, **over)
+    net.initialize()
+    return net
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _tiny_llama()
+
+
+def _row(pool, slot):
+    return [int(p) for p in pool.table()[slot] if p != 0]
+
+
+# ---------------------------------------------------------------------------
+# allocator refcounts
+# ---------------------------------------------------------------------------
+
+
+class TestRefcounts:
+    def test_shared_assign_and_staged_release(self, net):
+        pool = PagedKVPool(net, num_slots=4, max_seq=64, page_size=16)
+        pool.assign(0, 40)                       # 3 pages
+        shared = _row(pool, 0)[:2]
+        pool.assign_with_prefix(1, 40, shared)   # 2 shared + 1 fresh
+        row1 = _row(pool, 1)
+        assert row1[:2] == shared
+        assert row1[2] not in _row(pool, 0)      # the tail page is private
+        assert pool.refcount(shared[0]) == 2
+        assert pool.pages_shared == 2
+        # slot 0 releases: the shared pages stay live (slot 1 pins them)
+        pool.release(0)
+        assert pool.refcount(shared[0]) == 1
+        assert pool.refcount(shared[1]) == 1
+        pool.release(1)
+        assert pool.pages_used == 0
+
+    def test_incref_decref_and_live_page_never_freed(self, net):
+        pool = PagedKVPool(net, num_slots=2, max_seq=64, page_size=16)
+        pool.assign(0, 20)                       # 2 pages
+        pages = _row(pool, 0)
+        pool.incref(pages)                       # a trie adopting them
+        pool.release(0)                          # slot gone, trie holds
+        assert [pool.refcount(p) for p in pages] == [1, 1]
+        assert pool.pages_used == 2              # NOT recycled
+        pool.decref(pages)
+        assert pool.pages_used == 0
+        # decref below zero is corruption, loudly
+        with pytest.raises(MXNetError, match="decref"):
+            pool.decref(pages)
+
+    def test_shared_prefix_page_must_be_live(self, net):
+        pool = PagedKVPool(net, num_slots=2, max_seq=64, page_size=16)
+        with pytest.raises(MXNetError, match="not all live"):
+            pool.assign_with_prefix(0, 40, (3,))  # page 3 is on the free list
+
+    def test_exhaustion_is_atomic_with_shared_pages(self, net):
+        pool = PagedKVPool(net, num_slots=2, max_seq=64, page_size=16,
+                           num_pages=4)          # null + 3 usable
+        pool.assign(0, 20)                       # 2 pages
+        shared = _row(pool, 0)
+        # slot 1 wants 2 shared + 2 fresh but only 1 page is free:
+        # nothing must be increfed or installed
+        with pytest.raises(PoolExhausted):
+            pool.assign_with_prefix(1, 64, shared)
+        assert [pool.refcount(p) for p in shared] == [1, 1]
+        assert _row(pool, 1) == []
+        assert pool.exhausted_count == 1
+
+
+# ---------------------------------------------------------------------------
+# radix trie
+# ---------------------------------------------------------------------------
+
+
+class TestTrie:
+    def test_match_insert_full_pages_only(self, net):
+        pool = PagedKVPool(net, num_slots=2, max_seq=64, page_size=16)
+        trie = PrefixCache(pool, name="t_trie")
+        toks = list(range(100, 140))             # 40 tokens
+        pool.assign(0, len(toks))
+        pages = _row(pool, 0)
+        assert trie.insert(toks, pages) == 2     # 40 // 16 full pages
+        assert [pool.refcount(p) for p in pages[:2]] == [2, 2]
+        m, got = trie.match(toks)
+        assert m == 32 and list(got) == pages[:2]
+        # page-aligned prompt: the match is capped one page short so at
+        # least one token always prefills (its logits seed sampling)
+        m, got = trie.match(toks[:32])
+        assert m == 16 and len(got) == 1
+        m, got = trie.match(toks[:16])
+        assert m == 0 and not len(got)
+        m, got = trie.match([1, 2, 3])
+        assert m == 0 and not len(got)
+        s = trie.stats()
+        assert s["pages_held"] == 2 and s["hits"] == 2 and s["misses"] == 2
+
+    def test_reclaim_lru_skips_live_and_excluded(self, net):
+        pool = PagedKVPool(net, num_slots=2, max_seq=128, page_size=16)
+        trie = PrefixCache(pool, name="t_reclaim")
+        a, b = list(range(200, 232)), list(range(300, 332))
+        pool.assign(0, 32)
+        pa = _row(pool, 0)
+        trie.insert(a, pa)
+        pool.release(0)
+        pool.assign(0, 32)
+        pb = _row(pool, 0)
+        trie.insert(b, pb)
+        pool.release(0)
+        trie.match(a)                            # touch a: b is now LRU
+        # a live in-flight reference pins b's leaf against the sweep —
+        # and an interior node is never evicted from under its child,
+        # so the whole b chain survives: only a's chain (2 pages) frees
+        pool.incref([pb[1]])
+        assert trie.reclaim(4) == 2
+        assert pool.refcount(pb[1]) == 2         # untouched
+        assert trie.stats()["evictions"] == 2
+        m, _ = trie.match(a)
+        assert m == 0                            # a was swept (leaves first)
+        pool.decref([pb[1]])
+        # exclude: pages the admitting request just matched are immune,
+        # while the now-unpinned b chain sweeps clean
+        pool.assign(0, 32)
+        pc = _row(pool, 0)
+        trie.insert(list(range(400, 432)), pc)
+        pool.release(0)
+        assert trie.reclaim(8, exclude=set(pc)) == 2
+        assert trie.pages_held == 2
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+
+def _engine(net, prefix_on, **over):
+    kw = dict(max_seq=64, num_slots=2, page_size=8, prefill_chunk=8,
+              decode_path="baseline", prefix_cache=prefix_on,
+              max_queue=64, name=f"px_eng_{int(bool(prefix_on))}")
+    kw.update(over)
+    return ContinuousEngine(net, **kw)
+
+
+def _drive(eng, prompts, max_new=6):
+    first = eng.submit(prompts[0], max_new_tokens=max_new).result(60)
+    futs = [eng.submit(p, max_new_tokens=max_new) for p in prompts[1:]]
+    return [first["tokens"]] + [f.result(60)["tokens"] for f in futs]
+
+
+class TestEnginePrefix:
+    def test_on_off_token_identity_and_hit_rate(self, net):
+        system = list(range(3, 23))              # 20-token shared prompt
+        prompts = [system + [40 + i, 60 + i] for i in range(6)]
+        with _engine(net, False) as off:
+            ref = _drive(off, prompts)
+            off.assert_no_recompiles()
+        with _engine(net, True) as on:
+            got = _drive(on, prompts)
+            on.assert_no_recompiles()
+            snap = on.metrics.snapshot()
+            st = on.stats()
+        assert got == ref
+        assert snap["prefix_hit_rate"] > 0
+        assert snap["prefix_tokens_skipped"] > 0
+        # after every request retired, the only non-free pages are the
+        # trie's — nothing leaks past the refcounts
+        assert st["pool"]["pages_owned"] == 0
+        assert st["pool"]["pages_used"] == st["prefix"]["pages_held"]
+
+    def test_eviction_pressure_keeps_outputs_identical(self, net):
+        # zero-headroom pool (exactly the exhaustion-free floor): every
+        # trie-held page past the current match must be LRU-swept at
+        # admission instead of 503ing, and outputs must not move
+        families = [list(range(3, 19)), list(range(50, 66)),
+                    list(range(80, 96))]
+        prompts = [fam + [100 + 7 * i + j for j in range(3)]
+                   for i, fam in enumerate(families * 4)]
+        # 8 usable pages = exactly two live 4-page budgets: any page the
+        # trie retains past the current match MUST be swept at admission
+        kw = dict(num_pages=9)
+        with _engine(net, False, **kw) as off:
+            ref = _drive(off, prompts)
+        with _engine(net, True, **kw) as on:
+            got = _drive(on, prompts)
+            on.assert_no_recompiles()
+            st = on.stats()
+        assert got == ref                        # every future resolved OK
+        assert st["prefix"]["hits"] > 0
+        assert st["prefix"]["evictions"] > 0     # pressure really swept
+
+    def test_in_flight_pages_survive_eviction_pressure(self, net):
+        # a slow request decodes while later admissions sweep the trie:
+        # its shared pages are pinned by the pool refcount, so its
+        # output must equal the unshared reference
+        shared = list(range(3, 19))
+        slow = shared + [200]
+        with _engine(net, False, num_pages=17) as off:
+            want = off.submit(slow, max_new_tokens=24).result(60)["tokens"]
+        with _engine(net, True, num_pages=17) as on:
+            on.submit(slow, max_new_tokens=2).result(60)  # seed the trie
+            f = on.submit(slow, max_new_tokens=24)        # shares 2 pages
+            churn = [on.submit(list(range(50 + 11 * i, 66 + 11 * i)),
+                               max_new_tokens=2) for i in range(6)]
+            for c in churn:
+                c.result(60)
+            assert f.result(60)["tokens"] == want
+            on.assert_no_recompiles()
+
+
+# ---------------------------------------------------------------------------
+# generator / speculative integration
+# ---------------------------------------------------------------------------
+
+
+class TestGeneratorPrefix:
+    def test_paged_generator_prefix_identity(self, net):
+        prompts = [list(range(3, 23)) + [40 + i] for i in range(2)]
+        ref_gen = Generator(net, max_seq=64, batch_buckets=(2,),
+                            prompt_buckets=(8, 16, 32),
+                            decode_path="baseline", name="px_gen_off")
+        ref, _ = ref_gen.generate(prompts, max_new_tokens=6)
+        gen = Generator(net, max_seq=64, batch_buckets=(2,),
+                        prompt_buckets=(8, 16, 32), decode_path="baseline",
+                        prefix_cache=True, page_size=8, name="px_gen_on")
+        gen.warmup()
+        first, _ = gen.generate(prompts, max_new_tokens=6)  # seeds trie
+        again, _ = gen.generate(prompts, max_new_tokens=6)  # hits it
+        assert first == ref and again == ref
+        gen.assert_no_recompiles()
+        trie = next(iter(gen._prefix.values()))
+        assert trie.stats()["hits"] > 0
+
+    def test_prefix_requires_paged(self, net):
+        with pytest.raises(MXNetError, match="paged"):
+            Generator(net, max_seq=64, batch_buckets=(1,),
+                      prompt_buckets=(8,), paged=False, prefix_cache=True,
+                      name="px_gen_bad")
+
+    def test_speculative_prefix_identity(self, net):
+        draft = _tiny_llama(num_layers=1)
+        prompts = [list(range(3, 23)) + [40 + i] for i in range(2)]
+        ref_spec = SpeculativeGenerator(
+            net, draft, k=2, max_seq=64, batch_buckets=(2,),
+            prompt_buckets=(8, 16, 32), name="px_spec_off")
+        ref, _ = ref_spec.generate(prompts, max_new_tokens=6)
+        spec = SpeculativeGenerator(
+            net, draft, k=2, max_seq=64, batch_buckets=(2,),
+            prompt_buckets=(8, 16, 32), prefix_cache=True, page_size=8,
+            name="px_spec_on")
+        first, _ = spec.generate(prompts, max_new_tokens=6)
+        again, _ = spec.generate(prompts, max_new_tokens=6)
+        assert first == ref and again == ref
+        # draft and target each consult their own trie: the shared
+        # system prompt prefills at most once per model
+        for gen in (spec.target, spec.draft):
+            trie = next(iter(gen._prefix.values()))
+            assert trie.stats()["hits"] > 0

@@ -1,0 +1,112 @@
+"""Conformance tests for multi-tenant serving (PR-14,
+``mxnet_tpu/serve/tenancy.py``): ``ModelRegistry`` routing by model
+name, the ``MXNET_SERVE_MAX_MODELS`` residency budget with LRU
+(idle-first) eviction, transparent reload of an evicted tenant with
+token-identical output, PR-6 admission semantics passing through the
+tenant's own engine (deadlines -> 504, priority classes), and the
+``tenancy.*`` export surface.
+"""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.llama import get_llama
+from mxnet_tpu.serve import DeadlineExceeded, ModelRegistry, ServeError, \
+    registry_stats
+
+
+def _factory(seed=0):
+    def build():
+        mx.random.seed(seed)
+        net = get_llama("llama_tiny_test")
+        net.initialize()
+        return net
+    return build
+
+
+def _registry(**over):
+    kw = dict(max_models=1, name="t_reg", max_seq=48, num_slots=2,
+              page_size=8, prefill_chunk=8, decode_path="baseline",
+              prefix_cache=True)
+    kw.update(over)
+    return ModelRegistry(**kw)
+
+
+PROMPT = [5, 9, 2, 7]
+
+
+class TestRegistry:
+    def test_routing_eviction_and_warm_reload_identity(self):
+        with _registry() as reg:
+            reg.load("a", factory=_factory(0))
+            ra = reg.submit("a", PROMPT, max_new_tokens=4).result(60)
+            assert len(ra["tokens"]) == 4
+            # budget is 1: loading b evicts a (idle LRU victim)
+            reg.load("b", factory=_factory(1))
+            assert reg.resident() == ["b"]
+            assert reg.get("a") is None          # evicted, factory kept
+            s = reg.summary()
+            assert s["evictions"] == 1 and s["known"] == 2
+            # routing to the evicted tenant transparently reloads it —
+            # same factory, same weights, token-identical output
+            again = reg.submit("a", PROMPT, max_new_tokens=4).result(60)
+            assert again["tokens"] == ra["tokens"]
+            assert reg.resident() == ["a"]
+            s = reg.summary()
+            assert s["loads"] == 3 and s["evictions"] == 2
+            assert s["kv_cache_bytes"]["a"] > 0
+
+    def test_lru_order_and_touch(self):
+        with _registry(max_models=2) as reg:
+            reg.load("a", factory=_factory(0))
+            reg.load("b", factory=_factory(1))
+            reg.load("a")                        # touch: b is now LRU
+            reg.load("c", factory=_factory(2))
+            assert reg.resident() == ["a", "c"]
+
+    def test_unknown_model_is_a_serve_error(self):
+        with _registry() as reg:
+            with pytest.raises(ServeError, match="unknown model"):
+                reg.load("nope")
+            with pytest.raises(ServeError, match="unknown model"):
+                reg.submit("nope", PROMPT)
+
+    def test_admission_semantics_pass_through(self):
+        with _registry() as reg:
+            reg.load("a", factory=_factory(0))
+            # deadline -> 504 from the tenant engine, partial preserved
+            fut = reg.submit("a", PROMPT, max_new_tokens=8,
+                             priority="batch", deadline_ms=0.01)
+            with pytest.raises(DeadlineExceeded):
+                fut.result(60)
+            # the engine still serves afterwards
+            ok = reg.submit("a", PROMPT, max_new_tokens=2,
+                            priority="interactive").result(60)
+            assert len(ok["tokens"]) == 2
+
+    def test_explicit_evict_and_close(self):
+        reg = _registry()
+        try:
+            reg.load("a", factory=_factory(0))
+            assert reg.evict("a") is True
+            assert reg.evict("a") is False       # already cold
+            assert reg.resident() == []
+        finally:
+            reg.close()
+        with pytest.raises(ServeError, match="closed"):
+            reg.load("a")
+
+    def test_registry_stats_and_export_surface(self):
+        with _registry(name="t_export") as reg:
+            reg.load("a", factory=_factory(0))
+            assert registry_stats()["t_export"]["resident"] == 1
+            st = reg.stats()
+            assert "models" in st and "a" in st["models"]
+            from mxnet_tpu.profiler import export
+
+            snap = export.snapshot()
+            assert snap["tenancy.t_export.resident"] == 1
+            assert "tenancy.t_export.kv_cache_bytes.a" in snap
+
+    def test_max_models_validated(self):
+        with pytest.raises(ServeError, match=">= 1"):
+            ModelRegistry(max_models=0)

@@ -105,6 +105,28 @@ if [[ "${TIER1_DECODE:-0}" != "0" ]]; then
         fi
     done
 fi
+# Prefix-cache pass (TIER1_PREFIX=1 to enable): serve_smoke --prefix —
+# 8 ContinuousEngine clients sharing a 20-token system prompt must get
+# token-identical greedy output with the radix prefix cache on vs off,
+# with prefix_hit_rate > 0, zero recompiles, and no page leaks; then
+# two fresh subprocesses warm one MXNET_COMPILE_CACHE_DIR and the
+# second must replay the whole lattice from disk (disk_hits > 0,
+# disk_misses == 0) with identical stable signature keys. Re-run under
+# MXNET_LOCKDEP=1 to pin the trie-outside-pool lock order.
+if [[ "${TIER1_PREFIX:-0}" != "0" ]]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python tools/serve_smoke.py --prefix
+    prefix_rc=$?
+    if [[ "$rc" -eq 0 && "$prefix_rc" -ne 0 ]]; then
+        rc=$prefix_rc
+    fi
+    timeout -k 10 600 env JAX_PLATFORMS=cpu MXNET_LOCKDEP=1 \
+        python tools/serve_smoke.py --prefix
+    prefix_rc=$?
+    if [[ "$rc" -eq 0 && "$prefix_rc" -ne 0 ]]; then
+        rc=$prefix_rc
+    fi
+fi
 # Fleet soak smoke (TIER1_FLEET=0 to skip): ~8s of 64 mixed-priority
 # clients through a Router over 3 replicas under a seeded fault plan,
 # with one deterministic replica kill mid-traffic — asserts fleet-wide

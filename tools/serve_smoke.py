@@ -35,6 +35,18 @@ the llama generation stack under concurrent clients:
   decode steps and land in ``info["deadline_expired"]`` plus the
   ``deadline_expired["decode"]`` metric.
 
+With ``--prefix`` (the ``TIER1_PREFIX=1`` pass) the smoke drives the
+PR-14 "never redo prior work" stack:
+
+* 8 clients share a 20-token system prompt on a ``ContinuousEngine``
+  with the radix prefix cache on: outputs must be token-identical to
+  the cache-off run, ``prefix_hit_rate > 0``, zero recompiles, and
+  every non-free pool page accounted for by the trie after retirement,
+* two ``--prefix-child`` subprocesses warm the same
+  ``MXNET_COMPILE_CACHE_DIR``: identical stable signature keys +
+  greedy tokens, and the second must replay the lattice entirely from
+  disk (``disk_hits > 0, disk_misses == 0``).
+
 Exit status 0 on pass; nonzero with a one-line reason otherwise.
 """
 import os
@@ -89,6 +101,11 @@ def _trace_epilogue(sess, batcher_cls, runner, x, trace_out):
 
 
 def main():
+    if "--prefix-child" in sys.argv:
+        cache_dir = sys.argv[sys.argv.index("--prefix-child") + 1]
+        return _run_prefix_child(cache_dir)
+    if "--prefix" in sys.argv:
+        return _run_prefix()
     if "--decode-path" in sys.argv:
         path = sys.argv[sys.argv.index("--decode-path") + 1]
         return _run_decode(path)
@@ -98,6 +115,137 @@ def main():
         os.environ.setdefault("MXNET_TRACE", "1")
         os.environ.setdefault("MXNET_FLIGHT_RECORDER", "1")
     return _run(trace_out)
+
+
+def _run_prefix_child(cache_dir):
+    """Subprocess half of --prefix: enable the persistent compile cache
+    BEFORE any build, warm a ContinuousEngine over the standard tiny
+    lattice, decode one request, and print a greppable JSON line with
+    the disk hit/miss counters, the stable signature keys, and the
+    tokens — the parent asserts process 2 compiles nothing new and both
+    processes agree on keys + output."""
+    import json
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import cachedop, compile_cache
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.serve import ContinuousEngine
+
+    compile_cache.enable(cache_dir)
+    mx.random.seed(0)
+    model = get_llama("llama_tiny_test")
+    model.initialize()
+    eng = ContinuousEngine(model, max_seq=64, num_slots=4, page_size=8,
+                           prefill_chunk=8, decode_path="baseline",
+                           name="smoke_prefix_child")
+    eng.start()
+    try:
+        out = eng.submit([5, 9, 2, 4], max_new_tokens=6).result(60)
+    finally:
+        eng.close()
+    keys = sorted({k for op in list(cachedop._instances)
+                   for k in op.signature_keys()})
+    print("SERVE_SMOKE_PREFIX_CHILD=" + json.dumps({
+        "disk_hits": compile_cache.disk_hits(),
+        "disk_misses": compile_cache.disk_misses(),
+        "keys": keys, "tokens": out["tokens"]}), flush=True)
+    return 0
+
+
+def _run_prefix():
+    import json
+    import subprocess
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.serve import ContinuousEngine
+
+    mx.random.seed(0)
+    model = get_llama("llama_tiny_test")
+    model.initialize()
+
+    system = list(range(3, 23))  # 20-token shared system prompt
+    prompts = [system + [30 + i, 40 + i, 50 + i] for i in range(8)]
+
+    def run_engine(prefix_on):
+        eng = ContinuousEngine(model, max_seq=64, num_slots=4, page_size=8,
+                               prefill_chunk=8, decode_path="baseline",
+                               prefix_cache=prefix_on,
+                               name=f"smoke_prefix_{int(bool(prefix_on))}")
+        eng.start()
+        try:
+            # first client retires (donating its prefix to the trie)
+            # before the concurrent wave arrives
+            first = eng.submit(prompts[0], max_new_tokens=8).result(60)
+            futs = [eng.submit(p, max_new_tokens=8) for p in prompts[1:]]
+            outs = [first["tokens"]] + [f.result(60)["tokens"]
+                                        for f in futs]
+            eng.assert_no_recompiles()
+            return outs, eng.metrics.snapshot(), eng.stats()
+        finally:
+            eng.close()
+
+    ref, _, _ = run_engine(False)
+    got, snap, stats = run_engine(True)
+    if got != ref:
+        print(f"SERVE_SMOKE_PREFIX=FAIL prefix-cache-on outputs diverged "
+              f"from cache-off: {got} != {ref}")
+        return 1
+    if not snap["prefix_hit_rate"] > 0:
+        print(f"SERVE_SMOKE_PREFIX=FAIL shared system prompt produced no "
+              f"trie hits (snapshot={snap})")
+        return 1
+    if stats["pool"]["pages_used"] != stats["prefix"]["pages_held"]:
+        print(f"SERVE_SMOKE_PREFIX=FAIL retired engine leaks pages "
+              f"beyond the trie: pool={stats['pool']} "
+              f"prefix={stats['prefix']}")
+        return 1
+
+    # disk half: two fresh processes over one cache dir — the second
+    # must warm entirely from disk (no new compiles) with identical
+    # stable signature keys and identical greedy output
+    child = [sys.executable, os.path.abspath(__file__)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    docs = []
+    with tempfile.TemporaryDirectory() as d:
+        for i in (1, 2):
+            proc = subprocess.run(
+                child + ["--prefix-child", d], env=env,
+                capture_output=True, text=True, timeout=600)
+            line = [ln for ln in proc.stdout.splitlines()
+                    if ln.startswith("SERVE_SMOKE_PREFIX_CHILD=")]
+            if proc.returncode != 0 or not line:
+                print(f"SERVE_SMOKE_PREFIX=FAIL child {i} rc="
+                      f"{proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+                return 1
+            docs.append(json.loads(
+                line[0].split("=", 1)[1]))
+    p1, p2 = docs
+    if p1["keys"] != p2["keys"] or not p1["keys"]:
+        print(f"SERVE_SMOKE_PREFIX=FAIL stable signature keys differ "
+              f"across processes: {p1['keys']} != {p2['keys']}")
+        return 1
+    if p1["tokens"] != p2["tokens"]:
+        print(f"SERVE_SMOKE_PREFIX=FAIL disk-warmed process output "
+              f"diverged: {p2['tokens']} != {p1['tokens']}")
+        return 1
+    if p1["disk_misses"] == 0:
+        print(f"SERVE_SMOKE_PREFIX=FAIL cold process reported no disk "
+              f"misses (doc={p1})")
+        return 1
+    if not (p2["disk_hits"] > 0 and p2["disk_misses"] == 0):
+        print(f"SERVE_SMOKE_PREFIX=FAIL warm process did not replay the "
+              f"lattice from disk: hits={p2['disk_hits']} "
+              f"misses={p2['disk_misses']}")
+        return 1
+    print(f"SERVE_SMOKE_PREFIX=PASS clients={len(prompts)} "
+          f"hit_rate={snap['prefix_hit_rate']:.3f} "
+          f"tokens_skipped={snap['prefix_tokens_skipped']} "
+          f"signatures={len(p1['keys'])} "
+          f"cold_disk_misses={p1['disk_misses']} "
+          f"warm_disk_hits={p2['disk_hits']}")
+    return 0
 
 
 def _run_decode(path):
