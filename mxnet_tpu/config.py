@@ -462,6 +462,26 @@ register_flag(
     "Per-process cap on automatic flight-recorder dump files (first "
     "escalations win; later ones only land in the ring).", int)
 register_flag(
+    "MXNET_KVSTORE_BUCKET_MB", 0.0,
+    "Coalesce per-parameter collectives into flat fusion buffers of this "
+    "many MB (kvstore.bucketing.GradBucketer): gradient pushpull in "
+    "gluon.Trainer and the ZeRO param all-gathers in ShardedTrainer both "
+    "collapse to one collective per bucket. 0 (default): per-parameter "
+    "collectives, the pre-bucketing behavior.", float)
+register_flag(
+    "MXNET_KVSTORE_OVERLAP", True,
+    "With bucketing on, dispatch every bucket's collective async "
+    "(front-layer buckets first) and let the engine overlap them with "
+    "compute; 0 blocks after each bucket flush — the ablation baseline, "
+    "not a correctness knob (both settings are bitwise-identical).",
+    _bool)
+register_flag(
+    "MXNET_GRADIENT_COMPRESSION", "",
+    "Gradient compression for dist_tpu pushpull: '2bit' quantizes every "
+    "pushed grad to {-threshold, 0, +threshold} with per-(key, replica) "
+    "error-feedback residuals (kvstore.gradient_compression). Empty "
+    "(default): off — compression is approximate; opt in per run.")
+register_flag(
     "MXNET_METRICS_PORT", 0,
     "Serve the unified telemetry surface (profiler.export) over stdlib "
     "HTTP on this port: /metrics (Prometheus text), /healthz (serving "

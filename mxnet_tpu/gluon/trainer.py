@@ -71,6 +71,10 @@ class Trainer:
                 f"clip_global_norm must be > 0, got {clip_global_norm}")
         self._clip_global_norm = clip_global_norm
         self._grad_fault_checked = False
+        # gradient-bucketing plan cache (MXNET_KVSTORE_BUCKET_MB): built
+        # lazily from the params, NOT the store, so it survives
+        # rebind_kvstore across an elastic restart
+        self._bucket_plan = None
 
     # -- properties -------------------------------------------------------
     @property
@@ -139,7 +143,8 @@ class Trainer:
     def rebind_kvstore(self, kvstore):
         """Swap the gradient-reduction backend mid-run (elastic restart:
         the old store's mesh lost a device group; the new store was built
-        on the surviving mesh). The optimizer, states, and step count are
+        on the surviving mesh). The optimizer, states, step count, and
+        gradient bucket plan (keyed by the params, not the store) are
         untouched — only the reduction path changes."""
         if self._update_on_kvstore:
             raise MXNetError(
@@ -297,18 +302,128 @@ class Trainer:
         self._allreduce_grads()
 
     def _allreduce_grads(self):
+        # NOTE: compression is NOT applied here — set_gradient_compression
+        # installed it on the store, and dist_tpu.pushpull quantizes each
+        # replica (with per-(key, replica) error feedback) before the
+        # reduce. The old Trainer-side branch pushed packed uint8 buffers
+        # at float outs, which summed the *codes*.
         kv = self._kvstore
         if kv is None:
+            return
+        from .. import config as _cfg
+
+        bucket_mb = float(_cfg.get("MXNET_KVSTORE_BUCKET_MB") or 0.0)
+        if bucket_mb > 0 and self._allreduce_grads_bucketed(kv, bucket_mb):
             return
         for i, p in enumerate(self._params):
             grads = p.list_grad()
             if len(grads) > 1:
-                if self._compression_params and hasattr(kv, "_compression"):
-                    compressed = [kv._compression.compress((i, j), g)
-                                  for j, g in enumerate(grads)]
-                    kv.pushpull(i, compressed, out=grads)
-                else:
-                    kv.pushpull(i, grads, out=grads)
+                # registration order ≈ forward order: the front layer's
+                # grads are what the NEXT forward touches first, so they
+                # carry the highest priority (higher settles first)
+                kv.pushpull(i, grads, out=grads, priority=-i)
+
+    def _grad_bucket_specs(self, bucket_mb):
+        """(cached) bucket plan over the dense, multi-replica, floating
+        grads, in registration order — deterministic, so every process
+        builds the identical plan. Keyed by the params, not the store:
+        it survives ``rebind_kvstore`` across an elastic restart."""
+        if self._bucket_plan is not None \
+                and self._bucket_plan[0] == bucket_mb:
+            return self._bucket_plan[1], self._bucket_plan[2]
+        import numpy as _onp
+
+        from ..kvstore.bucketing import GradBucketer
+        from ..ndarray.sparse import RowSparseNDArray
+
+        items, index_of = [], {}
+        for i, p in enumerate(self._params):
+            grads = p.list_grad()
+            if len(grads) < 2:
+                continue
+            g0 = grads[0]
+            if isinstance(g0, RowSparseNDArray):
+                continue
+            dt = _onp.dtype(g0.dtype)
+            if not _onp.issubdtype(dt, _onp.floating):
+                continue
+            items.append((str(i), tuple(g0.shape), dt))
+            index_of[str(i)] = i
+        specs = GradBucketer(bucket_mb=bucket_mb).plan(items)
+        self._bucket_plan = (bucket_mb, specs, index_of)
+        return specs, index_of
+
+    def _allreduce_grads_bucketed(self, kv, bucket_mb):
+        """Coalesced allreduce: registration-ordered grads packed into
+        size-targeted fusion buffers, flushed front-layers-first, sliced
+        back into the per-param grads. With ``MXNET_KVSTORE_OVERLAP`` on
+        (default) all buckets go down in ONE grouped pushpull and the
+        host never blocks between them — XLA's async dispatch overlaps
+        the collectives; off, each bucket is flushed and synced in turn
+        (the ablation baseline). Returns False when nothing is
+        bucketable (single replica / sparse-only) so the caller falls
+        back to the per-param path. Bitwise parity with the unbucketed
+        path is by construction: concat + the same replica-ordered sum +
+        slice touches each element with the identical add order."""
+        specs, index_of = self._grad_bucket_specs(bucket_mb)
+        if not specs:
+            return False
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        from .. import config as _cfg
+        from ..kvstore import bucketing as _bk
+
+        overlap = bool(_cfg.get("MXNET_KVSTORE_OVERLAP"))
+        n_rep = len(self._params[index_of[specs[0].names[0]]].list_grad())
+        t0 = time.perf_counter()
+        keys, groups, prios = [], [], []
+        bucketed_is = set()
+        for spec in specs:
+            vals = []
+            for j in range(n_rep):
+                parts = [self._params[index_of[nm]].list_grad()[j]
+                         ._data.ravel() for nm in spec.names]
+                pad = spec.total - spec.numel
+                if pad:
+                    parts.append(jnp.zeros((pad,), dtype=spec.dtype))
+                vals.append(NDArray(jnp.concatenate(parts)
+                                    if len(parts) > 1 else parts[0]))
+            keys.append(spec.key)
+            groups.append(vals)
+            prios.append(spec.priority)
+            _bk.record_flush(spec.nbytes)
+            bucketed_is.update(index_of[nm] for nm in spec.names)
+        if overlap:
+            # one grouped dispatch; the store settles buckets by priority
+            kv.pushpull(keys, groups, out=groups, priority=prios)
+        else:
+            for k, g, pr in zip(keys, groups, prios):
+                kv.pushpull(k, g, out=g, priority=pr)
+                jax.block_until_ready([nd._data for nd in g])
+        # leftover multi-replica grads (sparse / non-float) reduce behind
+        # the buckets on the per-param path
+        for i, p in enumerate(self._params):
+            if i in bucketed_is:
+                continue
+            grads = p.list_grad()
+            if len(grads) > 1:
+                kv.pushpull(i, grads, out=grads, priority=-i)
+        if overlap:
+            jax.block_until_ready(
+                [nd._data for g in groups for nd in g])
+        _bk.record_overlap_window_ms((time.perf_counter() - t0) * 1e3)
+        # slice the reduced flat buffers back into the per-param grads
+        for spec, g in zip(specs, groups):
+            for j in range(n_rep):
+                fd = g[j]._data
+                for nm, off, size, shape in spec.items():
+                    self._params[index_of[nm]].list_grad()[j] \
+                        ._set_data_internal(
+                            fd[off:off + size].reshape(shape))
+        return True
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()

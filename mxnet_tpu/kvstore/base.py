@@ -35,6 +35,16 @@ class KVStoreBase:
         raise NotImplementedError
 
     def pushpull(self, key, value, out=None, priority=0):
+        """Reduce ``value`` across devices/workers and (optionally) pull
+        into ``out``.
+
+        ``priority`` contract (every backend honors it or rejects it
+        loudly — silent ignoring is a bug): a scalar applies to all keys
+        and keeps call order; a list/tuple must be exactly 1:1 with the
+        grouped keys and settles them by DESCENDING priority (stable),
+        so front-of-network gradients — which the next step's forward
+        needs first — flush before the tail. A mismatched list raises
+        ``MXNetError``."""
         raise NotImplementedError
 
     @staticmethod

@@ -25,6 +25,13 @@ class GradientCompression:
             raise MXNetError(f"unsupported compression type {type!r}")
         self.type = type
         self.threshold = float(threshold)
+        if not self.threshold > 0:
+            # threshold=0 quantizes EVERY value to 0 while the residual
+            # silently swallows the whole gradient — reject it loudly
+            # (reference kvstore.cc accepted it and trained on zeros)
+            raise MXNetError(
+                f"2bit gradient compression needs threshold > 0, got "
+                f"{self.threshold}")
         self._residual = {}
         self._shapes = {}
 

@@ -39,6 +39,10 @@ class KVStoreLocal(KVStoreBase):
         self._store = {}
         self._updater = None
         self._optimizer = None
+        self._compression = None
+        # settle-order telemetry: (key, priority) per flushed key, most
+        # recent last — the priority regression tests read it
+        self._flush_log = []
 
     # -- legacy init/push/pull API (reference kvstore.h) ------------------
     def init(self, key, value):
@@ -88,9 +92,24 @@ class KVStoreLocal(KVStoreBase):
                     d._set_data_internal(picked)
 
     def pushpull(self, key, value, out=None, priority=0):
-        self.push(key, value, priority)
-        if out is not None:
-            self.pull(key, out, priority)
+        """Push-then-pull per key. ``priority`` is honored (reference
+        ``p3`` semantics, higher first): a scalar applies to every key; a
+        list/tuple must be 1:1 with the grouped keys and orders the
+        flushes by DESCENDING priority (stable — equal priorities keep
+        call order), so front-layer grads settle before the tail."""
+        keys, values = _normalize_grouped(key, value)
+        _, outs = _normalize_grouped(key, out)
+        for idx, prio in _priority_order(keys, priority):
+            k = keys[idx]
+            self.push(k, values[idx])
+            if outs[idx] is not None:
+                self.pull(k, outs[idx])
+            self._record_flush(k, prio)
+
+    def _record_flush(self, k, prio):
+        self._flush_log.append((k, prio))
+        if len(self._flush_log) > 4096:
+            del self._flush_log[:2048]
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)
@@ -176,3 +195,21 @@ def _int_key(k):
         return int(k)
     except (TypeError, ValueError):
         return k
+
+
+def _priority_order(keys, priority):
+    """Flush order for grouped keys: ``[(index, priority), ...]`` sorted
+    by DESCENDING priority, stable. A scalar priority keeps call order; a
+    per-key list must match the key count — anything else is loudly
+    rejected (the reference silently ignored the argument)."""
+    if isinstance(priority, (list, tuple)):
+        if len(priority) != len(keys):
+            raise MXNetError(
+                f"pushpull: got {len(priority)} priorities for "
+                f"{len(keys)} keys — pass one int per key (or a single "
+                "scalar for all)")
+        prios = [int(p) for p in priority]
+    else:
+        prios = [int(priority)] * len(keys)
+    order = sorted(range(len(keys)), key=lambda i: -prios[i])
+    return [(i, prios[i]) for i in order]

@@ -42,6 +42,12 @@ class Optimizer:
     # back to the reference's eager per-parameter path).
     fused_safe = True
 
+    # False for optimizers whose update depends on whole-tensor reductions
+    # (layer-wise norms): concatenating several params into one flat
+    # fusion buffer (kvstore.bucketing) would change their math, so the
+    # ZeRO bucketed path refuses them.
+    elementwise = True
+
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=None, lr_scheduler=None,
                  multi_precision=False, param_dict=None, aggregate_num=0,
@@ -514,6 +520,8 @@ SignSGD = Signum
 class LARS(Optimizer):
     """Layer-wise adaptive rate scaling (reference ``optimizer/lars.py``)."""
 
+    elementwise = False  # per-tensor norm ratio
+
     def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -539,6 +547,8 @@ class LARS(Optimizer):
 @register
 class LAMB(Optimizer):
     """Layer-wise adaptive moments for batch training (reference lamb)."""
+
+    elementwise = False  # per-tensor trust ratio
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, lower_bound=None, upper_bound=None,
@@ -576,6 +586,8 @@ class LAMB(Optimizer):
 @register
 class LANS(Optimizer):
     """Accelerated large-batch (normalized gradients) variant of LAMB."""
+
+    elementwise = False  # per-tensor grad normalization + trust ratio
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, **kwargs):

@@ -260,7 +260,7 @@ class ShardedTrainer:
     def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
                  mesh=None, rules: Optional[ShardingRules] = None,
                  batch_spec=None, dtype=None, aux_loss_weight=0.01,
-                 abstract=False):
+                 abstract=False, zero_bucket_mb=None):
         import jax
         from jax.sharding import NamedSharding
 
@@ -341,6 +341,41 @@ class ShardedTrainer:
             # the SPMD step too
             self.optimizer.param_dict = {
                 i: params_od[n] for i, n in enumerate(self._train_names)}
+        # ZeRO collective bucketing (kvstore.bucketing): opt-in via
+        # MXNET_KVSTORE_BUCKET_MB or the zero_bucket_mb argument. Default
+        # -rule fsdp params are stored canonically as flat P(axis)-sharded
+        # fusion buffers, so the step gathers ONE buffer per bucket
+        # instead of one per param (the 1829-gather lowering collapses to
+        # a bucket-proportional count). Pack/unpack only ever happens on
+        # the host (init, sync_to_block) or on the replicated post-gather
+        # array — never on a sharded array in-trace, which would insert
+        # resharding collectives.
+        self._zb_specs = None
+        self._zb_axis = None
+        self._zb_names = set()
+        self._zb_by_key = {}
+        if zero_bucket_mb is None:
+            from .. import config as _cfg
+
+            zero_bucket_mb = _cfg.get("MXNET_KVSTORE_BUCKET_MB")
+        if zero_bucket_mb and float(zero_bucket_mb) > 0 \
+                and self._pp_meta is None:
+            self._setup_zero_buckets(params, params_od,
+                                     float(zero_bucket_mb))
+        if self._zb_specs:
+            # optimizer units follow _train_keys: a bucket takes its
+            # (uniform, plan-segregated) lr/wd mults from any member
+            self._train_keys = ([s.key for s in self._zb_specs]
+                                + [n for n in self._train_names
+                                   if n not in self._zb_names])
+            self.optimizer.param_dict = {
+                i: params_od[self._zb_by_key[k].names[0]
+                             if k in self._zb_by_key else k]
+                for i, k in enumerate(self._train_keys)
+                if (self._zb_by_key[k].names[0]
+                    if k in self._zb_by_key else k) in params_od}
+        else:
+            self._train_keys = list(self._train_names)
         # placement: params + optimizer state onto the mesh by rule
         if self._abstract:
             self.params = {
@@ -349,10 +384,16 @@ class ShardedTrainer:
                     sharding=NamedSharding(
                         self.mesh,
                         self.rules.spec_for(n, s.shape, self.mesh)))
-                for n, s in params.items()}
+                for n, s in params.items() if n not in self._zb_names}
+            if self._zb_specs:
+                self.params.update(self._zb_abstract_buckets())
             self._opt_states = self._init_opt_states_abstract()
         else:
-            self.params = self.rules.shard(params, self.mesh)
+            self.params = self.rules.shard(
+                {n: a for n, a in params.items()
+                 if n not in self._zb_names}, self.mesh)
+            if self._zb_specs:
+                self.params.update(self._zb_pack_buckets(params))
             self._opt_states = self._init_opt_states()
         self._step_jit = None
         self._compiled = {}   # batch-signature -> AOT executable
@@ -360,6 +401,87 @@ class ShardedTrainer:
         self._step_flops = None
         self._step_count = 0
         self._key = jax.random.PRNGKey(0)
+
+    # -- ZeRO bucketing ---------------------------------------------------
+    def _setup_zero_buckets(self, params, params_od, bucket_mb):
+        """Plan flat fusion buffers over the default-rule (fsdp) float
+        params. Explicitly-ruled params (tp/pp layouts) keep their
+        per-param sharding — replicating them through a bucket gather
+        would undo the layout the rule asked for."""
+        import jax.numpy as jnp
+
+        from ..kvstore import bucketing as _bkt
+
+        axis = self.rules.default_axis
+        if not axis or axis not in self.mesh.axis_names:
+            return
+        items = []
+        for n in self._train_names:
+            if n in self._frozen_names:
+                continue
+            if any(pat.search(n) for pat, _ in self.rules.rules):
+                continue
+            s = params[n]
+            if not jnp.issubdtype(jnp.dtype(s.dtype), jnp.floating):
+                continue
+            p = params_od.get(n)
+            group = (float(getattr(p, "lr_mult", 1.0)),
+                     float(getattr(p, "wd_mult", 1.0)))
+            items.append((n, tuple(s.shape), jnp.dtype(s.dtype), group))
+        if not items:
+            return
+        opt = self.optimizer
+        if not (getattr(opt, "fused_safe", True)
+                and getattr(opt, "elementwise", True)):
+            raise MXNetError(
+                f"ZeRO bucketing needs an elementwise optimizer: "
+                f"{type(opt).__name__} keeps per-tensor norms or python "
+                "-side state, so updating a flat fusion buffer would "
+                "change its math — unset MXNET_KVSTORE_BUCKET_MB (or "
+                "zero_bucket_mb) for this optimizer")
+        n_shards = int(self.mesh.shape[axis])
+        self._zb_specs = _bkt.GradBucketer(
+            bucket_mb, pad_multiple=n_shards).plan(items)
+        self._zb_axis = axis
+        self._zb_names = {n for s in self._zb_specs for n in s.names}
+        self._zb_by_key = {s.key: s for s in self._zb_specs}
+
+    def _spec_of(self, key, shape):
+        """PartitionSpec for a ``self.params`` key: flat buckets shard
+        P(axis) (their padded totals divide evenly by construction);
+        everything else goes through the rule table."""
+        if key in self._zb_by_key:
+            return _P()(self._zb_axis)
+        return self.rules.spec_for(key, shape, self.mesh)
+
+    def _zb_sharding(self):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, _P()(self._zb_axis))
+
+    def _zb_abstract_buckets(self):
+        import jax
+
+        sh = self._zb_sharding()
+        return {s.key: jax.ShapeDtypeStruct((s.total,), s.dtype,
+                                            sharding=sh)
+                for s in self._zb_specs}
+
+    def _zb_pack_buckets(self, params):
+        """Host-side pack of the block's materialized params into the
+        sharded flat buffers (init-time only)."""
+        import jax
+        import numpy as onp
+
+        sh = self._zb_sharding()
+        out = {}
+        for spec in self._zb_specs:
+            flat = onp.zeros((spec.total,), dtype=spec.dtype)
+            for n, off, size, shape in spec.items():
+                flat[off:off + size] = onp.asarray(
+                    jax.device_get(params[n])).reshape(-1)
+            out[spec.key] = jax.device_put(flat, sh)
+        return out
 
     # -- optimizer state --------------------------------------------------
     def _init_opt_states(self):
@@ -370,7 +492,7 @@ class ShardedTrainer:
         from ..ndarray.ndarray import NDArray
 
         states = {}
-        for i, n in enumerate(self._train_names):
+        for i, n in enumerate(self._train_keys):
             if n in self._frozen_names:
                 # frozen leaves are never updated: no momentum/variance
                 # buffers (they'd waste 2x the frozen size in HBM)
@@ -379,7 +501,7 @@ class ShardedTrainer:
             w = NDArray(self.params[n])
             st = self.optimizer.create_state_multi_precision(i, w)
             flat = [s._data for s in _flatten_state(st)]
-            spec = self.rules.spec_for(n, self.params[n].shape, self.mesh)
+            spec = self._spec_of(n, self.params[n].shape)
             placed = []
             for s in flat:
                 sh = (NamedSharding(self.mesh, spec) if s.shape == w.shape
@@ -400,7 +522,7 @@ class ShardedTrainer:
 
         P = _P()
         states = {}
-        for i, n in enumerate(self._train_names):
+        for i, n in enumerate(self._train_keys):
             w_struct = self.params[n]
 
             def mk(i=i, w_struct=w_struct):
@@ -411,7 +533,7 @@ class ShardedTrainer:
                 return tuple(s._data for s in _flatten_state(st))
 
             flat = jax.eval_shape(mk)
-            spec = self.rules.spec_for(n, w_struct.shape, self.mesh)
+            spec = self._spec_of(n, w_struct.shape)
             states[n] = tuple(
                 jax.ShapeDtypeStruct(
                     s.shape, s.dtype,
@@ -433,11 +555,11 @@ class ShardedTrainer:
 
         if self._step_jit is None:
             self._build_step()
-        n_train = len(self._train_names)
+        n_train = len(self._train_keys)
         lrs = tuple(self.optimizer._get_lr(i) for i in range(n_train))
         wds = tuple(self.optimizer._get_wd(i) for i in range(n_train))
         key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
-        train = {n: self.params[n] for n in self._train_names}
+        train = {n: self.params[n] for n in self._train_keys}
         state = {n: self.params[n] for n in self._state_names}
         args = (train, state, self._opt_states, batch_struct, labels_struct,
                 key_struct, lrs, wds, 1)
@@ -460,13 +582,17 @@ class ShardedTrainer:
     def _build_step(self):
         import jax
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding
 
         apply_fn = self._apply_fn
         loss_fn = self.loss_fn
         opt = self.optimizer
-        train_names = self._train_names
+        train_names = self._train_keys
         state_names = self._state_names
         has_state = bool(state_names)
+        zb_specs = self._zb_specs
+        zb_keys = frozenset(self._zb_by_key)
+        repl_shard = NamedSharding(self.mesh, _P()())
 
         amp_dtype = self._dtype
         # inner-AMP protocol: the block casts params at use inside its own
@@ -484,6 +610,26 @@ class ShardedTrainer:
             return x
 
         def loss_of(train_params, state_params, batch, labels, key):
+            if zb_specs:
+                # flat-bucket ZeRO: ONE all-gather per bucket (the
+                # constraint to replicated), then static slices of the
+                # replicated buffer rebuild the per-param views. Buckets
+                # are gathered front-first (plan order); XLA's latency
+                # -hiding scheduler prefetches bucket k+1 behind the
+                # layers consuming bucket k. The per-param gathers the
+                # partitioner would otherwise insert at every use site
+                # collapse to len(zb_specs) collectives.
+                full = {}
+                for spec in zb_specs:
+                    flat = jax.lax.with_sharding_constraint(
+                        train_params[spec.key], repl_shard)
+                    for pn, off, size, shape in spec.items():
+                        full[pn] = jax.lax.slice_in_dim(
+                            flat, off, off + size).reshape(shape)
+                for pn, a in train_params.items():
+                    if pn not in zb_keys:
+                        full[pn] = a
+                train_params = full
             params = dict(train_params)
             params.update(state_params)
             if amp_dtype is not None and not inner_amp:
@@ -531,13 +677,9 @@ class ShardedTrainer:
                     for n, v in new_state.items()}
             return jnp.mean(ldata.astype(jnp.float32)), new_state
 
-        from jax.sharding import NamedSharding
-
         mesh = self.mesh
         p_shard = {
-            n: NamedSharding(mesh,
-                             self.rules.spec_for(n, self.params[n].shape,
-                                                 mesh))
+            n: NamedSharding(mesh, self._spec_of(n, self.params[n].shape))
             for n in self.params
         }
         train_shard = {n: p_shard[n] for n in train_names}
@@ -672,7 +814,7 @@ class ShardedTrainer:
         """Advance step/update counts by n; return (lrs, wds, t_first)."""
         t_first = self._step_count + 1
         self._step_count += n
-        n_train = len(self._train_names)
+        n_train = len(self._train_keys)
         for i in range(n_train):
             self.optimizer._index_update_count[i] = self._step_count
         lrs = tuple(self.optimizer._get_lr(i) for i in range(n_train))
@@ -724,7 +866,7 @@ class ShardedTrainer:
         d, l = self._unwrap_batch(data, labels)
         lrs, wds, t = self._advance_optimizer(1)
         self._key, sub = jax.random.split(self._key)
-        train = {n: self.params[n] for n in self._train_names}
+        train = {n: self.params[n] for n in self._train_keys}
         state = {n: self.params[n] for n in self._state_names}
         args = (train, state, self._opt_states, d, l, sub, lrs, wds, t)
         sig = tuple(
@@ -763,7 +905,7 @@ class ShardedTrainer:
             l = jax.tree_util.tree_map(lambda x: x[:n], l)
         lrs, wds, t0 = self._advance_optimizer(n)
         self._key, sub = jax.random.split(self._key)
-        train = {k: self.params[k] for k in self._train_names}
+        train = {k: self.params[k] for k in self._train_keys}
         state = {k: self.params[k] for k in self._state_names}
         args = (train, state, self._opt_states, d, l, sub, lrs, wds, t0)
         sig = ("step_n", n, tuple(
@@ -839,7 +981,7 @@ class ShardedTrainer:
         self._step_count = int(blob["step_count"])
         if "rng_key" in blob:
             self._key = jax.device_put(blob["rng_key"])
-        for i in range(len(self._train_names)):
+        for i in range(len(self._train_keys)):
             self.optimizer._index_update_count[i] = self._step_count
 
     def sync_to_block(self):
@@ -849,6 +991,17 @@ class ShardedTrainer:
         import jax.numpy as jnp
 
         params_od = self.block.collect_params()
+        if self._zb_specs:
+            import jax
+            import numpy as onp
+
+            # bucketed params live only inside the flat buffers: gather
+            # each to host once and slice the members back out
+            for spec in self._zb_specs:
+                host = onp.asarray(jax.device_get(self.params[spec.key]))
+                for n, off, size, shape in spec.items():
+                    params_od[n].data()._set_data_internal(
+                        jnp.asarray(host[off:off + size].reshape(shape)))
         for n, arr in self.params.items():
             if n.startswith("__"):
                 continue

@@ -80,12 +80,29 @@ def shrink_mesh(mesh, lost, axis="dp", power_of_two=True):
     ring-scheduled over power-of-two groups, and dp8→dp4 keeps per-shape
     executables reusable where dp7 would not. Returns the new Mesh (the
     caller decides whether to :func:`set_mesh` it).
+
+    Only data-parallel-like axes (``dp``/``fsdp``) can shrink: dropping a
+    slice of a model-parallel axis would change every sharded parameter's
+    shape, so that raises :class:`~..resilience.elastic.MeshDegraded`
+    naming the unsupported axis. Likewise a non-power-of-two survivor
+    count on a *composite* (multi-axis) mesh is rejected even with
+    ``power_of_two=False`` — the other axes' ring schedules assume
+    power-of-two groups (a single-axis dp mesh may shrink to any size;
+    regression-pinned dp8→dp7).
     """
     from jax.sharding import Mesh
 
     if axis not in mesh.axis_names:
         raise MXNetError(
             f"shrink_mesh: axis {axis!r} not in mesh axes {mesh.axis_names}")
+    if axis not in ("dp", "fsdp"):
+        from ..resilience.elastic import MeshDegraded
+
+        raise MeshDegraded(
+            f"shrink_mesh: axis {axis!r} is not a data-parallel axis — "
+            "dropping a slice of a model-parallel axis would change every "
+            "sharded parameter's shape; only 'dp'/'fsdp' replicas can be "
+            "dropped elastically", mesh_size=int(mesh.devices.size))
     ax = mesh.axis_names.index(axis)
     lost = sorted({int(i) for i in (lost if hasattr(lost, "__iter__")
                                     else [lost])})
@@ -96,6 +113,17 @@ def shrink_mesh(mesh, lost, axis="dp", power_of_two=True):
             f"shrink_mesh: lost indices {bad} out of range for axis "
             f"{axis!r} of size {size}")
     keep = [i for i in range(size) if i not in lost]
+    if not power_of_two and len(mesh.axis_names) > 1 \
+            and len(keep) > 1 and (len(keep) & (len(keep) - 1)):
+        from ..resilience.elastic import MeshDegraded
+
+        raise MeshDegraded(
+            f"shrink_mesh: axis {axis!r} would survive with {len(keep)} "
+            "slots — not a power of two. On a composite mesh "
+            f"{dict(zip(mesh.axis_names, mesh.devices.shape))} the other "
+            "axes' ring schedules assume power-of-two groups; use "
+            "power_of_two=True to truncate, or rebuild the mesh",
+            mesh_size=int(mesh.devices.size))
     if power_of_two and len(keep) > 1:
         target = 1 << (len(keep).bit_length() - 1)
         keep = keep[:target]

@@ -108,6 +108,10 @@ def snapshot(include_aggregates=True):
     if kv is not None:
         _flatten("kvstore", kv.collective_stats(), out)
 
+    bk = sys.modules.get("mxnet_tpu.kvstore.bucketing")
+    if bk is not None:
+        _flatten("kvstore", bk.bucket_stats(), out)
+
     rescnt = sys.modules.get("mxnet_tpu.resilience.counters")
     if rescnt is not None:
         for k, v in rescnt.snapshot().items():

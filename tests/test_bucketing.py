@@ -1,0 +1,425 @@
+"""PR-15 collective bucketing/overlap/compression contract.
+
+Covers the `kvstore.bucketing` plan (deterministic, dtype/group
+-segregated, front-first priorities), the Trainer's coalesced allreduce
+(bitwise parity vs unbucketed, overlap on AND off), the priority settle
+-order contract across kvstore backends (honor-or-reject), the 2-bit
+gradient compression round-trip + error feedback + bounded divergence,
+the ZeRO flat-bucket lowering collapse (instruction-level all-gather
+count), shrink_mesh's MeshDegraded taxonomy, and the new kvstore.*
+counters through profiler.export.snapshot().
+"""
+import os
+import re
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore import bucketing
+from mxnet_tpu.kvstore.bucketing import BucketSpec, GradBucketer
+from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+from mxnet_tpu.kvstore.kvstore_local import KVStoreLocal, _priority_order
+
+
+# -- plan ------------------------------------------------------------------
+
+def _items(n, size=1024, dtype="float32"):
+    return [(f"p{i}", (size,), onp.dtype(dtype)) for i in range(n)]
+
+
+def test_plan_is_deterministic_and_ordered():
+    b = GradBucketer(bucket_mb=0.01)  # 10 KB -> 2 fp32 1024-vectors each
+    specs1 = b.plan(_items(6))
+    specs2 = GradBucketer(bucket_mb=0.01).plan(_items(6))
+    assert [s.names for s in specs1] == [s.names for s in specs2]
+    # registration order preserved within and across buckets
+    flat = [n for s in specs1 for n in s.names]
+    assert flat == [f"p{i}" for i in range(6)]
+    # front-first: bucket 0 (first-registered members) has top priority
+    prios = [s.priority for s in specs1]
+    assert prios == sorted(prios, reverse=True)
+    assert specs1[0].names[0] == "p0"
+    assert specs1[0].priority == len(specs1) - 1
+
+
+def test_plan_segregates_dtypes_and_groups():
+    items = [("a", (8,), onp.dtype("float32")),
+             ("b", (8,), onp.dtype("bfloat16")),
+             ("c", (8,), onp.dtype("float32")),
+             ("d", (8,), onp.dtype("float32"), ("g1",)),
+             ("e", (8,), onp.dtype("float32"), ("g1",))]
+    specs = GradBucketer(bucket_mb=1).plan(items)
+    by_names = {tuple(s.names): s for s in specs}
+    assert ("a", "c") in by_names          # same dtype, default group
+    assert ("b",) in by_names              # bf16 never shares fp32's buffer
+    assert ("d", "e") in by_names          # explicit group packs together
+    for s in specs:
+        assert len({s.dtype}) == 1
+
+
+def test_plan_oversized_item_gets_own_bucket():
+    b = GradBucketer(bucket_mb=0.001)  # ~1 KB target
+    specs = b.plan([("big", (4096,), onp.dtype("float32")),
+                    ("small", (4,), onp.dtype("float32"))])
+    assert [s.names for s in specs] == [["big"], ["small"]]
+
+
+def test_plan_padding_to_multiple():
+    specs = GradBucketer(bucket_mb=1, pad_multiple=8).plan(
+        [("a", (3,), onp.dtype("float32")),
+         ("b", (4,), onp.dtype("float32"))])
+    (s,) = specs
+    assert s.numel == 7 and s.total == 8
+    assert s.nbytes == 8 * 4
+
+
+def test_bucketer_rejects_nonpositive_size():
+    with pytest.raises(MXNetError):
+        GradBucketer(bucket_mb=0)
+    with pytest.raises(MXNetError):
+        GradBucketer(bucket_mb=-1)
+
+
+def test_pack_unpack_roundtrip():
+    import jax.numpy as jnp
+
+    spec = GradBucketer(bucket_mb=1, pad_multiple=4).plan(
+        [("a", (2, 3), onp.dtype("float32")),
+         ("b", (5,), onp.dtype("float32"))])[0]
+    arrs = [jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            jnp.arange(5, dtype=jnp.float32) + 100]
+    flat = bucketing.pack_arrays(spec, arrs)
+    assert flat.shape == (spec.total,)
+    back = bucketing.unpack_flat(spec, flat)
+    for a, b in zip(arrs, back):
+        assert (onp.asarray(a) == onp.asarray(b)).all()
+
+
+# -- priority contract ------------------------------------------------------
+
+def test_priority_order_scalar_keeps_call_order():
+    assert _priority_order(["a", "b", "c"], 0) == [(0, 0), (1, 0), (2, 0)]
+
+
+def test_priority_order_list_sorts_descending_stably():
+    order = _priority_order(["a", "b", "c", "d"], [0, 2, 2, 1])
+    assert order == [(1, 2), (2, 2), (3, 1), (0, 0)]
+
+
+def test_priority_list_mismatch_is_loudly_rejected():
+    kv = KVStoreLocal()
+    kv.init("a", mnp.array(onp.ones(3, "float32")))
+    with pytest.raises(MXNetError, match="priorit"):
+        kv.pushpull(["a"], [[mnp.array(onp.ones(3, "float32"))]],
+                    priority=[1, 2])
+
+
+def test_local_flushes_by_priority_and_logs_settle_order():
+    kv = KVStoreLocal()
+    for k in ("front", "mid", "tail"):
+        kv.init(k, mnp.array(onp.zeros(2, "float32")))
+    vals = [[mnp.array(onp.ones(2, "float32"))] for _ in range(3)]
+    kv.pushpull(["tail", "mid", "front"], vals, priority=[-2, -1, 0])
+    assert [k for k, _ in kv._flush_log] == ["front", "mid", "tail"]
+    assert [p for _, p in kv._flush_log] == [0, -1, -2]
+
+
+# -- gradient compression ---------------------------------------------------
+
+def test_compression_threshold_must_be_positive():
+    with pytest.raises(MXNetError, match="threshold"):
+        GradientCompression(threshold=0)
+    with pytest.raises(MXNetError, match="threshold"):
+        GradientCompression(threshold=-0.5)
+    with pytest.raises(MXNetError):
+        GradientCompression(type="1bit")
+
+
+def test_quantize_threshold_behavior():
+    gc = GradientCompression(threshold=0.5)
+    g = mnp.array(onp.array([0.6, -0.7, 0.2, -0.2, 0.5], "float32"))
+    q = gc.quantize("k", g).asnumpy()
+    onp.testing.assert_allclose(q, [0.5, -0.5, 0.0, 0.0, 0.5])
+
+
+def test_error_feedback_residual_accumulates():
+    gc = GradientCompression(threshold=0.5)
+    g = mnp.array(onp.array([0.3, -0.3], "float32"))
+    q1 = gc.quantize("k", g).asnumpy()
+    onp.testing.assert_allclose(q1, [0.0, 0.0])
+    # residual 0.3 + fresh 0.3 crosses the threshold on the second step
+    q2 = gc.quantize("k", g).asnumpy()
+    onp.testing.assert_allclose(q2, [0.5, -0.5])
+    res = onp.asarray(gc._residual["k"])
+    onp.testing.assert_allclose(res, [0.1, -0.1], atol=1e-6)
+
+
+def test_compress_decompress_roundtrip():
+    gc = GradientCompression(threshold=0.25)
+    g = onp.array([[0.3, -0.3, 0.1], [0.0, 0.26, -0.9]], "float32")
+    packed = gc.compress("k", mnp.array(g))
+    assert str(packed.dtype) == "uint8"
+    assert packed.size == -(-g.size // 4)  # ceil(n/4) bytes travel
+    back = gc.decompress("k", packed).asnumpy()
+    onp.testing.assert_allclose(
+        back, [[0.25, -0.25, 0.0], [0.0, 0.25, -0.25]])
+    with pytest.raises(MXNetError):
+        gc.decompress("unseen", packed)
+
+
+def test_dist_store_compression_off_by_default():
+    from mxnet_tpu.kvstore.dist_tpu import KVStoreDistTPUSync
+
+    assert not os.environ.get("MXNET_GRADIENT_COMPRESSION")
+    kv = KVStoreDistTPUSync()
+    assert kv._compression is None
+    assert kv._stats["compressed_bytes_saved"] == 0
+
+
+# -- trainer bucketed allreduce: parity + counters + compression ------------
+
+_RUN_CFG_CACHE = {}
+
+
+def _run_cfg(**kw):
+    """Memoized per-config train run: the base (unbucketed) arm is shared
+    by the parity, counters, and compression tests below — on the 1-core
+    tier-1 box every avoided rebuild+retrace is wall the suite gets back.
+    Results are final params (read-only asserts) plus the kvstore whose
+    flush log / stats the callers inspect."""
+    from tools.overlap_smoke import run_config
+
+    key = tuple(sorted(kw.items()))
+    if key not in _RUN_CFG_CACHE:
+        _RUN_CFG_CACHE[key] = run_config(steps=6, seed=3, **kw)
+    return _RUN_CFG_CACHE[key]
+
+
+def test_bucketed_overlapped_step_is_bitwise_vs_unbucketed():
+    base, _, _, _ = _run_cfg(bucket_mb=0, overlap=False, compression=None)
+    for overlap in (True, False):
+        got, _, _, kv = _run_cfg(bucket_mb=0.02, overlap=overlap,
+                                 compression=None)
+        for k in base:
+            assert (base[k] == got[k]).all(), (overlap, k)
+        # the flat fusion buffers actually flushed, front-first
+        log = [e for e in kv._flush_log if e[0].startswith("__zb")]
+        assert log, "bucketed run never flushed a bucket"
+        n_buckets = len({k for k, _ in log})
+        assert n_buckets > 1, "plan collapsed to one bucket; lower bucket_mb"
+        step0 = [p for _, p in log[:n_buckets]]
+        assert step0 == sorted(step0, reverse=True)
+
+
+def test_bucketed_counters_reach_export_snapshot():
+    from mxnet_tpu.profiler import export
+
+    # no reset: the stats are cumulative module globals and the bucketed
+    # run may be a memoized hit from the parity test — either way at
+    # least one flush has been recorded by the time it returns
+    _run_cfg(bucket_mb=0.02, overlap=True, compression=None)
+    stats = bucketing.bucket_stats()
+    assert stats["buckets_flushed"] > 0
+    assert stats["bucket_bytes"] > 0
+    assert stats["overlap_window_ms"] > 0
+    snap = export.snapshot()
+    for key in ("kvstore.bucket_bytes", "kvstore.buckets_flushed",
+                "kvstore.overlap_window_ms",
+                "kvstore.compressed_bytes_saved"):
+        assert key in snap, key
+
+
+def test_two_bit_compression_bounded_divergence():
+    base, _, _, _ = _run_cfg(bucket_mb=0, overlap=False, compression=None)
+    got, _, _, kv = _run_cfg(bucket_mb=0, overlap=False,
+                             compression="2bit")
+    assert kv._compression is not None
+    worst = max(float(onp.abs(base[k] - got[k]).max()) for k in base)
+    assert onp.isfinite(worst)
+    assert 0 < worst < 0.5, worst  # diverges (it quantizes) but bounded
+    assert kv._stats["compressed_bytes_saved"] > 0
+
+
+def test_bucket_plan_survives_rebind_kvstore():
+    from mxnet_tpu.device import Context
+    from mxnet_tpu.gluon import Trainer, nn
+    from mxnet_tpu.kvstore.dist_tpu import KVStoreDistTPUSync
+    from mxnet_tpu.parallel import mesh as mesh_mod
+
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    ctxs = [Context("cpu", i) for i in range(2)]
+    net = nn.Dense(1, in_units=4)
+    net.initialize(ctx=ctxs)
+    mesh = mesh_mod.make_mesh({"dp": 2},
+                              devices=[c.jax_device() for c in ctxs])
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 kvstore=KVStoreDistTPUSync(mesh=mesh))
+    specs, _ = tr._grad_bucket_specs(1.0)
+    assert tr._bucket_plan is not None
+    tr.rebind_kvstore(KVStoreDistTPUSync(mesh=mesh))
+    specs2, _ = tr._grad_bucket_specs(1.0)
+    assert specs is specs2  # same plan object: keyed by params, not store
+
+
+# -- ZeRO flat-bucket lowering collapse -------------------------------------
+
+_AG_INSTR = re.compile(r"= \S+ all-gather(?:-start)?\(")
+
+
+def _zero_lowering(zero_bucket_mb):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.parallel.functional import ShardedTrainer, ShardingRules
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(onp.array(devs[:8]).reshape(8), ("fsdp",))
+
+    def loss_fn(out, labels):
+        return gloss.SoftmaxCrossEntropyLoss(sparse_label=True)(out, labels)
+
+    model = get_llama("llama_tiny_test", remat=True)
+    tr = ShardedTrainer(model, loss_fn, "adam", {"learning_rate": 1e-4},
+                        mesh=mesh, rules=ShardingRules((),
+                                                       default_axis="fsdp"),
+                        batch_spec=P("fsdp"), abstract=True,
+                        zero_bucket_mb=zero_bucket_mb)
+    compiled = tr.aot_lower(jax.ShapeDtypeStruct((8, 64), jnp.int32),
+                            jax.ShapeDtypeStruct((8, 64), jnp.int32))
+    specs = tr._zb_specs or ()
+    n_buckets = len(specs)
+    n_params = sum(len(s.names) for s in specs)
+    return len(_AG_INSTR.findall(compiled.as_text())), n_buckets, n_params
+
+
+def test_zero_bucketing_collapses_all_gathers():
+    """THE tentpole pin (tiny config): per-param ZeRO gathers collapse to
+    exactly ONE all-gather instruction per bucket — strictly fewer than
+    the packed param count, which is the floor an unbucketed per-param
+    lowering pays (one gather per param; 1829 at 8B, see the slow-marked
+    pin in tests/test_llama8b_aot.py). Counted at the INSTRUCTION level —
+    `as_text().count("all-gather")` also matches metadata mentions and
+    overcounts ~30x. Single lowering only: the tier-1 box is 1-core and
+    every avoided ~3.5s jit pays the wall budget back."""
+    bucketed_ag, n_buckets, n_params = _zero_lowering(0.05)
+    assert n_buckets > 1
+    assert bucketed_ag == n_buckets, (bucketed_ag, n_buckets)
+    assert n_buckets < n_params, (n_buckets, n_params)
+
+
+def test_zero_bucketing_rejects_non_elementwise_optimizer():
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.parallel.functional import ShardedTrainer, ShardingRules
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(onp.array(devs[:8]).reshape(8), ("fsdp",))
+
+    def loss_fn(out, labels):
+        return gloss.SoftmaxCrossEntropyLoss(sparse_label=True)(out, labels)
+
+    with pytest.raises(MXNetError, match="elementwise"):
+        ShardedTrainer(get_llama("llama_tiny_test"), loss_fn, "lamb",
+                       {"learning_rate": 1e-4}, mesh=mesh,
+                       rules=ShardingRules((), default_axis="fsdp"),
+                       batch_spec=P("fsdp"), abstract=True,
+                       zero_bucket_mb=50)
+
+
+@pytest.mark.slow
+def test_zero_bucketed_step_matches_unbucketed():
+    """Real (non-abstract) ZeRO training step with flat buckets must land
+    on the same parameters as the per-param layout. Marked slow (~6s of
+    compiles) for the 1-core tier-1 wall budget: tier-1 still pins the
+    bucketed ZeRO lowering shape above, and the default-on TIER1_OVERLAP
+    smoke asserts train-step parity on every pipeline run."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.parallel.functional import ShardedTrainer, ShardingRules
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(onp.array(devs[:8]).reshape(8), ("fsdp",))
+    ids = (onp.arange(8 * 16).reshape(8, 16) % 256).astype("int32")
+
+    def loss_fn(out, labels):
+        return gloss.SoftmaxCrossEntropyLoss(sparse_label=True)(out, labels)
+
+    results = []
+    for zb in (None, 0.05):
+        model = get_llama("llama_tiny_test")
+        model.initialize(init=mx.init.Xavier(), force_reinit=True)
+        onp.random.seed(11)
+        for _, p in sorted(model.collect_params().items()):
+            p.set_data(mnp.array(
+                onp.random.randn(*p.shape).astype("float32") * 0.02))
+        tr = ShardedTrainer(model, loss_fn, "sgd", {"learning_rate": 0.1},
+                            mesh=mesh,
+                            rules=ShardingRules((), default_axis="fsdp"),
+                            batch_spec=P("fsdp"),
+                            zero_bucket_mb=(0 if zb is None else zb))
+        losses = [float(tr.step(ids, ids).asnumpy()) for _ in range(2)]
+        tr.sync_to_block()
+        params = {n: p.data().asnumpy().copy()
+                  for n, p in sorted(model.collect_params().items())}
+        results.append((losses, params))
+    (l0, p0), (l1, p1) = results
+    onp.testing.assert_allclose(l0, l1, rtol=1e-5)
+    for k in p0:
+        onp.testing.assert_allclose(p0[k], p1[k], atol=1e-5,
+                                    err_msg=k)
+
+
+# -- shrink_mesh taxonomy ---------------------------------------------------
+
+def test_shrink_mesh_rejects_model_parallel_axis():
+    import jax
+
+    from mxnet_tpu.parallel.mesh import make_mesh, shrink_mesh
+    from mxnet_tpu.resilience.elastic import MeshDegraded
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    comp = make_mesh({"dp": 4, "tp": 2})
+    with pytest.raises(MeshDegraded, match="tp"):
+        shrink_mesh(comp, [0], axis="tp")
+    # MeshDegraded IS an MXNetError: existing handlers keep working
+    with pytest.raises(MXNetError):
+        shrink_mesh(comp, [0], axis="tp")
+
+
+def test_shrink_mesh_rejects_non_pow2_composite_survivor():
+    import jax
+
+    from mxnet_tpu.parallel.mesh import make_mesh, shrink_mesh
+    from mxnet_tpu.resilience.elastic import MeshDegraded
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    comp = make_mesh({"dp": 4, "tp": 2})
+    with pytest.raises(MeshDegraded, match="power of two"):
+        shrink_mesh(comp, [1], axis="dp", power_of_two=False)
+    # the regression-pinned single-axis dp8 -> dp7 shrink must survive
+    m8 = make_mesh({"dp": 8})
+    assert shrink_mesh(m8, [5], axis="dp",
+                       power_of_two=False).devices.shape == (7,)

@@ -201,6 +201,21 @@ if [[ "${TIER1_LOCKDEP:-1}" != "0" ]]; then
         rc=$ld_rc
     fi
 fi
+# Collective overlap smoke (TIER1_OVERLAP=1 to enable): a dp4 training
+# loop with gradient bucketing + overlapped priority-ordered flushes on
+# (MXNET_KVSTORE_BUCKET_MB / MXNET_KVSTORE_OVERLAP) — asserts bitwise
+# parameter parity vs the unbucketed baseline, zero steady-state
+# recompiles at every ablation point, front-first bucket settle order,
+# and bounded 2-bit compression divergence. The assertion-level suite is
+# tests/test_bucketing.py.
+if [[ "${TIER1_OVERLAP:-0}" != "0" ]]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python tools/overlap_smoke.py
+    overlap_rc=$?
+    if [[ "$rc" -eq 0 && "$overlap_rc" -ne 0 ]]; then
+        rc=$overlap_rc
+    fi
+fi
 # Elastic soak smoke (TIER1_ELASTIC=0 to skip): one seeded
 # kill/lag/corrupt sweep through a dp8 training loop — asserts the
 # chip-loss dp8->dp4 resume lands bitwise on the dp4 reference run,
