@@ -1232,8 +1232,11 @@ def bench_llama_decode(max_new=32, reps=3, batch=16, spec_k=4):
 
     from mxnet_tpu import numpy as mnp
     from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.profiler import attribution as _attr
     from mxnet_tpu.serve import Generator, SpeculativeGenerator
 
+    attr_was_on = _attr.ENABLED
+    _attr.enable()
     target = get_llama("llama_serve_12l_test")
     target.initialize()
     for blk in target._blocks[2:]:
@@ -1263,18 +1266,46 @@ def bench_llama_decode(max_new=32, reps=3, batch=16, spec_k=4):
                 extra = {k: info[k] for k in ("acceptance_rate", "rounds")
                          if k in info}
         gen.assert_no_recompiles()
-        return round(best, 1), extra, round(warm["wall_s"], 2)
+        # critical-path attribution (Generator rungs only: the spec
+        # round loop is not a fixed-width decode, its ledger stays
+        # empty): one reconcile rep on a FRESH ledger so the 4-phase
+        # sum + schedule bucket must cover THAT rep's decode wall —
+        # >10% daylight means the partition is lying, fail loudly
+        # exactly like a recompile
+        attr = None
+        if type(gen) is Generator:
+            gen.ledger = _attr.Ledger(gen.ledger.name)
+            _, info = gen.generate(prompts, max_new_tokens=max_new)
+            snap = gen.ledger.snapshot()
+            phase_ms = (snap["host_ms"] + snap["dispatch_ms"]
+                        + snap["device_ms"] + snap["wait_ms"])
+            coverage = ((phase_ms + snap["schedule_ms"])
+                        / info["decode_ms"]) if info["decode_ms"] else 0.0
+            assert 0.90 <= coverage <= 1.10, (
+                f"{gen.ledger.name}: attribution phases cover "
+                f"{coverage:.1%} of the decode wall (want 90-110%)")
+            attr = {
+                "host_overhead_fraction":
+                    round(snap["host_overhead_fraction"], 4),
+                "device_ms_per_token":
+                    round(snap["device_ms_per_token"], 4),
+                "phase_coverage": round(coverage, 3),
+            }
+        return round(best, 1), extra, round(warm["wall_s"], 2), attr
 
-    ladder, warm_s, spec_extra = {}, {}, {}
+    ladder, warm_s, spec_extra, attribution = {}, {}, {}, {}
     for path in ("baseline", "pallas", "int8"):
         gen = Generator(target, max_seq=64, batch_buckets=(batch,),
                         prompt_buckets=(16,), name=f"llama_decode_{path}",
                         decode_path=path)
-        ladder[path], _, warm_s[path] = measure(gen)
+        ladder[path], _, warm_s[path], attribution[path] = measure(gen)
     spec = SpeculativeGenerator(
         target, draft, k=spec_k, max_seq=64, batch_buckets=(batch,),
         prompt_buckets=(16,), name="llama_decode_spec", decode_path="int8")
-    ladder["spec"], spec_extra, warm_s["spec"] = measure(spec)
+    ladder["spec"], spec_extra, warm_s["spec"], _ = measure(spec)
+    attribution.pop("spec", None)
+    if not attr_was_on:
+        _attr.disable()
 
     base = ladder["baseline"]
     order = ("baseline", "pallas", "int8", "spec")
@@ -1296,6 +1327,13 @@ def bench_llama_decode(max_new=32, reps=3, batch=16, spec_k=4):
         "batch": batch,
         "max_new_tokens": max_new,
         "warmup_s": warm_s,
+        # critical-path readout from the fastest fixed-width rung: how
+        # much of each decode iteration is host overhead vs device work
+        "host_overhead_fraction":
+            attribution["int8"]["host_overhead_fraction"],
+        "device_ms_per_token":
+            attribution["int8"]["device_ms_per_token"],
+        "attribution": attribution,
     })
 
 

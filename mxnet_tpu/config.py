@@ -489,3 +489,32 @@ register_flag(
     "Explicitly set to 0: bind an EPHEMERAL port (no CI port-collision "
     "flakes) and report it back via a MXNET_METRICS_PORT_BOUND=<port> "
     "line on stderr + profiler.export.server_port().", int)
+register_flag(
+    "MXNET_ATTRIBUTION", False,
+    "Decode critical-path attribution (profiler.attribution): split "
+    "every decode iteration's wall time into host / dispatch / device / "
+    "wait phases, tag engine:wait stalls with the active phase, and "
+    "publish serve.<name>.host_overhead_fraction / device_ms_per_token "
+    "gauges. Off: one bool check per instrumented site.", _bool)
+register_flag(
+    "MXNET_ATTRIBUTION_WINDOW", 512,
+    "Rolling window (decode iterations) of the attribution ledger's "
+    "steady-state gauges.", int)
+register_flag(
+    "MXNET_SLO_WINDOW_S", 60.0,
+    "Default slow evaluation window (seconds) for SLO objectives "
+    "(profiler.slo.SLO) constructed without an explicit window; the "
+    "fast window defaults to 1/12 of it (the SRE 1h/5m shape).", float)
+register_flag(
+    "MXNET_SLO_BURN_THRESHOLD", 14.4,
+    "Default error-budget burn-rate alert threshold: an objective burns "
+    "only when BOTH its fast and slow windows exceed this (14.4 is the "
+    "classic fast-page rate).", float)
+register_flag(
+    "MXNET_SLO_EVAL_INTERVAL_S", 0.25,
+    "Minimum seconds between passive SLO burn-rate evaluations on the "
+    "observing thread (amortizes the window walk).", float)
+register_flag(
+    "MXNET_SLO_MIN_EVENTS", 12,
+    "Minimum fast-window events before an SLO objective may alert — a "
+    "sparse healthy run cannot false-alarm.", int)

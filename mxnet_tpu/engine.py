@@ -31,6 +31,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import threading
+import time
 import weakref
 
 from .base import MXNetError
@@ -46,6 +47,12 @@ _PROF = None
 # a plan installs; wait points consult it so simulated async device errors
 # surface exactly where contract (c) says real ones do
 _FAULTS = None
+
+# attribution hot-state (profiler.attribution module slot): None until the
+# profiler package imports; wait points tag their stall events with the
+# thread's active phase (decode/prefill/train/other) and, while the ledger
+# is ENABLED, feed the stall duration into the per-phase wait accounting
+_ATTR = None
 
 # recently dispatched arrays (weakrefs): wait_all() drains these instead of
 # blocking on every live array in the process (jax.live_arrays() is O(all
@@ -132,19 +139,28 @@ def wait_for_var(data):
         # exceptions from WaitForVar and WaitForAll alike)
         flt.check("engine:wait")
     prof = _PROF
-    if prof is None or not prof.ENABLED:
+    attr = _ATTR
+    profiling = prof is not None and prof.ENABLED
+    attributing = attr is not None and attr.ENABLED
+    if not profiling and not attributing:
         try:
             return data.block_until_ready()
         except AttributeError:
             return data
-    t0 = prof.begin()
+    t0 = time.perf_counter_ns()
     try:
         try:
             return data.block_until_ready()
         except AttributeError:
             return data
     finally:
-        prof.record_duration("engine::wait_for_var", "engine", t0)
+        t1 = time.perf_counter_ns()
+        phase = attr.current_phase() if attr is not None else "other"
+        if attributing:
+            attr.note_wait(t1 - t0, phase)
+        if profiling:
+            prof.record_duration("engine::wait_for_var", "engine", t0, t1,
+                                 args={"phase": phase})
 
 
 def _block_settled(a):
@@ -186,7 +202,10 @@ def wait_all():
     from . import config
 
     prof = _PROF
-    t0 = prof.begin() if prof is not None and prof.ENABLED else 0
+    attr = _ATTR
+    profiling = prof is not None and prof.ENABLED
+    attributing = attr is not None and attr.ENABLED
+    t0 = time.perf_counter_ns() if profiling or attributing else 0
     drained = 0
     first_failure = None
     try:
@@ -214,9 +233,15 @@ def wait_all():
             elif r != "skip" and first_failure is None:
                 first_failure = r
         if t0:
-            prof.record_duration("engine::wait_all", "engine", t0,
-                                 args={"mode": "full",
-                                       "failed": first_failure is not None})
+            t1 = time.perf_counter_ns()
+            phase = attr.current_phase() if attr is not None else "other"
+            if attributing:
+                attr.note_wait(t1 - t0, phase)
+            if profiling:
+                prof.record_duration(
+                    "engine::wait_all", "engine", t0, t1,
+                    args={"mode": "full", "phase": phase,
+                          "failed": first_failure is not None})
     else:
         with _pending_lock:
             deques = [dq for _, dq in _pending_registry.values()]
@@ -246,10 +271,16 @@ def wait_all():
                 elif r != "skip" and first_failure is None:
                     first_failure = r
         if t0:
-            prof.record_duration("engine::wait_all", "engine", t0,
-                                 args={"drained": drained,
-                                       "failed": first_failure is not None})
-            prof.set_counter("engine.queue_depth", 0, cat="engine")
+            t1 = time.perf_counter_ns()
+            phase = attr.current_phase() if attr is not None else "other"
+            if attributing:
+                attr.note_wait(t1 - t0, phase)
+            if profiling:
+                prof.record_duration(
+                    "engine::wait_all", "engine", t0, t1,
+                    args={"drained": drained, "phase": phase,
+                          "failed": first_failure is not None})
+                prof.set_counter("engine.queue_depth", 0, cat="engine")
     if first_failure is not None:
         raise MXNetError(
             f"async operation failed, surfaced at wait_all: "

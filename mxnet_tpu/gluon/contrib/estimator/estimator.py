@@ -64,6 +64,7 @@ class Estimator:
         train_begin, epoch_begin, batch_begin, pre_step, batch_end, \
             epoch_end, train_end = self._categorize(handlers)
 
+        from ....profiler import attribution as _attr
         from ....profiler import trace as _trace
 
         # request-scoped tracing (MXNET_TRACE=1): the whole fit is one
@@ -83,7 +84,11 @@ class Estimator:
                 if fit_trace is not None:
                     step_n += 1
                     _trace.set_step(step_n)
-                with _trace.activate(fit_trace), \
+                # the train phase scope tags any engine:wait stall
+                # inside the step as train-phase (the decode-phase
+                # "near zero" query needs train waits filterable out)
+                with _attr.phase_scope("train"), \
+                        _trace.activate(fit_trace), \
                         _trace.span("train::step", {"step": step_n}):
                     for h in batch_begin:
                         h.batch_begin(self, batch=batch)

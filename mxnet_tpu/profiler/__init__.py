@@ -47,7 +47,7 @@ import contextlib
 import time
 
 from ..base import MXNetError
-from . import core, export, metrics, recorder, trace, xla
+from . import attribution, core, export, metrics, recorder, slo, trace, xla
 from .core import (aggregate_stats, register_thread_name, reset,
                    snapshot_events)
 from .metrics import (
@@ -99,6 +99,9 @@ def _install_hooks():
 
     _engine._PROF = core
     _registry._PROF = core
+    # phase-tagged engine:wait events need the attribution module's
+    # thread-local phase even when the ledger itself is off
+    _engine._ATTR = attribution
 
 
 def set_state(state="stop", profile_process="worker"):  # pylint: disable=unused-argument
@@ -260,6 +263,10 @@ elif _cfg.get("MXNET_PROFILER_IMPERATIVE"):
 # as chrome events while the bus records, but summaries work regardless)
 if _cfg.get("MXNET_TRACE"):
     trace.enable(max_traces=_cfg.get("MXNET_TRACE_MAX"))
+
+# MXNET_ATTRIBUTION=1: decode critical-path ledger on from import
+if _cfg.get("MXNET_ATTRIBUTION"):
+    attribution.enable()
 
 # MXNET_METRICS_PORT=<p>: unified /metrics + /healthz endpoint at import
 export.maybe_start_from_env()

@@ -138,6 +138,18 @@ def snapshot(include_aggregates=True):
         for name, snap in fleet.fleet_stats().items():
             _flatten(f"fleet.{name}", snap, out)
 
+    slo_mod = sys.modules.get("mxnet_tpu.profiler.slo")
+    if slo_mod is not None:
+        for name, snap in slo_mod.all_snapshots().items():
+            _flatten(f"slo.{name}", snap, out)
+
+    attr_mod = sys.modules.get("mxnet_tpu.profiler.attribution")
+    if attr_mod is not None:
+        for name, snap in attr_mod.all_snapshots().items():
+            _flatten(f"attribution.{name}", snap, out)
+        for phase, ms in attr_mod.wait_ms_by_phase().items():
+            out[f"attribution.wait_ms[{phase}]"] = round(ms, 3)
+
     out["recorder.enabled"] = int(_recorder.ENABLED)
     out["recorder.notes"] = _recorder._seq
     out["recorder.dumps"] = _recorder.dump_count()

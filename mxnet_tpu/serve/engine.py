@@ -497,7 +497,7 @@ class InferenceSession:
             draining = self._draining
             inflight = self._inflight
         requests = snap["requests"]
-        return {
+        out = {
             "state": "draining" if draining else "serving",
             "ready": self.ready(),
             "warm": self._warm_signatures is not None,
@@ -509,6 +509,14 @@ class InferenceSession:
             "deadline_expired": snap["deadline_expired"],
             "watchdog_orphans": watchdog_orphans(),
         }
+        # SLO burn: degraded, not dead — ready() is untouched (an SLO
+        # violation is a page, not a kill switch), the probe just says so
+        slo_mon = getattr(self.metrics, "slo", None)
+        if slo_mon is not None:
+            out["slo"] = slo_mon.health()
+            if out["slo"]["state"] == "degraded":
+                out["state"] = "degraded"
+        return out
 
     def ready(self):
         """Readiness probe: warm (lattice compiled + frozen), admitting

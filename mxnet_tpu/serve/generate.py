@@ -29,6 +29,7 @@ import numpy as _onp
 
 from .. import random as _rng
 from ..base import MXNetError
+from ..profiler import attribution as _attr
 from ..profiler import trace as _trace
 from ..gluon.block import HybridBlock
 from ..ops import nn as _ops
@@ -483,6 +484,9 @@ class Generator:
             name=name, deterministic=(self.decode_path == "baseline"))
         self.metrics = self.session.metrics
         self.metrics.set_decode_path(self.decode_path)
+        # decode critical-path ledger (tentpole PR 16): observations
+        # gated on _attr.ENABLED, the object always present for readout
+        self.ledger = _attr.Ledger(name)
         self._zero_caches = {}  # batch bucket -> shared zeroed rings
 
     def _fresh_cache(self, batch_bucket):
@@ -753,9 +757,17 @@ class Generator:
                                             max_new)
         run_ok = False
         try:
-            with _trace.span("serve::prefill", {"batch": n_real}):
+            with _attr.phase_scope("prefill"), \
+                    _trace.span("serve::prefill", {"batch": n_real}):
                 logits, cache = self._prefix_prefill(toks, lens, matched,
                                                      cache)
+                # the step-0 sample blocks on the PREFILL logits: its
+                # device time is prefill wall — the steady-state decode
+                # rate and the attribution ledger both exclude it (same
+                # call order as before — one sample per entered step, so
+                # the RNG key stream is unchanged)
+                next_ids = sample_tokens(logits, temperature=temperature,
+                                         top_k=top_k)
             t_prefill = time.perf_counter()
 
             out = [[] for _ in range(n_real)]
@@ -765,8 +777,7 @@ class Generator:
             stop = set(int(s) for s in stop_ids)
             n_decoded = 0
             for step in range(max_new):
-                next_ids = sample_tokens(logits, temperature=temperature,
-                                         top_k=top_k)
+                th0 = time.perf_counter()
                 for i in range(n_real):
                     if stopped[i]:
                         continue
@@ -791,9 +802,43 @@ class Generator:
                     # running decode_step here would be a discarded T=1
                     # pass
                     break
-                with _trace.span("serve::decode_step", {"step": step}):
-                    logits, cache = self.decode_step(next_ids, positions,
-                                                     cache)
+                live = n_real - sum(stopped)
+                attributing = _attr.ENABLED
+                if attributing:
+                    # per-step token accounting above is host work
+                    # between device calls: the schedule bucket
+                    self.ledger.observe_schedule(
+                        (time.perf_counter() - th0) * 1e3)
+                args = {"step": step, "live": live}
+                with _attr.phase_scope("decode"):
+                    t1 = time.perf_counter()
+                    w1 = _attr.thread_wait_ns() if attributing else 0
+                    with _trace.span("serve::decode_step", args):
+                        logits, cache = self.decode_step(next_ids,
+                                                         positions, cache)
+                        t2 = time.perf_counter()
+                        w2 = _attr.thread_wait_ns() if attributing else 0
+                        # the next step's sample is THIS step's blocking
+                        # device fetch — inside the span, so the four
+                        # phases partition the span wall
+                        next_ids = sample_tokens(logits,
+                                                 temperature=temperature,
+                                                 top_k=top_k)
+                        t3 = time.perf_counter()
+                        if attributing:
+                            w3 = _attr.thread_wait_ns()
+                            dispatch_ms = max(
+                                0.0, (t2 - t1) * 1e3 - (w2 - w1) / 1e6)
+                            device_ms = (t3 - t2) * 1e3
+                            wait_ms = max(0.0, (w2 - w1) / 1e6)
+                            args.update(host_ms=0.0,
+                                        dispatch_ms=round(dispatch_ms, 4),
+                                        device_ms=round(device_ms, 4),
+                                        wait_ms=round(wait_ms, 4))
+                            self.ledger.observe_step(0.0, dispatch_ms,
+                                                     device_ms, wait_ms,
+                                                     live=live)
+                self.metrics.observe_itl((t3 - t1) * 1e3, live=live)
                 positions = positions + 1
                 n_decoded += 1
             run_ok = True
@@ -803,6 +848,10 @@ class Generator:
         decode_s = t_done - t_prefill
         n_tokens = sum(len(o) for o in out)
         self.metrics.observe_tokens(n_tokens, decode_s)
+        if _attr.ENABLED:
+            self.metrics.set_attribution(
+                self.ledger.host_overhead_fraction(),
+                self.ledger.device_ms_per_token())
         info = {
             "prefill_ms": (t_prefill - t_start) * 1e3,
             "decode_ms": decode_s * 1e3,

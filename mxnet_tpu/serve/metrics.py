@@ -88,6 +88,7 @@ class ServeMetrics:
         # slot-occupancy gauges the scheduler publishes between steps
         self._ttft_ms = collections.deque(maxlen=int(window))
         self._itl_ms = collections.deque(maxlen=int(window))
+        self._itl_live = collections.deque(maxlen=int(window))
         self.kv_pages_used = 0
         self.kv_pages_free = 0
         self.slots_live = 0
@@ -100,6 +101,14 @@ class ServeMetrics:
         self.prefix_pages_shared = 0
         self.prefix_pages_held = 0
         self.prefix_evictions = 0
+        # attribution gauges (tentpole PR 16): the scheduler publishes
+        # its Ledger's steady-state readout here so it rides the
+        # serve.<name>.* export surface
+        self.host_overhead_fraction = 0.0
+        self.device_ms_per_token = 0.0
+        # optional SLO burn-rate monitor (profiler.slo.SLOMonitor
+        # .attach()); None keeps every observation at one branch
+        self.slo = None
         _instances.add(self)
 
     # -- observations -------------------------------------------------------
@@ -128,6 +137,9 @@ class ServeMetrics:
                         priority,
                         collections.deque(maxlen=self._window))
                 ring.append(total)
+        slo = self.slo
+        if slo is not None:
+            slo.observe("completion", ok=ok, deadline_ok=deadline_ok)
         if _prof.ENABLED:
             t1 = _prof.begin()
             _prof.record_duration(f"serve::request({self.name})", "serve",
@@ -210,18 +222,28 @@ class ServeMetrics:
         under continuous batching — admission waits show up here."""
         with self._lock:
             self._ttft_ms.append(float(ms))
+        slo = self.slo
+        if slo is not None:
+            slo.observe("ttft_ms", float(ms))
         if _prof.ENABLED:
             _prof.record_instant(f"serve::ttft({self.name})", "serve",
                                  args={"ms": round(float(ms), 3),
                                        "priority": priority})
 
-    def observe_itl(self, ms):
+    def observe_itl(self, ms, live=1):
         """Inter-token latency: wall time of one decode iteration,
         observed once per step for every live slot. Its p99 bounds how
         long any request's token stream can stall — including stalls
-        caused by other requests' admissions/prefills."""
+        caused by other requests' admissions/prefills. ``live`` is the
+        step's live-slot count, so attribution can normalize device
+        cost by occupancy (a 1-live step and a 16-live step are not the
+        same sample)."""
         with self._lock:
             self._itl_ms.append(float(ms))
+            self._itl_live.append(int(live))
+        slo = self.slo
+        if slo is not None:
+            slo.observe("itl_ms", float(ms))
 
     def observe_prefix(self, matched_tokens):
         """One admission consulted the prefix trie: ``matched_tokens``
@@ -265,6 +287,21 @@ class ServeMetrics:
             _prof.set_counter(f"serve.slots_live({self.name})",
                               int(live), cat="serve")
 
+    def set_attribution(self, host_overhead_fraction, device_ms_per_token):
+        """Gauge pair the attribution ledger publishes between steps:
+        the fraction of windowed decode wall NOT spent in the blocking
+        device window, and device ms per emitted token (ROADMAP item
+        3's acceptance numbers)."""
+        self.host_overhead_fraction = float(host_overhead_fraction)
+        self.device_ms_per_token = float(device_ms_per_token)
+        if _prof.ENABLED:
+            _prof.set_counter(
+                f"serve.host_overhead_fraction({self.name})",
+                round(float(host_overhead_fraction), 4), cat="serve")
+            _prof.set_counter(
+                f"serve.device_ms_per_token({self.name})",
+                round(float(device_ms_per_token), 4), cat="serve")
+
     def set_queue_depth(self, depth):
         self.queue_depth = int(depth)
         if _prof.ENABLED:
@@ -288,6 +325,12 @@ class ServeMetrics:
                                  args={"path": str(path)})
 
     # -- readout ------------------------------------------------------------
+    def itl_samples(self):
+        """Windowed ``(ms, live)`` pairs, oldest first — the raw decode
+        iteration record attribution normalizes by occupancy."""
+        with self._lock:
+            return list(zip(self._itl_ms, self._itl_live))
+
     def latency_percentiles(self):
         with self._lock:
             lat = list(self._latency_ms)
@@ -314,6 +357,7 @@ class ServeMetrics:
             e = list(self._exec_ms)
             ttft = list(self._ttft_ms)
             itl = list(self._itl_ms)
+            itl_live = list(self._itl_live)
             batches = self.batches
             out = {
                 "name": self.name,
@@ -353,12 +397,16 @@ class ServeMetrics:
                 "prefix_pages_shared": self.prefix_pages_shared,
                 "prefix_pages_held": self.prefix_pages_held,
                 "prefix_evictions": self.prefix_evictions,
+                "host_overhead_fraction": self.host_overhead_fraction,
+                "device_ms_per_token": self.device_ms_per_token,
             }
         out["ttft_p50_ms"] = percentile(ttft, 50)
         out["ttft_p95_ms"] = percentile(ttft, 95)
         out["ttft_p99_ms"] = percentile(ttft, 99)
         out["itl_p50_ms"] = percentile(itl, 50)
         out["itl_p99_ms"] = percentile(itl, 99)
+        out["itl_live_mean"] = (sum(itl_live) / len(itl_live)
+                                if itl_live else 0.0)
         out["class_percentiles"] = self.class_percentiles()
         out["p50_ms"] = percentile(lat, 50)
         out["p95_ms"] = percentile(lat, 95)

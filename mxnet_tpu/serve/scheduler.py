@@ -51,6 +51,7 @@ import time
 import numpy as _onp
 
 from ..base import MXNetError
+from ..profiler import attribution as _attr
 from ..profiler import trace as _trace
 from ..resilience import faults as _faults
 from .batcher import DynamicBatcher
@@ -190,6 +191,11 @@ class ContinuousEngine:
         self._batcher = DynamicBatcher(
             _no_runner, start=False, max_batch_size=self.num_slots,
             name=f"{name}_queue", metrics=self.metrics, **batcher_kwargs)
+        # decode critical-path ledger (tentpole PR 16): observations are
+        # gated on _attr.ENABLED, the ledger object itself is always
+        # there so tests/bench can read it without reaching into flags
+        self.ledger = _attr.Ledger(name)
+        self._last_emit_t = None   # previous decode step's token stamp
         self._slots = [None] * self.num_slots
         self._steps = 0            # completed scheduler iterations
         self._pf_next = 0          # round-robin cursor over prefill slots
@@ -369,8 +375,19 @@ class ContinuousEngine:
         table = _onp.zeros((1, self.pool.pages_per_slot), _onp.int32)
         table[0] = self.pool.table()[i]
         try:
-            with _trace.span("serve::prefill_chunk", {"slot": i, "n": n}):
-                logits = self._run_step(toks, [s.consumed], [n - 1], table)
+            pf_args = {"slot": i, "n": n}
+            with _attr.phase_scope("prefill"):
+                p0_ns = time.perf_counter_ns()
+                try:
+                    logits = self._run_step(toks, [s.consumed], [n - 1],
+                                            table)
+                except Exception as e:
+                    pf_args["error"] = type(e).__name__
+                    raise
+                finally:
+                    self._span_fanout("serve::prefill_chunk", p0_ns,
+                                      time.perf_counter_ns(), pf_args,
+                                      (i,))
         except Exception as exc:  # pylint: disable=broad-except
             # only THIS slot was inside the failing call
             self._settle_slot(i, error=exc)
@@ -397,9 +414,13 @@ class ContinuousEngine:
         decoding = [i for i, s in enumerate(self._slots)
                     if s is not None and s.decoding and not s.finished]
         if not decoding:
+            # idle gap, not a stall: no live token stream is waiting, so
+            # the next decode step's ITL restarts from its own window
+            self._last_emit_t = None
             return
         _faults.fault_point("serve:decode",
                             {"session": self.session.name})
+        t_build = time.perf_counter()
         S = self.num_slots
         toks = _onp.zeros((S, 1), _onp.int32)
         pos = _onp.zeros(S, _onp.int32)
@@ -410,28 +431,97 @@ class ContinuousEngine:
             toks[i, 0] = s.pending
             pos[i] = s.pos
             table[i] = live_table[i]
-        t0 = time.perf_counter()
-        with _trace.span("serve::decode_step", {"live": len(decoding)}):
-            logits = self._run_step(toks, pos, _onp.zeros(S, _onp.int32),
-                                    table)
-        self.metrics.observe_itl((time.perf_counter() - t0) * 1e3)
         temps = [self._slots[i].temperature for i in decoding]
-        if all(t is None or t <= 0.0 for t in temps):
-            ids = sample_tokens(logits)  # one greedy argmax for all rows
-            sampled = {i: int(ids[i]) for i in decoding}
-        else:
-            arr = logits.asnumpy()
-            sampled = {}
-            for i in decoding:
-                s = self._slots[i]
-                sampled[i] = int(sample_tokens(
-                    arr[i:i + 1], temperature=s.temperature,
-                    top_k=s.top_k)[0])
-        for i in decoding:
+        # the iteration's four-way attribution (host/dispatch/device/
+        # wait partitions the span wall exactly; the pre-span input
+        # assembly above lands in the ledger's schedule bucket): the
+        # span covers dispatch, the blocking logits fetch (the ONE
+        # sanctioned device sync — that delta is the device phase), and
+        # the host-side sampling/emit bookkeeping
+        attributing = _attr.ENABLED
+        args = {"live": len(decoding)}
+        with _attr.phase_scope("decode"):
+            t1 = time.perf_counter()
+            w1 = _attr.thread_wait_ns() if attributing else 0
+            s0_ns = time.perf_counter_ns()
+            try:
+                logits = self._run_step(toks, pos,
+                                        _onp.zeros(S, _onp.int32), table)
+                t2 = time.perf_counter()
+                w2 = _attr.thread_wait_ns() if attributing else 0
+                if all(t is None or t <= 0.0 for t in temps):
+                    # one greedy argmax for all rows; blocks on device
+                    ids = sample_tokens(logits)
+                    t3 = time.perf_counter()
+                    w3 = _attr.thread_wait_ns() if attributing else 0
+                    sampled = {i: int(ids[i]) for i in decoding}
+                else:
+                    arr = logits.asnumpy()  # blocking device fetch
+                    t3 = time.perf_counter()
+                    w3 = _attr.thread_wait_ns() if attributing else 0
+                    sampled = {}
+                    for i in decoding:
+                        s = self._slots[i]
+                        sampled[i] = int(sample_tokens(
+                            arr[i:i + 1], temperature=s.temperature,
+                            top_k=s.top_k)[0])
+                for i in decoding:
+                    s = self._slots[i]
+                    s.pos += 1
+                    s.decode_steps += 1
+                    s.emit(sampled[i])
+                if attributing:
+                    t4 = time.perf_counter()
+                    w4 = _attr.thread_wait_ns()
+                    dispatch_ms = max(
+                        0.0, (t2 - t1) * 1e3 - (w2 - w1) / 1e6)
+                    device_ms = (t3 - t2) * 1e3
+                    host_ms = max(
+                        0.0, (t4 - t3) * 1e3 - (w4 - w3) / 1e6)
+                    wait_ms = max(0.0, ((w2 - w1) + (w4 - w3)) / 1e6)
+                    args.update(host_ms=round(host_ms, 4),
+                                dispatch_ms=round(dispatch_ms, 4),
+                                device_ms=round(device_ms, 4),
+                                wait_ms=round(wait_ms, 4))
+                    self.ledger.observe_step(host_ms, dispatch_ms,
+                                             device_ms, wait_ms,
+                                             live=len(decoding))
+                    self.ledger.observe_schedule((t1 - t_build) * 1e3)
+            except Exception as e:
+                args["error"] = type(e).__name__
+                raise
+            finally:
+                self._span_fanout("serve::decode_step", s0_ns,
+                                  time.perf_counter_ns(), args, decoding)
+        # ITL is the token-to-token gap, not just the device window: in
+        # steady state it runs from the PREVIOUS step's emission, so
+        # scheduler stalls between steps (admissions, prefill chunks, an
+        # injected serve:decode delay) land in the stream-stall number
+        # the SLO monitor judges. First step after idle has no waiting
+        # stream; it falls back to its own decode window.
+        prev = self._last_emit_t
+        self._last_emit_t = t3
+        itl_start = prev if prev is not None else t1
+        self.metrics.observe_itl((t3 - itl_start) * 1e3,
+                                 live=len(decoding))
+
+    def _span_fanout(self, name, t0_ns, t1_ns, args, slot_idx):
+        """Record one span into every listed slot's request trace — an
+        iteration-level step is on EACH rider's critical path, and the
+        engine thread has no ambient request trace to catch ``span()``
+        — plus the ambient trace when one IS active (inline ``step()``
+        under an activated trace), never duplicating a target."""
+        targets = []
+        amb = _trace.current()
+        if amb is not None:
+            targets.append(amb)
+        for i in slot_idx:
             s = self._slots[i]
-            s.pos += 1
-            s.decode_steps += 1
-            s.emit(sampled[i])
+            tr = s.p.trace if s is not None else None
+            if tr is not None and tr not in targets:
+                targets.append(tr)
+        for tr in targets:
+            tr.span_at(name, t0_ns, t1_ns, args)
 
     def step(self):
         """One scheduler iteration: retire -> admit -> one prefill chunk
@@ -440,8 +530,13 @@ class ContinuousEngine:
         fail the requests that were inside the failing call — the
         scheduler itself keeps serving, exactly like the batcher's
         batch-failure isolation."""
+        t0 = time.perf_counter()
         self._retire()
         self._admit()
+        if _attr.ENABLED:
+            # host-schedule: the admit/retire bookkeeping between
+            # device calls — ROADMAP item 3's kill target
+            self.ledger.observe_schedule((time.perf_counter() - t0) * 1e3)
         self._prefill_once()
         try:
             self._decode_once()
@@ -453,6 +548,10 @@ class ContinuousEngine:
         self.metrics.set_kv_pages(self.pool.pages_used,
                                   self.pool.pages_free)
         self.metrics.set_slot_occupancy(len(self._live()), self.num_slots)
+        if _attr.ENABLED:
+            self.metrics.set_attribution(
+                self.ledger.host_overhead_fraction(),
+                self.ledger.device_ms_per_token())
         if self.prefix is not None:
             self.metrics.set_prefix_gauges(self.pool.pages_shared,
                                            self.prefix.pages_held,

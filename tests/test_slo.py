@@ -1,0 +1,272 @@
+"""SLO burn-rate guard (PR 16 tentpole, layer 2): declarative
+objectives over the serving metric families, multi-window burn-rate
+math, the min-events gate, the edge-triggered ``slo_burn``
+flight-recorder escalation (exactly ONE dump under a sustained
+delay-fault storm, none on a clean run), and degraded-not-dead
+``/healthz``."""
+import json
+
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.profiler import export, recorder, trace
+from mxnet_tpu.profiler.slo import SLO, SLOMonitor
+from mxnet_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_slo_state():
+    recorder.reset()
+    faults.clear_plan()
+    yield
+    recorder.reset()
+    recorder.ENABLED = False
+    faults.clear_plan()
+    trace.disable()
+    trace.reset()
+
+
+def _itl_slo(target=100.0, threshold=10.0):
+    return SLO("itl_p99_ms", target, window=60.0, fast_window=5.0,
+               threshold=threshold)
+
+
+def _monitor(objectives, min_events=10):
+    # eval never auto-fires: the table tests drive evaluate() by hand
+    return SLOMonitor("t", objectives, eval_interval=1e9,
+                      min_events=min_events)
+
+
+# -- objective declaration ---------------------------------------------------
+
+
+def test_unknown_metric_raises():
+    with pytest.raises(MXNetError, match="unknown SLO metric"):
+        SLO("throughput_p50", 1.0)
+
+
+def test_budget_semantics_per_family():
+    assert _itl_slo().budget == pytest.approx(0.01)
+    assert SLO("ttft_p99_ms", 1000.0).budget == pytest.approx(0.01)
+    assert SLO("goodput", 0.95).budget == pytest.approx(0.05)
+    assert SLO("error_rate", 0.05).budget == pytest.approx(0.05)
+    # fast window defaults to the SRE 1h/5m shape scaled to the window
+    assert SLO("itl_p99_ms", 50.0, window=60.0).fast_window == \
+        pytest.approx(5.0)
+
+
+def test_good_event_judgement():
+    lat = _itl_slo(target=100.0)
+    assert lat.good(value=100.0) and not lat.good(value=100.1)
+    gp = SLO("goodput", 0.9)
+    assert gp.good(ok=True, deadline_ok=True)
+    assert not gp.good(ok=True, deadline_ok=False)   # late != good
+    er = SLO("error_rate", 0.1)
+    assert er.good(ok=True, deadline_ok=False)       # late != error
+    assert not er.good(ok=False)
+
+
+# -- burn-rate math (explicit timestamps, manual evaluate) -------------------
+
+
+def test_healthy_stream_does_not_burn():
+    mon = _monitor([_itl_slo()])
+    for k in range(20):
+        mon.observe("itl_ms", 50.0, ts=1000.0 + 0.01 * k)
+    (row,) = mon.evaluate(now=1000.5)
+    assert row["burn_rate_fast"] == 0.0 and not row["burning"]
+    assert row["budget_remaining"] == pytest.approx(1.0)
+    assert mon.state == "ok" and mon.burns == 0
+
+
+def test_sustained_violation_burns_once_and_recovers():
+    mon = _monitor([_itl_slo()])
+    for k in range(20):
+        mon.observe("itl_ms", 500.0, ts=1000.0 + 0.01 * k)
+    (row,) = mon.evaluate(now=1000.5)
+    # all-bad stream: burn = 1.0 / 0.01 budget = 100x on both windows
+    assert row["burn_rate_fast"] == pytest.approx(100.0)
+    assert row["burn_rate_slow"] == pytest.approx(100.0)
+    assert row["burning"] and row["budget_remaining"] == 0.0
+    assert mon.state == "degraded" and mon.burns == 1
+    assert mon.health() == {"state": "degraded",
+                            "violations": ["itl_p99_ms"],
+                            "burns": 1}
+    # still burning: degraded persists, NO new edge
+    mon.evaluate(now=1001.0)
+    assert mon.burns == 1
+    # both windows drain -> ok; a fresh storm is a fresh edge
+    (row,) = mon.evaluate(now=2000.0)
+    assert not row["burning"] and mon.state == "ok"
+    for k in range(20):
+        mon.observe("itl_ms", 500.0, ts=3000.0 + 0.01 * k)
+    mon.evaluate(now=3000.5)
+    assert mon.burns == 2
+
+
+def test_min_events_gate_blocks_sparse_false_alarm():
+    mon = _monitor([_itl_slo()], min_events=10)
+    for k in range(5):    # 5 terrible samples < min_events
+        mon.observe("itl_ms", 9999.0, ts=1000.0 + 0.1 * k)
+    (row,) = mon.evaluate(now=1001.0)
+    assert row["burn_rate_fast"] == pytest.approx(100.0)
+    assert not row["burning"] and mon.state == "ok"
+
+
+def test_burn_requires_both_windows():
+    """An old (slow-window-only) violation with a clean fast window must
+    not page — the multi-window rule."""
+    mon = _monitor([_itl_slo()])
+    for k in range(20):
+        mon.observe("itl_ms", 500.0, ts=1000.0 + 0.01 * k)   # old, bad
+    for k in range(20):
+        mon.observe("itl_ms", 10.0, ts=1050.0 + 0.01 * k)    # fresh, good
+    (row,) = mon.evaluate(now=1051.0)
+    assert row["burn_rate_fast"] == 0.0
+    assert row["burn_rate_slow"] == pytest.approx(50.0)
+    assert not row["burning"] and mon.state == "ok"
+
+
+def test_completion_families_route_independently():
+    mon = SLOMonitor("t", [SLO("goodput", 0.5, window=60.0,
+                               fast_window=5.0, threshold=1.5),
+                           SLO("error_rate", 0.5, window=60.0,
+                               fast_window=5.0, threshold=1.5)],
+                     eval_interval=1e9, min_events=5)
+    # ok-but-late completions: bad for goodput, good for error_rate
+    for k in range(10):
+        mon.observe("completion", ok=True, deadline_ok=False,
+                    ts=1000.0 + 0.01 * k)
+    rows = {r["metric"]: r for r in mon.evaluate(now=1000.2)}
+    assert rows["goodput"]["burning"]
+    assert rows["goodput"]["burn_rate_fast"] == pytest.approx(2.0)
+    assert not rows["error_rate"]["burning"]
+    assert rows["error_rate"]["burn_rate_fast"] == 0.0
+    assert mon.health()["violations"] == ["goodput"]
+
+
+def test_snapshot_rides_export_surface():
+    mon = _monitor([_itl_slo()])
+    mon.observe("itl_ms", 50.0, ts=1000.0)
+    mon.evaluate(now=1000.1)
+    snap = export.snapshot()
+    assert snap["slo.t.state"] == "ok"
+    assert snap["slo.t.burns"] == 0
+    assert snap["slo.t.itl_p99_ms.burning"] == 0
+    assert "slo.t.itl_p99_ms.budget_remaining" in snap
+
+
+# -- the flight-recorder escalation ------------------------------------------
+
+
+def test_burn_edge_dumps_flight_recorder_once(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER_DIR", str(tmp_path))
+    recorder.enable()
+    recorder.reset()
+    mon = _monitor([_itl_slo()])
+    for k in range(20):
+        mon.observe("itl_ms", 500.0, ts=1000.0 + 0.01 * k)
+    mon.evaluate(now=1000.5)
+    assert recorder.dump_count() == 1
+    # sustained storm: state stays degraded, edge never re-fires
+    for k in range(20):
+        mon.observe("itl_ms", 500.0, ts=1001.0 + 0.01 * k)
+    mon.evaluate(now=1001.5)
+    mon.evaluate(now=1002.0)
+    assert recorder.dump_count() == 1 and mon.burns == 1
+    doc = json.loads(open(recorder.last_dump_path()).read())
+    assert doc["reason"] == "slo_burn"
+    assert doc["args"]["monitor"] == "t"
+    assert doc["args"]["objective"] == "itl_p99_ms"
+    assert doc["args"]["burn_rate_fast"] == pytest.approx(100.0)
+    assert any(e["kind"] == "escalation" and e["name"] == "slo.burn(t)"
+               for e in doc["ring"])
+
+
+# -- end to end over a live engine -------------------------------------------
+
+
+def _tiny_engine(name):
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.serve import ContinuousEngine
+
+    net = get_llama("llama_tiny_test")
+    net.initialize()
+    return ContinuousEngine(net, max_seq=64, num_slots=4, page_size=16,
+                            prefill_chunk=16, decode_path="baseline",
+                            name=name)
+
+
+@pytest.mark.serial
+def test_delay_fault_storm_trips_exactly_one_dump(tmp_path, monkeypatch):
+    """The acceptance storm: a sustained serve:decode delay fault pushes
+    every token-to-token gap over a tight ITL objective; the monitor
+    pages ONCE (edge-triggered + recorder rate limit), and the engine
+    keeps serving (degraded, not dead)."""
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER_DIR", str(tmp_path))
+    recorder.enable()
+    recorder.reset()
+    with _tiny_engine("slo_storm") as eng:
+        mon = SLOMonitor("storm", [
+            SLO("itl_p99_ms", 1.0, window=60.0, fast_window=5.0,
+                threshold=2.0)], eval_interval=0.0, min_events=5)
+        mon.attach(eng.metrics)
+        faults.install_plan({"rules": [{"site": "serve:decode",
+                                        "kind": "delay",
+                                        "seconds": 0.02,
+                                        "prob": 1.0}]})
+        try:
+            futs = [eng.submit([3, 4, 5], max_new_tokens=12),
+                    eng.submit([6, 7], max_new_tokens=12)]
+            for f in futs:
+                assert len(f.result(timeout=120)["tokens"]) == 12
+        finally:
+            faults.clear_plan()
+    assert mon.state == "degraded"
+    assert mon.burns == 1
+    assert recorder.dump_count() == 1
+    doc = json.loads(open(recorder.last_dump_path()).read())
+    assert doc["reason"] == "slo_burn"
+    assert doc["args"]["objective"] == "itl_p99_ms"
+
+
+@pytest.mark.serial
+def test_clean_run_trips_no_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER_DIR", str(tmp_path))
+    recorder.enable()
+    recorder.reset()
+    with _tiny_engine("slo_clean") as eng:
+        mon = SLOMonitor("clean", [
+            SLO("itl_p99_ms", 60_000.0, window=60.0, fast_window=5.0,
+                threshold=2.0)], eval_interval=0.0, min_events=5)
+        mon.attach(eng.metrics)
+        assert len(eng.submit([3, 4, 5], max_new_tokens=12)
+                   .result(timeout=120)["tokens"]) == 12
+    rows = mon.evaluate()
+    assert not any(r["burning"] for r in rows)
+    assert mon.state == "ok" and mon.burns == 0
+    assert recorder.dump_count() == 0
+
+
+# -- degraded-not-dead /healthz ----------------------------------------------
+
+
+@pytest.mark.serial
+def test_healthz_degraded_not_dead():
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serve import InferenceSession
+
+    net = nn.Dense(4)
+    net.initialize()
+    sess = InferenceSession(net, batch_buckets=(1,), name="slo_health")
+    sess.warmup(mnp.ones((1, 4)))
+    mon = _monitor([_itl_slo()]).attach(sess.metrics)
+    assert sess.ready() and sess.health()["state"] != "degraded"
+    for k in range(20):
+        mon.observe("itl_ms", 500.0, ts=1000.0 + 0.01 * k)
+    mon.evaluate(now=1000.5)
+    h = sess.health()
+    assert h["state"] == "degraded"
+    assert h["slo"]["violations"] == ["itl_p99_ms"]
+    assert sess.ready()   # a burn is a page, not a kill switch

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Perf-regression gate over the checked-in bench history.
+
+``BENCH_r*.json`` / ``MULTICHIP_r*.json`` record each growth round's
+bench emission: the driver stores ``{"n", "cmd", "rc", "tail"}`` where
+``tail`` is the (possibly mid-JSON truncated) stdout tail containing one
+JSON metric row per line::
+
+    {"metric": "resnet50_v1_train_bs256_bf16_amp", "value": 2707.31,
+     "unit": "img/s", "n": 5, "spread": [2609.86, 2780.03], ...}
+
+This tool recovers every intact row by scanning for ``{"metric":`` and
+``raw_decode``-ing from there (truncated final rows are dropped, not
+fatal), builds a per-metric series across rounds, and gates a candidate
+emission against it:
+
+* ``--fresh FILE``  gate a fresh emission (bench stdout or a JSON list
+  of rows) against the full history.
+* default (no ``--fresh``)  self-check: the NEWEST round plays the
+  candidate and every earlier round is history — this must stay green
+  on the checked-in r01..r05 files, so the gate itself is regression-
+  tested by the repo state.
+
+Noise model (spread-aware): a metric regresses only when the candidate
+value falls outside the reference round's ``spread`` envelope AND past
+the relative slack (``--tol``, default 10%).  When either side is
+``weather_dominated`` (the bench marked the round as shared-machine
+noise) the slack is widened by ``--weather-factor``.  Direction comes
+from the unit: ``*/s`` throughput is higher-better, ``ms``/``s``/``us``
+latency is lower-better.
+
+Exit status: 0 green (or clean SKIP when there is nothing to compare),
+1 with a line naming the regressed row otherwise.  Importable: tests
+drive :func:`extract_rows`, :func:`load_history`, and :func:`main`.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+_DECODER = json.JSONDecoder()
+
+# latency-flavoured units (lower is better); anything "per second" or
+# unknown is treated as throughput (higher is better)
+_LOWER_BETTER_UNITS = ("ms", "us", "ns", "s", "s/iter", "ms/token",
+                       "ms/step")
+
+
+def extract_rows(text):
+    """Every intact ``{"metric": ...}`` JSON object in ``text``.
+
+    Tolerates arbitrary surrounding log noise and a truncated final
+    object (the driver keeps only a byte-bounded tail).  Rows that nest
+    the full row set under ``"extra"`` (the bench's final summary line)
+    are kept too — callers dedupe by metric name.
+    """
+    rows = []
+    i = 0
+    while True:
+        j = text.find('{"metric"', i)
+        if j < 0:
+            break
+        try:
+            obj, end = _DECODER.raw_decode(text[j:])
+        except ValueError:
+            i = j + 1
+            continue
+        if isinstance(obj.get("metric"), str) \
+                and isinstance(obj.get("value"), (int, float)):
+            rows.append(obj)
+        i = j + end
+    return rows
+
+
+def _round_key(path):
+    """Sort key: (rNN, family) so BENCH_r02 precedes BENCH_r03 and the
+    bench/multichip files of one round stay adjacent."""
+    base = os.path.basename(path)
+    digits = "".join(c for c in base if c.isdigit())
+    return (int(digits) if digits else 0, base)
+
+
+def load_history(root):
+    """``[(label, [row, ...]), ...]`` oldest-first from the checked-in
+    ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` files under ``root``.
+
+    Within one round, later duplicates of a metric are dropped (the
+    bench's final summary line repeats the last row with an ``extra``
+    payload).  Rounds with no recoverable rows (e.g. every MULTICHIP
+    file — their tails carry no metric lines) are skipped, not fatal.
+    """
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))
+                   + glob.glob(os.path.join(root, "MULTICHIP_r*.json")),
+                   key=_round_key)
+    out = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rows, seen = [], set()
+        for r in extract_rows(doc.get("tail") or ""):
+            if r["metric"] in seen:
+                continue
+            seen.add(r["metric"])
+            rows.append(r)
+        if rows:
+            out.append((os.path.basename(p), rows))
+    return out
+
+
+def load_fresh(path):
+    """Candidate rows from ``path``: a JSON list of rows, a driver-style
+    ``{"tail": ...}`` doc, or raw bench stdout — whichever parses."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, list):
+        return [r for r in doc if isinstance(r, dict) and "metric" in r]
+    if isinstance(doc, dict) and "tail" in doc:
+        text = doc.get("tail") or ""
+    rows, seen = [], set()
+    for r in extract_rows(text):
+        if r["metric"] not in seen:
+            seen.add(r["metric"])
+            rows.append(r)
+    return rows
+
+
+def _higher_is_better(unit):
+    u = (unit or "").strip().lower()
+    return u not in _LOWER_BETTER_UNITS
+
+
+def _band(row, tol, weather_factor):
+    """Acceptance band ``(lo, hi)`` around a reference row: the wider of
+    the measured spread envelope and the relative slack, weather-widened
+    when the round was marked noise-dominated."""
+    v = float(row["value"])
+    slack = tol * (weather_factor if row.get("weather_dominated") else 1.0)
+    lo, hi = v * (1.0 - slack), v * (1.0 + slack)
+    spread = row.get("spread")
+    if isinstance(spread, (list, tuple)) and len(spread) == 2:
+        try:
+            lo = min(lo, float(spread[0]) * (1.0 - slack))
+            hi = max(hi, float(spread[1]) * (1.0 + slack))
+        except (TypeError, ValueError):
+            pass
+    return lo, hi
+
+
+def _candidate_edge(row, higher_better):
+    """The candidate's most favourable defensible value: its own spread
+    edge toward the reference (a noisy-but-overlapping run is not a
+    regression)."""
+    v = float(row["value"])
+    spread = row.get("spread")
+    if isinstance(spread, (list, tuple)) and len(spread) == 2:
+        try:
+            return max(v, float(spread[1])) if higher_better \
+                else min(v, float(spread[0]))
+        except (TypeError, ValueError):
+            pass
+    return v
+
+
+def compare(history, fresh_rows, tol=0.10, weather_factor=3.0):
+    """Gate ``fresh_rows`` against ``history``; returns
+    ``(regressions, checked)`` where each regression is a dict naming
+    the row, both values, and the violated band."""
+    ref = {}  # metric -> (round_label, row); last occurrence wins
+    for label, rows in history:
+        for r in rows:
+            ref[r["metric"]] = (label, r)
+    regressions, checked = [], 0
+    for row in fresh_rows:
+        got = ref.get(row["metric"])
+        if got is None:
+            continue  # new metric: nothing to regress against
+        label, base = got
+        checked += 1
+        higher = _higher_is_better(row.get("unit") or base.get("unit"))
+        # weather widening applies when EITHER side is noise-dominated;
+        # _band handles the reference's own flag
+        eff_tol = tol * (weather_factor
+                         if row.get("weather_dominated") else 1.0)
+        lo, hi = _band(base, eff_tol, weather_factor)
+        edge = _candidate_edge(row, higher)
+        bad = edge < lo if higher else edge > hi
+        if bad:
+            regressions.append({
+                "metric": row["metric"],
+                "value": float(row["value"]),
+                "unit": row.get("unit") or base.get("unit"),
+                "reference": float(base["value"]),
+                "reference_round": label,
+                "band": [round(lo, 4), round(hi, 4)],
+                "direction": "higher" if higher else "lower",
+            })
+    return regressions, checked
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="spread-aware perf-regression gate over the "
+                    "checked-in BENCH_r*/MULTICHIP_r* history")
+    ap.add_argument("--history-dir", default=None,
+                    help="directory holding BENCH_r*.json "
+                         "(default: repo root above tools/)")
+    ap.add_argument("--fresh", default=None,
+                    help="candidate emission (bench stdout / JSON rows); "
+                         "omitted -> self-check newest round vs the rest")
+    ap.add_argument("--tol", type=float, default=0.10,
+                    help="relative slack outside the spread envelope "
+                         "(default 0.10)")
+    ap.add_argument("--weather-factor", type=float, default=3.0,
+                    help="slack multiplier for weather_dominated rounds "
+                         "(default 3.0)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a machine-readable verdict object")
+    args = ap.parse_args(argv)
+
+    root = args.history_dir or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    history = load_history(root)
+
+    if args.fresh is not None:
+        try:
+            fresh = load_fresh(args.fresh)
+        except OSError as e:
+            print(f"PERFGUARD SKIP (fresh emission unreadable: {e})")
+            return 0
+        if not fresh:
+            print("PERFGUARD SKIP (fresh emission has no metric rows)")
+            return 0
+        label = args.fresh
+    else:
+        if len(history) < 2:
+            print("PERFGUARD SKIP (need >= 2 history rounds for "
+                  "self-check, have %d)" % len(history))
+            return 0
+        label, fresh = history[-1]
+        history = history[:-1]
+
+    if not history:
+        print("PERFGUARD SKIP (no bench history rows)")
+        return 0
+
+    regressions, checked = compare(history, fresh, tol=args.tol,
+                                   weather_factor=args.weather_factor)
+    if args.json:
+        print(json.dumps({"candidate": label, "checked": checked,
+                          "regressions": regressions}, indent=2))
+    if regressions:
+        for r in regressions:
+            print("PERF_REGRESSION: %s = %g %s vs %g (%s, %s-is-better, "
+                  "band [%g, %g])"
+                  % (r["metric"], r["value"], r["unit"], r["reference"],
+                     r["reference_round"], r["direction"],
+                     r["band"][0], r["band"][1]))
+        return 1
+    print("PERFGUARD PASS (%s: %d row%s checked against %d round%s)"
+          % (label, checked, "" if checked == 1 else "s",
+             len(history), "" if len(history) == 1 else "s"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

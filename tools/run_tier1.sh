@@ -201,6 +201,46 @@ if [[ "${TIER1_LOCKDEP:-1}" != "0" ]]; then
         rc=$ld_rc
     fi
 fi
+# SLO smoke (TIER1_SLO=1 to enable): the healthy 32-client serve smoke
+# with a declarative SLO monitor attached (itl/ttft p99, goodput,
+# error-rate burn objectives) — asserts no objective burns, the monitor
+# health stays "ok", and the flight recorder produces zero slo_burn
+# dumps (the guard's false-positive contract). Re-run under
+# MXNET_LOCKDEP=1: the monitor's observe/evaluate path runs on the
+# metrics-observing threads and must stay cycle-free.
+if [[ "${TIER1_SLO:-0}" != "0" ]]; then
+    timeout -k 10 120 env JAX_PLATFORMS=cpu \
+        python tools/serve_smoke.py --slo
+    slo_rc=$?
+    if [[ "$rc" -eq 0 && "$slo_rc" -ne 0 ]]; then
+        rc=$slo_rc
+    fi
+    timeout -k 10 120 env JAX_PLATFORMS=cpu MXNET_LOCKDEP=1 \
+        python tools/serve_smoke.py --slo
+    slo_rc=$?
+    if [[ "$rc" -eq 0 && "$slo_rc" -ne 0 ]]; then
+        rc=$slo_rc
+    fi
+fi
+# Perf-regression gate (TIER1_PERFGUARD=1 to enable): the spread-aware
+# gate over the checked-in BENCH_r*/MULTICHIP_r* history
+# (tools/perf_regression.py). With TIER1_PERFGUARD_FRESH=<file> the
+# gate compares that fresh bench emission against the full history;
+# without it the newest checked-in round plays the candidate
+# (self-check — must stay green on the committed files). The tool
+# SKIPs cleanly (exit 0) when there is nothing to compare.
+if [[ "${TIER1_PERFGUARD:-0}" != "0" ]]; then
+    if [[ -n "${TIER1_PERFGUARD_FRESH:-}" ]]; then
+        timeout -k 10 60 python tools/perf_regression.py \
+            --fresh "$TIER1_PERFGUARD_FRESH"
+    else
+        timeout -k 10 60 python tools/perf_regression.py
+    fi
+    perf_rc=$?
+    if [[ "$rc" -eq 0 && "$perf_rc" -ne 0 ]]; then
+        rc=$perf_rc
+    fi
+fi
 # Collective overlap smoke (TIER1_OVERLAP=1 to enable): a dp4 training
 # loop with gradient bucketing + overlapped priority-ordered flushes on
 # (MXNET_KVSTORE_BUCKET_MB / MXNET_KVSTORE_OVERLAP) — asserts bitwise

@@ -35,6 +35,14 @@ the llama generation stack under concurrent clients:
   decode steps and land in ``info["deadline_expired"]`` plus the
   ``deadline_expired["decode"]`` metric.
 
+With ``--slo`` (the ``TIER1_SLO=1`` pass) the same healthy 32-client
+run executes with a declarative SLO monitor attached to the session
+metrics (itl/ttft p99, goodput, error-rate objectives at generous CI
+targets): after the run NO objective may be burning, the monitor state
+must be ``ok``, and the flight recorder must have produced zero
+``slo_burn`` dumps — the guard's false-positive contract on a healthy
+service.
+
 With ``--prefix`` (the ``TIER1_PREFIX=1`` pass) the smoke drives the
 PR-14 "never redo prior work" stack:
 
@@ -114,6 +122,9 @@ def main():
         trace_out = sys.argv[sys.argv.index("--trace-out") + 1]
         os.environ.setdefault("MXNET_TRACE", "1")
         os.environ.setdefault("MXNET_FLIGHT_RECORDER", "1")
+    if "--slo" in sys.argv:
+        os.environ.setdefault("MXNET_FLIGHT_RECORDER", "1")
+        return _run(trace_out, slo=True)
     return _run(trace_out)
 
 
@@ -343,7 +354,7 @@ def _run_decode(path):
     return 0
 
 
-def _run(trace_out=None):
+def _run(trace_out=None, slo=False):
     import mxnet_tpu as mx  # noqa: F401  (framework init)
     from mxnet_tpu import autograd, gluon
     from mxnet_tpu import numpy as mnp
@@ -372,6 +383,18 @@ def _run(trace_out=None):
     net.initialize()
 
     sess = InferenceSession(net, batch_buckets=(1, 2, 4, 8), name="smoke")
+    monitor = None
+    if slo:
+        from mxnet_tpu.profiler import recorder as _recorder
+        from mxnet_tpu.profiler.slo import SLO, SLOMonitor
+        _recorder.reset()
+        monitor = SLOMonitor("smoke", [
+            SLO("itl_p99_ms", 500.0),
+            SLO("ttft_p99_ms", 2000.0),
+            SLO("goodput", 0.95),
+            SLO("error_rate", 0.05),
+        ])
+        monitor.attach(sess.metrics)
     sess.warmup(np.zeros((1, 16), np.float32))
 
     def runner(payloads):
@@ -435,6 +458,23 @@ def _run(trace_out=None):
           f"occupancy={snap['batch_occupancy']:.2f} "
           f"signatures={sess.signature_count()} "
           f"serve_hits={sess.cache_stats()['serve_hits']}")
+    if monitor is not None:
+        from mxnet_tpu.profiler import recorder as _recorder
+        rows = monitor.evaluate()
+        burning = [r["metric"] for r in rows if r["burning"]]
+        health = monitor.health()
+        if burning or health["state"] != "ok" or monitor.burns > 0:
+            print(f"SLO_SMOKE=FAIL healthy run tripped the burn guard: "
+                  f"burning={burning} health={health} rows={rows}")
+            return 1
+        if _recorder.dump_count() > 0:
+            print(f"SLO_SMOKE=FAIL healthy run produced "
+                  f"{_recorder.dump_count()} flight-recorder dump(s): "
+                  f"{_recorder.last_dump_path()}")
+            return 1
+        print(f"SLO_SMOKE=PASS objectives={len(rows)} state="
+              f"{health['state']} burns={monitor.burns} "
+              f"events={[r['events_slow'] for r in rows]}")
     if trace_out is not None:
         return _trace_epilogue(sess, DynamicBatcher, runner, xs[0],
                                trace_out)

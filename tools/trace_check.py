@@ -16,7 +16,13 @@ Checks, in order:
   finish ('f'), with ``f.ts >= s.ts``;
 * ``--expect-lane``: at least one async id forms a connected per-request
   lane — >= min-span distinct span names across >= min-threads threads
-  (the serving submit -> flush -> settle handoff made visible).
+  (the serving submit -> flush -> settle handoff made visible);
+* ``--expect-attribution``: the trace contains ``serve::decode_step``
+  spans and EVERY one carries the four critical-path ledger args
+  (``host_ms``/``dispatch_ms``/``device_ms``/``wait_ms``) whose sum
+  reconciles with the span's own wall time within 10% (floor 0.05 ms)
+  — the profiler/attribution contract that the phase partition covers
+  the iteration exactly.
 
 Exit 0 on pass; 1 with one reason line per failure.
 """
@@ -25,8 +31,11 @@ import collections
 import json
 import sys
 
+_LEDGER_KEYS = ("host_ms", "dispatch_ms", "device_ms", "wait_ms")
 
-def check_trace(path, expect_lane=False, min_spans=3, min_threads=2):
+
+def check_trace(path, expect_lane=False, min_spans=3, min_threads=2,
+                expect_attribution=False):
     """Returns a list of failure strings (empty = pass)."""
     failures = []
     try:
@@ -43,6 +52,7 @@ def check_trace(path, expect_lane=False, min_spans=3, min_threads=2):
     async_by_id = collections.defaultdict(list)  # id -> events
     flow_s = collections.defaultdict(list)
     flow_f = collections.defaultdict(list)
+    decode_evs = collections.defaultdict(list)  # (cat,id,name) -> (ts,ph,ev)
 
     for i, ev in enumerate(events):
         ph = ev.get("ph")
@@ -74,6 +84,8 @@ def check_trace(path, expect_lane=False, min_spans=3, min_threads=2):
                 continue
             async_evs[key].append((ts, ph))
             async_by_id[key[1]].append(ev)
+            if ev.get("name") == "serve::decode_step":
+                decode_evs[key].append((ts, ph, ev))
         elif ph == "s":
             flow_s[ev.get("id")].append(ts)
         elif ph == "f":
@@ -136,6 +148,49 @@ def check_trace(path, expect_lane=False, min_spans=3, min_threads=2):
                 f"{best[2]!r} has {best[0]} span name(s) across "
                 f"{best[1]} thread(s); want >= {min_spans} spans on "
                 f">= {min_threads} threads")
+
+    if expect_attribution:
+        n_spans, n_bad = 0, 0
+        for key, rows in sorted(decode_evs.items(),
+                                key=lambda kv: str(kv[0])):
+            # pair b/e in ts order (LIFO — spans of one name on one lane
+            # never interleave, but be defensive about nesting)
+            stack = []
+            for ts, ph, ev in sorted(rows, key=lambda r: (r[0],
+                                                          r[1] == "b")):
+                if ph == "b":
+                    stack.append((ts, ev))
+                    continue
+                if not stack:
+                    continue  # mismatch already reported above
+                t0, b_ev = stack.pop()
+                n_spans += 1
+                args = b_ev.get("args") or {}
+                missing = [k for k in _LEDGER_KEYS if not isinstance(
+                    args.get(k), (int, float))]
+                if missing:
+                    n_bad += 1
+                    if n_bad <= 5:
+                        failures.append(
+                            f"decode_step span (id {key[1]}) at "
+                            f"{t0:.3f}us missing ledger args {missing}")
+                    continue
+                wall_ms = (ts - t0) / 1e3  # ts is in us
+                ledger_ms = sum(args[k] for k in _LEDGER_KEYS)
+                tol = max(0.10 * wall_ms, 0.05)
+                if abs(ledger_ms - wall_ms) > tol:
+                    n_bad += 1
+                    if n_bad <= 5:
+                        failures.append(
+                            f"decode_step span (id {key[1]}) at "
+                            f"{t0:.3f}us: ledger sum {ledger_ms:.3f}ms "
+                            f"vs wall {wall_ms:.3f}ms (tol {tol:.3f}ms)")
+        if n_spans == 0:
+            failures.append("no serve::decode_step spans found "
+                            "(attribution expected)")
+        elif n_bad > 5:
+            failures.append(f"... and {n_bad - 5} more decode_step "
+                            "attribution mismatches")
     return failures
 
 
@@ -146,10 +201,14 @@ def main(argv=None):
                     help="require one connected per-request async lane")
     ap.add_argument("--min-spans", type=int, default=3)
     ap.add_argument("--min-threads", type=int, default=2)
+    ap.add_argument("--expect-attribution", action="store_true",
+                    help="require serve::decode_step spans carrying the "
+                         "four ledger args summing to the span wall")
     args = ap.parse_args(argv)
     failures = check_trace(args.trace, expect_lane=args.expect_lane,
                            min_spans=args.min_spans,
-                           min_threads=args.min_threads)
+                           min_threads=args.min_threads,
+                           expect_attribution=args.expect_attribution)
     if failures:
         for f in failures:
             print(f"TRACE_CHECK=FAIL {f}")
