@@ -1174,6 +1174,35 @@ def bench_elastic_resume():
     })
 
 
+def bench_elastic_resume_3d():
+    """MULTICHIP composed-mesh elastic row (resilience.elastic): a
+    dp2×tp2 ShardedTrainer run killed mid-step by a coordinate-addressed
+    chip_loss, rebuilt to dp1×tp2 (tp extent pinned, the touched
+    dp-group dropped) and resumed from its layout-carrying sharded
+    checkpoint resharded onto the survivor mesh. Reports the recovery
+    wall-time (classify → rebuild_mesh → trainer rebind → cross-layout
+    restore) and steps lost; the bitwise parity check against a clean
+    dp1×tp2 run from the same checkpoint runs inside the leg and fails
+    the row loudly on any divergence."""
+    from tools.elastic_soak import run_kill_reshard_3d
+
+    violations, row = run_kill_reshard_3d(seed=7, n_batches=10)
+    if violations:
+        raise RuntimeError(f"elastic 3d kill-and-reshard violated: "
+                           f"{violations}")
+    return _emit({
+        "metric": "elastic_resume_3d_recovery_ms",
+        "value": round(row["recovery_wall_s"] * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": None,
+        "steps_lost": row["steps_lost"],
+        "dp": f"{row['dp_from']}->{row['dp_to']}",
+        "tp": row["tp"],
+        "killed_device": row["killed_device"],
+        "parity": row["resume_parity"],
+    })
+
+
 def bench_collective_overlap():
     """MULTICHIP collective row (kvstore.bucketing): the bucketing ×
     overlap × compression ablation grid over a dp4 training loop —
@@ -1654,6 +1683,7 @@ def main():
                      ("bandwidth", bench_bandwidth),
                      ("guardrail_overhead", bench_guardrail_overhead),
                      ("elastic_resume", bench_elastic_resume),
+                     ("elastic_resume_3d", bench_elastic_resume_3d),
                      ("collective_overlap", bench_collective_overlap),
                      ("lenet_eager", bench_lenet_eager),
                      ("trace_overhead", bench_trace_overhead),

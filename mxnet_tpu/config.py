@@ -411,6 +411,19 @@ register_flag(
     "Fewest surviving data-parallel replicas an elastic restart will "
     "resume on; fewer survivors re-raises MeshDegraded.", int)
 register_flag(
+    "MXNET_ELASTIC_REBUILD", True,
+    "Composed-mesh (dp×tp(×pp)) elasticity: on chip loss, "
+    "ElasticTrainingHandler.recover_sharded rebuilds the mesh with "
+    "parallel.mesh.rebuild_mesh (tp/pp extents pinned, touched dp-groups "
+    "dropped) and reshards the newest layout-carrying sharded checkpoint "
+    "onto the survivors. 0: composed-mesh losses re-raise (the pre-rebuild "
+    "degrade path), pure-dp shrink_mesh elasticity is unaffected.", _bool)
+register_flag(
+    "MXNET_ELASTIC_MIN_DP_GROUPS", 1,
+    "Fewest surviving data-parallel GROUPS (dp extent of the rebuilt "
+    "composed mesh) recover_sharded will resume on; fewer survivors "
+    "re-raises the mesh loss.", int)
+register_flag(
     "MXNET_DESYNC_CHECK_STEPS", 0,
     "Cadence (in batches) of the cross-replica parameter-fingerprint "
     "desync audit (resilience.elastic.DesyncAuditHandler). 0 (default) "

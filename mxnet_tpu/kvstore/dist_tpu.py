@@ -184,10 +184,14 @@ class KVStoreDistTPUSync(KVStoreLocal):
             return None
         lost = getattr(exc, "replica", None)
         lost = [int(lost)] if lost is not None else None
+        # coordinate-addressed chip loss (composed dp×tp meshes): forward
+        # the device address so the elastic layer can rebuild_mesh on it
+        device = getattr(exc, "device", None)
         return self._mesh_degraded(
-            lost, f"{type(exc).__name__}: {exc}", op)
+            lost, f"{type(exc).__name__}: {exc}", op,
+            lost_devices=[device] if device is not None else None)
 
-    def _mesh_degraded(self, lost, cause, op):
+    def _mesh_degraded(self, lost, cause, op, lost_devices=None):
         """Count + trace + warn one mesh-loss event and build the
         :class:`MeshDegraded` to raise (shared by exception
         classification and the breaker-open device probe)."""
@@ -203,6 +207,7 @@ class KVStoreDistTPUSync(KVStoreLocal):
         # crash forensics: the moments before a mesh loss, on disk
         _recorder.dump("mesh_degraded",
                        args={"op": op, "lost": lost,
+                             "lost_devices": lost_devices,
                              "cause": str(cause)[:500],
                              "step": _trace.current_step()})
         warnings.warn(
@@ -213,7 +218,8 @@ class KVStoreDistTPUSync(KVStoreLocal):
         return _elastic.MeshDegraded(
             f"{op} lost part of the mesh: {cause}",
             lost_replicas=lost,
-            mesh_size=self._mesh.size if self._mesh is not None else None)
+            mesh_size=self._mesh.size if self._mesh is not None else None,
+            lost_devices=lost_devices)
 
     def _probe_lost_devices(self):
         """Tiny device_put + blocking read against every mesh device;

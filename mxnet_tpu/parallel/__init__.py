@@ -7,9 +7,11 @@ on ``jax.sharding`` + XLA collectives.
 from __future__ import annotations
 
 from . import mesh
-from .mesh import get_mesh, initialize_distributed, make_mesh, mesh_scope, set_mesh
+from .mesh import (get_mesh, initialize_distributed, make_mesh, mesh_scope,
+                   rebuild_mesh, set_mesh, shrink_mesh, touched_groups)
 from . import functional
-from .functional import ShardedTrainer, ShardingRules, functionalize
+from .functional import (ParallelConfig, ShardedTrainer, ShardingRules,
+                         functionalize)
 from . import pipeline
 from .pipeline import PipelinedBlock, pipeline_apply, stack_stage_params
 from . import moe

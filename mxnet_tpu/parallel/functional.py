@@ -233,6 +233,48 @@ class ShardingRules:
 
 
 # ---------------------------------------------------------------------------
+# declarative parallel composition
+# ---------------------------------------------------------------------------
+
+
+class ParallelConfig:
+    """Declarative dp×tp(×pp) composition for :class:`ShardedTrainer`.
+
+    ``ParallelConfig(dp=2, tp=2)`` names the mesh the trainer runs over:
+    ``dp`` data-parallel groups (the batch axis; ZeRO flat buckets shard
+    over it), ``tp``-way tensor parallelism (explicit ``shard_map``
+    collectives following the param rules' layouts), and optionally
+    ``pp`` pipeline stages (the ``parallel.pipeline`` path; tp and pp do
+    not compose yet). ``resilience.elastic`` rebuilds trainers from these
+    three integers after chip loss: dp shrinks to the survivor groups
+    while the tp/pp extents stay pinned (``parallel.mesh.rebuild_mesh``).
+    """
+
+    def __init__(self, dp, tp=1, pp=0):
+        self.dp = int(dp)
+        self.tp = int(tp)
+        self.pp = int(pp)
+        if self.dp < 1 or self.tp < 1 or self.pp < 0:
+            raise MXNetError(
+                f"ParallelConfig needs dp>=1, tp>=1, pp>=0; got "
+                f"dp={dp}, tp={tp}, pp={pp}")
+
+    def mesh_shape(self):
+        """Axis-name -> extent dict for ``make_mesh``. ``dp`` is always
+        present (the batch spec needs its axis even at extent 1); tp/pp
+        appear only when actually used."""
+        shape = {"dp": self.dp}
+        if self.tp > 1:
+            shape["tp"] = self.tp
+        if self.pp > 0:
+            shape["pp"] = self.pp
+        return shape
+
+    def __repr__(self):
+        return f"ParallelConfig(dp={self.dp}, tp={self.tp}, pp={self.pp})"
+
+
+# ---------------------------------------------------------------------------
 # sharded training step
 # ---------------------------------------------------------------------------
 
@@ -260,7 +302,7 @@ class ShardedTrainer:
     def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
                  mesh=None, rules: Optional[ShardingRules] = None,
                  batch_spec=None, dtype=None, aux_loss_weight=0.01,
-                 abstract=False, zero_bucket_mb=None):
+                 abstract=False, zero_bucket_mb=None, parallel=None):
         import jax
         from jax.sharding import NamedSharding
 
@@ -275,10 +317,32 @@ class ShardedTrainer:
                                             **(optimizer_params or {}))
         else:
             self.optimizer = optimizer
+        self._parallel = parallel
+        self._use_shard_map = False
+        if parallel is not None:
+            if parallel.tp > 1 and parallel.pp:
+                raise MXNetError(
+                    "ParallelConfig: composed tp×pp is not supported yet — "
+                    "run tp (shard_map) or pp (pipeline) but not both")
+            if mesh is None:
+                mesh = mesh_mod.make_mesh(parallel.mesh_shape())
+            else:
+                for ax, n in parallel.mesh_shape().items():
+                    if int(mesh.shape.get(ax, 0)) != n:
+                        raise MXNetError(
+                            f"ParallelConfig wants {ax}={n} but the given "
+                            f"mesh has {ax}={mesh.shape.get(ax, 'absent')}")
+            self._use_shard_map = parallel.tp > 1
         self.mesh = mesh if mesh is not None else mesh_mod.get_mesh(create=True)
         if self.mesh is None:
             raise MXNetError("ShardedTrainer needs a device mesh")
-        self.rules = rules or ShardingRules()
+        if rules is None:
+            # under a declarative ParallelConfig the ZeRO default axis is
+            # dp: unruled params bucket over the dp groups while tp/pp
+            # layouts come from explicit rules
+            rules = ShardingRules(default_axis="dp") \
+                if parallel is not None else ShardingRules()
+        self.rules = rules
         # AMP policy (amp.py bf16-first): compute casts float params+inputs
         # to `dtype` inside the step; master weights, grads and the update
         # stay fp32 — the multi-precision layout of optimizer_op-inl.h
@@ -295,6 +359,11 @@ class ShardedTrainer:
         pp_axis = getattr(block, "_pp_axis", None)
         if hasattr(block, "_pp_functionalize") \
                 and pp_axis in self.mesh.axis_names:
+            if self._use_shard_map:
+                raise MXNetError(
+                    "ParallelConfig(tp>1) cannot drive a pipelined block: "
+                    "the shard_map tp step and the pp stage schedule do "
+                    "not compose yet")
             # pipeline-parallel path (parallel/pipeline.PipelinedBlock):
             # body layers arrive stacked as `pp::<rel>` leaves sharded
             # P(pp) — one stage's params per device along the pp axis
@@ -580,6 +649,235 @@ class ShardedTrainer:
 
     # -- the compiled step ------------------------------------------------
     def _build_step(self):
+        if self._use_shard_map:
+            return self._build_step_shard_map()
+        return self._build_step_pjit()
+
+    def _build_step_shard_map(self):
+        """Explicit-collective step for composed dp×tp meshes: the whole
+        step runs under ``shard_map``, so every array is its per-device
+        block and every cross-device exchange is written out instead of
+        left to the SPMD partitioner.
+
+        The math mirrors the pjit path exactly:
+
+        * the local loss is ``pmean``-ed over ALL mesh axes — over dp
+          that is the global batch mean; over tp it is value-identical
+          (every tp peer sees the same gathered params and the same
+          batch block) but it is what makes the tiled ``all_gather``
+          transpose (a psum_scatter over tp) come out unscaled;
+        * each param's grad is then ``psum``-ed over exactly the axes
+          its PartitionSpec does NOT mention — dp for tp layouts, tp for
+          dp-sharded ZeRO buckets, both for replicated params — and
+          divided by ``mesh.size`` (every device seeds cotangent 1 on
+          its replicated loss), after which the local grad IS the exact
+          global-batch-mean grad of that slice;
+        * optimizer updates run on the local slices (elementwise
+          optimizers only), so sharded state never materializes whole.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import NamedSharding
+
+        opt = self.optimizer
+        if not (getattr(opt, "fused_safe", True)
+                and getattr(opt, "elementwise", True)):
+            raise MXNetError(
+                "ParallelConfig(tp>1) runs optimizer updates on local "
+                f"shards, which needs an elementwise optimizer: "
+                f"{type(opt).__name__} keeps per-tensor norms or python"
+                "-side state, so updating slices would change its math")
+        mesh = self.mesh
+        P = _P()
+        all_axes = tuple(mesh.axis_names)
+        mesh_n = int(mesh.size)
+        apply_fn = self._apply_fn
+        loss_fn = self.loss_fn
+        train_names = self._train_keys
+        state_names = self._state_names
+        has_state = bool(state_names)
+        zb_specs = self._zb_specs
+        zb_keys = frozenset(self._zb_by_key)
+        spec_of = {n: self._spec_of(n, self.params[n].shape)
+                   for n in self.params}
+        amp_dtype = self._dtype
+
+        def cast_amp(x):
+            if amp_dtype is not None and jnp.issubdtype(x.dtype,
+                                                        jnp.floating):
+                return x.astype(amp_dtype)
+            return x
+
+        def axes_of(spec):
+            out = []
+            for entry in spec:
+                if entry is None:
+                    continue
+                out.extend(entry if isinstance(entry, (tuple, list))
+                           else (entry,))
+            return tuple(out)
+
+        def gather_full(x, spec):
+            # local block -> full tensor; tiled all_gather per sharded
+            # dim is differentiable (its transpose is psum_scatter)
+            for dim, entry in enumerate(spec):
+                if entry is None:
+                    continue
+                for ax in (entry if isinstance(entry, (tuple, list))
+                           else (entry,)):
+                    x = jax.lax.all_gather(x, ax, axis=dim, tiled=True)
+            return x
+
+        def scatter_local(x, spec):
+            for dim, entry in enumerate(spec):
+                if entry is None:
+                    continue
+                for ax in (entry if isinstance(entry, (tuple, list))
+                           else (entry,)):
+                    size = x.shape[dim] // int(mesh.shape[ax])
+                    x = jax.lax.dynamic_slice_in_dim(
+                        x, jax.lax.axis_index(ax) * size, size, axis=dim)
+            return x
+
+        def local_loss(train_params, state_params, batch, labels, key):
+            full = {}
+            if zb_specs:
+                # ZeRO per dp-group: ONE all_gather per flat bucket
+                # rebuilds the replicated buffer; per-param views are
+                # static slices of it — the pjit path's bucket
+                # discipline with the collective written out
+                for spec in zb_specs:
+                    flat = gather_full(train_params[spec.key],
+                                       spec_of[spec.key])
+                    for pn, off, size, shape in spec.items():
+                        full[pn] = jax.lax.slice_in_dim(
+                            flat, off, off + size).reshape(shape)
+            for pn, a in train_params.items():
+                if pn not in zb_keys:
+                    full[pn] = gather_full(a, spec_of[pn])
+            params = dict(full)
+            for sn, a in state_params.items():
+                params[sn] = gather_full(a, spec_of[sn])
+            if amp_dtype is not None:
+                params = {n: cast_amp(a) for n, a in params.items()}
+                batch = jax.tree_util.tree_map(cast_amp, batch)
+            batch = batch if isinstance(batch, tuple) else (batch,)
+            r = apply_fn(params, *batch, rng_key=key)
+            if has_state:
+                out, new_state = r
+            else:
+                out, new_state = r, {}
+            from ..ndarray.ndarray import NDArray
+
+            out_nd = jax.tree_util.tree_map(
+                lambda x: x if isinstance(x, NDArray) else NDArray(x), out,
+                is_leaf=lambda x: isinstance(x, NDArray))
+            lbl_nd = jax.tree_util.tree_map(NDArray, labels)
+            loss = loss_fn(out_nd, lbl_nd)
+            ldata = loss._data if isinstance(loss, NDArray) else loss
+            aux = _collect_aux_losses(self.block)
+            if aux is not None:
+                ldata = ldata + self._aux_weight * aux
+            if amp_dtype is not None:
+                new_state = {n: v.astype(state_params[n].dtype)
+                             for n, v in new_state.items()}
+            return jax.lax.pmean(
+                jnp.mean(ldata.astype(jnp.float32)), all_axes), new_state
+
+        def step(train_params, state_params, opt_states, batch, labels,
+                 key, lrs, wds, t):
+            (loss, new_state), grads = jax.value_and_grad(
+                local_loss, has_aux=True)(train_params, state_params,
+                                          batch, labels, key)
+            new_train = {}
+            new_opt = {}
+            frozen = self._frozen_names
+            for i, n in enumerate(train_names):
+                if n in frozen:
+                    new_train[n] = train_params[n]
+                    new_opt[n] = opt_states[n]
+                    continue
+                g = grads[n].astype(train_params[n].dtype)
+                missing = tuple(a for a in all_axes
+                                if a not in axes_of(spec_of[n]))
+                if missing:
+                    g = jax.lax.psum(g, missing)
+                # every device seeds cotangent 1 on its own (replicated)
+                # pmean'd loss, so after the psum the grad is mesh.size×
+                # the global-batch-mean grad — one normalization for all
+                # layouts (sharded axes already collapse in the backward,
+                # missing axes in the psum above)
+                g = g / float(mesh_n)
+                g = opt._prep_grad(g)
+                p_new, s_new = opt._update_raw(
+                    train_params[n], g, opt_states[n], lrs[i], wds[i], t)
+                new_train[n] = p_new
+                new_opt[n] = tuple(s_new) \
+                    if isinstance(s_new, (list, tuple)) else (s_new,)
+            # mutable block state (BN running stats): average the
+            # per-shard updates, keep only the local block of the result
+            new_state = {n: scatter_local(jax.lax.pmean(v, all_axes),
+                                          spec_of[n])
+                         for n, v in new_state.items()}
+            return new_train, new_state, new_opt, loss
+
+        train_in = {n: spec_of[n] for n in train_names}
+        state_in = {n: spec_of[n] for n in state_names}
+        opt_in = {n: tuple(s.sharding.spec for s in self._opt_states[n])
+                  for n in train_names}
+        sm = shard_map(
+            step, mesh=mesh,
+            in_specs=(train_in, state_in, opt_in, self.batch_spec,
+                      self.batch_spec, P(), P(), P(), P()),
+            out_specs=(train_in, state_in, opt_in, P()),
+            check_rep=False)
+        train_shard = {n: NamedSharding(mesh, spec_of[n])
+                       for n in train_names}
+        state_shard = {n: NamedSharding(mesh, spec_of[n])
+                       for n in state_names}
+        opt_shard = {
+            n: tuple(NamedSharding(mesh, s.sharding.spec)
+                     for s in self._opt_states[n])
+            for n in train_names}
+        batch_shard = NamedSharding(mesh, self.batch_spec)
+        repl = NamedSharding(mesh, P())
+        self._step_jit = jax.jit(
+            sm,
+            in_shardings=(train_shard, state_shard, opt_shard, batch_shard,
+                          batch_shard, repl, None, None, None),
+            out_shardings=(train_shard, state_shard, opt_shard, repl),
+            donate_argnums=(0, 1, 2),
+        )
+        stacked_spec = P(None, *self.batch_spec)
+        stacked_shard = NamedSharding(mesh, stacked_spec)
+
+        def step_n_fn(train_params, state_params, opt_states, d_all, l_all,
+                      key, lrs, wds, t0):
+            def body(carry, xs):
+                tr, st, op, t, k = carry
+                k, sub = jax.random.split(k)
+                d, l = xs
+                ntr, nst, nop, loss = sm(tr, st, op, d, l, sub, lrs, wds,
+                                         t)
+                return (ntr, nst, nop, t + 1, k), loss
+
+            (tr, st, op, _, _), losses = jax.lax.scan(
+                body, (train_params, state_params, opt_states, t0, key),
+                (d_all, l_all))
+            return tr, st, op, losses
+
+        self._stepn_fn = step_n_fn
+        self._stepn_jit = jax.jit(
+            step_n_fn,
+            in_shardings=(train_shard, state_shard, opt_shard,
+                          stacked_shard, stacked_shard, repl, None, None,
+                          None),
+            out_shardings=(train_shard, state_shard, opt_shard, repl),
+            donate_argnums=(0, 1, 2),
+        )
+
+    def _build_step_pjit(self):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding
@@ -796,13 +1094,23 @@ class ShardedTrainer:
         return total
 
     # -- shared host-side step machinery ----------------------------------
-    def _unwrap_batch(self, data, labels):
+    def _unwrap_batch(self, data, labels, spec=None):
         import jax
+        from jax.sharding import NamedSharding
 
         from ..ndarray.ndarray import NDArray
 
+        sh = NamedSharding(self.mesh,
+                           spec if spec is not None else self.batch_spec)
+
         def raw(x):
-            return x._data if isinstance(x, NDArray) else x
+            d = x._data if isinstance(x, NDArray) else x
+            if isinstance(d, jax.Array) and getattr(d, "_committed", False):
+                # eager NDArrays sit committed on their ctx device; the
+                # step's in_shardings contract wants mesh-laid-out (or
+                # uncommitted) inputs — re-place instead of erroring
+                d = jax.device_put(d, sh)
+            return d
 
         d = tuple(raw(x) for x in data) if isinstance(data, (list, tuple)) \
             else raw(data)
@@ -860,7 +1168,14 @@ class ShardedTrainer:
         import jax
 
         from ..ndarray.ndarray import NDArray
+        from ..resilience import faults as _faults
 
+        # chip-loss injection surface for composed-mesh elasticity: a
+        # `chip_loss` rule here (optionally device-addressed) raises
+        # BEFORE the compiled SPMD step dispatches, exactly where a real
+        # ICI/chip failure would surface as a poisoned dispatch
+        _faults.fault_point("trainer:sharded_step",
+                            {"step": self._step_count})
         if self._step_jit is None:
             self._build_step()
         d, l = self._unwrap_batch(data, labels)
@@ -891,7 +1206,8 @@ class ShardedTrainer:
 
         if self._step_jit is None:
             self._build_step()
-        d, l = self._unwrap_batch(data, labels)
+        d, l = self._unwrap_batch(data, labels,
+                                  spec=_P()(None, *self.batch_spec))
         avail = jax.tree_util.tree_leaves(d)[0].shape[0]
         n = avail if num_steps is None else int(num_steps)
         if n < 1 or n > avail:
@@ -983,6 +1299,216 @@ class ShardedTrainer:
             self._key = jax.device_put(blob["rng_key"])
         for i in range(len(self._train_keys)):
             self.optimizer._index_update_count[i] = self._step_count
+
+    # -- portable state (elastic rebuild-and-reshard) ---------------------
+    def checkpoint_layouts(self):
+        """Tensor-split layout of every explicitly tp/pp-sharded param:
+        ``{name: {"axis", "dim", "parts"}}`` — what
+        ``resilience.checkpoint.save_sharded_checkpoint(layouts=...)``
+        records in its manifest so a resume under ANY mesh reassembles
+        the full tensor before re-laying it out. dp/fsdp sharding is
+        ownership, not layout, and is not recorded."""
+        out = {}
+        for n in self.params:
+            if n in self._zb_by_key:
+                continue
+            spec = self._spec_of(n, self.params[n].shape)
+            for dim, entry in enumerate(spec):
+                if entry is None:
+                    continue
+                for ax in (entry if isinstance(entry, (tuple, list))
+                           else (entry,)):
+                    if ax in ("dp", "fsdp"):
+                        continue
+                    if n in out:
+                        raise MXNetError(
+                            f"checkpoint_layouts: {n!r} is sharded over "
+                            "more than one non-dp axis/dim — multi-axis "
+                            "tensor layouts cannot be checkpointed yet")
+                    out[n] = {"axis": ax, "dim": dim,
+                              "parts": int(self.mesh.shape[ax])}
+        return out
+
+    def export_state(self):
+        """Gather the FULL training state to host, bucket-free: whole
+        numpy tensors per param (flat ZeRO buckets unpacked back into
+        their member views, padding dropped), optimizer state re-keyed
+        per param the same way, plus step count and RNG position. The
+        result is mesh-independent: :meth:`import_state` repacks it under
+        the destination trainer's own bucket plan and shardings — what
+        lets an elastic resume cross dp extents."""
+        import jax
+        import numpy as onp
+
+        params = {}
+        opt_states = {}
+        for n, a in self.params.items():
+            if n not in self._zb_by_key:
+                params[n] = onp.asarray(jax.device_get(a))
+        for n in self._train_keys:
+            st = tuple(onp.asarray(jax.device_get(s))
+                       for s in self._opt_states[n])
+            spec = self._zb_by_key.get(n)
+            if spec is None:
+                opt_states[n] = st
+                continue
+            flat = onp.asarray(jax.device_get(self.params[n]))
+            for pn, off, size, shape in spec.items():
+                params[pn] = flat[off:off + size].reshape(shape).copy()
+                # per-element state (momentum) slices like the weight;
+                # anything else (scalars) replicates per member
+                opt_states[pn] = tuple(
+                    s[off:off + size].reshape(shape).copy()
+                    if s.shape == flat.shape else s.copy() for s in st)
+        return {"params": params, "opt_states": opt_states,
+                "step_count": self._step_count,
+                "rng_key": onp.asarray(jax.device_get(self._key))}
+
+    def _zb_repack(self, spec, values, dtype, what):
+        """Zero-padded flat repack of per-member host arrays into one
+        bucket buffer. Zero-filling the padding is exact for elementwise
+        optimizers: a padding slot's grad is identically zero and decay
+        multiplies zero, so its momentum never leaves zero."""
+        import numpy as onp
+
+        flat = onp.zeros((spec.total,), dtype=dtype)
+        for pn, off, size, shape in spec.items():
+            v = values.get(pn)
+            if v is None:
+                raise MXNetError(
+                    f"{what} for bucket member {pn!r} is missing from "
+                    "the imported state")
+            v = onp.asarray(v)
+            if int(v.size) != size:
+                raise MXNetError(
+                    f"{what} for bucket member {pn!r} has {v.size} "
+                    f"elements, expected {size}")
+            flat[off:off + size] = v.reshape(-1)
+        return flat
+
+    def import_params(self, params):
+        """Place a dict of FULL host tensors (numpy or NDArray) into this
+        trainer — repacking flat ZeRO buckets and resharding every array
+        to the live mesh layout. Accepts ``export_state()['params']`` or
+        ``resilience.checkpoint.load_checkpoint``'s reassembled output
+        (extra entries are ignored; missing ones raise)."""
+        import jax
+        import numpy as onp
+
+        def host(v):
+            return v.asnumpy() if hasattr(v, "asnumpy") else onp.asarray(v)
+
+        for n, live in self.params.items():
+            spec = self._zb_by_key.get(n)
+            if spec is not None:
+                flat = self._zb_repack(
+                    spec,
+                    {pn: host(params[pn]) for pn, _, _, _ in spec.items()
+                     if pn in params},
+                    live.dtype, "parameter")
+                self.params[n] = jax.device_put(flat, live.sharding)
+                continue
+            if n not in params:
+                raise MXNetError(
+                    f"import_params: parameter {n!r} missing from the "
+                    "imported dict")
+            h = host(params[n])
+            if tuple(h.shape) != tuple(live.shape):
+                raise MXNetError(
+                    f"import_params: {n!r} has shape {tuple(h.shape)} "
+                    f"but this trainer expects {tuple(live.shape)}")
+            self.params[n] = jax.device_put(
+                onp.asarray(h, dtype=live.dtype), live.sharding)
+
+    def _import_opt_states(self, opt_states):
+        import jax
+        import numpy as onp
+
+        def host(v):
+            return v.asnumpy() if hasattr(v, "asnumpy") else onp.asarray(v)
+
+        new = {}
+        for n in self._train_keys:
+            live = self._opt_states[n]
+            spec = self._zb_by_key.get(n)
+            if spec is None:
+                if n not in opt_states:
+                    raise MXNetError(
+                        f"optimizer state for {n!r} is missing from the "
+                        "imported state")
+                st = tuple(host(s) for s in opt_states[n])
+                if len(st) != len(live):
+                    raise MXNetError(
+                        f"optimizer state for {n!r} has arity {len(st)} "
+                        f"but this trainer expects {len(live)}")
+                new[n] = tuple(
+                    jax.device_put(onp.asarray(h, dtype=l.dtype),
+                                   l.sharding)
+                    for h, l in zip(st, live))
+                continue
+            placed = []
+            first = spec.names[0]
+            for i, l in enumerate(live):
+                if tuple(l.shape) == (spec.total,):
+                    members = {}
+                    for pn, off, size, shape in spec.items():
+                        sts = opt_states.get(pn)
+                        if sts is None or len(sts) <= i:
+                            raise MXNetError(
+                                f"optimizer state for bucket member "
+                                f"{pn!r} is missing from the imported "
+                                "state")
+                        members[pn] = host(sts[i])
+                    flat = self._zb_repack(spec, members, l.dtype,
+                                           "optimizer state")
+                    placed.append(jax.device_put(flat, l.sharding))
+                else:
+                    sts = opt_states.get(first)
+                    if sts is None or len(sts) <= i:
+                        raise MXNetError(
+                            f"optimizer state for bucket member {first!r} "
+                            "is missing from the imported state")
+                    placed.append(jax.device_put(
+                        onp.asarray(host(sts[i]), dtype=l.dtype),
+                        l.sharding))
+            new[n] = tuple(placed)
+        self._opt_states = new
+
+    def import_state(self, blob):
+        """Inverse of :meth:`export_state` onto THIS trainer's mesh and
+        bucket plan — params, optimizer state, step count, RNG
+        position."""
+        self.import_params(blob["params"])
+        self._restore_scalars(blob)
+
+    def _restore_scalars(self, blob):
+        import jax
+
+        self._import_opt_states(blob["opt_states"])
+        self._step_count = int(blob["step_count"])
+        if blob.get("rng_key") is not None:
+            self._key = jax.device_put(blob["rng_key"])
+        for i in range(len(self._train_keys)):
+            self.optimizer._index_update_count[i] = self._step_count
+
+    def states_to_bytes(self):
+        """Trainer blob for ``resilience.checkpoint`` (the duck-typed
+        ``trainer=`` hook): optimizer state + step count + RNG position,
+        bucket-free — params travel separately through the checkpoint's
+        own (layout-aware) params path."""
+        import pickle
+
+        st = self.export_state()
+        st.pop("params")
+        return pickle.dumps(st)
+
+    def load_states_from_bytes(self, raw):
+        """Restore a :meth:`states_to_bytes` blob onto THIS trainer —
+        which may sit on a different mesh than the saver; the per-param
+        repack is the reshard an elastic resume relies on."""
+        import pickle
+
+        self._restore_scalars(pickle.loads(raw))
 
     def sync_to_block(self):
         """Copy trained weights back into the Block's Parameters (a copy —

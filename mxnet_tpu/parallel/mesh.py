@@ -95,6 +95,8 @@ def shrink_mesh(mesh, lost, axis="dp", power_of_two=True):
     if axis not in mesh.axis_names:
         raise MXNetError(
             f"shrink_mesh: axis {axis!r} not in mesh axes {mesh.axis_names}")
+    lost = sorted({int(i) for i in (lost if hasattr(lost, "__iter__")
+                                    else [lost])})
     if axis not in ("dp", "fsdp"):
         from ..resilience.elastic import MeshDegraded
 
@@ -102,10 +104,9 @@ def shrink_mesh(mesh, lost, axis="dp", power_of_two=True):
             f"shrink_mesh: axis {axis!r} is not a data-parallel axis — "
             "dropping a slice of a model-parallel axis would change every "
             "sharded parameter's shape; only 'dp'/'fsdp' replicas can be "
-            "dropped elastically", mesh_size=int(mesh.devices.size))
+            "dropped elastically", lost_replicas=lost,
+            mesh_size=int(mesh.devices.size))
     ax = mesh.axis_names.index(axis)
-    lost = sorted({int(i) for i in (lost if hasattr(lost, "__iter__")
-                                    else [lost])})
     size = mesh.devices.shape[ax]
     bad = [i for i in lost if not 0 <= i < size]
     if bad:
@@ -123,7 +124,7 @@ def shrink_mesh(mesh, lost, axis="dp", power_of_two=True):
             f"{dict(zip(mesh.axis_names, mesh.devices.shape))} the other "
             "axes' ring schedules assume power-of-two groups; use "
             "power_of_two=True to truncate, or rebuild the mesh",
-            mesh_size=int(mesh.devices.size))
+            lost_replicas=lost, mesh_size=int(mesh.devices.size))
     if power_of_two and len(keep) > 1:
         target = 1 << (len(keep).bit_length() - 1)
         keep = keep[:target]
@@ -135,17 +136,140 @@ def shrink_mesh(mesh, lost, axis="dp", power_of_two=True):
     return Mesh(arr, mesh.axis_names)
 
 
-def mesh_contexts(mesh, axis="dp"):
+def touched_groups(mesh, lost_devices, axis="dp"):
+    """Map arbitrary lost-device addresses to the set of ``axis`` indices
+    (dp-groups) they touch. Each entry of ``lost_devices`` is either a flat
+    device index into ``mesh.devices`` (C order) or a coordinate dict
+    ``{"axis": name, "index": i}`` addressing a whole slice of a named
+    axis. Addressing a slice of a *different* axis touches every
+    ``axis``-group (the slice crosses all of them)."""
+    names = mesh.axis_names
+    if axis not in names:
+        raise MXNetError(
+            f"touched_groups: axis {axis!r} not in mesh axes {names}")
+    ax = names.index(axis)
+    shape = mesh.devices.shape
+    if isinstance(lost_devices, (int, dict)):
+        lost_devices = [lost_devices]
+    touched = set()
+    for dev in lost_devices:
+        if isinstance(dev, dict):
+            a = dev.get("axis")
+            if a not in names:
+                raise MXNetError(
+                    f"touched_groups: lost-device axis {a!r} not in mesh "
+                    f"axes {names}")
+            i = int(dev.get("index", 0))
+            extent = shape[names.index(a)]
+            if not 0 <= i < extent:
+                raise MXNetError(
+                    f"touched_groups: lost-device index {i} out of range "
+                    f"for axis {a!r} of size {extent}")
+            if a == axis:
+                touched.add(i)
+            else:
+                # a whole slice of another axis crosses every dp-group
+                touched.update(range(shape[ax]))
+        else:
+            f = int(dev)
+            if not 0 <= f < mesh.devices.size:
+                raise MXNetError(
+                    f"touched_groups: flat device index {f} out of range "
+                    f"for mesh of size {mesh.devices.size}")
+            coords = _onp.unravel_index(f, shape)
+            touched.add(int(coords[ax]))
+    return touched
+
+
+def rebuild_mesh(mesh, lost_devices, axis="dp", power_of_two=True):
+    """Composed-mesh elasticity policy: given arbitrary lost device
+    coordinates on a (possibly multi-axis) mesh, keep every non-``axis``
+    extent (tp/pp) fixed and drop each ``axis``-group (dp-group) touched
+    by a loss. A chip loss anywhere in a dp-group breaks that group's ICI
+    rings, so the whole group leaves the mesh; the tp/pp structure of the
+    survivors is untouched and their sharded parameters keep their shapes.
+
+    ``lost_devices`` entries are flat device indices or coordinate dicts
+    ``{"axis": ..., "index": ...}`` (see :func:`touched_groups` —
+    coordinate-addressed ``chip_loss`` faults arrive in either form). On a
+    composite mesh the survivor count is truncated to the largest power of
+    two (ring schedules on the remaining axes assume power-of-two groups);
+    a single-axis mesh honors the existing any-size exception when
+    ``power_of_two=False``, exactly like :func:`shrink_mesh`.
+
+    Compositions that shard over expert (``ep``, :mod:`.moe`) or sequence
+    (``sp``, :mod:`.ring_attention`) axes are pinned *unsupported*: a
+    dp-group drop cannot preserve their all-to-all / ring layouts, so the
+    loss raises :class:`~..resilience.elastic.MeshDegraded` loudly (with
+    ``lost_replicas``/``mesh_size`` populated) instead of silently
+    misplacing shards.
+
+    Returns ``(new_mesh, group_map)`` where ``group_map`` maps each
+    surviving old dp-group index to its index on the new mesh.
+    """
+    from jax.sharding import Mesh
+
+    from ..resilience.elastic import MeshDegraded
+
+    names = mesh.axis_names
+    if axis not in names:
+        raise MXNetError(
+            f"rebuild_mesh: axis {axis!r} not in mesh axes {names}")
+    ax = names.index(axis)
+    size = mesh.devices.shape[ax]
+    touched = touched_groups(mesh, lost_devices, axis=axis)
+    unsupported = [a for a in names if a in ("ep", "sp")]
+    if unsupported and touched:
+        raise MeshDegraded(
+            f"rebuild_mesh: mesh axes {unsupported} are pinned unsupported "
+            "under mesh loss — dropping a dp-group cannot preserve the "
+            "MoE all-to-all ('ep') / ring-attention ('sp') layouts; "
+            "restart on a fresh mesh instead",
+            lost_replicas=sorted(touched), mesh_size=int(mesh.devices.size))
+    keep = [i for i in range(size) if i not in touched]
+    if not keep:
+        raise MeshDegraded(
+            f"rebuild_mesh: the loss touches every {axis!r}-group "
+            f"(lost {sorted(touched)} of {size}) — no survivor mesh",
+            lost_replicas=sorted(touched), mesh_size=int(mesh.devices.size))
+    composite = len(names) > 1
+    if composite and (len(keep) & (len(keep) - 1)):
+        if not power_of_two:
+            raise MeshDegraded(
+                f"rebuild_mesh: axis {axis!r} would survive with "
+                f"{len(keep)} groups — not a power of two. On a composite "
+                f"mesh {dict(zip(names, mesh.devices.shape))} the other "
+                "axes' ring schedules assume power-of-two groups",
+                lost_replicas=sorted(touched),
+                mesh_size=int(mesh.devices.size))
+        keep = keep[:1 << (len(keep).bit_length() - 1)]
+    elif power_of_two and len(keep) > 1:
+        keep = keep[:1 << (len(keep).bit_length() - 1)]
+    arr = _onp.take(mesh.devices, keep, axis=ax)
+    group_map = {int(old): new for new, old in enumerate(keep)}
+    return Mesh(arr, names), group_map
+
+
+def mesh_contexts(mesh, axis="dp", full=False):
     """The :class:`~..device.Context` list matching ``mesh``'s slots along
     ``axis`` (one context per axis index, resolved via the device at the
     zero position of every other axis) — what a data-parallel training
-    loop initializes parameter replicas on."""
+    loop initializes parameter replicas on.
+
+    On a composed mesh each ``axis``-group spans the whole cross-section
+    of the other axes; ``full=True`` returns one context *list* per group
+    (every device in the group's slice, C order) instead of just the
+    zero-position representative — what composed-mesh elasticity uses to
+    attribute a lost chip to its dp-group."""
     from ..device import from_jax_device
 
     if axis not in mesh.axis_names:
         raise MXNetError(
             f"mesh_contexts: axis {axis!r} not in {mesh.axis_names}")
     ax = mesh.axis_names.index(axis)
+    if full:
+        groups = _onp.moveaxis(mesh.devices, ax, 0)
+        return [[from_jax_device(d) for d in grp.ravel()] for grp in groups]
     sel = [0] * mesh.devices.ndim
     out = []
     for i in range(mesh.devices.shape[ax]):

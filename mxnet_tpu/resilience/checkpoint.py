@@ -178,19 +178,35 @@ def _shard_path(path, i, n):
     return f"{path}.shard{i:02d}-of{n:02d}"
 
 
+def _slice_name(name, j):
+    """Entry name of tensor-split slice ``j`` of parameter ``name``
+    inside the shard containers (layout-carrying saves only)."""
+    return f"{name}::{j:02d}"
+
+
 def save_sharded_checkpoint(path, net=None, trainer=None, params=None,
                             meta=None, num_shards=None, mesh_axes=None,
-                            axis="dp"):
+                            axis="dp", layouts=None):
     """Write one *sharded* checkpoint: ``num_shards`` sibling containers
     each holding a round-robin name-partition of the parameters (whole
     tensors — a ZeRO-style ownership split, not a tensor split), plus a
     manifest at ``path`` recording the saving mesh/axis layout and every
     shard's CRC32, with the trainer blob inside the manifest.
 
+    ``layouts`` records *tensor-split* (tp/pp-sharded) parameters:
+    ``{name: {"axis": <mesh axis>, "dim": <tensor dim>, "parts": N}}``.
+    A laid-out parameter's value may be the full tensor (split here into
+    ``parts`` equal slices along ``dim``) or a pre-split list of the
+    per-rank slices in rank order; either way each slice is stored as its
+    own ``name::NN`` entry and the manifest carries the layout, so a load
+    can reassemble the full tensor and re-lay it out onto whatever mesh
+    is current (see :func:`_load_sharded`).
+
     Write order is shards-first, manifest-last (each write atomic): a
     crash mid-sequence leaves shard files with no manifest — invisible to
     ``CheckpointManager.load_latest``, cleaned by rotation — never a
     manifest pointing at missing shards. Returns ``path``."""
+    from ..ndarray.ndarray import NDArray
     from ..ndarray.utils import save_parameters_buffer
 
     if net is None and params is None:
@@ -200,13 +216,49 @@ def save_sharded_checkpoint(path, net=None, trainer=None, params=None,
     num_shards = int(num_shards or 1)
     if num_shards < 1:
         raise MXNetError(f"num_shards must be >= 1, got {num_shards}")
-    names = list(params)
+    layouts = dict(layouts or {})
+    entries = {}
+    for name, value in params.items():
+        lay = layouts.get(name)
+        if lay is None:
+            if isinstance(value, (list, tuple)):
+                raise MXNetError(
+                    f"save_sharded_checkpoint: {name!r} is a slice list "
+                    "but has no layouts entry describing its split")
+            entries[name] = value
+            continue
+        parts, dim = int(lay["parts"]), int(lay.get("dim", 0))
+        if isinstance(value, (list, tuple)):
+            slices = list(value)
+            if len(slices) != parts:
+                raise MXNetError(
+                    f"save_sharded_checkpoint: {name!r} has {len(slices)} "
+                    f"slices but its layout declares parts={parts}")
+        else:
+            host = value.asnumpy() if hasattr(value, "asnumpy") else value
+            if host.shape[dim] % parts:
+                raise MXNetError(
+                    f"save_sharded_checkpoint: {name!r} dim {dim} of size "
+                    f"{host.shape[dim]} does not split into {parts} equal "
+                    f"{lay.get('axis', '?')}-slices")
+            import numpy as _np
+
+            slices = _np.split(host, parts, axis=dim)
+        import numpy as _np
+
+        for j, s in enumerate(slices):
+            if not isinstance(s, NDArray):
+                if hasattr(s, "asnumpy"):
+                    s = s.asnumpy()
+                s = NDArray(_np.ascontiguousarray(s))
+            entries[_slice_name(name, j)] = s
+    names = list(entries)
     t0 = _prof.begin()
     shard_table = []
     for i in range(num_shards):
         own = names[i::num_shards]
         blob = _pack([("params", save_parameters_buffer(
-            {n: params[n] for n in own}))],
+            {n: entries[n] for n in own}))],
             {"shard": i, "num_shards": num_shards})
         spath = _shard_path(path, i, num_shards)
         _atomic_write(spath, blob)
@@ -215,6 +267,11 @@ def save_sharded_checkpoint(path, net=None, trainer=None, params=None,
     manifest = {"shards": shard_table, "num_shards": num_shards,
                 "mesh_axes": dict(mesh_axes or {axis: num_shards}),
                 "axis": axis}
+    if layouts:
+        manifest["layouts"] = {
+            n: {"axis": lay.get("axis", "tp"), "dim": int(lay.get("dim", 0)),
+                "parts": int(lay["parts"])}
+            for n, lay in layouts.items()}
     mmeta = dict(meta or {})
     mmeta.update({"sharded": True, "num_shards": num_shards,
                   "mesh_axes": manifest["mesh_axes"], "axis": axis})
@@ -229,12 +286,80 @@ def save_sharded_checkpoint(path, net=None, trainer=None, params=None,
     return path
 
 
-def _load_sharded(path, sections, meta, net=None, trainer=None):
+def _note_reshard(path, saved_axes, cur_axes):
+    """Count + warn one reshard-on-resume event, split by mesh axis:
+    ``resilience.reshard_resumes`` fires once per load whose layout
+    changed at all, and ``resilience.reshard_resumes[<ax>]`` names each
+    axis whose extent differs between the saving and resuming mesh."""
+    changed = sorted(
+        ax for ax in set(saved_axes) | set(cur_axes)
+        if int(saved_axes.get(ax, 1)) != int(cur_axes.get(ax, 1)))
+    if not changed:
+        return
+    _counters.incr("resilience.reshard_resumes")
+    for ax in changed:
+        _counters.incr(f"resilience.reshard_resumes[{ax}]")
+    if _prof.ENABLED:
+        _prof.record_instant("resilience::reshard", "resilience",
+                             args={"axes": changed,
+                                   "from": dict(saved_axes),
+                                   "to": dict(cur_axes)})
+    import warnings
+
+    frm = "×".join(f"{a}{saved_axes.get(a, 1)}" for a in changed)
+    to = "×".join(f"{a}{cur_axes.get(a, 1)}" for a in changed)
+    warnings.warn(
+        f"resharding checkpoint {os.path.basename(str(path))}: saved at "
+        f"{frm}, restoring onto {to}", RuntimeWarning, stacklevel=4)
+
+
+def _reassemble_layouts(path, params, manifest):
+    """Rebuild full tensors from the ``name::NN`` tensor-split slices a
+    layout-carrying save wrote (tp/pp-sharded parameters). The manifest's
+    layout is authoritative: a missing/mismatched slice set — the
+    signature of a tp-extent change the shards cannot express — raises
+    :class:`CheckpointCorruptError` loudly instead of silently misplacing
+    shard contents."""
+    import numpy as _np
+
+    from ..ndarray.ndarray import NDArray
+
+    for name, lay in (manifest.get("layouts") or {}).items():
+        parts, dim = int(lay["parts"]), int(lay.get("dim", 0))
+        slices = []
+        missing = []
+        for j in range(parts):
+            key = _slice_name(name, j)
+            if key in params:
+                slices.append(params.pop(key))
+            else:
+                missing.append(key)
+        if missing:
+            raise CheckpointCorruptError(
+                f"{path}: laid-out parameter {name!r} (axis "
+                f"{lay.get('axis')!r}, {parts} parts) cannot be "
+                f"reconstructed — slice(s) {missing} are absent from the "
+                "shard set; a save under a different tp extent cannot be "
+                "reinterpreted, resave or restore the matching layout")
+        if name in params:
+            raise CheckpointCorruptError(
+                f"{path}: parameter {name!r} appears both whole and as "
+                f"{parts} layout slices — ambiguous shard set")
+        full = _np.concatenate([s.asnumpy() for s in slices], axis=dim)
+        params[name] = NDArray(_np.ascontiguousarray(full))
+    return params
+
+
+def _load_sharded(path, sections, meta, net=None, trainer=None,
+                  mesh_axes=None):
     """Manifest half of :func:`load_checkpoint`: validate every shard
     (manifest CRC of the file bytes, then the shard's own container CRC),
-    reassemble the full parameter dict, and restore it onto the CURRENT
-    context list — the saving dp size in ``meta['mesh_axes']`` does not
-    have to match (reshard-on-resume)."""
+    reassemble the full parameter dict — including tensor-split (tp/pp)
+    slices recorded in the manifest's ``layouts`` — and restore it onto
+    the CURRENT mesh layout: the saving layout in ``meta['mesh_axes']``
+    does not have to match (reshard-on-resume). ``mesh_axes`` names the
+    resuming layout for the per-axis reshard accounting; when omitted it
+    is inferred from ``net``'s replica count (the pure-dp path)."""
     from ..ndarray.utils import load_parameters_buffer
 
     if trainer is not None and "trainer" not in sections:
@@ -265,6 +390,10 @@ def _load_sharded(path, sections, meta, net=None, trainer=None):
             raise CheckpointCorruptError(
                 f"{path}: shard {entry['name']} has no params section")
         params.update(load_parameters_buffer(ssec["params"]))
+    params = _reassemble_layouts(path, params, manifest)
+    saved_axes = dict(meta.get("mesh_axes") or {})
+    axis = meta.get("axis", "dp")
+    saved_axes.setdefault(axis, int(meta.get("num_shards", 1)))
     if net is not None:
         net_params = net.collect_params()
         missing = set(net_params) - set(params)
@@ -272,39 +401,32 @@ def _load_sharded(path, sections, meta, net=None, trainer=None):
             raise MXNetError(
                 f"{path}: sharded checkpoint missing parameters "
                 f"{sorted(missing)}")
-        saved_axes = meta.get("mesh_axes") or {}
-        axis = meta.get("axis", "dp")
-        saved_dp = int(saved_axes.get(axis, meta.get("num_shards", 1)))
-        cur_dp = max([len(p._data) for p in net_params.values()
-                      if p._data is not None] or [1])
-        if cur_dp != saved_dp:
-            # the reshard event itself — the whole point of the format,
-            # but operators must be able to see it happened
-            _counters.incr("resilience.reshard_resumes")
-            if _prof.ENABLED:
-                _prof.record_instant("resilience::reshard", "resilience",
-                                     args={"axis": axis, "from": saved_dp,
-                                           "to": cur_dp})
-            import warnings
-
-            warnings.warn(
-                f"resharding checkpoint {os.path.basename(str(path))}: "
-                f"saved at {axis}{saved_dp}, restoring onto {axis}"
-                f"{cur_dp} replicas", RuntimeWarning, stacklevel=3)
+        if mesh_axes is None:
+            cur_dp = max([len(p._data) for p in net_params.values()
+                          if p._data is not None] or [1])
+            mesh_axes = {axis: cur_dp}
+        _note_reshard(path, saved_axes, mesh_axes)
         for name, p in net_params.items():
             p.set_data(params[name])
+    elif mesh_axes is not None:
+        # no net to restore into (e.g. a ShardedTrainer resume pushes the
+        # returned dict itself) — the caller still declared the resuming
+        # layout, so the reshard event is still accounted per axis
+        _note_reshard(path, saved_axes, mesh_axes)
     if trainer is not None:
         _restore_trainer(trainer, sections["trainer"])
     return params, meta
 
 
-def load_checkpoint(path, net=None, trainer=None):
+def load_checkpoint(path, net=None, trainer=None, mesh_axes=None):
     """Load + validate one checkpoint; restores into ``net`` / ``trainer``
     when given. Raises :class:`CheckpointCorruptError` on a bad file
     (nothing is restored in that case). Sharded manifests (see
-    :func:`save_sharded_checkpoint`) reassemble from their shard files
-    and may restore onto a different replica count than they were saved
-    with. Returns ``(params_dict, meta)``."""
+    :func:`save_sharded_checkpoint`) reassemble from their shard files —
+    tensor-split (tp/pp) slices included — and may restore onto a
+    different mesh layout than they were saved with; pass ``mesh_axes``
+    (``{"dp": 2, "tp": 2}``-style) to declare the resuming layout for the
+    per-axis reshard accounting. Returns ``(params_dict, meta)``."""
     from ..ndarray.utils import load_parameters_buffer
 
     with open(path, "rb") as f:
@@ -312,7 +434,7 @@ def load_checkpoint(path, net=None, trainer=None):
     sections, meta = _unpack(raw, path=str(path))
     if meta.get("sharded"):
         return _load_sharded(path, sections, meta, net=net,
-                             trainer=trainer)
+                             trainer=trainer, mesh_axes=mesh_axes)
     if "params" not in sections:
         raise CheckpointCorruptError(f"{path}: no params section")
     if trainer is not None and "trainer" not in sections:
@@ -365,14 +487,15 @@ class CheckpointManager:
         return sorted(steps)
 
     def save(self, step, net=None, trainer=None, params=None, meta=None,
-             sharded=False, num_shards=None, mesh_axes=None, axis="dp"):
+             sharded=False, num_shards=None, mesh_axes=None, axis="dp",
+             layouts=None):
         meta = dict(meta or {})
         meta["step"] = int(step)
         if sharded:
             path = save_sharded_checkpoint(
                 self._path(step), net=net, trainer=trainer, params=params,
                 meta=meta, num_shards=num_shards, mesh_axes=mesh_axes,
-                axis=axis)
+                axis=axis, layouts=layouts)
         else:
             path = save_checkpoint(self._path(step), net=net,
                                    trainer=trainer, params=params,
@@ -448,16 +571,19 @@ class CheckpointManager:
                 RuntimeWarning, stacklevel=3)
         return True
 
-    def load_latest(self, net=None, trainer=None):
+    def load_latest(self, net=None, trainer=None, mesh_axes=None):
         """Restore the newest valid checkpoint; corrupt files roll back to
         the previous one. Returns its ``meta`` dict (contains ``step``),
-        or ``None`` when no valid checkpoint exists."""
+        or ``None`` when no valid checkpoint exists. ``mesh_axes``
+        declares the resuming mesh layout (forwarded to
+        :func:`load_checkpoint` for the per-axis reshard accounting)."""
         import warnings
 
         for step in reversed(self.list_steps()):
             path = self._path(step)
             try:
-                _, meta = load_checkpoint(path, net=net, trainer=trainer)
+                _, meta = load_checkpoint(path, net=net, trainer=trainer,
+                                          mesh_axes=mesh_axes)
                 return meta
             except CheckpointCorruptError as e:
                 _counters.incr("resilience.checkpoints_corrupt")

@@ -94,13 +94,20 @@ class MeshDegraded(MXNetError):
     ``lost_replicas``: indices of the lost device group(s) along the data
     -parallel axis, or ``None`` when the failure didn't identify one (the
     handler then probes each device). ``mesh_size``: the mesh size at the
-    time of the failure."""
+    time of the failure. ``lost_devices``: coordinate addresses of the
+    dead chip(s) on a composed dp×tp(×pp) mesh — flat device indices or
+    ``{"axis": ..., "index": ...}`` dicts, the form
+    :func:`~..parallel.mesh.rebuild_mesh` consumes — or ``None`` when the
+    failure only knew a replica index."""
 
-    def __init__(self, msg, lost_replicas=None, mesh_size=None):
+    def __init__(self, msg, lost_replicas=None, mesh_size=None,
+                 lost_devices=None):
         super().__init__(msg)
         self.lost_replicas = (None if lost_replicas is None
                               else [int(i) for i in lost_replicas])
         self.mesh_size = mesh_size
+        self.lost_devices = (None if lost_devices is None
+                             else list(lost_devices))
 
 
 def is_mesh_loss(exc) -> bool:
@@ -538,6 +545,19 @@ class ElasticTrainingHandler(TrainBegin, PreStep, BatchEnd, EpochEnd):
         if ctxs is None:
             return False
         lost = exc.lost_replicas
+        if lost is None and getattr(exc, "lost_devices", None):
+            # coordinate-addressed chip loss on the kvstore's mesh: map
+            # each dead-chip address to the dp-group it took down
+            from ..parallel import mesh as mesh_mod
+
+            kv_mesh = getattr(getattr(trainer, "_kvstore", None),
+                              "_mesh", None)
+            if kv_mesh is not None:
+                try:
+                    lost = sorted(mesh_mod.touched_groups(
+                        kv_mesh, exc.lost_devices, axis=self.axis))
+                except MXNetError:
+                    lost = None
         if lost is None:
             lost = probe_contexts(ctxs)
         lost = [i for i in lost if 0 <= i < len(ctxs)]
@@ -626,6 +646,156 @@ class ElasticTrainingHandler(TrainBegin, PreStep, BatchEnd, EpochEnd):
             f"{restored} ({lost_steps} step(s) lost, recovery "
             f"{dt * 1e3:.0f}ms)", RuntimeWarning, stacklevel=2)
         return True
+
+    # -- composed-mesh (dp×tp(×pp)) elasticity ---------------------------
+    def save_sharded_trainer(self, trainer, step, epoch=0):
+        """Snapshot a ``ShardedTrainer`` (SPMD, ``ParallelConfig``) as a
+        sharded checkpoint whose manifest records the FULL mesh layout
+        (every axis extent, not just dp) and the tensor-split layouts of
+        tp/pp-sharded params — the save format
+        :meth:`recover_sharded` can restore onto a rebuilt survivor
+        mesh. Flat ZeRO buckets are unpacked to per-param tensors first
+        (``export_state``), so the file is mesh-independent."""
+        from ..ndarray.ndarray import NDArray
+
+        mesh_axes = {a: int(trainer.mesh.shape[a])
+                     for a in trainer.mesh.axis_names}
+        host = trainer.export_state()["params"]
+        self.manager.save(
+            step, params={n: NDArray(v) for n, v in host.items()},
+            trainer=trainer,
+            meta={"batch": int(step), "epoch": int(epoch)},
+            sharded=True, num_shards=mesh_axes.get(self.axis, 1),
+            mesh_axes=mesh_axes, axis=self.axis,
+            layouts=trainer.checkpoint_layouts())
+        self.current_batch = int(step)
+        self.current_epoch = int(epoch)
+
+    def recover_sharded(self, trainer, exc, make_trainer):
+        """Rebuild-and-reshard recovery for a ``ShardedTrainer`` on a
+        composed dp×tp(×pp) mesh — the coordinate-addressed analog of
+        :meth:`step_error`. ``exc`` is the failure the step raised
+        (:class:`~.faults.ChipLostError` with a ``.device`` coordinate,
+        or :class:`MeshDegraded` carrying ``lost_devices``);
+        ``make_trainer(new_mesh)`` builds a fresh trainer over the
+        survivor mesh (same block/optimizer/rules, smaller dp). On
+        success returns ``(new_trainer, restored_step)`` — params +
+        optimizer state + step count restored from the newest sharded
+        save, tp slices reassembled and re-laid-out. Returns ``None``
+        when unrecoverable (budget spent, ``MXNET_ELASTIC_REBUILD=0``,
+        too few survivor dp-groups per
+        ``MXNET_ELASTIC_MIN_DP_GROUPS``, no checkpoint): the caller
+        re-raises its original exception."""
+        if not is_mesh_loss(exc):
+            return None
+        self.stats["mesh_losses"] += 1
+        if not _flag("MXNET_ELASTIC_REBUILD"):
+            warnings.warn(
+                "MXNET_ELASTIC_REBUILD=0: composed-mesh rebuild is "
+                "disabled — re-raising the mesh loss", RuntimeWarning,
+                stacklevel=2)
+            return None
+        if self.stats["restarts"] >= self.max_restarts:
+            warnings.warn(
+                f"elastic restart budget exhausted "
+                f"({self.stats['restarts']}/{self.max_restarts}) — "
+                "re-raising; a mesh shedding chips this fast is a "
+                "hardware incident", RuntimeWarning, stacklevel=2)
+            return None
+        t0 = time.perf_counter()
+        from ..parallel import mesh as mesh_mod
+
+        mesh = trainer.mesh
+        old_dp = int(mesh.shape.get(self.axis, 1))
+        lost_devices = getattr(exc, "lost_devices", None)
+        if not lost_devices:
+            dev = getattr(exc, "device", None)
+            if dev is not None:
+                lost_devices = [dev]
+        if not lost_devices:
+            # replica-int-only failures still name the dp coordinate
+            reps = getattr(exc, "lost_replicas", None)
+            if reps is None and getattr(exc, "replica", None) is not None:
+                reps = [exc.replica]
+            if reps:
+                lost_devices = [{"axis": self.axis, "index": int(r)}
+                                for r in reps]
+        if not lost_devices:
+            warnings.warn(
+                "mesh loss did not identify a dead chip (no device "
+                "coordinate, no replica index) — refusing a rebuild",
+                RuntimeWarning, stacklevel=2)
+            return None
+        try:
+            new_mesh, group_map = mesh_mod.rebuild_mesh(
+                mesh, lost_devices, axis=self.axis,
+                power_of_two=self.power_of_two)
+        except MXNetError as e:
+            warnings.warn(
+                f"mesh rebuild failed ({e}) — re-raising the original "
+                "mesh loss", RuntimeWarning, stacklevel=2)
+            return None
+        min_groups = max(1, int(_flag("MXNET_ELASTIC_MIN_DP_GROUPS")))
+        new_dp = int(new_mesh.shape.get(self.axis, 1))
+        if new_dp < min_groups:
+            warnings.warn(
+                f"mesh loss left {new_dp} dp-group(s), below "
+                f"MXNET_ELASTIC_MIN_DP_GROUPS={min_groups} — not "
+                "recoverable", RuntimeWarning, stacklevel=2)
+            return None
+        # dry-validate a restorable checkpoint BEFORE touching the global
+        # mesh — same discipline as step_error
+        meta = self.manager.load_latest()
+        if meta is None:
+            warnings.warn(
+                "mesh loss with NO valid checkpoint to resume from — "
+                "re-raising (call save_sharded_trainer before injecting "
+                "chip loss)", RuntimeWarning, stacklevel=2)
+            return None
+        from . import checkpoint as ckpt_mod
+
+        mesh_mod.set_mesh(new_mesh)
+        new_trainer = make_trainer(new_mesh)
+        mesh_axes = {a: int(new_mesh.shape[a])
+                     for a in new_mesh.axis_names}
+        step = int(meta["step"])
+        try:
+            params, meta = ckpt_mod.load_checkpoint(
+                self.manager._path(step), trainer=new_trainer,
+                mesh_axes=mesh_axes)
+        except (ckpt_mod.CheckpointCorruptError, MXNetError) as e:
+            warnings.warn(
+                f"mesh loss: checkpoint failed to restore after "
+                f"validation ({e}) — re-raising", RuntimeWarning,
+                stacklevel=2)
+            return None
+        new_trainer.import_params(params)
+        restored = int(meta.get("batch", meta.get("step", 0)))
+        lost_steps = max(0, self.current_batch + 1 - restored)
+        dt = time.perf_counter() - t0
+        self.stats["restarts"] += 1
+        self.stats["steps_lost"] += lost_steps
+        self.stats["last_recovery_s"] = dt
+        self.stats["dp_history"].append((old_dp, new_dp))
+        self._just_restarted = True
+        _counters.incr("resilience.elastic_restarts")
+        if _prof.ENABLED:
+            _prof.record_instant("resilience::elastic_restart",
+                                 "resilience",
+                                 args={"lost_devices": [str(d) for d in
+                                                        lost_devices],
+                                       "dp_from": old_dp, "dp_to": new_dp,
+                                       "group_map": {str(k): v for k, v
+                                                     in group_map.items()},
+                                       "steps_lost": lost_steps,
+                                       "recovery_s": round(dt, 4)})
+        warnings.warn(
+            f"elastic rebuild: lost device(s) {lost_devices} of a "
+            f"{'×'.join(f'{a}{n}' for a, n in mesh.shape.items())} mesh "
+            f"— resumed at dp{new_dp} (tp/pp extents pinned) from "
+            f"checkpoint batch {restored} ({lost_steps} step(s) lost, "
+            f"recovery {dt * 1e3:.0f}ms)", RuntimeWarning, stacklevel=2)
+        return new_trainer, restored
 
 
 # ---------------------------------------------------------------------------

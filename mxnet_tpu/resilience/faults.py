@@ -68,7 +68,16 @@ each takes a ``"replica"`` field naming the device-group index it targets:
     loss and raises :class:`~.elastic.MeshDegraded` so an
     :class:`~.elastic.ElasticTrainingHandler` can shrink the mesh and
     resume; with elastic off it degrades to the eager fallback like any
-    fatal fast-path failure (PR-2 semantics, bitwise preserved).
+    fatal fast-path failure (PR-2 semantics, bitwise preserved). For
+    composed dp×tp(×pp) meshes the rule may instead (or additionally)
+    carry a ``"device"`` field addressing the dead chip by mesh
+    coordinate — either a flat device index (int) or
+    ``{"axis": ..., "index": ...}`` naming a slice of a named axis — and
+    :class:`ChipLostError` forwards it as ``.device`` so the elastic
+    layer can drop the whole dp-group that contained the chip
+    (:func:`~..parallel.mesh.rebuild_mesh`). Replica-int plans are
+    unchanged: ``"replica"`` still targets a device-group index and
+    ``.replica`` keeps its meaning.
 ``replica_delay``
     does not raise: sleeps ``seconds`` *only when the call site's current
     replica matches the rule's* (sites pass ``info={"replica": i}``;
@@ -145,6 +154,11 @@ KNOWN_SITES = (
                             # Router marks the replica dead and fails the
                             # request over to a survivor); 'transient'/
                             # 'fatal' model flaky dispatch RPCs
+    "trainer:sharded_step", # parallel.functional.ShardedTrainer.step,
+                            # before the compiled SPMD step dispatches —
+                            # a coordinate-addressed 'chip_loss' here is
+                            # the composed-mesh (dp×tp) kill the elastic
+                            # rebuild-and-reshard path recovers from
 )
 
 
@@ -159,11 +173,18 @@ class InjectedFaultError(MXNetError):
 class ChipLostError(MXNetError):
     """Injected dead-chip analog: the device group ``replica`` dropped off
     the mesh mid-collective. Never retried (the chip is gone, not busy);
-    ``dist_tpu`` classifies it as mesh loss when ``MXNET_ELASTIC=1``."""
+    ``dist_tpu`` classifies it as mesh loss when ``MXNET_ELASTIC=1``.
 
-    def __init__(self, msg, replica=0):
+    ``device`` optionally addresses the dead chip by mesh coordinate — a
+    flat device index (int) or ``{"axis": ..., "index": ...}`` — for
+    composed dp×tp(×pp) meshes where a replica index alone cannot name
+    the loss; :func:`~..parallel.mesh.rebuild_mesh` consumes either
+    form."""
+
+    def __init__(self, msg, replica=0, device=None):
         super().__init__(msg)
         self.replica = int(replica)
+        self.device = device
 
 
 class SimulatedWorkerDeath(BaseException):
@@ -210,6 +231,23 @@ class FaultPlan:
                 raise MXNetError(
                     f"fault rule {i} ({site}): exactly one trigger of "
                     f"'at'/'times'/'prob' required, got {triggers or r}")
+            device = r.get("device")
+            if device is not None:
+                if kind != "chip_loss":
+                    raise MXNetError(
+                        f"fault rule {i} ({site}): 'device' is only valid "
+                        f"on chip_loss rules, not {kind!r}")
+                if isinstance(device, dict):
+                    if not isinstance(device.get("axis"), str) \
+                            or "index" not in device:
+                        raise MXNetError(
+                            f"fault rule {i} ({site}): coordinate device "
+                            "must be {'axis': <name>, 'index': <int>}, "
+                            f"got {device!r}")
+                    device = {"axis": device["axis"],
+                              "index": int(device["index"])}
+                else:
+                    device = int(device)
             self._rules.append({
                 "site": site,
                 "kind": kind,
@@ -218,6 +256,7 @@ class FaultPlan:
                 "prob": float(r["prob"]) if "prob" in r else None,
                 "seconds": float(r.get("seconds", 0.05)),
                 "replica": int(r["replica"]) if "replica" in r else None,
+                "device": device,
                 "message": r.get("message"),
                 # per-rule RNG: independent deterministic streams, immune
                 # to other rules' draw counts
@@ -301,12 +340,15 @@ class FaultPlan:
             return {"kind": "param_corrupt",
                     "replica": action["replica"] or 0}
         if kind == "chip_loss":
+            where = (f"device {action['device']}"
+                     if action["device"] is not None
+                     else f"device group {action['replica'] or 0}")
             raise ChipLostError(
                 action["message"] or
-                f"injected chip loss at {site}: device group "
-                f"{action['replica'] or 0} dropped off the mesh "
-                f"(plan seed {self.seed})",
-                replica=action["replica"] or 0)
+                f"injected chip loss at {site}: {where} dropped off the "
+                f"mesh (plan seed {self.seed})",
+                replica=action["replica"] or 0,
+                device=action["device"])
         if kind == "transient":
             raise TransientFaultError(msg)
         if kind == "die":

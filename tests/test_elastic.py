@@ -50,7 +50,9 @@ def _clean_elastic_state():
                        "MXNET_DESYNC_CHECK_STEPS",
                        "MXNET_DESYNC_MAX_RESYNCS",
                        "MXNET_STRAGGLER_THRESHOLD_MS",
-                       "MXNET_COLLECTIVE_TIMEOUT")}
+                       "MXNET_COLLECTIVE_TIMEOUT",
+                       "MXNET_ELASTIC_REBUILD",
+                       "MXNET_ELASTIC_MIN_DP_GROUPS")}
     yield
     faults.clear_plan()
     _prof.reset()
@@ -862,3 +864,282 @@ def test_elastic_knobs_registered_and_default_off():
     assert config.get("MXNET_ELASTIC_MAX_RESTARTS") == 2
     assert config.get("MXNET_ELASTIC_MIN_REPLICAS") == 1
     assert config.get("MXNET_DESYNC_MAX_RESYNCS") == 2
+
+
+# ---------------------------------------------------------------------------
+# composed-mesh elasticity (dp×tp): rebuild_mesh policy, coordinate
+# faults, layout-carrying sharded checkpoints, the dp2×tp2 kill pin
+# ---------------------------------------------------------------------------
+
+
+def _mesh_2x2():
+    return mesh_mod.make_mesh({"dp": 2, "tp": 2})
+
+
+def test_rebuild_mesh_drops_touched_group_flat_and_coord():
+    """One lost chip — addressed by flat mesh index OR by dp-coordinate —
+    drops its whole dp-group; the tp extent is pinned and the survivor
+    group keeps its devices."""
+    m = _mesh_2x2()
+    for lost in ([1], [{"axis": "dp", "index": 0}]):  # both chips of g0
+        nm, gmap = mesh_mod.rebuild_mesh(m, lost)
+        assert dict(zip(nm.axis_names, nm.devices.shape)) == \
+            {"dp": 1, "tp": 2}
+        assert gmap == {1: 0}
+        assert list(nm.devices[0]) == list(m.devices[1])
+
+
+def test_rebuild_mesh_multi_loss_and_power_of_two():
+    """dp4×tp2: one lost chip → dp2 survivors renumbered contiguously;
+    two chips in distinct groups → 2 survivors (power of two, kept);
+    with 3 survivors the composite mesh truncates to 2."""
+    m = mesh_mod.make_mesh({"dp": 4, "tp": 2})
+    nm, gmap = mesh_mod.rebuild_mesh(m, [{"axis": "dp", "index": 2}])
+    assert nm.devices.shape[0] == 2  # 3 survivors -> pow2 truncation
+    assert gmap == {0: 0, 1: 1}
+    nm, gmap = mesh_mod.rebuild_mesh(m, [0, 7])  # groups 0 and 3
+    assert nm.devices.shape[0] == 2
+    assert gmap == {1: 0, 2: 1}
+    with pytest.raises(MeshDegraded):
+        mesh_mod.rebuild_mesh(m, [{"axis": "dp", "index": 3}],
+                              power_of_two=False)
+
+
+def test_rebuild_mesh_single_axis_any_size_exception():
+    """The pure-dp any-survivor-count exception survives the rebuild
+    path: dp8 minus one group may resume at dp7 with
+    power_of_two=False, exactly like shrink_mesh."""
+    m = mesh_mod.make_mesh({"dp": 8})
+    nm, gmap = mesh_mod.rebuild_mesh(m, [3], power_of_two=False)
+    assert nm.devices.shape[0] == 7
+    assert gmap[4] == 3  # renumbered past the hole
+    nm, _ = mesh_mod.rebuild_mesh(m, [3])  # default truncates to pow2
+    assert nm.devices.shape[0] == 4
+
+
+def test_rebuild_mesh_no_survivors_raises_populated():
+    m = _mesh_2x2()
+    with pytest.raises(MeshDegraded) as ei:
+        mesh_mod.rebuild_mesh(m, [0, 2])  # one chip in each group
+    assert ei.value.lost_replicas == [0, 1]
+    assert ei.value.mesh_size == 4
+
+
+def test_rebuild_mesh_ep_sp_pinned_unsupported():
+    """MeshDegraded-on-purpose pins: MoE ('ep') and ring-attention
+    ('sp') compositions cannot survive a dp-group drop — the loss
+    raises loudly with mesh_size/lost_replicas populated instead of
+    silently misplacing expert / sequence shards."""
+    for extra in ("ep", "sp"):
+        m = mesh_mod.make_mesh({"dp": 2, extra: 2})
+        with pytest.raises(MeshDegraded) as ei:
+            mesh_mod.rebuild_mesh(m, [{"axis": "dp", "index": 0}])
+        assert extra in str(ei.value)
+        assert ei.value.mesh_size == 4
+        assert ei.value.lost_replicas == [0]
+
+
+def test_shrink_mesh_error_paths_populate_degraded_fields():
+    """Bugfix pin: shrink_mesh's MeshDegraded paths (model-parallel
+    axis, composite non-power-of-two) carry mesh_size and
+    lost_replicas, like every other mesh-loss raise."""
+    m = _mesh_2x2()
+    with pytest.raises(MeshDegraded) as ei:
+        mesh_mod.shrink_mesh(m, 0, axis="tp")
+    assert ei.value.mesh_size == 4
+    assert ei.value.lost_replicas == [0]
+    m3 = mesh_mod.make_mesh({"dp": 4, "tp": 2})
+    with pytest.raises(MeshDegraded) as ei:
+        mesh_mod.shrink_mesh(m3, 1, power_of_two=False)
+    assert ei.value.mesh_size == 8
+    assert ei.value.lost_replicas == [1]
+
+
+def test_chip_loss_device_coordinate_forms():
+    """Satellite: chip_loss rules address the victim by mesh coordinate
+    or flat device index; the error carries .device for the handler's
+    coordinate-aware classification."""
+    for dev in ({"axis": "dp", "index": 1}, 3):
+        faults.install_plan({"seed": 0, "rules": [
+            {"site": "kvstore:allreduce", "kind": "chip_loss",
+             "device": dev, "at": [0]}]})
+        with pytest.raises(ChipLostError) as ei:
+            faults.fault_point("kvstore:allreduce")
+        assert ei.value.device == dev
+        faults.clear_plan()
+
+
+def test_chip_loss_replica_plans_unchanged():
+    """Replica-int plans are byte-for-byte the old behaviour: .replica
+    set, .device unset."""
+    faults.install_plan({"seed": 0, "rules": [
+        {"site": "kvstore:allreduce", "kind": "chip_loss",
+         "replica": 5, "at": [0]}]})
+    with pytest.raises(ChipLostError) as ei:
+        faults.fault_point("kvstore:allreduce")
+    assert ei.value.replica == 5
+    assert getattr(ei.value, "device", None) is None
+
+
+def test_chip_loss_device_validation():
+    for dev in ({"axis": "dp"}, {"index": 0}, "g0", 1.5):
+        with pytest.raises(MXNetError):
+            faults.install_plan({"seed": 0, "rules": [
+                {"site": "kvstore:allreduce", "kind": "chip_loss",
+                 "device": dev}]})
+
+
+def _tiny_3d_trainer(dp=2, tp=2, seed=0, mesh=None):
+    from tools.elastic_soak import _make_3d_trainer
+
+    return _make_3d_trainer(seed, dp=dp, tp=tp, mesh=mesh)
+
+
+@pytest.mark.integration
+def test_sharded_checkpoint_layouts_cross_mesh_roundtrip(tmp_path):
+    """A dp2×tp2 trainer's sharded checkpoint carries the saving layout
+    (tp-split weight) and restores exactly onto a dp1×tp2 mesh; the
+    reshard counter splits by axis."""
+    net, tr = _tiny_3d_trainer(dp=2, tp=2, seed=11)
+    x = onp.random.RandomState(0).randn(8, 4).astype("float32")
+    y = onp.random.RandomState(1).randn(8, 2).astype("float32")
+    tr.step(mx.nd.array(x), mx.nd.array(y))
+    assert tr.checkpoint_layouts()  # the tp-split weight is recorded
+    eh = ElasticTrainingHandler(str(tmp_path))
+    eh.save_sharded_trainer(tr, 0)
+    want = tr.export_state()["params"]
+
+    net2, tr2 = _tiny_3d_trainer(dp=1, tp=2, seed=99)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        params, meta = ckpt.load_checkpoint(
+            eh.manager._path(0), trainer=tr2,
+            mesh_axes={"dp": 1, "tp": 2})
+    tr2.import_params(params)
+    got = tr2.export_state()["params"]
+    assert set(got) == set(want)
+    for k in want:
+        assert onp.array_equal(got[k], want[k]), k
+    assert counters.get("resilience.reshard_resumes[dp]") == 1
+
+
+@pytest.mark.integration
+def test_sharded_layout_missing_slice_fails_loudly(tmp_path):
+    """An unreconstructable tp-extent change (a layout slice missing
+    from every shard) raises CheckpointCorruptError, never a silently
+    misassembled tensor."""
+    import json as _json
+
+    net, tr = _tiny_3d_trainer(dp=2, tp=2, seed=11)
+    eh = ElasticTrainingHandler(str(tmp_path))
+    eh.save_sharded_trainer(tr, 0)
+    # rewrite the manifest to declare a tp4 layout the tp2 shard set
+    # cannot express (slices ::02/::03 do not exist anywhere)
+    mpath = eh.manager._path(0)
+    sections, meta = ckpt._unpack(open(mpath, "rb").read(), path=mpath)
+    manifest = _json.loads(sections["manifest"])
+    assert manifest["layouts"]  # the tp-split weight is recorded
+    for lay in manifest["layouts"].values():
+        lay["parts"] *= 2
+    secs = [("manifest", _json.dumps(manifest).encode())]
+    if "trainer" in sections:
+        secs.append(("trainer", sections["trainer"]))
+    ckpt._atomic_write(mpath, ckpt._pack(secs, meta))
+    net2, tr2 = _tiny_3d_trainer(dp=1, tp=2, seed=99)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(ckpt.CheckpointCorruptError,
+                           match="cannot be reconstructed"):
+            ckpt.load_checkpoint(mpath, trainer=tr2,
+                                 mesh_axes={"dp": 1, "tp": 2})
+
+
+def test_reassemble_layouts_missing_slice_unit():
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    params = {"weight::00": NDArray(onp.zeros((2, 2), "float32"))}
+    manifest = {"layouts": {"weight": {"axis": "tp", "dim": 1,
+                                       "parts": 2}}}
+    with pytest.raises(ckpt.CheckpointCorruptError,
+                       match="weight::01"):
+        ckpt._reassemble_layouts("<p>", params, manifest)
+
+
+@pytest.mark.integration
+def test_kill_one_chip_dp2_tp2_recovers_without_degrade():
+    """THE composed-mesh acceptance pin: a dp2×tp2 run killed by a
+    coordinate-addressed chip_loss recovers WITHOUT MeshDegraded —
+    rebuilds to dp1×tp2 (tp pinned), reshards from its own sharded
+    checkpoint, and lands bitwise on a clean dp1×tp2 run from the same
+    checkpoint. One step lost, dp_history records (2, 1)."""
+    from tools.elastic_soak import run_kill_reshard_3d
+
+    violations, row = run_kill_reshard_3d(seed=7, n_batches=10)
+    assert violations == []
+    assert row["resume_parity"] == "bitwise"
+    assert row["steps_lost"] == 1
+    assert row["dp_from"] == 2 and row["dp_to"] == 1 and row["tp"] == 2
+    assert counters.get("resilience.elastic_restarts") == 1
+
+
+@pytest.mark.integration
+def test_rebuild_disabled_reraises_mesh_loss(tmp_path):
+    """MXNET_ELASTIC_REBUILD=0 pins the pre-rebuild degrade path on
+    composed meshes: recover_sharded declines and the loss re-raises."""
+    os.environ["MXNET_ELASTIC_REBUILD"] = "0"
+    net, tr = _tiny_3d_trainer(dp=2, tp=2, seed=5)
+    eh = ElasticTrainingHandler(str(tmp_path))
+    eh.save_sharded_trainer(tr, 0)
+    exc = ChipLostError("chip down", device={"axis": "dp", "index": 0})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert eh.recover_sharded(tr, exc, lambda m: None) is None
+    assert eh.stats["restarts"] == 0
+
+
+@pytest.mark.integration
+def test_min_dp_groups_floor_declines_rebuild(tmp_path):
+    """A loss that would leave fewer dp-groups than
+    MXNET_ELASTIC_MIN_DP_GROUPS declines the rebuild (the caller's
+    mesh loss re-raises)."""
+    os.environ["MXNET_ELASTIC_MIN_DP_GROUPS"] = "2"
+    net, tr = _tiny_3d_trainer(dp=2, tp=2, seed=5)
+    eh = ElasticTrainingHandler(str(tmp_path))
+    eh.save_sharded_trainer(tr, 0)
+    exc = ChipLostError("chip down", device={"axis": "dp", "index": 1})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert eh.recover_sharded(tr, exc, lambda m: None) is None
+    assert eh.stats["restarts"] == 0
+    assert eh.stats["dp_history"] == []
+
+
+def test_parallel_config_validates_and_shapes():
+    from mxnet_tpu.parallel import ParallelConfig
+
+    assert ParallelConfig(dp=2, tp=2).mesh_shape() == {"dp": 2, "tp": 2}
+    assert ParallelConfig(dp=4).mesh_shape() == {"dp": 4}
+    assert ParallelConfig(dp=1, tp=1, pp=2).mesh_shape() == \
+        {"dp": 1, "pp": 2}
+    with pytest.raises(MXNetError):
+        ParallelConfig(dp=0)
+    with pytest.raises(MXNetError):
+        ParallelConfig(dp=1, tp=-1)
+
+
+def test_run_tier1_carries_elastic3d_leg():
+    """Satellite: the tier-1 gate grows the opt-in TIER1_ELASTIC3D
+    composed-mesh leg (with its MXNET_LOCKDEP re-run)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "run_tier1.sh")
+    src = open(path).read()
+    assert "TIER1_ELASTIC3D" in src
+    assert "--legs 3d" in src
+    assert src.count("--legs 3d") >= 2  # plain + MXNET_LOCKDEP re-run
+
+
+def test_composed_elastic_knobs_registered_defaults():
+    from mxnet_tpu import config
+
+    assert config.get("MXNET_ELASTIC_REBUILD") is True
+    assert config.get("MXNET_ELASTIC_MIN_DP_GROUPS") == 1

@@ -212,6 +212,211 @@ def _run_kill_reshard_inner(seed, n_batches, say):
     return violations, row
 
 
+BATCH3D = 8
+DIM3D = 4
+
+
+def _make_3d_trainer(seed, dp, tp=2, mesh=None):
+    """Dense(2) ShardedTrainer over a declarative dp×tp ParallelConfig:
+    weight tensor-split P(None, 'tp'), bias in a dp-sharded ZeRO bucket,
+    sgd+momentum — the smallest model exercising every reshard case
+    (tp layout slice, bucket flat, replicated scalar state)."""
+    from jax.sharding import PartitionSpec as P
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import (ParallelConfig, ShardedTrainer,
+                                    ShardingRules)
+
+    net = gluon.nn.Dense(2, in_units=DIM3D)
+    net.initialize()
+    pd = net.collect_params()
+    names = list(pd)
+    rng = np.random.RandomState(seed)
+    pd[names[0]].set_data(
+        mx.nd.array(rng.randn(2, DIM3D).astype("float32")))
+    pd[names[1]].set_data(mx.nd.array(np.zeros(2, "float32")))
+
+    def loss_fn(out, label):
+        d = out - label
+        return d * d
+
+    tr = ShardedTrainer(net, loss_fn, "sgd",
+                        {"learning_rate": 0.05, "momentum": 0.9},
+                        mesh=mesh,
+                        parallel=ParallelConfig(dp=dp, tp=tp),
+                        rules=ShardingRules([(r"weight", P(None, "tp"))],
+                                            default_axis="dp"),
+                        zero_bucket_mb=1.0)
+    return net, tr
+
+
+def run_kill_reshard_3d(seed=7, n_batches=10, say=lambda m: None):
+    """Kill-one-chip under a COMPOSED dp2×tp2 mesh (importable —
+    bench.py's ``elastic_resume_3d`` MULTICHIP row): a coordinate
+    -addressed ``chip_loss`` at ``trainer:sharded_step`` takes down one
+    chip; ``ElasticTrainingHandler.recover_sharded`` rebuilds the mesh
+    to dp1×tp2 (tp pinned, the touched dp-group dropped) and reshards
+    the newest layout-carrying sharded checkpoint onto the survivors.
+    Asserted: recovery WITHOUT MeshDegraded escaping, exactly one
+    restart / one step lost, and the resumed run bitwise-equal (losses
+    and final params) to a clean dp1×tp2 run continued from the same
+    checkpoint. Returns ``(violations, row)``."""
+    prev = os.environ.get("MXNET_ELASTIC")
+    os.environ["MXNET_ELASTIC"] = "1"
+    try:
+        return _run_kill_reshard_3d_inner(seed, n_batches, say)
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_ELASTIC", None)
+        else:
+            os.environ["MXNET_ELASTIC"] = prev
+
+
+def _run_kill_reshard_3d_inner(seed, n_batches, say):
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import mesh as mesh_mod
+    from mxnet_tpu.resilience import checkpoint as ckpt, faults
+    from mxnet_tpu.resilience.elastic import (ElasticTrainingHandler,
+                                              MeshDegraded, is_mesh_loss)
+
+    DP3, TP3 = 2, 2
+    violations = []
+    rng = np.random.RandomState(seed * 131 + 4)
+    kill_group = int(rng.randint(0, DP3))
+    kill_tp = int(rng.randint(0, TP3))
+    kill_step = int(rng.randint(2, n_batches - 2))
+    # both coordinate forms rebuild_mesh accepts, seeded: an axis-index
+    # dict naming the dp-group, or a flat index into the mesh array
+    # (row-major dp×tp, so group*TP+j) naming one specific chip
+    if rng.randint(0, 2):
+        device = {"axis": "dp", "index": kill_group}
+    else:
+        device = kill_group * TP3 + kill_tp
+    say(f"3d kill leg: chip_loss device {device} during batch "
+        f"{kill_step} on dp{DP3}x tp{TP3} (seed {seed})")
+
+    bx = np.random.RandomState(seed).randn(
+        n_batches, BATCH3D, DIM3D).astype("float32")
+    by = np.random.RandomState(seed + 1).randn(
+        n_batches, BATCH3D, 2).astype("float32")
+    prev_mesh = mesh_mod.get_mesh()
+    d = tempfile.mkdtemp(prefix="elastic_soak3d_")
+    eh = ElasticTrainingHandler(d, max_keep=n_batches + 2)
+    net, tr = _make_3d_trainer(seed, dp=DP3, tp=TP3)
+    faults.install_plan({"seed": seed, "rules": [
+        {"site": "trainer:sharded_step", "kind": "chip_loss",
+         "device": device, "at": [kill_step]}]})
+    t0 = time.perf_counter()
+    losses = []
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            i = 0
+            while i < n_batches:
+                try:
+                    losses.append(float(
+                        tr.step(mx.nd.array(bx[i]),
+                                mx.nd.array(by[i])).asnumpy()))
+                except Exception as exc:  # noqa: BLE001 — recovery path
+                    if isinstance(exc, MeshDegraded):
+                        violations.append(
+                            "3d kill: MeshDegraded escaped the rebuild "
+                            f"path: {exc}")
+                        return violations, {}
+                    if not is_mesh_loss(exc):
+                        raise
+
+                    def make_trainer(new_mesh, _s=seed + 500):
+                        _net, _tr = _make_3d_trainer(
+                            _s, dp=int(new_mesh.shape["dp"]), tp=TP3,
+                            mesh=new_mesh)
+                        return _tr
+
+                    rec = eh.recover_sharded(tr, exc, make_trainer)
+                    if rec is None:
+                        raise
+                    tr, restored = rec
+                    i = restored + 1
+                    continue
+                eh.save_sharded_trainer(tr, i)
+                i += 1
+    except Exception as exc:  # noqa: BLE001 — taxonomy violation
+        violations.append(
+            f"3d kill: training raised {type(exc).__name__}: {exc}")
+        return violations, {}
+    finally:
+        faults.clear_plan()
+        mesh_mod.set_mesh(prev_mesh)
+    wall = time.perf_counter() - t0
+
+    if eh.stats["restarts"] != 1:
+        violations.append(f"3d kill: expected 1 restart, got {eh.stats}")
+        return violations, {}
+    if eh.stats["dp_history"] != [(DP3, 1)]:
+        violations.append(
+            f"3d kill: expected dp{DP3}->dp1 (tp pinned), got "
+            f"{eh.stats['dp_history']}")
+    if eh.stats["steps_lost"] != 1:
+        violations.append(
+            f"3d kill: expected 1 step lost, got "
+            f"{eh.stats['steps_lost']}")
+    if int(tr.mesh.shape.get("tp", 0)) != TP3:
+        violations.append(
+            f"3d kill: tp extent changed: {dict(tr.mesh.shape)}")
+
+    # bitwise reference: a CLEAN dp1×tp2 trainer continued from the SAME
+    # sharded checkpoint over the same remaining batches — the resumed
+    # elastic run and this run execute the identical compiled program
+    # from identical state, so any difference is silent divergence
+    try:
+        net_r, tr_r = _make_3d_trainer(seed + 999, dp=1, tp=TP3)
+        ref_losses = []
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            params, _meta = ckpt.load_checkpoint(
+                eh.manager._path(kill_step - 1), trainer=tr_r,
+                mesh_axes={"dp": 1, "tp": TP3})
+            tr_r.import_params(params)
+            for i in range(kill_step, n_batches):
+                ref_losses.append(float(
+                    tr_r.step(mx.nd.array(bx[i]),
+                              mx.nd.array(by[i])).asnumpy()))
+    except Exception as exc:  # noqa: BLE001
+        violations.append(
+            f"3d kill: dp1x tp2 reference raised "
+            f"{type(exc).__name__}: {exc}")
+        return violations, {}
+    finally:
+        mesh_mod.set_mesh(prev_mesh)
+    parity = True
+    if losses[kill_step:] != ref_losses:
+        parity = False
+        violations.append(
+            f"3d kill: resumed losses {losses[kill_step:]} differ from "
+            f"the clean dp1x tp2 reference {ref_losses}")
+    p_elastic = tr.export_state()["params"]
+    p_ref = tr_r.export_state()["params"]
+    for k in p_elastic:
+        if not np.array_equal(p_elastic[k], p_ref[k]):
+            parity = False
+            violations.append(
+                f"3d kill: param {k} differs from the clean dp1x tp2 "
+                "reference (silent divergence)")
+    row = {"steps_lost": eh.stats["steps_lost"],
+           "recovery_wall_s": eh.stats["last_recovery_s"],
+           "dp_from": DP3, "dp_to": 1, "tp": TP3,
+           "killed_device": str(device), "killed_step": kill_step,
+           "resume_parity": "bitwise" if parity else "DIVERGED",
+           "leg_wall_s": wall}
+    say(f"3d kill leg: steps_lost={row['steps_lost']} "
+        f"recovery={(row['recovery_wall_s'] or 0) * 1e3:.0f}ms "
+        f"dp{DP3}->dp1 tp{TP3} pinned parity={row['resume_parity']}")
+    return violations, row
+
+
 def _run_lag_leg(seed, n_batches, say):
     from mxnet_tpu.parallel import mesh as mesh_mod
     from mxnet_tpu.resilience import faults
@@ -325,15 +530,25 @@ def _run_corrupt_leg(seed, n_batches, say):
                         "cadence": cadence}
 
 
-def run_soak(seed=7, n_batches=12, verbose=True):
-    """One full seeded kill/lag/corrupt sweep; returns a report dict with
-    ``ok``/``violations`` plus the per-leg numbers. Importable —
-    ``tests/test_elastic.py`` runs the same machinery."""
+def run_soak(seed=7, n_batches=12, verbose=True, legs="all"):
+    """One full seeded kill/lag/corrupt/kill-3d sweep; returns a report
+    dict with ``ok``/``violations`` plus the per-leg numbers.
+    Importable — ``tests/test_elastic.py`` runs the same machinery.
+    ``legs="3d"`` runs only the composed-mesh kill leg (the opt-in
+    ``TIER1_ELASTIC3D`` tier-1 gate)."""
     import mxnet_tpu as mx  # noqa: F401
 
     def say(msg):
         if verbose:
             print(f"ELASTIC_SOAK {msg}", flush=True)
+
+    if legs == "3d":
+        violations, kill3d_row = run_kill_reshard_3d(seed, n_batches, say)
+        report = {"ok": not violations, "violations": violations,
+                  "seed": seed, "kill_3d": kill3d_row}
+        say(f"seed {seed}: {'PASS' if report['ok'] else 'FAIL'} "
+            f"kill_3d={kill3d_row}")
+        return report
 
     prev = os.environ.get("MXNET_ELASTIC")
     os.environ["MXNET_ELASTIC"] = "1"
@@ -346,12 +561,13 @@ def run_soak(seed=7, n_batches=12, verbose=True):
             os.environ.pop("MXNET_ELASTIC", None)
         else:
             os.environ["MXNET_ELASTIC"] = prev
-    violations += v2 + v3
+    v4, kill3d_row = run_kill_reshard_3d(seed, n_batches, say)
+    violations += v2 + v3 + v4
     report = {"ok": not violations, "violations": violations,
               "seed": seed, "kill": kill_row, "lag": lag_row,
-              "corrupt": corrupt_row}
+              "corrupt": corrupt_row, "kill_3d": kill3d_row}
     say(f"seed {seed}: {'PASS' if report['ok'] else 'FAIL'} "
-        f"kill={kill_row} corrupt={corrupt_row}")
+        f"kill={kill_row} corrupt={corrupt_row} kill_3d={kill3d_row}")
     return report
 
 
@@ -362,15 +578,18 @@ def main(argv=None):
                     help="sweep seed..seed+N-1 (tier-1 smoke: 1; "
                          "full sweep: 8)")
     ap.add_argument("--batches", type=int, default=12)
+    ap.add_argument("--legs", choices=("all", "3d"), default="all",
+                    help="'3d' runs only the composed dp2xtp2 "
+                         "kill-one-chip leg (TIER1_ELASTIC3D gate)")
     args = ap.parse_args(argv)
 
     failures = []
     for s in range(args.seed, args.seed + args.seeds):
-        report = run_soak(seed=s, n_batches=args.batches)
+        report = run_soak(seed=s, n_batches=args.batches, legs=args.legs)
         if not report["ok"]:
             failures.append((s, report["violations"]))
         else:
-            k = report["kill"]
+            k = report.get("kill") or report["kill_3d"]
             print(f"ELASTIC_SOAK=PASS seed={s} "
                   f"steps_lost={k.get('steps_lost')} "
                   f"recovery_ms={(k.get('recovery_wall_s') or 0) * 1e3:.0f} "

@@ -46,6 +46,10 @@ _DECODER = json.JSONDecoder()
 _LOWER_BETTER_UNITS = ("ms", "us", "ns", "s", "s/iter", "ms/token",
                        "ms/step")
 
+# metric-name fallback for rows whose unit went missing in an old
+# emission: elastic recovery time (elastic_resume/_3d) is lower-better
+_LOWER_BETTER_METRIC_SUFFIXES = ("recovery_ms",)
+
 
 def extract_rows(text):
     """Every intact ``{"metric": ...}`` JSON object in ``text``.
@@ -132,7 +136,9 @@ def load_fresh(path):
     return rows
 
 
-def _higher_is_better(unit):
+def _higher_is_better(unit, metric=None):
+    if metric and str(metric).endswith(_LOWER_BETTER_METRIC_SUFFIXES):
+        return False
     u = (unit or "").strip().lower()
     return u not in _LOWER_BETTER_UNITS
 
@@ -184,7 +190,8 @@ def compare(history, fresh_rows, tol=0.10, weather_factor=3.0):
             continue  # new metric: nothing to regress against
         label, base = got
         checked += 1
-        higher = _higher_is_better(row.get("unit") or base.get("unit"))
+        higher = _higher_is_better(row.get("unit") or base.get("unit"),
+                                   metric=row["metric"])
         # weather widening applies when EITHER side is noise-dominated;
         # _band handles the reference's own flag
         eff_tol = tol * (weather_factor

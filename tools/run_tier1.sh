@@ -269,4 +269,28 @@ if [[ "${TIER1_ELASTIC:-1}" != "0" ]]; then
         rc=$elastic_rc
     fi
 fi
+# Composed-mesh elastic smoke (TIER1_ELASTIC3D=1 to enable): the
+# kill-one-chip dp2xtp2 leg alone — a coordinate-addressed chip_loss
+# rebuilds the mesh to dp1xtp2 (tp extent pinned, touched dp-group
+# dropped) and reshards the layout-carrying sharded checkpoint onto the
+# survivors; asserts no MeshDegraded escapes and the resumed run lands
+# bitwise on a clean dp1xtp2 run from the same checkpoint. Re-run under
+# MXNET_LOCKDEP=1: recovery walks checkpoint-manager and mesh-registry
+# locks from the failure path and must stay cycle-free.
+if [[ "${TIER1_ELASTIC3D:-0}" != "0" ]]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python tools/elastic_soak.py --legs 3d \
+        --seeds "${TIER1_ELASTIC_SEEDS:-1}"
+    e3d_rc=$?
+    if [[ "$rc" -eq 0 && "$e3d_rc" -ne 0 ]]; then
+        rc=$e3d_rc
+    fi
+    timeout -k 10 180 env JAX_PLATFORMS=cpu MXNET_LOCKDEP=1 \
+        python tools/elastic_soak.py --legs 3d \
+        --seeds "${TIER1_ELASTIC_SEEDS:-1}"
+    e3d_rc=$?
+    if [[ "$rc" -eq 0 && "$e3d_rc" -ne 0 ]]; then
+        rc=$e3d_rc
+    fi
+fi
 exit "$rc"
