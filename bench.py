@@ -1148,6 +1148,67 @@ def bench_guardrail_overhead():
     })
 
 
+def bench_ckpt_stall():
+    """Async-checkpoint stall row (resilience.checkpoint): the training
+    stall of an ``async_write=True`` save — the synchronous host-snapshot
+    phase — vs the full synchronous save wall time, over a llama-8B-class
+    parameter census (same tensor count/shape mix: embedding, per-layer
+    qkv/out/mlp/norm) scaled to a dev box (~220 MB fp32). Reports the
+    async stall in ms (lower is better; the perf gate treats ``ms`` rows
+    as lower-better automatically) and fails loudly if the stall exceeds
+    10% of the sync save — the acceptance bound async checkpointing
+    exists to hold."""
+    import os
+    import tempfile
+
+    import numpy as onp
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.resilience import checkpoint as ckpt
+
+    rng = onp.random.RandomState(0)
+    H, V, L = 512, 8192, 16
+    params = {"embed.weight": nd.array(rng.randn(V, H).astype("float32"))}
+    for i in range(L):
+        for nme, shape in (("attn_qkv", (3 * H, H)), ("attn_out", (H, H)),
+                           ("mlp_up", (4 * H, H)), ("mlp_down", (H, 4 * H)),
+                           ("norm", (H,))):
+            params[f"layers.{i}.{nme}.weight"] = nd.array(
+                rng.randn(*shape).astype("float32"))
+    nbytes = sum(int(onp.prod(s)) for s in
+                 [v.shape for v in params.values()]) * 4
+
+    d = tempfile.mkdtemp(prefix="bench_ckpt_stall_")
+    sync_ms, stall_ms = [], []
+    for r in range(3):
+        t0 = time.perf_counter()
+        ckpt.save_checkpoint(os.path.join(d, f"sync{r}.ckpt"),
+                             params=params, meta={"step": r})
+        sync_ms.append((time.perf_counter() - t0) * 1e3)
+        h = ckpt.save_checkpoint(os.path.join(d, f"async{r}.ckpt"),
+                                 params=params, meta={"step": r},
+                                 async_write=True)
+        if not h.join():
+            raise RuntimeError(f"async checkpoint write failed: {h.error}")
+        stall_ms.append(h.stall_ms)
+    sync = sorted(sync_ms)[1]
+    stall = sorted(stall_ms)[1]
+    frac = stall / sync
+    if frac > 0.10:
+        raise RuntimeError(
+            f"async save stall {stall:.1f}ms is {frac:.1%} of the "
+            f"{sync:.0f}ms sync save — the <10% stall bound regressed")
+    return _emit({
+        "metric": "ckpt_stall_ms",
+        "value": round(stall, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "sync_save_ms": round(sync, 1),
+        "stall_frac": round(frac, 4),
+        "params_mb": round(nbytes / 1e6, 1),
+    })
+
+
 def bench_elastic_resume():
     """MULTICHIP elastic row (resilience.elastic): a dp8 training run on
     the 8-device mesh killed mid-step by an injected chip_loss, resumed
@@ -1682,6 +1743,7 @@ def main():
                      ("infer_pallas_fused", bench_resnet_infer_pallas_fused),
                      ("bandwidth", bench_bandwidth),
                      ("guardrail_overhead", bench_guardrail_overhead),
+                     ("ckpt_stall", bench_ckpt_stall),
                      ("elastic_resume", bench_elastic_resume),
                      ("elastic_resume_3d", bench_elastic_resume_3d),
                      ("collective_overlap", bench_collective_overlap),

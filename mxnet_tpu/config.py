@@ -439,6 +439,29 @@ register_flag(
     "rate-limited warning). 0 (default): tracking-only, never flags.",
     float)
 register_flag(
+    "MXNET_CKPT_ASYNC", False,
+    "Async checkpointing (resilience.checkpoint): CheckpointManager.save "
+    "stalls only for the synchronous host snapshot of params/trainer/"
+    "data state, then packs, CRCs and atomically writes on a background "
+    "thread; the generation is advertised only after its commit lands, "
+    "and every manager read fences on the in-flight write. Off "
+    "(default): the whole save happens in the caller (PR-4 semantics).",
+    _bool)
+register_flag(
+    "MXNET_CKPT_STALL_BUDGET_MS", 0.0,
+    "Budget (ms) for an async save's synchronous stall (the host "
+    "snapshot). Exceeding it counts resilience.ckpt_stall_overruns and "
+    "warns, rate-limited — the stall is the part the step loop actually "
+    "feels, so overruns mean the snapshot itself got too slow. 0 "
+    "(default): unbudgeted.", float)
+register_flag(
+    "MXNET_PREEMPT_GRACE_S", 30.0,
+    "Grace window (seconds) a preempted process has to drain "
+    "(resilience.preemption): the serving-side drain (fleet Routers, "
+    "registered batchers) is bounded by it; training uses it as the "
+    "budget between the SIGTERM and the force-saved checkpoint's "
+    "commit.", float)
+register_flag(
     "MXNET_LOSS_SCALE_MIN", 1.0,
     "Lower clamp for the dynamic LossScaler (amp.py): repeated overflows "
     "can never drive the scale to 0.", float)

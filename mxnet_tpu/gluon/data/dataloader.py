@@ -107,6 +107,9 @@ class DataLoader:
                 "batch_size/shuffle/sampler/last_batch are mutually "
                 "exclusive with batch_sampler")
         self._batch_sampler = batch_sampler
+        self._epoch_count = 0
+        self._batches_served = 0
+        self._resume = None
         self._num_workers = max(0, num_workers)
         self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch if prefetch is not None
@@ -136,12 +139,55 @@ class DataLoader:
     def __len__(self):
         return len(self._batch_sampler)
 
+    def state_dict(self):
+        """Resumable position: epoch count, batches served this epoch,
+        and the batch sampler's own state (permutation RNG anchor,
+        rollover tail). Checkpoint this between batches and a fresh
+        DataLoader over the same dataset resumes on the exact next batch
+        of the SAME shuffled order — no replayed or skipped samples."""
+        sd = getattr(self._batch_sampler, "state_dict", None)
+        return {"type": "DataLoader", "epoch": int(self._epoch_count),
+                "batches": int(self._batches_served),
+                "sampler": sd() if sd is not None else None}
+
+    def load_state_dict(self, state):
+        """Arm resumption: the NEXT ``__iter__`` restores the sampler
+        state (redrawing the interrupted epoch's permutation) and skips
+        the already-served batches by consuming their sampler indices —
+        skipped batches are never materialized or dispatched to workers."""
+        if state.get("type") != "DataLoader":
+            raise MXNetError(
+                f"DataLoader.load_state_dict: state is for "
+                f"{state.get('type')!r}, not DataLoader")
+        self._epoch_count = int(state.get("epoch", 0))
+        self._resume = dict(state)
+
+    def _begin_epoch(self):
+        """Skip count for this epoch: non-zero only on the first epoch
+        after :meth:`load_state_dict`."""
+        if self._resume is None:
+            self._batches_served = 0
+            return 0
+        state, self._resume = self._resume, None
+        if state.get("sampler") is not None \
+                and hasattr(self._batch_sampler, "load_state_dict"):
+            self._batch_sampler.load_state_dict(state["sampler"])
+        skip = max(0, int(state.get("batches", 0)))
+        self._batches_served = skip
+        return skip
+
     def __iter__(self):
+        skip = self._begin_epoch()
         if self._pool is None:
             for indices in self._batch_sampler:
+                if skip > 0:
+                    skip -= 1
+                    continue
                 batch = self._batchify_fn(
                     [self._dataset[i] for i in indices])
+                self._batches_served += 1
                 yield _as_ndarray(batch, self._pin_memory)
+            self._epoch_count += 1
             return
 
         # async map with bounded in-flight queue (reference prefetch depth)
@@ -160,6 +206,11 @@ class DataLoader:
 
         inflight = collections.deque()
         it = iter(self._batch_sampler)
+        # resume skip: consume the already-served batches' indices before
+        # anything is dispatched — skipped batches cost no worker time
+        for _ in range(skip):
+            if next(it, None) is None:
+                break
         try:
             for _ in range(self._prefetch or 1):
                 indices = next(it, None)
@@ -172,7 +223,9 @@ class DataLoader:
                 indices = next(it, None)
                 if indices is not None:
                     inflight.append(self._pool.apply_async(work, (indices,)))
+                self._batches_served += 1
                 yield _as_ndarray(batch, self._pin_memory)
+            self._epoch_count += 1
         except multiprocessing.TimeoutError:
             raise MXNetError(
                 f"DataLoader worker timed out after {self._timeout}s; "

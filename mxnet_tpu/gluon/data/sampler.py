@@ -13,6 +13,15 @@ class Sampler:
     def __iter__(self):
         raise NotImplementedError
 
+    # resumable-iteration protocol: stateless samplers (sequential,
+    # filter, interval — their order is a pure function of construction)
+    # inherit these no-ops; samplers with draw/rollover state override
+    def state_dict(self):
+        return {}
+
+    def load_state_dict(self, state):
+        pass
+
 
 class SequentialSampler(Sampler):
     def __init__(self, length, start=0):
@@ -29,13 +38,35 @@ class SequentialSampler(Sampler):
 class RandomSampler(Sampler):
     def __init__(self, length):
         self._length = length
+        self._draw_state = None    # RNG state that produced the CURRENT
+        self._resume_state = None  # epoch's permutation / restore request
 
     def __iter__(self):
-        indices = _onp.random.permutation(self._length)
+        if self._resume_state is not None:
+            # resume path: replay the permutation the interrupted epoch
+            # was drawn with, from a private RandomState — the GLOBAL
+            # numpy RNG is left untouched (restoring it would silently
+            # rewind every other consumer of the global stream)
+            self._draw_state, self._resume_state = self._resume_state, None
+            rs = _onp.random.RandomState()
+            rs.set_state(self._draw_state)
+            indices = rs.permutation(self._length)
+        else:
+            self._draw_state = _onp.random.get_state()
+            indices = _onp.random.permutation(self._length)
         return iter(indices.tolist())
 
     def __len__(self):
         return self._length
+
+    def state_dict(self):
+        """The RNG state captured immediately BEFORE the current epoch's
+        permutation was drawn — enough to redraw the identical order on
+        resume (the order itself can be huge; the state is 2.5 KB)."""
+        return {"type": "RandomSampler", "draw_state": self._draw_state}
+
+    def load_state_dict(self, state):
+        self._resume_state = state.get("draw_state")
 
 
 class FilterSampler(Sampler):
@@ -77,6 +108,17 @@ class BatchSampler(Sampler):
                 yield batch
             elif self._last_batch == "rollover":
                 self._prev = batch
+
+    def state_dict(self):
+        inner = getattr(self._sampler, "state_dict", None)
+        return {"type": "BatchSampler", "prev": list(self._prev),
+                "sampler": inner() if inner is not None else None}
+
+    def load_state_dict(self, state):
+        self._prev = list(state.get("prev", []))
+        if state.get("sampler") is not None \
+                and hasattr(self._sampler, "load_state_dict"):
+            self._sampler.load_state_dict(state["sampler"])
 
     def __len__(self):
         n = len(self._sampler) + len(self._prev)

@@ -162,6 +162,35 @@ class NDArrayIter(DataIter):
             return self.cursor + self.batch_size - len(self._epoch)
         return 0
 
+    def state_dict(self):
+        """Resumable position: the epoch's (possibly shuffled) index
+        order, the cursor into it, and the roll_over leftover tail. Saved
+        into checkpoints (``save_checkpoint(..., data_state=...)``) so a
+        resumed run continues on the exact next sample — no replays, no
+        skips — even though the shuffle order came from the global RNG."""
+        return {"type": "NDArrayIter", "cursor": int(self.cursor),
+                "epoch": list(self._epoch),
+                "leftover": list(self._leftover)}
+
+    def load_state_dict(self, state):
+        """Restore a position captured by :meth:`state_dict`. The epoch
+        order is restored verbatim (NOT redrawn), so a shuffled epoch
+        resumes with the same permutation it was interrupted in."""
+        if state.get("type") != "NDArrayIter":
+            raise MXNetError(
+                f"NDArrayIter.load_state_dict: state is for "
+                f"{state.get('type')!r}, not NDArrayIter")
+        epoch = [int(i) for i in state["epoch"]]
+        bad = [i for i in epoch if not 0 <= i < self.num_data]
+        if bad:
+            raise MXNetError(
+                f"NDArrayIter.load_state_dict: state indexes samples "
+                f"{bad[:3]}... but this iterator holds {self.num_data} — "
+                "the checkpoint belongs to a different dataset")
+        self._epoch = epoch
+        self._leftover = [int(i) for i in state.get("leftover", [])]
+        self.cursor = int(state["cursor"])
+
 
 def _read_csv(path):
     """Native threaded parser (textparse.cc) with numpy fallback — the
@@ -347,6 +376,7 @@ class PrefetchIter(DataIter):
         self._stop = threading.Event()
         self._done = False
         self._error = None
+        self._rebase()
         self._start()
 
     @property
@@ -392,6 +422,7 @@ class PrefetchIter(DataIter):
     def reset(self):
         self._drain()
         self.data_iter.reset()
+        self._rebase()
         self._start()
 
     def next(self):
@@ -403,12 +434,55 @@ class PrefetchIter(DataIter):
             raise StopIteration
         kind, payload = self._queue.get()
         if kind == "batch":
+            self._served += 1
             return payload
         self._done = True
         if kind == "error":
             self._error = payload
             raise payload
         raise StopIteration
+
+    def _rebase(self):
+        """Re-anchor the resumable position: the inner iterator's state as
+        of now, with zero batches served since. The prefetch thread runs
+        AHEAD of the consumer, so the inner iterator's live cursor never
+        describes what the consumer actually saw — the anchor + served
+        count does."""
+        sd = getattr(self.data_iter, "state_dict", None)
+        self._base_state = sd() if sd is not None else None
+        self._served = 0
+
+    def state_dict(self):
+        """Resumable position of the CONSUMER (not the prefetch thread):
+        the inner iterator's state at the last anchor point plus how many
+        batches were served since. Restoring replays the inner iterator to
+        exactly the consumer's position, regardless of prefetch depth."""
+        return {"type": "PrefetchIter", "base": self._base_state,
+                "served": int(self._served)}
+
+    def load_state_dict(self, state):
+        if state.get("type") != "PrefetchIter":
+            raise MXNetError(
+                f"PrefetchIter.load_state_dict: state is for "
+                f"{state.get('type')!r}, not PrefetchIter")
+        self._drain()
+        if state.get("base") is not None:
+            self.data_iter.load_state_dict(state["base"])
+        # fast-forward to the consumer's position without materializing
+        # batches (iter_next only moves the cursor); iterators without the
+        # DataIter protocol pay the full next() cost
+        for _ in range(int(state.get("served", 0))):
+            stepper = getattr(self.data_iter, "iter_next", None)
+            if stepper is not None:
+                if not stepper():
+                    break
+            else:
+                try:
+                    next(self.data_iter)
+                except StopIteration:
+                    break
+        self._rebase()
+        self._start()
 
 
 class PrefetchingIter(PrefetchIter):

@@ -29,6 +29,11 @@ the runtime *survive* them. Three cooperating layers:
   parameter-fingerprint desync audit
   (:class:`~.elastic.DesyncAuditHandler`), and per-replica straggler
   detection (:class:`~.elastic.StragglerMonitor`).
+* :mod:`.preemption` — scheduled death: SIGTERM/SIGINT graceful drain
+  (finish the step → force-save through the async checkpoint writer →
+  fence → clean stop; serving routes the signal to the fleet/batcher
+  drain), with the ``preempt:deliver`` fault site for deterministic
+  CPU-box injection (:class:`~.preemption.PreemptionHandler`).
 
 Everything emits ``resilience::*`` events/counters on the PR-1 profiler
 bus; :func:`resilience_stats` snapshots them for bench/BENCH rows.
@@ -59,6 +64,7 @@ _ELASTIC_NAMES = (
     "ElasticBatchProcessor", "DesyncAuditHandler", "StragglerMonitor",
     "is_mesh_loss", "probe_contexts", "replica_fingerprints",
 )
+_PREEMPTION_NAMES = ("preemption", "PreemptionHandler")
 _LOCKDEP_NAMES = ("lockdep",)
 
 
@@ -88,6 +94,14 @@ def __getattr__(name):
         globals()["elastic"] = _el
         for n in _ELASTIC_NAMES[1:]:
             globals()[n] = getattr(_el, n)
+        return globals()[name]
+    if name in _PREEMPTION_NAMES:
+        import importlib
+
+        _pre = importlib.import_module(__name__ + ".preemption")
+        globals()["preemption"] = _pre
+        for n in _PREEMPTION_NAMES[1:]:
+            globals()[n] = getattr(_pre, n)
         return globals()[name]
     if name in _LOCKDEP_NAMES:
         import importlib
@@ -131,6 +145,14 @@ def resilience_stats():
         "resilience.desync_rewinds",
         "resilience.stragglers",
         "resilience.checkpoints_quarantined",
+        # preemption + async checkpointing (resilience.preemption)
+        "resilience.preemptions",
+        "resilience.preempt_saves",
+        "resilience.preempt_drains",
+        "resilience.ckpt_async_saves",
+        "resilience.ckpt_async_failed",
+        "resilience.ckpt_backpressure",
+        "resilience.ckpt_stall_overruns",
     )
     out = {k.split(".", 1)[1]: _counters.get(k) for k in keys}
     out["fault_plan_active"] = faults._active is not None

@@ -54,7 +54,7 @@ import zlib
 
 from ..base import MXNetError
 from ..gluon.contrib.estimator.event_handler import (BatchEnd, EpochEnd,
-                                                     TrainBegin)
+                                                     TrainBegin, TrainEnd)
 from ..profiler import core as _prof
 from . import counters as _counters
 
@@ -153,24 +153,212 @@ def _restore_trainer(trainer, raw):
     trainer.load_states_from_bytes(raw)
 
 
-def save_checkpoint(path, net=None, trainer=None, params=None, meta=None):
+def _data_state_blob(state):
+    import pickle
+
+    return pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _data_state_from_blob(raw):
+    import pickle
+
+    return pickle.loads(raw)
+
+
+def _restore_data_iter(path, sections, data_iter):
+    """Restore a data iterator's position from the ``datastate`` section.
+    A checkpoint written without one warns instead of raising — the
+    params/trainer restore is still valid, only the data position resets
+    (the pre-resumable-iterator behavior, now loud instead of silent)."""
+    if data_iter is None:
+        return
+    if "datastate" not in sections:
+        import warnings
+
+        warnings.warn(
+            f"{os.path.basename(str(path))}: checkpoint carries no "
+            "datastate section — data iterator position NOT restored, the "
+            "epoch will replay from the iterator's current position",
+            RuntimeWarning, stacklevel=4)
+        return
+    data_iter.load_state_dict(_data_state_from_blob(sections["datastate"]))
+
+
+def _snapshot_params(params):
+    """Point-in-time host copy of a params dict — the synchronous half
+    (the 'stall') of an async save. Device→host transfers happen here;
+    serialization/CRC/write happen off-thread against this snapshot, so
+    continued training never races the in-flight write."""
+    import numpy as _np
+
+    from ..ndarray.ndarray import NDArray
+
+    out = {}
+    for name, v in params.items():
+        if isinstance(v, (list, tuple)):
+            # pre-split tensor slices (layout-carrying sharded saves)
+            out[name] = [s if isinstance(s, _np.ndarray)
+                         else (s.asnumpy() if hasattr(s, "asnumpy")
+                               else _np.asarray(s)) for s in v]
+        elif hasattr(v, "asnumpy"):
+            out[name] = NDArray(v.asnumpy())
+        else:
+            out[name] = NDArray(_np.ascontiguousarray(_np.asarray(v)))
+    return out
+
+
+def _write_container(path, raw, shard=None):
+    """One container write, instrumented as the ``ckpt:write`` fault
+    site: a ``die`` rule kills the writer BEFORE this container lands
+    (the crash-mid-sequence case — for sharded saves the manifest never
+    commits and last-good stands), a ``torn`` marker lands truncated
+    bytes at the FINAL name — the corrupt-file state the CRC footer +
+    quarantine rollback must catch."""
+    slot = _faults_slot()
+    if slot is not None:
+        marker = slot.check("ckpt:write",
+                            {"path": os.path.basename(str(path)),
+                             "shard": shard})
+        if isinstance(marker, dict) and marker.get("kind") == "torn":
+            with open(path, "wb") as f:
+                f.write(raw[:max(1, len(raw) // 2)])
+            return
+    _atomic_write(path, raw)
+
+
+#: last measured synchronous stall of an async save, in ms (bench hook)
+LAST_STALL_MS = None
+
+
+def _note_stall(stall_ms):
+    """Account one async save's synchronous stall; warns when it blows
+    the ``MXNET_CKPT_STALL_BUDGET_MS`` budget (0 = unbudgeted)."""
+    global LAST_STALL_MS
+    LAST_STALL_MS = stall_ms
+    _counters.incr("resilience.ckpt_async_saves")
+    from .. import config
+
+    budget = float(config.get("MXNET_CKPT_STALL_BUDGET_MS") or 0)
+    if budget and stall_ms > budget:
+        _counters.incr("resilience.ckpt_stall_overruns")
+        n = _counters.get("resilience.ckpt_stall_overruns")
+        if _counters.should_warn(n):
+            import warnings
+
+            warnings.warn(
+                f"async checkpoint stall {stall_ms:.1f}ms exceeds "
+                f"MXNET_CKPT_STALL_BUDGET_MS={budget:g} ({n} overrun(s) "
+                "this process) — the host snapshot itself is too slow, "
+                "not the background write", RuntimeWarning, stacklevel=4)
+    if _prof.ENABLED:
+        _prof.record_instant("resilience::ckpt_stall", "resilience",
+                             args={"ms": round(float(stall_ms), 3)})
+
+
+class AsyncCheckpoint:
+    """Handle for one in-flight background checkpoint write.
+
+    The save call already snapshotted params/trainer/data state to host
+    (the bounded stall, recorded in :attr:`stall_ms`); the thread behind
+    this handle owns serialization + CRC + atomic write. :meth:`join` is
+    the consistency fence — a second save, a load, a quarantine, or a
+    shutdown must join the in-flight write first. A failed background
+    write (including an injected ``die`` at ``ckpt:write``) does NOT
+    raise into the joiner: the generation simply never commits, readers
+    fall back to last-good, and the failure is counted
+    (``resilience.ckpt_async_failed``) and warned about."""
+
+    def __init__(self, path, stall_ms):
+        self.path = path
+        self.stall_ms = stall_ms
+        self.error = None
+        self._thread = None
+
+    def in_flight(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def join(self, timeout=None):
+        """Fence: block until the write lands (or fails). Returns True
+        when the checkpoint committed."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self.error is None
+
+
+def _spawn_commit(commit, path, stall_ms):
+    import threading
+
+    handle = AsyncCheckpoint(path, stall_ms)
+
+    def run():
+        _prof.register_thread_name()
+        try:
+            commit()
+        except BaseException as exc:  # incl. SimulatedWorkerDeath
+            handle.error = exc
+            _counters.incr("resilience.ckpt_async_failed")
+            n = _counters.get("resilience.ckpt_async_failed")
+            if _counters.should_warn(n):
+                import warnings
+
+                warnings.warn(
+                    f"async checkpoint write failed for "
+                    f"{os.path.basename(str(path))}: "
+                    f"{type(exc).__name__}: {exc} — generation never "
+                    "committed, resume falls back to last-good",
+                    RuntimeWarning, stacklevel=2)
+
+    t = threading.Thread(target=run, daemon=True, name="mxtpu-ckpt-write")
+    handle._thread = t
+    t.start()
+    return handle
+
+
+def save_checkpoint(path, net=None, trainer=None, params=None, meta=None,
+                    data_state=None, async_write=False):
     """Atomically write one checkpoint file covering block parameters
     (``net`` or an explicit name->NDArray ``params`` dict) and, when given,
-    the Trainer's optimizer state + step count. Returns ``path``."""
-    from ..ndarray.utils import save_parameters_buffer
+    the Trainer's optimizer state + step count. ``data_state`` (any
+    pickleable object, typically an iterator's ``state_dict()``) rides
+    along as a ``datastate`` section so resume restores the data position
+    sample-exactly.
+
+    ``async_write=True`` splits the save: params/trainer/data state are
+    snapshotted to host synchronously (the bounded stall), then
+    pack/CRC/atomic-write run on a background thread; returns an
+    :class:`AsyncCheckpoint` handle whose :meth:`~AsyncCheckpoint.join`
+    fences the write. Synchronous saves return ``path``."""
+    import time as _time
 
     if net is None and params is None:
         raise MXNetError("save_checkpoint needs a net or a params dict")
     if params is None:
         params = net._params_data()
-    sections = [("params", save_parameters_buffer(params))]
-    if trainer is not None:
-        sections.append(("trainer", _trainer_blob(trainer)))
     t0 = _prof.begin()
-    _atomic_write(path, _pack(sections, meta))
-    _prof.record_duration("resilience::checkpoint_save", "resilience", t0,
-                          args={"path": os.path.basename(str(path))})
-    _counters.incr("resilience.checkpoints_saved")
+    tw = _time.perf_counter()
+    host = _snapshot_params(params)
+    trainer_blob = _trainer_blob(trainer) if trainer is not None else None
+    data_blob = (_data_state_blob(data_state) if data_state is not None
+                 else None)
+    stall_ms = (_time.perf_counter() - tw) * 1e3
+
+    def commit():
+        from ..ndarray.utils import save_parameters_buffer
+
+        sections = [("params", save_parameters_buffer(host))]
+        if trainer_blob is not None:
+            sections.append(("trainer", trainer_blob))
+        if data_blob is not None:
+            sections.append(("datastate", data_blob))
+        _write_container(path, _pack(sections, meta))
+        _prof.record_duration("resilience::checkpoint_save", "resilience",
+                              t0, args={"path": os.path.basename(str(path))})
+        _counters.incr("resilience.checkpoints_saved")
+
+    if async_write:
+        _note_stall(stall_ms)
+        return _spawn_commit(commit, path, stall_ms)
+    commit()
     return path
 
 
@@ -186,7 +374,8 @@ def _slice_name(name, j):
 
 def save_sharded_checkpoint(path, net=None, trainer=None, params=None,
                             meta=None, num_shards=None, mesh_axes=None,
-                            axis="dp", layouts=None):
+                            axis="dp", layouts=None, data_state=None,
+                            async_write=False):
     """Write one *sharded* checkpoint: ``num_shards`` sibling containers
     each holding a round-robin name-partition of the parameters (whole
     tensors — a ZeRO-style ownership split, not a tensor split), plus a
@@ -205,9 +394,15 @@ def save_sharded_checkpoint(path, net=None, trainer=None, params=None,
     Write order is shards-first, manifest-last (each write atomic): a
     crash mid-sequence leaves shard files with no manifest — invisible to
     ``CheckpointManager.load_latest``, cleaned by rotation — never a
-    manifest pointing at missing shards. Returns ``path``."""
+    manifest pointing at missing shards. ``data_state`` rides in the
+    manifest container (written last, atomically) as a ``datastate``
+    section. ``async_write=True`` snapshots everything to host
+    synchronously and runs the whole shard+manifest write sequence on a
+    background thread, returning an :class:`AsyncCheckpoint` handle;
+    synchronous saves return ``path``."""
+    import time as _time
+
     from ..ndarray.ndarray import NDArray
-    from ..ndarray.utils import save_parameters_buffer
 
     if net is None and params is None:
         raise MXNetError("save_sharded_checkpoint needs a net or params")
@@ -217,6 +412,8 @@ def save_sharded_checkpoint(path, net=None, trainer=None, params=None,
     if num_shards < 1:
         raise MXNetError(f"num_shards must be >= 1, got {num_shards}")
     layouts = dict(layouts or {})
+    t0 = _prof.begin()
+    tw = _time.perf_counter()
     entries = {}
     for name, value in params.items():
         lay = layouts.get(name)
@@ -252,37 +449,55 @@ def save_sharded_checkpoint(path, net=None, trainer=None, params=None,
                     s = s.asnumpy()
                 s = NDArray(_np.ascontiguousarray(s))
             entries[_slice_name(name, j)] = s
+    # synchronous half ends here: host snapshot of every entry plus the
+    # trainer/data blobs — the background thread touches no live state
+    entries = _snapshot_params(entries)
+    trainer_blob = _trainer_blob(trainer) if trainer is not None else None
+    data_blob = (_data_state_blob(data_state) if data_state is not None
+                 else None)
+    stall_ms = (_time.perf_counter() - tw) * 1e3
     names = list(entries)
-    t0 = _prof.begin()
-    shard_table = []
-    for i in range(num_shards):
-        own = names[i::num_shards]
-        blob = _pack([("params", save_parameters_buffer(
-            {n: entries[n] for n in own}))],
-            {"shard": i, "num_shards": num_shards})
-        spath = _shard_path(path, i, num_shards)
-        _atomic_write(spath, blob)
-        shard_table.append({"name": os.path.basename(spath),
-                            "crc": zlib.crc32(blob), "params": own})
-    manifest = {"shards": shard_table, "num_shards": num_shards,
-                "mesh_axes": dict(mesh_axes or {axis: num_shards}),
-                "axis": axis}
-    if layouts:
-        manifest["layouts"] = {
-            n: {"axis": lay.get("axis", "tp"), "dim": int(lay.get("dim", 0)),
-                "parts": int(lay["parts"])}
-            for n, lay in layouts.items()}
-    mmeta = dict(meta or {})
-    mmeta.update({"sharded": True, "num_shards": num_shards,
-                  "mesh_axes": manifest["mesh_axes"], "axis": axis})
-    sections = [("manifest", json.dumps(manifest).encode())]
-    if trainer is not None:
-        sections.append(("trainer", _trainer_blob(trainer)))
-    _atomic_write(path, _pack(sections, mmeta))
-    _prof.record_duration("resilience::checkpoint_save", "resilience", t0,
-                          args={"path": os.path.basename(str(path)),
-                                "shards": num_shards})
-    _counters.incr("resilience.checkpoints_saved")
+
+    def commit():
+        from ..ndarray.utils import save_parameters_buffer
+
+        shard_table = []
+        for i in range(num_shards):
+            own = names[i::num_shards]
+            blob = _pack([("params", save_parameters_buffer(
+                {n: entries[n] for n in own}))],
+                {"shard": i, "num_shards": num_shards})
+            spath = _shard_path(path, i, num_shards)
+            _write_container(spath, blob, shard=i)
+            shard_table.append({"name": os.path.basename(spath),
+                                "crc": zlib.crc32(blob), "params": own})
+        manifest = {"shards": shard_table, "num_shards": num_shards,
+                    "mesh_axes": dict(mesh_axes or {axis: num_shards}),
+                    "axis": axis}
+        if layouts:
+            manifest["layouts"] = {
+                n: {"axis": lay.get("axis", "tp"),
+                    "dim": int(lay.get("dim", 0)),
+                    "parts": int(lay["parts"])}
+                for n, lay in layouts.items()}
+        mmeta = dict(meta or {})
+        mmeta.update({"sharded": True, "num_shards": num_shards,
+                      "mesh_axes": manifest["mesh_axes"], "axis": axis})
+        sections = [("manifest", json.dumps(manifest).encode())]
+        if trainer_blob is not None:
+            sections.append(("trainer", trainer_blob))
+        if data_blob is not None:
+            sections.append(("datastate", data_blob))
+        _write_container(path, _pack(sections, mmeta), shard="manifest")
+        _prof.record_duration("resilience::checkpoint_save", "resilience",
+                              t0, args={"path": os.path.basename(str(path)),
+                                        "shards": num_shards})
+        _counters.incr("resilience.checkpoints_saved")
+
+    if async_write:
+        _note_stall(stall_ms)
+        return _spawn_commit(commit, path, stall_ms)
+    commit()
     return path
 
 
@@ -351,7 +566,7 @@ def _reassemble_layouts(path, params, manifest):
 
 
 def _load_sharded(path, sections, meta, net=None, trainer=None,
-                  mesh_axes=None):
+                  mesh_axes=None, data_iter=None):
     """Manifest half of :func:`load_checkpoint`: validate every shard
     (manifest CRC of the file bytes, then the shard's own container CRC),
     reassemble the full parameter dict — including tensor-split (tp/pp)
@@ -415,10 +630,12 @@ def _load_sharded(path, sections, meta, net=None, trainer=None,
         _note_reshard(path, saved_axes, mesh_axes)
     if trainer is not None:
         _restore_trainer(trainer, sections["trainer"])
+    _restore_data_iter(path, sections, data_iter)
     return params, meta
 
 
-def load_checkpoint(path, net=None, trainer=None, mesh_axes=None):
+def load_checkpoint(path, net=None, trainer=None, mesh_axes=None,
+                    data_iter=None):
     """Load + validate one checkpoint; restores into ``net`` / ``trainer``
     when given. Raises :class:`CheckpointCorruptError` on a bad file
     (nothing is restored in that case). Sharded manifests (see
@@ -426,7 +643,11 @@ def load_checkpoint(path, net=None, trainer=None, mesh_axes=None):
     tensor-split (tp/pp) slices included — and may restore onto a
     different mesh layout than they were saved with; pass ``mesh_axes``
     (``{"dp": 2, "tp": 2}``-style) to declare the resuming layout for the
-    per-axis reshard accounting. Returns ``(params_dict, meta)``."""
+    per-axis reshard accounting. ``data_iter`` restores an iterator's
+    position from the checkpoint's ``datastate`` section (see
+    ``save_checkpoint(..., data_state=...)``) — a checkpoint without one
+    warns and leaves the iterator untouched. Returns
+    ``(params_dict, meta)``."""
     from ..ndarray.utils import load_parameters_buffer
 
     with open(path, "rb") as f:
@@ -434,7 +655,8 @@ def load_checkpoint(path, net=None, trainer=None, mesh_axes=None):
     sections, meta = _unpack(raw, path=str(path))
     if meta.get("sharded"):
         return _load_sharded(path, sections, meta, net=net,
-                             trainer=trainer, mesh_axes=mesh_axes)
+                             trainer=trainer, mesh_axes=mesh_axes,
+                             data_iter=data_iter)
     if "params" not in sections:
         raise CheckpointCorruptError(f"{path}: no params section")
     if trainer is not None and "trainer" not in sections:
@@ -453,6 +675,7 @@ def load_checkpoint(path, net=None, trainer=None, mesh_axes=None):
             p.set_data(params[name])
     if trainer is not None:
         _restore_trainer(trainer, sections["trainer"])
+    _restore_data_iter(path, sections, data_iter)
     return params, meta
 
 
@@ -463,13 +686,33 @@ class CheckpointManager:
     oldest and quarantines corrupt files as ``<name>.corrupt`` instead of
     failing, so one torn/bit-rotted checkpoint costs one save interval, not
     the whole run.
+
+    **Async writes** (``async_write=True`` or ``MXNET_CKPT_ASYNC=1``):
+    :meth:`save` stalls only for the host snapshot and hands
+    serialization + atomic write to a background thread. A generation is
+    advertised only after its COMMIT (the ``os.replace``) lands — the
+    manager's own reads (:meth:`save`, :meth:`load_latest`,
+    :meth:`quarantine`, :meth:`wait`) all fence on the in-flight write
+    first, and since :meth:`list_steps` is disk truth an uncommitted
+    write is simply invisible. A save arriving while the previous one is
+    still writing counts ``resilience.ckpt_backpressure`` (saves are
+    outpacing checkpoint I/O) before joining; a write that dies mid-flight
+    never commits, so readers fall back to last-good.
     """
 
-    def __init__(self, directory, prefix="ckpt", max_keep=3):
+    def __init__(self, directory, prefix="ckpt", max_keep=3,
+                 async_write=None):
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.prefix = prefix
         self.max_keep = int(max_keep)
+        if async_write is None:
+            from .. import config
+
+            async_write = bool(config.get("MXNET_CKPT_ASYNC"))
+        self.async_write = bool(async_write)
+        self.last_stall_ms = None
+        self._inflight = None
 
     def _path(self, step):
         return os.path.join(self.directory, f"{self.prefix}-{step:012d}.ckpt")
@@ -486,22 +729,63 @@ class CheckpointManager:
                     continue
         return sorted(steps)
 
+    def _fence(self, next_step=None):
+        """Join any in-flight async write (the consistency fence). When a
+        NEW save arrives while the previous generation is still writing
+        (``next_step`` given), the backpressure is counted and warned
+        about first — an operator must be able to see saves outpacing
+        checkpoint I/O, not just feel the joins."""
+        handle, self._inflight = self._inflight, None
+        if handle is None:
+            return
+        if next_step is not None and handle.in_flight():
+            _counters.incr("resilience.ckpt_backpressure")
+            n = _counters.get("resilience.ckpt_backpressure")
+            if _counters.should_warn(n):
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint save backpressure: "
+                    f"{os.path.basename(handle.path)} still writing when "
+                    f"the step-{next_step} save arrived ({n} "
+                    "occurrence(s) this process) — saves are outpacing "
+                    "checkpoint I/O, lengthen the save period or speed up "
+                    "the checkpoint disk", RuntimeWarning, stacklevel=4)
+        if handle.join():
+            self._rotate()
+
+    def wait(self):
+        """Public fence: block until the in-flight async write (if any)
+        commits and rotation runs. Returns True when the last write landed
+        cleanly (or none was pending) — call before process exit so a
+        preempted worker never abandons a half-written generation."""
+        handle = self._inflight
+        self._fence()
+        return handle is None or handle.error is None
+
     def save(self, step, net=None, trainer=None, params=None, meta=None,
              sharded=False, num_shards=None, mesh_axes=None, axis="dp",
-             layouts=None):
+             layouts=None, data_state=None):
+        self._fence(next_step=step)
         meta = dict(meta or {})
         meta["step"] = int(step)
         if sharded:
-            path = save_sharded_checkpoint(
+            out = save_sharded_checkpoint(
                 self._path(step), net=net, trainer=trainer, params=params,
                 meta=meta, num_shards=num_shards, mesh_axes=mesh_axes,
-                axis=axis, layouts=layouts)
+                axis=axis, layouts=layouts, data_state=data_state,
+                async_write=self.async_write)
         else:
-            path = save_checkpoint(self._path(step), net=net,
-                                   trainer=trainer, params=params,
-                                   meta=meta)
+            out = save_checkpoint(self._path(step), net=net,
+                                  trainer=trainer, params=params,
+                                  meta=meta, data_state=data_state,
+                                  async_write=self.async_write)
+        if self.async_write:
+            self._inflight = out
+            self.last_stall_ms = out.stall_ms
+            return out.path
         self._rotate()
-        return path
+        return out
 
     def _shard_files(self, step):
         """LIVE shard siblings of step's manifest (present only for
@@ -538,6 +822,7 @@ class CheckpointManager:
         and warned about by file name, rate-limited to powers of ten — an
         operator watching a fleet must be able to see corruption
         *frequency*, not just the per-run rollback."""
+        self._fence()
         path = self._path(step)
         try:
             os.replace(path, path + suffix)
@@ -571,19 +856,25 @@ class CheckpointManager:
                 RuntimeWarning, stacklevel=3)
         return True
 
-    def load_latest(self, net=None, trainer=None, mesh_axes=None):
+    def load_latest(self, net=None, trainer=None, mesh_axes=None,
+                    data_iter=None):
         """Restore the newest valid checkpoint; corrupt files roll back to
         the previous one. Returns its ``meta`` dict (contains ``step``),
         or ``None`` when no valid checkpoint exists. ``mesh_axes``
         declares the resuming mesh layout (forwarded to
-        :func:`load_checkpoint` for the per-axis reshard accounting)."""
+        :func:`load_checkpoint` for the per-axis reshard accounting);
+        ``data_iter`` restores the iterator position saved alongside.
+        Fences on any in-flight async write first, so a load never races
+        its own manager's background writer."""
         import warnings
 
+        self._fence()
         for step in reversed(self.list_steps()):
             path = self._path(step)
             try:
                 _, meta = load_checkpoint(path, net=net, trainer=trainer,
-                                          mesh_axes=mesh_axes)
+                                          mesh_axes=mesh_axes,
+                                          data_iter=data_iter)
                 return meta
             except CheckpointCorruptError as e:
                 _counters.incr("resilience.checkpoints_corrupt")
@@ -603,7 +894,7 @@ class CheckpointManager:
         return None
 
 
-class ResilientCheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+class ResilientCheckpointHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
     """Estimator event handler: periodic atomic checkpoints + resume.
 
     Unlike the reference-shaped ``CheckpointHandler`` (two files, plain
@@ -619,13 +910,18 @@ class ResilientCheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
     """
 
     def __init__(self, model_dir, model_prefix="model", epoch_period=1,
-                 batch_period=None, max_keep=3):
+                 batch_period=None, max_keep=3, data_iter=None,
+                 async_write=None):
         self.manager = CheckpointManager(model_dir, prefix=model_prefix,
-                                         max_keep=max_keep)
+                                         max_keep=max_keep,
+                                         async_write=async_write)
         self.epoch_period = epoch_period
         self.batch_period = batch_period
         self.current_batch = 0
         self.current_epoch = 0
+        # resumable data iterator: its state_dict rides in every save and
+        # resume() restores it, so the epoch continues sample-exact
+        self.data_iter = data_iter
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
@@ -642,16 +938,25 @@ class ResilientCheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         if self.epoch_period and self.current_epoch % self.epoch_period == 0:
             self._save(estimator)
 
+    def train_end(self, estimator, *args, **kwargs):
+        # fence: a run must not exit with its final save still in flight
+        self.manager.wait()
+
     def _save(self, estimator):
+        data_state = (self.data_iter.state_dict()
+                      if self.data_iter is not None else None)
         self.manager.save(
             self.current_batch, net=estimator.net, trainer=estimator.trainer,
-            meta={"batch": self.current_batch, "epoch": self.current_epoch})
+            meta={"batch": self.current_batch, "epoch": self.current_epoch},
+            data_state=data_state)
 
     def resume(self, estimator):
         """Restore the newest valid checkpoint into the estimator's net and
-        trainer. Returns the batch index to continue from (0 = fresh)."""
+        trainer (and the data iterator's position, when one was given).
+        Returns the batch index to continue from (0 = fresh)."""
         meta = self.manager.load_latest(net=estimator.net,
-                                        trainer=estimator.trainer)
+                                        trainer=estimator.trainer,
+                                        data_iter=self.data_iter)
         if meta is None:
             return 0
         self.current_batch = int(meta.get("batch", meta.get("step", 0)))

@@ -454,11 +454,13 @@ class ElasticTrainingHandler(TrainBegin, PreStep, BatchEnd, EpochEnd):
     def __init__(self, model_dir, model_prefix="model", epoch_period=1,
                  batch_period=None, max_keep=3, axis="dp",
                  max_restarts=None, min_replicas=None, power_of_two=True,
-                 priority=-1400):
+                 data_iter=None, async_write=None, priority=-1400):
         from .checkpoint import CheckpointManager
 
         self.manager = CheckpointManager(model_dir, prefix=model_prefix,
-                                         max_keep=max_keep)
+                                         max_keep=max_keep,
+                                         async_write=async_write)
+        self.data_iter = data_iter
         self.epoch_period = epoch_period
         self.batch_period = batch_period
         self.axis = axis
@@ -491,7 +493,9 @@ class ElasticTrainingHandler(TrainBegin, PreStep, BatchEnd, EpochEnd):
             meta={"batch": self.current_batch,
                   "epoch": self.current_epoch},
             sharded=True, num_shards=n, mesh_axes={self.axis: n},
-            axis=self.axis)
+            axis=self.axis,
+            data_state=(self.data_iter.state_dict()
+                        if self.data_iter is not None else None))
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
@@ -512,9 +516,13 @@ class ElasticTrainingHandler(TrainBegin, PreStep, BatchEnd, EpochEnd):
     def resume(self, estimator):
         """Restore the newest valid (sharded or plain) checkpoint into
         the estimator's net + trainer — onto the CURRENT replica set,
-        whatever size it is. Returns the batch index to continue from."""
+        whatever size it is. Returns the batch index to continue from.
+        When the handler carries a resumable ``data_iter``, its position
+        (epoch/cursor/RNG) is restored too — so a dp4→dp2 reshard resumes
+        sample-exact, the *remaining* data resplit among survivors."""
         meta = self.manager.load_latest(net=estimator.net,
-                                        trainer=estimator.trainer)
+                                        trainer=estimator.trainer,
+                                        data_iter=self.data_iter)
         if meta is None:
             return 0
         self.current_batch = int(meta.get("batch", meta.get("step", 0)))
@@ -615,7 +623,8 @@ class ElasticTrainingHandler(TrainBegin, PreStep, BatchEnd, EpochEnd):
         trainer.rebind_kvstore(KVStoreDistTPUSync(mesh=new_mesh,
                                                   axis=self.axis))
         estimator.net.collect_params().reset_ctx(new_ctxs)
-        meta = self.manager.load_latest(net=estimator.net, trainer=trainer)
+        meta = self.manager.load_latest(net=estimator.net, trainer=trainer,
+                                        data_iter=self.data_iter)
         if meta is None:
             # the file validated a moment ago and vanished/corrupted
             # since — nothing left to restore

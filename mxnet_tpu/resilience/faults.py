@@ -57,6 +57,19 @@ hit sequence). Kinds:
     on CPU. Sites that don't implement corruption ignore the return value,
     so a ``nan`` rule on e.g. ``engine:wait`` fires (and is counted) but
     has no effect.
+``torn``
+    does not raise: returns ``{"kind": "torn"}`` and the checkpoint write
+    path (``ckpt:write``) lands deliberately truncated bytes at the FINAL
+    checkpoint name — the on-disk state that bit rot or a partially-synced
+    disk produces and that the atomic tmp+rename protocol normally rules
+    out — so the CRC-quarantine + last-good rollback path is exercised
+    deterministically. A ``die`` at the same site instead kills the writer
+    between atomic container writes (shards present, manifest absent).
+``preempt``
+    does not raise: returns ``{"kind": "preempt"}`` and the preemption
+    guard (``preempt:deliver`` in ``resilience.preemption``) treats the
+    hit as a delivered SIGTERM — finish the step, force-save, stop — so
+    graceful-drain recovery is testable without real signal delivery.
 
 Per-replica kinds (elastic multichip training, ``resilience.elastic``) —
 each takes a ``"replica"`` field naming the device-group index it targets:
@@ -159,6 +172,22 @@ KNOWN_SITES = (
                             # a coordinate-addressed 'chip_loss' here is
                             # the composed-mesh (dp×tp) kill the elastic
                             # rebuild-and-reshard path recovers from
+    "ckpt:write",           # resilience.checkpoint write path, once per
+                            # container (each shard, then the manifest)
+                            # BEFORE its atomic write, with info=
+                            # {"path", "shard"} — a 'die' here is a crash
+                            # mid-shard-sequence (the manifest never
+                            # lands, last-good stands); a 'torn' marker
+                            # makes the writer land truncated bytes at
+                            # the FINAL name (the bit-rot / partial-sync
+                            # state os.replace normally rules out), so
+                            # the CRC-quarantine rollback is testable
+    "preempt:deliver",      # resilience.preemption.PreemptionHandler,
+                            # once per batch with info={"batch": n} — a
+                            # 'preempt' marker is an injected SIGTERM-
+                            # equivalent: the training loop finishes the
+                            # step, force-saves and stops exactly as if
+                            # the real signal had arrived
 )
 
 
@@ -221,7 +250,8 @@ class FaultPlan:
             if not site:
                 raise MXNetError(f"fault rule {i} missing 'site'")
             if kind not in ("transient", "fatal", "delay", "die", "nan",
-                            "chip_loss", "replica_delay", "param_corrupt"):
+                            "chip_loss", "replica_delay", "param_corrupt",
+                            "torn", "preempt"):
                 raise MXNetError(f"fault rule {i}: unknown kind {kind!r}")
             triggers = [t for t in ("at", "times", "prob") if t in r]
             if len(triggers) != 1:
@@ -329,6 +359,13 @@ class FaultPlan:
             return
         if kind == "nan":
             return "nan"
+        if kind == "torn":
+            # the checkpoint writer lands deliberately truncated bytes at
+            # the final name instead of the atomic tmp+rename sequence
+            return {"kind": "torn"}
+        if kind == "preempt":
+            # the preemption guard treats this as a delivered SIGTERM
+            return {"kind": "preempt"}
         if kind == "replica_delay":
             # the replica filter above already scoped this hit to the
             # target replica (or the site carries no replica info)

@@ -1,0 +1,253 @@
+"""Preemption-aware graceful drain (SIGTERM/SIGINT lifecycle).
+
+A preempted TPU worker gets a SIGTERM and a short grace window — the most
+common production interruption there is. This module turns that signal
+into a clean, resumable exit instead of lost work:
+
+* **Training**: :func:`install` sets a process-wide *requested* flag in
+  the signal handler (signal-safe: no I/O, no locks held across user
+  code). A :class:`PreemptionHandler` in the estimator's event-handler
+  list polls the flag once per batch — AFTER the optimizer step — and on
+  delivery force-saves through its checkpoint handler (async write, then
+  :meth:`~.checkpoint.CheckpointManager.wait` as the commit fence) and
+  stops training cleanly. The next process resumes from that generation,
+  sample-exact when a resumable data iterator was checkpointed along.
+* **Serving**: the handler routes the signal to the serving stack on a
+  background thread — every registered drainable plus every live
+  ``serve.fleet.Router`` — so in-flight requests settle before exit
+  (``Router.drain`` / ``DynamicBatcher.drain`` semantics), bounded by
+  ``MXNET_PREEMPT_GRACE_S``.
+
+Determinism on a CPU dev box: the ``preempt:deliver`` fault site fires in
+:meth:`PreemptionHandler.batch_end` with ``info={"batch": n}`` — a
+``{"kind": "preempt", "at": [k]}`` rule injects the SIGTERM-equivalent at
+exactly batch ``k`` with no real signal delivery, so the whole
+drain → force-save → resume path is testable and seedable.
+
+Lifecycle: ``signal → request() → finish current step → force-save →
+fence (join the async write) → stop/exit → next process resumes``.
+"""
+from __future__ import annotations
+
+import signal as _signal
+import threading
+import weakref
+
+from ..gluon.contrib.estimator.event_handler import (BatchEnd, TrainBegin,
+                                                     TrainEnd)
+from ..profiler import core as _prof
+from ..profiler import recorder as _recorder
+from . import counters as _counters
+
+_lock = threading.Lock()
+_requested = threading.Event()
+_reason = None
+_installed = {}      # signum -> previous handler (for uninstall/chaining)
+_drainables = []     # weakrefs to serving objects with drain()/close()
+_exit_after_drain = False
+
+
+def _grace_s():
+    from .. import config
+
+    try:
+        return float(config.get("MXNET_PREEMPT_GRACE_S"))
+    except (TypeError, ValueError):
+        return 30.0
+
+
+def requested():
+    """True once a preemption (signal or injected) has been delivered."""
+    return _requested.is_set()
+
+
+def reason():
+    """Why preemption was requested (``None`` if it wasn't)."""
+    return _reason
+
+
+def clear():
+    """Reset the delivered flag (test hygiene / a survived drill)."""
+    global _reason
+    with _lock:
+        _requested.clear()
+        _reason = None
+
+
+def request(why="api"):
+    """Mark preemption requested — the programmatic SIGTERM-equivalent
+    (the ``preempt:deliver`` fault site and the real signal handler both
+    land here). Idempotent; only the first delivery counts."""
+    global _reason
+    with _lock:
+        if _requested.is_set():
+            return
+        _reason = str(why)
+        _requested.set()
+    _counters.incr("resilience.preemptions")
+    _recorder.note("preempt", "deliver", {"reason": str(why)})
+    if _prof.ENABLED:
+        _prof.record_instant("resilience::preempt", "resilience",
+                             args={"reason": str(why)})
+
+
+def register_drainable(obj):
+    """Register a serving-side object to drain on preemption: anything
+    with ``drain(timeout=...)`` (preferred — in-flight work settles) or
+    ``close(timeout=...)``. Held by weakref; live ``serve.fleet.Router``
+    instances are drained without registration."""
+    with _lock:
+        _drainables.append(weakref.ref(obj))
+
+
+def drain_serving(timeout=None):
+    """Route a preemption to the serving stack: drain every registered
+    drainable and every live ``serve.fleet.Router`` within ``timeout``
+    seconds (default ``MXNET_PREEMPT_GRACE_S``). Returns how many objects
+    were drained cleanly."""
+    import sys
+
+    budget = _grace_s() if timeout is None else float(timeout)
+    targets = []
+    with _lock:
+        live = []
+        for ref in _drainables:
+            obj = ref()
+            if obj is not None:
+                targets.append(obj)
+                live.append(ref)
+        _drainables[:] = live
+    fleet = sys.modules.get("mxnet_tpu.serve.fleet")
+    if fleet is not None:
+        for router in list(getattr(fleet, "_routers", ()) or ()):
+            if router not in targets:
+                targets.append(router)
+    n = 0
+    for obj in targets:
+        try:
+            if hasattr(obj, "drain"):
+                ok = obj.drain(timeout=budget)
+            else:
+                obj.close(timeout=budget)
+                ok = True
+            n += 1 if ok is not False else 0
+        except Exception as exc:  # noqa: BLE001 — drain the rest anyway
+            import warnings
+
+            warnings.warn(
+                f"preemption drain of {type(obj).__name__} failed: "
+                f"{type(exc).__name__}: {exc}", RuntimeWarning,
+                stacklevel=2)
+    _counters.incr("resilience.preempt_drains")
+    return n
+
+
+_drain_thread = None
+
+
+def drain_in_progress():
+    """True while the post-signal background drain is still running —
+    the liveness probe for the ``mxtpu-preempt-drain`` thread."""
+    t = _drain_thread
+    return t is not None and t.is_alive()
+
+
+def _handler(signum, frame):
+    global _drain_thread
+    prev = _installed.get(signum)
+    request(f"signal {signum}")
+    # serving drains on a background thread: the main thread may be deep
+    # in a training step and must keep running to finish it
+    _drain_thread = threading.Thread(target=_drain_then_exit, daemon=True,
+                                     name="mxtpu-preempt-drain")
+    _drain_thread.start()
+    if callable(prev) and prev not in (_signal.SIG_IGN, _signal.SIG_DFL):
+        prev(signum, frame)  # preserve application handlers
+
+
+def _drain_then_exit():
+    _prof.register_thread_name()
+    drain_serving()
+    if _exit_after_drain:
+        import os
+
+        os._exit(0)
+
+
+def install(signals=(_signal.SIGTERM, _signal.SIGINT), exit_after_drain=False):
+    """Install the preemption handlers (main thread only — CPython
+    restriction). ``exit_after_drain=True`` is for serving-only daemons
+    with no training loop to drive the exit: once the serving stack has
+    drained, the process exits 0. Training processes leave it False — the
+    :class:`PreemptionHandler` stops the fit loop and the script exits on
+    its own. Idempotent; :func:`uninstall` restores the previous
+    handlers."""
+    global _exit_after_drain
+    _exit_after_drain = bool(exit_after_drain)
+    for signum in signals:
+        if signum in _installed:
+            continue
+        _installed[signum] = _signal.signal(signum, _handler)
+
+
+def uninstall():
+    """Restore the signal handlers :func:`install` replaced."""
+    while _installed:
+        signum, prev = _installed.popitem()
+        try:
+            _signal.signal(signum, prev)
+        except (TypeError, ValueError):
+            _signal.signal(signum, _signal.SIG_DFL)
+
+
+class PreemptionHandler(TrainBegin, BatchEnd, TrainEnd):
+    """Estimator guard: finish the step, force-save, stop.
+
+    Runs AFTER the checkpoint handler in the batch_end order (priority
+    100 > the checkpoint handlers' 0), so the force-save snapshots the
+    batch counter the periodic saves use. On a delivered preemption —
+    real signal via :func:`install`, programmatic :func:`request`, or an
+    injected ``preempt:deliver`` fault — it:
+
+    1. force-saves through ``ckpt_handler`` (its ``_save``: async
+       snapshot + background write),
+    2. fences (``manager.wait()``) so the generation COMMITS before the
+       process exits, and
+    3. sets ``stop_training`` — the fit loop exits after this batch.
+
+    Works with both :class:`~.checkpoint.ResilientCheckpointHandler` and
+    :class:`~.elastic.ElasticTrainingHandler` (anything with ``_save`` +
+    ``manager``)."""
+
+    def __init__(self, ckpt_handler=None, priority=100):
+        self.ckpt = ckpt_handler
+        self.priority = priority
+        self.stop_training = False
+        self.preempted = False
+        self._batch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.stop_training = False
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._batch += 1
+        from . import faults
+
+        plan = faults.get_plan()
+        if plan is not None:
+            marker = plan.check("preempt:deliver", {"batch": self._batch})
+            if isinstance(marker, dict) and marker.get("kind") == "preempt":
+                request(f"injected at batch {self._batch}")
+        if not requested() or self.stop_training:
+            return
+        self.preempted = True
+        if self.ckpt is not None:
+            self.ckpt._save(estimator)
+            self.ckpt.manager.wait()  # commit fence: never exit mid-write
+            _counters.incr("resilience.preempt_saves")
+        self.stop_training = True
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.preempted and _prof.ENABLED:
+            _prof.record_instant("resilience::preempt_stop", "resilience",
+                                 args={"batch": self._batch})

@@ -212,6 +212,7 @@ class Router:
         self._recent_lat = collections.deque(maxlen=256)
         self.counters = dict.fromkeys(_COUNTERS, 0)
         self._closed = False
+        self._draining = False
         for r in replicas:
             self.add_replica(r)
         self._supervisor = None
@@ -265,8 +266,10 @@ class Router:
         never dispatches a second copy. ``deadline_ms`` is the total
         fleet-side budget; failover re-dispatches carry the *remaining*
         budget, not a fresh one."""
-        if self._closed:
-            raise ServiceUnavailable(f"fleet {self.name!r} is closed")
+        if self._closed or self._draining:
+            raise ServiceUnavailable(
+                f"fleet {self.name!r} is "
+                f"{'closed' if self._closed else 'draining'}")
         deadline = (time.monotonic() + float(deadline_ms) / 1e3
                     if deadline_ms is not None and deadline_ms > 0
                     else None)
@@ -877,6 +880,33 @@ class Router:
             }
         out["replica"] = rep
         return out
+
+    def drain(self, timeout=30.0):
+        """Graceful preemption drain: stop admitting new requests
+        (submit raises :class:`ServiceUnavailable`), wait up to
+        ``timeout`` seconds for every in-flight request to settle
+        through the normal dispatch/failover machinery, then
+        :meth:`close`. Returns True when the fleet drained clean —
+        False means the timeout expired and the leftovers settled 503
+        through close(). This is the serving half of the SIGTERM
+        lifecycle (``resilience.preemption`` routes the signal here)."""
+        with self._lock:
+            if self._closed:
+                return True
+            self._draining = True
+        deadline = time.monotonic() + float(timeout)
+        drained = True
+        while True:
+            with self._lock:
+                pending = len(self._requests)
+            if pending == 0:
+                break
+            if time.monotonic() >= deadline:
+                drained = False
+                break
+            time.sleep(0.01)
+        self.close(timeout=max(0.1, deadline - time.monotonic()))
+        return drained
 
     def close(self, timeout=5.0):
         """Shut the fleet down: stop the supervisor, close every

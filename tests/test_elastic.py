@@ -529,6 +529,7 @@ def test_kill_and_reshard_resume_exact_parity(seed):
     assert violations == []
     assert row["steps_lost"] == 1  # exactly the killed batch
     assert row["dp_from"] == DP and row["dp_to"] == DP // 2
+    assert row["data_parity"] == "exact"  # iterator rewound with params
     assert row["recovery_wall_s"] is not None
     assert resilience_stats()["mesh_losses"] == 1
     assert resilience_stats()["elastic_restarts"] == 1

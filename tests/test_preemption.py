@@ -1,0 +1,550 @@
+"""Preemption-safe training tests (mxnet_tpu/resilience/preemption.py +
+the async half of mxnet_tpu/resilience/checkpoint.py + the resumable
+data-iterator state layer): async save commit fence + kill-mid-write
+last-good rollback + torn-write quarantine, backpressure/stall-budget
+accounting, sample-exact NDArrayIter / PrefetchIter /
+DataLoader+RandomSampler resume, the injected ``preempt:deliver``
+drill, real-SIGTERM graceful drain for both an Estimator fit loop and a
+Router with in-flight requests, and the end-to-end preempt-resume
+parity smoke (``tools/preempt_smoke.py``, the ``TIER1_PREEMPT`` leg)."""
+import os
+import signal
+import threading
+import time
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu import np as mnp
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.profiler import core as _prof
+from mxnet_tpu.resilience import (checkpoint as ckpt, counters, faults,
+                                  resilience_stats)
+from mxnet_tpu.resilience import preemption as pre
+from mxnet_tpu.resilience.preemption import PreemptionHandler
+
+
+@pytest.fixture(autouse=True)
+def _clean_preempt_state():
+    """No fault plan, no delivered preemption, no installed signal
+    handlers, fresh counters — before and after every test."""
+    faults.clear_plan()
+    pre.clear()
+    pre.uninstall()
+    _prof.reset()
+    counters.reset()
+    saved = {k: os.environ.pop(k, None)
+             for k in ("MXNET_FAULT_PLAN", "MXNET_CKPT_ASYNC",
+                       "MXNET_CKPT_STALL_BUDGET_MS",
+                       "MXNET_PREEMPT_GRACE_S")}
+    yield
+    faults.clear_plan()
+    pre.clear()
+    pre.uninstall()
+    _prof.reset()
+    counters.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _params():
+    rng = onp.random.RandomState(3)
+    return {"w": mx.nd.array(rng.randn(8, 4).astype("float32")),
+            "b": mx.nd.array(rng.randn(8).astype("float32"))}
+
+
+def _np(d):
+    return {k: v.asnumpy() if hasattr(v, "asnumpy") else onp.asarray(v)
+            for k, v in d.items()}
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing: stall/commit fence, kill mid-write, torn write
+# ---------------------------------------------------------------------------
+
+
+def test_async_save_matches_sync(tmp_path):
+    p = _params()
+    ckpt.save_checkpoint(str(tmp_path / "s.ckpt"), params=p,
+                         meta={"step": 1})
+    h = ckpt.save_checkpoint(str(tmp_path / "a.ckpt"), params=p,
+                             meta={"step": 1}, async_write=True)
+    assert h.stall_ms >= 0.0
+    assert h.join()
+    ps, ms = ckpt.load_checkpoint(str(tmp_path / "s.ckpt"))
+    pa, ma = ckpt.load_checkpoint(str(tmp_path / "a.ckpt"))
+    assert ms == ma
+    for k in ps:
+        assert onp.array_equal(_np(ps)[k], _np(pa)[k])
+    assert resilience_stats()["ckpt_async_saves"] == 1
+
+
+def test_manager_advertises_only_after_commit(tmp_path):
+    """COMMIT-then-advertise: while the background write is delayed, the
+    new generation must be invisible to list_steps/load_latest."""
+    m = ckpt.CheckpointManager(str(tmp_path), async_write=True)
+    m.save(1, params=_params())
+    assert m.wait()
+    faults.install_plan({"rules": [
+        {"site": "ckpt:write", "kind": "delay", "seconds": 0.25,
+         "times": 1}]})
+    m.save(2, params=_params())
+    assert m.list_steps() == [1]  # gen 2 not yet committed
+    assert m.wait()
+    assert m.list_steps() == [1, 2]
+
+
+def test_kill_during_async_save_loads_last_good(tmp_path):
+    """A die injected mid-async-write kills the writer thread, never the
+    trainer; the generation never lands and last-good loads."""
+    m = ckpt.CheckpointManager(str(tmp_path), async_write=True)
+    m.save(1, params=_params())
+    assert m.wait()
+    faults.install_plan({"rules": [
+        {"site": "ckpt:write", "kind": "die", "at": [0]}]})
+    m.save(2, params=_params())
+    with pytest.warns(RuntimeWarning, match="async checkpoint write"):
+        assert m.wait() is False  # the in-flight write died
+    faults.clear_plan()
+    assert m.list_steps() == [1]
+    meta = m.load_latest()
+    assert meta["step"] == 1
+    assert resilience_stats()["ckpt_async_failed"] == 1
+
+
+def test_torn_async_write_quarantined_rolls_back(tmp_path):
+    """A torn marker lands truncated bytes at the FINAL name — the CRC
+    check must quarantine that file and roll back to last-good."""
+    m = ckpt.CheckpointManager(str(tmp_path), async_write=True)
+    m.save(1, params=_params())
+    assert m.wait()
+    faults.install_plan({"rules": [
+        {"site": "ckpt:write", "kind": "torn", "at": [0]}]})
+    m.save(2, params=_params())
+    m.wait()
+    faults.clear_plan()
+    with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
+        meta = m.load_latest()
+    assert meta["step"] == 1
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".corrupt")]
+    assert resilience_stats()["checkpoints_quarantined"] == 1
+
+
+def test_sync_die_mid_write_propagates_and_leaves_last_good(tmp_path):
+    """On the SYNCHRONOUS path the same die is the SIGKILL analog: it
+    propagates to the caller and the half-written generation never
+    advertises."""
+    m = ckpt.CheckpointManager(str(tmp_path), async_write=False)
+    m.save(1, params=_params())
+    faults.install_plan({"rules": [
+        {"site": "ckpt:write", "kind": "die", "at": [0]}]})
+    with pytest.raises(faults.SimulatedWorkerDeath):
+        m.save(2, params=_params())
+    faults.clear_plan()
+    assert m.list_steps() == [1]
+    assert m.load_latest()["step"] == 1
+
+
+def test_sharded_die_mid_shard_sequence_keeps_last_good(tmp_path):
+    """Sharded async save killed after the first shard container: the
+    manifest never lands, so the generation is invisible and last-good
+    (a complete sharded save) still loads."""
+    p = _params()
+    m = ckpt.CheckpointManager(str(tmp_path), async_write=True)
+    m.save(1, params=p, sharded=True, num_shards=2)
+    assert m.wait()
+    faults.install_plan({"rules": [
+        {"site": "ckpt:write", "kind": "die", "at": [1]}]})  # 2nd shard
+    m.save(2, params=p, sharded=True, num_shards=2)
+    with pytest.warns(RuntimeWarning, match="async checkpoint write"):
+        assert m.wait() is False
+    faults.clear_plan()
+    assert m.list_steps() == [1]
+    got, meta = ckpt.load_checkpoint(m._path(1))
+    assert meta["step"] == 1
+    for k in p:
+        assert onp.array_equal(_np(p)[k], _np(got)[k])
+
+
+def test_backpressure_counter_when_write_outpaced(tmp_path):
+    """save N+1 arriving while N is still writing must warn + count —
+    the operator signal that saves are outpacing checkpoint I/O."""
+    m = ckpt.CheckpointManager(str(tmp_path), async_write=True)
+    faults.install_plan({"rules": [
+        {"site": "ckpt:write", "kind": "delay", "seconds": 0.2,
+         "times": 1}]})
+    m.save(1, params=_params())
+    with pytest.warns(RuntimeWarning, match="backpressure"):
+        m.save(2, params=_params())
+    assert m.wait()
+    assert resilience_stats()["ckpt_backpressure"] == 1
+    assert m.list_steps() == [1, 2]
+
+
+def test_stall_budget_overrun_warns(tmp_path):
+    os.environ["MXNET_CKPT_STALL_BUDGET_MS"] = "0.000001"
+    with pytest.warns(RuntimeWarning, match="stall"):
+        h = ckpt.save_checkpoint(str(tmp_path / "a.ckpt"),
+                                 params=_params(), meta={"step": 1},
+                                 async_write=True)
+    assert h.join()
+    assert resilience_stats()["ckpt_stall_overruns"] == 1
+
+
+def test_manager_async_default_from_env(tmp_path):
+    os.environ["MXNET_CKPT_ASYNC"] = "1"
+    m = ckpt.CheckpointManager(str(tmp_path))
+    m.save(1, params=_params())
+    assert m.wait()
+    assert resilience_stats()["ckpt_async_saves"] == 1
+
+
+# ---------------------------------------------------------------------------
+# resumable data iterators: sample-exact resume
+# ---------------------------------------------------------------------------
+
+
+def _epoch_indices(it):
+    out = []
+    while True:
+        try:
+            b = it.next()
+        except StopIteration:
+            return out
+        out.append([int(i) for i in b.index])
+
+
+@pytest.mark.parametrize("cut", [1, 3, 5])
+def test_ndarrayiter_resume_sample_exact(cut):
+    x = onp.arange(48, dtype="float32").reshape(24, 2)
+    onp.random.seed(11)
+    it = mx.io.NDArrayIter(x, batch_size=4, shuffle=True)
+    ref = _epoch_indices(it)
+
+    onp.random.seed(11)
+    it1 = mx.io.NDArrayIter(x, batch_size=4, shuffle=True)
+    head = [[int(i) for i in it1.next().index] for _ in range(cut)]
+    state = it1.state_dict()
+
+    onp.random.seed(999)  # fresh draw must NOT matter
+    it2 = mx.io.NDArrayIter(x, batch_size=4, shuffle=True)
+    it2.load_state_dict(state)
+    tail = _epoch_indices(it2)
+    assert head + tail == ref
+    assert sorted(i for b in head + tail for i in b) == list(range(24))
+
+
+def test_ndarrayiter_state_rejects_foreign_dataset():
+    it = mx.io.NDArrayIter(onp.zeros((24, 2), "float32"), batch_size=4)
+    state = it.state_dict()
+    small = mx.io.NDArrayIter(onp.zeros((8, 2), "float32"), batch_size=4)
+    with pytest.raises(MXNetError, match="different dataset"):
+        small.load_state_dict(state)
+
+
+@pytest.mark.parametrize("cut", [2, 4])
+def test_prefetchiter_resume_sample_exact(cut):
+    x = onp.arange(64, dtype="float32").reshape(32, 2)
+
+    def make(seed):
+        onp.random.seed(seed)
+        return mx.io.PrefetchIter(
+            mx.io.NDArrayIter(x, batch_size=4, shuffle=True),
+            num_prefetch=2)
+
+    ref = _epoch_indices(make(21))
+    it1 = make(21)
+    head = [[int(i) for i in it1.next().index] for _ in range(cut)]
+    state = it1.state_dict()
+    it2 = make(777)
+    it2.load_state_dict(state)
+    tail = _epoch_indices(it2)
+    assert head + tail == ref
+    assert sorted(i for b in head + tail for i in b) == list(range(32))
+
+
+@pytest.mark.parametrize("cut", [1, 3])
+def test_dataloader_random_sampler_resume_sample_exact(cut):
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(mx.nd.array(
+        onp.arange(20, dtype="float32").reshape(10, 2)))
+
+    def make(seed):
+        onp.random.seed(seed)
+        return DataLoader(ds, batch_size=2, shuffle=True)
+
+    ref = [b.asnumpy() for b in make(31)]
+    dl1 = make(31)
+    it = iter(dl1)
+    head = [next(it).asnumpy() for _ in range(cut)]
+    state = dl1.state_dict()
+    dl2 = make(888)
+    dl2.load_state_dict(state)
+    tail = [b.asnumpy() for b in dl2]
+    got = head + tail
+    assert len(got) == len(ref)
+    for a, b in zip(got, ref):
+        assert onp.array_equal(a, b)
+    seen = sorted(float(v) for b in got for v in b.asnumpy().ravel()
+                  ) if hasattr(got[0], "asnumpy") else sorted(
+        float(v) for b in got for v in b.ravel())
+    assert seen == sorted(float(v) for v in onp.arange(20, dtype="float32"))
+
+
+def test_dataloader_state_rejects_foreign_type():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    dl = DataLoader(ArrayDataset(mx.nd.array(onp.zeros((4, 2), "f"))),
+                    batch_size=2)
+    with pytest.raises(MXNetError, match="DataLoader"):
+        dl.load_state_dict({"type": "NDArrayIter", "cursor": 0})
+
+
+def test_datastate_rides_in_checkpoint_and_restores(tmp_path):
+    x = onp.arange(48, dtype="float32").reshape(24, 2)
+    onp.random.seed(5)
+    it = mx.io.NDArrayIter(x, batch_size=4, shuffle=True)
+    it.next(), it.next()
+    ckpt.save_checkpoint(str(tmp_path / "c.ckpt"), params=_params(),
+                         meta={"step": 2}, data_state=it.state_dict())
+    rest_ref = _epoch_indices(it)
+
+    onp.random.seed(444)
+    it2 = mx.io.NDArrayIter(x, batch_size=4, shuffle=True)
+    ckpt.load_checkpoint(str(tmp_path / "c.ckpt"), data_iter=it2)
+    assert _epoch_indices(it2) == rest_ref
+
+
+def test_missing_datastate_section_warns(tmp_path):
+    ckpt.save_checkpoint(str(tmp_path / "c.ckpt"), params=_params(),
+                         meta={"step": 1})
+    it = mx.io.NDArrayIter(onp.zeros((8, 2), "f"), batch_size=4)
+    with pytest.warns(RuntimeWarning, match="no datastate section"):
+        ckpt.load_checkpoint(str(tmp_path / "c.ckpt"), data_iter=it)
+
+
+# ---------------------------------------------------------------------------
+# preemption: injected drill, real SIGTERM, serving drain
+# ---------------------------------------------------------------------------
+
+
+def _make_batches(n=8, batch=4, dim=3, seed=0):
+    rng = onp.random.RandomState(seed)
+    return [(mnp.array(rng.randn(batch, dim).astype("float32")),
+             mnp.array(rng.randn(batch, 1).astype("float32")))
+            for _ in range(n)]
+
+
+def _fresh_estimator(seed=7):
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    net(mnp.ones((4, 3)))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    return Estimator(net, gluon.loss.L2Loss(), trainer=tr,
+                     train_metrics=[gluon.metric.MAE()])
+
+
+def test_request_is_idempotent():
+    pre.request("first")
+    pre.request("second")
+    assert pre.requested()
+    assert pre.reason() == "first"
+    assert resilience_stats()["preemptions"] == 1
+    pre.clear()
+    assert not pre.requested() and pre.reason() is None
+
+
+def test_install_uninstall_restores_handlers():
+    prev = signal.getsignal(signal.SIGTERM)
+    pre.install()
+    assert signal.getsignal(signal.SIGTERM) is pre._handler
+    pre.install()  # idempotent
+    pre.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_injected_preempt_stops_after_current_batch(tmp_path):
+    """The deterministic drill: a preempt:deliver rule at batch k stops
+    training after that batch with a committed force-save carrying the
+    batch counter."""
+    est = _fresh_estimator()
+    rh = ckpt.ResilientCheckpointHandler(str(tmp_path), batch_period=None,
+                                         epoch_period=None,
+                                         async_write=True)
+    ph = PreemptionHandler(ckpt_handler=rh)
+    faults.install_plan({"rules": [
+        {"site": "preempt:deliver", "kind": "preempt", "at": [2]}]})
+    batches = _make_batches(n=8)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        est.fit(batches, batches=8, event_handlers=[rh, ph])
+    assert ph.preempted
+    assert rh.current_batch == 3  # stopped after the delivered batch
+    meta = rh.manager.load_latest()
+    assert meta["batch"] == 3
+    st = resilience_stats()
+    assert st["preemptions"] == 1 and st["preempt_saves"] == 1
+
+
+def test_preemption_handler_without_ckpt_still_stops():
+    est = _fresh_estimator()
+    ph = PreemptionHandler()
+    pre.request("unit")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        est.fit(_make_batches(n=6), batches=6, event_handlers=[ph])
+    assert ph.preempted and ph.stop_training
+    assert ph._batch == 1  # stopped after the first batch
+
+
+def test_sigterm_drains_estimator_fit_loop(tmp_path):
+    """A REAL SIGTERM mid-fit: the handler finishes the current batch,
+    force-saves, stops the loop cleanly, and the background drain thread
+    runs (counted) — no exit, because exit_after_drain defaults False."""
+    from mxnet_tpu.gluon.contrib.estimator.event_handler import BatchEnd
+
+    class _Kill(BatchEnd):
+        priority = -9999  # before the PreemptionHandler this batch
+
+        def batch_end(self, estimator, *a, **kw):
+            if not pre.requested():
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    est = _fresh_estimator()
+    rh = ckpt.ResilientCheckpointHandler(str(tmp_path), batch_period=None,
+                                         epoch_period=None,
+                                         async_write=True)
+    ph = PreemptionHandler(ckpt_handler=rh)
+    pre.install()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            est.fit(_make_batches(n=8), batches=8,
+                    event_handlers=[_Kill(), rh, ph])
+    finally:
+        pre.uninstall()
+    assert ph.preempted
+    assert ph._batch == 1  # stopped after the batch the signal landed in
+    assert pre.reason() == f"signal {int(signal.SIGTERM)}"
+    assert rh.manager.load_latest()["batch"] == 1
+    deadline = time.monotonic() + 5.0
+    while counters.get("resilience.preempt_drains") < 1:
+        assert time.monotonic() < deadline, "drain thread never ran"
+        time.sleep(0.01)
+
+
+def test_sigterm_drains_router_in_flight(tmp_path):
+    """A REAL SIGTERM with a Router holding an in-flight request: the
+    drain lets it settle, then refuses new submissions."""
+    from mxnet_tpu.serve import Replica, Router, ServiceUnavailable
+
+    gate = threading.Event()
+
+    def runner(payloads):
+        gate.wait(10)
+        return [p * 2 for p in payloads]
+
+    r = Router([Replica(runner, index=0, max_batch_size=4,
+                        timeout_ms=2.0, max_queue=64)],
+               name="preempt-drain", probe_ms=0.0)
+    pre.install()
+    try:
+        fut = r.submit(21)
+        threading.Timer(0.1, gate.set).start()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 10.0
+        while counters.get("resilience.preempt_drains") < 1:
+            assert time.monotonic() < deadline, "drain never completed"
+            time.sleep(0.01)
+        assert fut.result(timeout=5) == 42  # in-flight settled, not shed
+        _wait_closed(r, deadline)
+        with pytest.raises(ServiceUnavailable):
+            r.submit(1)
+    finally:
+        pre.uninstall()
+        r.close()
+
+
+def _wait_closed(router, deadline):
+    while not router._closed:
+        assert time.monotonic() < deadline, "router never closed"
+        time.sleep(0.01)
+
+
+def test_router_drain_direct_settles_and_refuses():
+    from mxnet_tpu.serve import Replica, Router, ServiceUnavailable
+
+    gate = threading.Event()
+
+    def runner(payloads):
+        gate.wait(10)
+        return [p + 1 for p in payloads]
+
+    r = Router([Replica(runner, index=0, max_batch_size=4,
+                        timeout_ms=2.0, max_queue=64)],
+               name="drain-direct", probe_ms=0.0)
+    try:
+        fut = r.submit(1)
+        threading.Timer(0.05, gate.set).start()
+        assert r.drain(timeout=10.0) is True
+        assert fut.result(timeout=1) == 2
+        with pytest.raises(ServiceUnavailable):
+            r.submit(2)
+    finally:
+        r.close()
+
+
+def test_register_drainable_weakref_and_dedup():
+    calls = []
+
+    class D:
+        def drain(self, timeout=None):
+            calls.append(timeout)
+            return True
+
+    # earlier tests may leave dead-but-uncollected routers in the fleet
+    # WeakSet, so absolute drain counts are noisy — assert on OUR
+    # drainable's observed calls only
+    d = D()
+    pre.register_drainable(d)
+    os.environ["MXNET_PREEMPT_GRACE_S"] = "3.5"
+    assert pre.drain_serving() >= 1
+    assert calls == [3.5]  # budget came from MXNET_PREEMPT_GRACE_S
+    del d
+    import gc
+
+    gc.collect()
+    pre.drain_serving()
+    assert calls == [3.5]  # collected object silently dropped
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: preempt mid-epoch, resume, exact parity (the tier-1 leg)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.integration
+def test_preempt_resume_exact_parity_smoke():
+    """tools/preempt_smoke.py as a pytest surface: injected preemption
+    mid-epoch, async force-save, resume in a fresh estimator/iterator —
+    sample sequence exactly-once across the cut, params bitwise vs the
+    uninterrupted reference."""
+    from tools.preempt_smoke import run_preempt_smoke
+
+    violations, row = run_preempt_smoke(seed=11)
+    assert violations == []
+    assert row["param_parity"] == "bitwise"
+    assert row["data_parity"] == "exact"
+    assert row["stall_ms"] is not None

@@ -124,9 +124,41 @@ def run_kill_reshard(seed=7, n_batches=12, say=lambda m: None):
             os.environ["MXNET_ELASTIC"] = prev_elastic
 
 
+class _ShadowAdvance:
+    """BatchEnd handler consuming one batch of a shadow index iterator
+    per training batch — runs BEFORE the ElasticTrainingHandler's save
+    (priority -2000 < -1400), so each checkpoint's datastate records the
+    position the params correspond to. Skips the absorbed (lost) batch:
+    its samples rewound with the restore and are re-served on the next
+    real batch, keeping applied-sample delivery exactly-once."""
+
+    priority = -2000
+
+    def __init__(self, it, eh=None):
+        self.it = it
+        self.eh = eh
+        self.consumed = []
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if self.eh is not None and getattr(self.eh, "_just_restarted",
+                                           False):
+            return
+        b = self.it.next()
+        self.consumed.extend(
+            int(v) for v in b.data[0].asnumpy().ravel().tolist())
+
+
+def _make_shadow_advance(it, eh=None):
+    from mxnet_tpu.gluon.contrib.estimator.event_handler import BatchEnd
+
+    cls = type("_ShadowAdvanceH", (_ShadowAdvance, BatchEnd), {})
+    return cls(it, eh)
+
+
 def _run_kill_reshard_inner(seed, n_batches, say):
     import tempfile
 
+    import mxnet_tpu as mx
     from mxnet_tpu.parallel import mesh as mesh_mod
     from mxnet_tpu.resilience import checkpoint as ckpt, faults
     from mxnet_tpu.resilience.elastic import ElasticTrainingHandler
@@ -148,16 +180,25 @@ def _run_kill_reshard_inner(seed, n_batches, say):
     batches = _make_batches(n_batches, seed)
     d = tempfile.mkdtemp(prefix="elastic_soak_")
     t0 = time.perf_counter()
+    # shadow data iterator: one index per sample, consumed in lockstep
+    # with the training batches and checkpointed through the handler's
+    # data_iter — the kill leg asserts DATA-POSITION parity alongside
+    # the bitwise param parity
+    idx_all = np.arange(n_batches * BATCH, dtype="float32").reshape(-1, 1)
     try:
+        shadow = mx.io.NDArrayIter(idx_all, batch_size=BATCH)
         net, tr, est = _fresh(ctxs8, seed)
         eh = ElasticTrainingHandler(d, batch_period=1,
-                                    max_keep=n_batches + 2)
+                                    max_keep=n_batches + 2,
+                                    data_iter=shadow)
+        advance = _make_shadow_advance(shadow, eh)
         faults.install_plan({"seed": seed, "rules": [
             {"site": "kvstore:allreduce", "kind": "chip_loss",
              "replica": kill_replica, "at": [kill_hit]}]})
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
-            est.fit(batches, batches=n_batches, event_handlers=[eh])
+            est.fit(batches, batches=n_batches,
+                    event_handlers=[advance, eh])
     except Exception as exc:  # noqa: BLE001 — taxonomy violation
         violations.append(f"kill: training raised {type(exc).__name__}: "
                           f"{exc}")
@@ -184,12 +225,15 @@ def _run_kill_reshard_inner(seed, n_batches, say):
     ctxs4 = mesh_mod.mesh_contexts(m4)
     try:
         net2, tr2, est2 = _fresh(ctxs4, seed + 1000)  # init must not matter
+        shadow_ref = mx.io.NDArrayIter(idx_all, batch_size=BATCH)
+        advance_ref = _make_shadow_advance(shadow_ref)
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
             ckpt.load_checkpoint(eh.manager._path(kill_step), net=net2,
-                                 trainer=tr2)
+                                 trainer=tr2, data_iter=shadow_ref)
             est2.fit(batches[kill_step + 1:],
-                     batches=n_batches - kill_step - 1)
+                     batches=n_batches - kill_step - 1,
+                     event_handlers=[advance_ref])
     except Exception as exc:  # noqa: BLE001
         violations.append(
             f"kill: dp4 reference run raised {type(exc).__name__}: {exc}")
@@ -202,13 +246,39 @@ def _run_kill_reshard_inner(seed, n_batches, say):
             violations.append(
                 f"kill: param {k} differs from the uninterrupted dp4 "
                 "reference (silent divergence)")
+    # data-position parity: the reshard rewound the data iterator in
+    # lockstep with the params — applied samples are served exactly once
+    # (the lost step's batch re-served after recovery, nothing replayed
+    # or skipped), and the resumed run ends at the same position a clean
+    # dp4 continuation restored from the same checkpoint ends at
+    data_parity = True
+    expect = list(range((n_batches - 1) * BATCH))
+    if advance.consumed != expect:
+        data_parity = False
+        violations.append(
+            "kill: elastic run consumed samples "
+            f"{advance.consumed[:6]}...{advance.consumed[-3:]} — not the "
+            "exactly-once epoch sequence (replay or skip across the "
+            "reshard)")
+    if advance.consumed[kill_step * BATCH:] != advance_ref.consumed:
+        data_parity = False
+        violations.append(
+            "kill: post-checkpoint sample stream differs from the clean "
+            "dp4 reference restored from the same checkpoint")
+    if shadow.state_dict() != shadow_ref.state_dict():
+        data_parity = False
+        violations.append(
+            f"kill: final data position {shadow.state_dict()['cursor']} "
+            f"!= reference {shadow_ref.state_dict()['cursor']}")
     row = {"steps_lost": eh.stats["steps_lost"],
            "recovery_wall_s": eh.stats["last_recovery_s"],
            "dp_from": DP, "dp_to": DP // 2,
            "killed_replica": kill_replica, "killed_step": kill_step,
+           "data_parity": "exact" if data_parity else "DIVERGED",
            "leg_wall_s": wall}
     say(f"kill leg: steps_lost={row['steps_lost']} "
-        f"recovery={row['recovery_wall_s'] * 1e3:.0f}ms parity=EXACT")
+        f"recovery={row['recovery_wall_s'] * 1e3:.0f}ms parity=EXACT "
+        f"data={row['data_parity']}")
     return violations, row
 
 

@@ -293,4 +293,19 @@ if [[ "${TIER1_ELASTIC3D:-0}" != "0" ]]; then
         rc=$e3d_rc
     fi
 fi
+# Preemption smoke (TIER1_PREEMPT=1 to enable): interrupt a training
+# epoch mid-way via the deterministic preempt:deliver site (the
+# SIGTERM-equivalent), force-save through the async checkpoint writer,
+# resume in a fresh estimator/iterator — asserts the epoch's sample
+# sequence is consumed exactly once across the cut and the final params
+# land bitwise on the uninterrupted reference. The assertion-level suite
+# is tests/test_preemption.py.
+if [[ "${TIER1_PREEMPT:-0}" != "0" ]]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python tools/preempt_smoke.py --seeds "${TIER1_PREEMPT_SEEDS:-1}"
+    preempt_rc=$?
+    if [[ "$rc" -eq 0 && "$preempt_rc" -ne 0 ]]; then
+        rc=$preempt_rc
+    fi
+fi
 exit "$rc"
