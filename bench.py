@@ -1427,6 +1427,145 @@ def bench_llama_decode(max_new=32, reps=3, batch=16, spec_k=4):
     })
 
 
+def bench_llama_multistep_decode(max_new=32, reps=2, batch=16, spec_k=4):
+    """Serving row (tentpole PR 19): the device-side multi-step decode
+    ladder — the same 12L llama serve config, prompts, and (batch, seq)
+    bucket as ``bench_llama_decode``, but the token loop runs as one
+    compiled ``while_loop`` super-step of N decode iterations per host
+    visit (``MXNET_SERVE_MULTISTEP`` / ``MXNET_SERVE_DECODE_STEPS``):
+
+    * ``baseline``/``pallas``/``int8`` x N in {1, 4, 8} — each multistep
+      rung must be greedy token-identical to its single-step Generator,
+      compile exactly one extra signature (the super-step), and never
+      recompile
+    * ``spec`` — SpeculativeGenerator with the whole draft-propose phase
+      of a round as ONE draft super-step (2 host visits per round
+      instead of k+2), stacked on the int8 rung
+
+    ``host_visits_per_token`` is the ladder's reason to exist: at N=8 a
+    32-token row takes ~4 device visits instead of ~31, and the row
+    asserts visits/token <= 1/4 AND tokens/s strictly above the same
+    path's single-step rate — if killing the host round-trip doesn't
+    show up in the rate, the super-step is broken, fail loudly."""
+    import numpy as onp
+
+    from mxnet_tpu import numpy as mnp
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.serve import Generator, SpeculativeGenerator
+
+    target = get_llama("llama_serve_12l_test")
+    target.initialize()
+    for blk in target._blocks[2:]:
+        for p in (blk.attention.o_proj.weight, blk.ffn.down_proj.weight):
+            p.set_data(mnp.zeros(p.shape, dtype="float32"))
+    draft = get_llama("llama_serve_12l_test", num_layers=2)
+    draft.initialize()
+    tparams = dict(target.collect_params().items())
+    for name, p in draft.collect_params().items():
+        p.set_data(tparams[name].data())
+
+    rng = onp.random.RandomState(0)
+    prompts = [rng.randint(1, 500, size=int(rng.randint(4, 13))).tolist()
+               for _ in range(batch)]
+
+    def measure(gen, ref_outs=None, label=""):
+        warm = gen.warmup()
+        best, hv, outs = 0.0, None, None
+        for _ in range(reps):
+            outs, info = gen.generate(prompts, max_new_tokens=max_new)
+            if ref_outs is not None:
+                assert outs == ref_outs, (
+                    f"{label}: multistep greedy output diverged from "
+                    f"the single-step reference")
+            # steady-state: each row's first token rides prefill wall
+            toks = sum(len(o) for o in outs) - len(outs)
+            rate = toks / (info["decode_ms"] / 1e3)
+            best = max(best, rate)
+            if "decode_visits" in info:
+                hv = info["decode_visits"] / max(toks, 1)
+        gen.assert_no_recompiles()
+        return round(best, 1), hv, outs, round(warm["wall_s"], 2)
+
+    steps_ladder = (1, 4, 8)
+    ladder, visits, warm_s, refs = {}, {}, {}, {}
+    for path in ("baseline", "pallas", "int8"):
+        single = Generator(target, max_seq=64, batch_buckets=(batch,),
+                           prompt_buckets=(16,),
+                           name=f"llama_ms_{path}_single",
+                           decode_path=path, multistep=False)
+        rate1, _, ref_outs, w = measure(single, label=f"{path}/single")
+        ladder[path] = {"single": rate1}
+        visits[path] = {"single": 1.0}
+        warm_s[f"{path}_single"] = w
+        refs[path] = ref_outs
+        for n in steps_ladder:
+            gen = Generator(target, max_seq=64, batch_buckets=(batch,),
+                            prompt_buckets=(16,),
+                            name=f"llama_ms_{path}_n{n}",
+                            decode_path=path, multistep=True,
+                            decode_steps=n)
+            rate, hv, _, w = measure(gen, ref_outs=ref_outs,
+                                     label=f"{path}/N={n}")
+            ladder[path][f"n{n}"] = rate
+            visits[path][f"n{n}"] = round(hv, 4)
+            warm_s[f"{path}_n{n}"] = w
+        assert visits[path]["n8"] <= 0.25, (
+            f"{path}: N=8 host_visits_per_token "
+            f"{visits[path]['n8']:.3f} > 1/4 — the super-step is not "
+            f"amortizing the host round-trip")
+        # the headline rung (int8) must be STRICTLY faster than
+        # single-step; the others get the same 2% run-to-run noise
+        # tolerance as bench_llama_decode's monotone check
+        floor = ladder[path]["single"] * (1.0 if path == "int8" else 0.98)
+        assert ladder[path]["n8"] > floor, (
+            f"{path}: N=8 rate {ladder[path]['n8']} tok/s not above the "
+            f"single-step rate {ladder[path]['single']} — killing the "
+            f"host round-trip must show up in throughput")
+
+    # spec rung: draft-round-as-super-step, stacked on int8. Greedy
+    # speculative decoding is defined by emitting the target's greedy
+    # sequence, so the int8 single-step reference is its identity oracle.
+    spec = SpeculativeGenerator(
+        target, draft, k=spec_k, max_seq=64, batch_buckets=(batch,),
+        prompt_buckets=(16,), name="llama_ms_spec", decode_path="int8",
+        multistep=True)
+    spec_warm = spec.warmup()
+    spec_best, spec_info = 0.0, {}
+    for _ in range(reps):
+        outs, info = spec.generate(prompts, max_new_tokens=max_new)
+        assert outs == refs["int8"], (
+            "spec: draft-super-step output diverged from the int8 "
+            "single-step greedy reference")
+        toks = sum(len(o) for o in outs) - len(outs)
+        spec_best = max(spec_best, toks / (info["decode_ms"] / 1e3))
+        spec_info = info
+    spec.assert_no_recompiles()
+    ladder["spec"] = {"single": ladder["int8"]["single"],
+                      "n8": round(spec_best, 1)}
+    warm_s["spec"] = round(spec_warm["wall_s"], 2)
+
+    speedup_vs_single = {
+        p: round(ladder[p]["n8"] / ladder[p]["single"], 2)
+        for p in ("baseline", "pallas", "int8")}
+    return _emit({
+        "metric": "llama_multistep_decode_tokens_s",
+        "value": ladder["int8"]["n8"],
+        "unit": "tokens/s",
+        "vs_baseline": round(ladder["int8"]["n8"]
+                             / ladder["baseline"]["single"], 2),
+        "decode_steps": 8,
+        "ladder": ladder,
+        "host_visits_per_token": visits["int8"]["n8"],
+        "visits": visits,
+        "speedup_vs_single": speedup_vs_single,
+        "acceptance_rate": round(spec_info.get("acceptance_rate", 0.0), 3),
+        "spec_k": spec_k,
+        "batch": batch,
+        "max_new_tokens": max_new,
+        "warmup_s": warm_s,
+    })
+
+
 def bench_llama_continuous_batching(reps=2):
     """Serving row (serve.scheduler): continuous batching vs the static
     bucket ladder on the same 12L llama serve config and the same mixed
@@ -1753,6 +1892,7 @@ def main():
                      ("bert", bench_bert_train),
                      ("bert_fused", bench_bert_train_fused),
                      ("llama_decode", bench_llama_decode),
+                     ("llama_multistep_decode", bench_llama_multistep_decode),
                      ("llama_continuous_batching",
                       bench_llama_continuous_batching),
                      ("llama_prefix_cache", bench_llama_prefix_cache),

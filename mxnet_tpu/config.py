@@ -356,6 +356,21 @@ register_flag(
     "(serve.SpeculativeGenerator's default k): each round costs k draft "
     "steps plus one k+1-wide target verify step.", int)
 register_flag(
+    "MXNET_SERVE_MULTISTEP", False,
+    "Run the decode loop as device-side multi-step super-steps: one "
+    "compiled lax.while_loop executes up to MXNET_SERVE_DECODE_STEPS "
+    "decode iterations (model forward + in-trace sampling + EOS/budget "
+    "masking) per host visit, and the host settles the returned "
+    "(slots, N) token block in one pass. Off (default): one host visit "
+    "per token (the PR-10 behavior).", _bool)
+register_flag(
+    "MXNET_SERVE_DECODE_STEPS", 8,
+    "Decode iterations per multi-step super-step (the compiled loop's "
+    "static trip-count ceiling N). The host can lower the per-call "
+    "limit down to 1 through the same executable — tight deadlines "
+    "auto-degrade to single-step so 504 retirement latency stays "
+    "bounded by one iteration.", int)
+register_flag(
     "MXNET_FLEET_HEDGE_MS", 0.0,
     "Hedged-retry delay for serve.fleet.Router: an *interactive* request "
     "dispatched to a replica flagged straggling gets a second (hedge) "

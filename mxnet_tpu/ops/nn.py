@@ -1103,6 +1103,71 @@ def gather_positions(data, indices):
     return _apply(f, (data, indices), name="gather_positions")
 
 
+def sample_step(logits, temperature, top_k, seeds, positions, key_bits):
+    """In-trace next-token sampling for the multi-step decode super-step
+    (``serve.generate._MultiStepForward``).
+
+    ``logits`` (B, V) f32; per-row ``temperature`` (B,) f32 (<= 0 means
+    greedy argmax — matching ``serve.generate.sample_tokens``), ``top_k``
+    (B,) int32 (0 or >= V means no truncation), ``seeds`` (B,) int32 (one
+    stream per serving slot) and ``positions`` (B,) int32 (the absolute
+    decode position being sampled). ``key_bits`` is a (2,) uint32 raw
+    threefry2x32 key — an ordinary traced input, NOT a baked constant, so
+    one compiled executable serves every reseed.
+
+    Keying is counter-based, not stateful: row ``b``'s key is
+    ``fold_in(fold_in(key_bits, seeds[b]), positions[b])`` — a pure
+    function of (base, slot stream, position). That is what makes the
+    token stream invariant to super-step boundaries: running N=8
+    iterations per compiled loop or degrading the same executable to
+    N=1 draws the identical key for every position, so sampled output
+    is token-identical across ``steps_limit`` choices (a stateful
+    ``mx.random`` draw would advance once per TRACE, not per iteration,
+    and every loop iteration would reuse one key).
+
+    Returns (B,) int32 sampled token ids.
+    """
+
+    def f(lg, temp, tk, sd, pos, kb):
+        import jax
+
+        jnp = _jnp()
+        v = lg.shape[-1]
+        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        base = jax.random.wrap_key_data(kb.astype(jnp.uint32),
+                                        impl="threefry2x32")
+
+        def row(l, t, k, s, p):
+            key = jax.random.fold_in(
+                jax.random.fold_in(base, s.astype(jnp.int32)),
+                p.astype(jnp.int32))
+            scaled = l / jnp.maximum(t, 1e-6)
+            # per-row dynamic top-k: threshold at the k-th largest logit
+            # (descending sort; same tie semantics as sample_tokens'
+            # static jax.lax.top_k truncation — values >= kth survive)
+            srt = jnp.sort(scaled)[::-1]
+            kth = srt[jnp.clip(k, 1, v) - 1]
+            keep = jnp.where((k > 0) & (k < v), scaled >= kth, True)
+            return jax.random.categorical(
+                key, jnp.where(keep, scaled, -jnp.inf))
+
+        def drawn(_):
+            sampled = jax.vmap(row)(
+                lg, temp.astype(jnp.float32), tk.astype(jnp.int32),
+                sd, pos).astype(jnp.int32)
+            return jnp.where(temp > 0.0, sampled, greedy)
+
+        # lax.cond, not where: an all-greedy batch (the bench rungs, every
+        # temperature-0 request mix) must not pay the per-row vocab sort +
+        # categorical draw on its decode critical path — the sampled
+        # branch only executes when some lane actually wants it
+        return jax.lax.cond(jnp.any(temp > 0.0), drawn,
+                            lambda _: greedy, 0)
+
+    return _apply(f, (logits, temperature, top_k, seeds, positions,
+                      key_bits), name="sample_step")
+
+
 # ---------------------------------------------------------------------------
 # Paged KV-cache ops (mxnet_tpu.serve.kv_blocks / serve.scheduler)
 #

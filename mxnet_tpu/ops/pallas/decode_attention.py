@@ -26,6 +26,18 @@ through the Pallas interpreter on CPU. Decode-shaped calls (T == 1) that
 land on the XLA fallback additionally record a flight-recorder note and
 bump the ``serve.decode_fallbacks`` counter so silent slow-path serving is
 diagnosable from ``/metrics``.
+
+Loop-carried ``start_pos`` (multi-step decode, PR 19): every input —
+including ``start_pos`` — may be a traced value inside a
+``lax.while_loop`` body, advancing per iteration while the kernel stays
+the SAME compiled program. The contract that makes this work: routing
+(``_supports_pallas``) depends only on static shapes/dtypes/platform,
+never on start_pos values; the valid-length mask and the block-skip
+predicate consume start_pos as data (SMEM scalars / in-kernel compares);
+and the path/fallback records fire at TRACE time, so one super-step
+compile records exactly one path decision no matter how many iterations
+the loop later runs. ``reset_fallbacks()`` rezeroes the cumulative
+counter for tests/bench rungs that assert a clean kernel run.
 """
 from __future__ import annotations
 
@@ -81,6 +93,15 @@ _FALLBACKS = 0
 
 def fallback_count() -> int:
     return _FALLBACKS
+
+
+def reset_fallbacks() -> None:
+    """Rezero the cumulative decode-fallback counter (tests / bench
+    rungs that assert a specific trace produced zero fallbacks — the
+    counter is trace-time, so differencing around a cached replay would
+    always read 0 even on a fallback path)."""
+    global _FALLBACKS
+    _FALLBACKS = 0
 
 
 def _record_fallback(reason, shape):

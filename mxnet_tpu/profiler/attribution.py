@@ -174,20 +174,27 @@ class Ledger:
             window = config.get("MXNET_ATTRIBUTION_WINDOW")
         self.name = name
         self._lock = threading.Lock()
-        # (host_ms, dispatch_ms, device_ms, wait_ms, live)
+        # (host_ms, dispatch_ms, device_ms, wait_ms, live, tokens)
         self._rows = collections.deque(maxlen=int(window))
         self._sched_ms = collections.deque(maxlen=int(window))
         self.steps = 0
         _instances.add(self)
 
     def observe_step(self, host_ms, dispatch_ms, device_ms, wait_ms,
-                     live=1):
-        """One decode iteration's exclusive four-phase split (ms) and its
-        live-slot count (= tokens the step produced)."""
+                     live=1, tokens=None):
+        """One decode host visit's exclusive four-phase split (ms), its
+        live-slot count, and the tokens it produced. In the classic
+        single-step loop one visit is one iteration and ``tokens`` can
+        stay ``None`` (it defaults to ``live``: every live slot emits
+        one token). A multi-step super-step passes ``tokens`` explicitly
+        — host/dispatch/wait are real per-visit costs (paid once for the
+        whole block), while device time covers N iterations, so
+        ``device_ms_per_token`` must divide by tokens, not visits."""
         with self._lock:
             self._rows.append((float(host_ms), float(dispatch_ms),
                                float(device_ms), float(wait_ms),
-                               int(live)))
+                               int(live),
+                               int(live if tokens is None else tokens)))
             self.steps += 1
 
     def observe_schedule(self, ms):
@@ -199,12 +206,12 @@ class Ledger:
     def _totals(self):
         host = dispatch = device = wait = 0.0
         tokens = 0
-        for h, di, de, w, live in self._rows:
+        for h, di, de, w, live, tok in self._rows:
             host += h
             dispatch += di
             device += de
             wait += w
-            tokens += live
+            tokens += tok
         return host, dispatch, device, wait, tokens, sum(self._sched_ms)
 
     def host_overhead_fraction(self):
@@ -241,6 +248,7 @@ class Ledger:
             "wait_ms": round(wait, 3),
             "schedule_ms": round(sched, 3),
             "tokens": tokens,
+            "tokens_per_visit": tokens / n if n else 0.0,
             "host_overhead_fraction": (
                 (sched + host + dispatch + wait) / total if total else 0.0),
             "device_ms_per_token": device / tokens if tokens else 0.0,
@@ -284,6 +292,7 @@ def report(trace_id):
     counts = {"prefill": 0, "decode": 0}
     ledger = dict.fromkeys(_LEDGER_KEYS, 0.0)
     ledger_steps = 0
+    ledger_tokens = 0
     for span in s["spans"]:
         b = _bucket(span["name"])
         phase_ms[b] += span["dur_ms"]
@@ -293,6 +302,9 @@ def report(trace_id):
         if span["name"] == "serve::decode_step" and args \
                 and all(k in args for k in _LEDGER_KEYS):
             ledger_steps += 1
+            # multi-step visits stamp the tokens their block settled;
+            # classic single-step spans predate the arg and count 1
+            ledger_tokens += int(args.get("tokens", 1))
             for k in _LEDGER_KEYS:
                 ledger[k] += float(args[k])
     accounted = sum(phase_ms.values())
@@ -312,6 +324,9 @@ def report(trace_id):
         "other_ms": phase_ms["other"],
         "phase_ledger": {k: round(v, 3) for k, v in ledger.items()},
         "ledger_steps": ledger_steps,
+        "ledger_tokens": ledger_tokens,
+        "tokens_per_visit": (ledger_tokens / ledger_steps
+                             if ledger_steps else 0.0),
         "coverage": accounted / total if total > 0 else 0.0,
     }
 

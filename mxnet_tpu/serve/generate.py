@@ -301,6 +301,182 @@ def sample_tokens(logits, temperature=0.0, top_k=None):
         jax.random.categorical(key, scaled, axis=-1)).astype(_onp.int32)
 
 
+# stop-token matrix width of the multi-step super-step: per-lane stop
+# sets are padded/truncated to this many int32 entries (-1 = unused).
+# Requests with more stop ids than this still stop correctly — the host
+# settle replay checks the FULL stop set — the device loop just cannot
+# freeze the lane early on the overflowed ids (graceful degradation:
+# extra iterations, never wrong output).
+_STOP_WIDTH = 8
+
+
+def _stop_matrix(rows, stop_sets):
+    """(len(rows), _STOP_WIDTH) int32 stop matrix, padded with -1."""
+    m = _onp.full((rows, _STOP_WIDTH), -1, _onp.int32)
+    for i, st in enumerate(stop_sets):
+        ids = sorted(int(t) for t in st)[:_STOP_WIDTH]
+        m[i, :len(ids)] = ids
+    return m
+
+
+def _fresh_key_bits():
+    """(2,) uint32 threefry2x32 key data drawn from ``mxnet_tpu.random``'s
+    seeded stream — the traced base-key input of the multi-step
+    super-step (see ``ops.nn.sample_step``)."""
+    import jax
+
+    return _onp.asarray(
+        jax.random.key_data(_rng.as_threefry(_rng.next_key()))
+    ).astype(_onp.uint32).reshape(2)
+
+
+class _MultiStepForward(HybridBlock):
+    """The compiled decode super-step: up to N decode iterations in ONE
+    executable (ROADMAP item 3 — the host round-trip killer).
+
+    Calling convention::
+
+        (tokens (S,1), start_pos (S,), steps_limit (1,), remaining (S,),
+         seeds (S,), temps (S,), top_ks (S,), stops (S, _STOP_WIDTH),
+         key_bits (2,), [page_table (S,P),] *rings)
+        -> (block (S,N), valid (S,), done (S,), *rings)
+
+    The body is a ``lax.while_loop`` whose iteration feeds each lane's
+    pending token through the UNCHANGED model cache path (same
+    layers/ops as the single-step executable — Pallas decode attention,
+    int8 rings, fusion fences all compile per iteration with the
+    loop-carried ``start_pos``), samples the successor in-trace
+    (``ops.nn.sample_step``: greedy + per-lane temperature/top-k off
+    counter-based threefry keys), records it in the (S, N) token block,
+    and advances. ``steps_limit`` is a *traced* ceiling: the cond is
+    ``(i < steps_limit) & ~all(done)``, so the host degrades N down to 1
+    (tight deadlines) through the SAME executable, and the loop exits
+    early the moment every lane is done.
+
+    Finished lanes FREEZE instead of masking: a lane that hit a stop id
+    or its token budget stops advancing ``(token, position)``, so each
+    further iteration recomputes and rewrites byte-identical K/V at its
+    frozen position — idempotent by induction (every input of the write
+    is unchanged), which is why no masked cache-write variant is needed
+    and dead lanes idle harmlessly at full batch width.
+
+    Paged mode hoists the brackets: ONE ``paged_kv_gather`` before the
+    loop, rings carried through it, ONE ``paged_kv_scatter`` of length N
+    after. Rows past a lane's write extent scatter back the exact bytes
+    the gather produced (no-op), and positions past its page budget
+    clip onto the null page — both established-safe. Note this fuses
+    the brackets into the executable on EVERY rung, including baseline:
+    a compiled loop cannot run eager brackets per iteration, so
+    multi-step baseline carries greedy token-identity (not the PR-5
+    bitwise-vs-ring contract; the deterministic compiler options still
+    apply).
+    """
+
+    def __init__(self, model, max_seq, steps, path="baseline", quant=None,
+                 qindex=(), paged=False, **kwargs):
+        super().__init__(**kwargs)
+        self.model = model  # child registration shares the params
+        self._max_seq = int(max_seq)
+        self._steps = int(steps)
+        self._path = path
+        self._quant = quant
+        self._qindex = list(qindex)
+        self._paged = bool(paged)
+        n_layers = len(model._blocks)
+        self._n_cache = n_layers * (4 if quant else 2)
+
+    def forward(self, tokens, start_pos, steps_limit, remaining, seeds,
+                temps, top_ks, stops, key_bits, *rest):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import NDArray
+
+        def raw(x):
+            return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+        page_table = None
+        if self._paged:
+            page_table, rest = rest[0], rest[1:]
+        flat_cache = rest[:self._n_cache]
+        qflat = rest[self._n_cache:]
+        pools = None
+        if self._paged:
+            pools = flat_cache
+            flat_cache = [_ops.paged_kv_gather(p, page_table)
+                          for p in pools]
+        quant_weights = None
+        if qflat:
+            # same packed int8 side-table reslice as _CacheForward; the
+            # slices are loop-invariant captures of the while body
+            packed_w, packed_s = qflat
+            quant_weights, woff, soff = {}, 0, 0
+            for pid, (o, u) in self._qindex:
+                quant_weights[pid] = (
+                    packed_w[woff:woff + o * u].reshape(o, u),
+                    packed_s[soff:soff + o])
+                woff += o * u
+                soff += o
+
+        n = self._steps
+        lanes = tokens.shape[0]
+        limit = raw(steps_limit).astype(jnp.int32)[0]
+        rem = raw(remaining).astype(jnp.int32)
+        stop_m = raw(stops).astype(jnp.int32)
+        temps_r = raw(temps).astype(jnp.float32)
+        tks_r = raw(top_ks).astype(jnp.int32)
+        seeds_r = raw(seeds).astype(jnp.int32)
+        kb = raw(key_bits)
+        max_seq, quant, path = self._max_seq, self._quant, self._path
+        model = self.model
+
+        def body(carry):
+            it, cur, pos, done, emitted, block = carry[:6]
+            rings = carry[6:]
+            cache = KVCache.from_flat([NDArray(r) for r in rings],
+                                      max_seq, quant=quant)
+            cache.path = path
+            cache.quant_weights = quant_weights
+            logits = model(NDArray(cur), cache=cache,
+                           start_pos=NDArray(pos))
+            new_rings = tuple(raw(a) for a in cache.flat())
+            lg = raw(logits)[:, 0]  # T = 1: the only position's logits
+            nxt = raw(_ops.sample_step(
+                NDArray(lg), NDArray(temps_r), NDArray(tks_r),
+                NDArray(seeds_r), NDArray(pos), NDArray(kb)))
+            active = ~done
+            block = block.at[:, it].set(jnp.where(active, nxt, -1))
+            emitted = emitted + active.astype(jnp.int32)
+            is_stop = jnp.any(stop_m == nxt[:, None], axis=1)
+            done = done | (active & is_stop) | (emitted >= rem)
+            # advance only lanes still alive AFTER this emission: newly
+            # finished lanes freeze at their last written position, so
+            # subsequent iterations are byte-identical rewrites
+            adv = active & ~done
+            cur = jnp.where(adv[:, None], nxt[:, None], cur)
+            pos = jnp.where(adv, pos + 1, pos)
+            return (it + 1, cur, pos, done, emitted, block) + new_rings
+
+        def cond(carry):
+            return (carry[0] < limit) & ~jnp.all(carry[3])
+
+        init = ((jnp.int32(0),
+                 raw(tokens).astype(jnp.int32),
+                 raw(start_pos).astype(jnp.int32),
+                 rem <= 0,
+                 jnp.zeros((lanes,), jnp.int32),
+                 jnp.full((lanes, n), -1, jnp.int32))
+                + tuple(raw(r) for r in flat_cache))
+        out = jax.lax.while_loop(cond, body, init)
+        done, emitted, block = out[3], out[4], out[5]
+        rings = [NDArray(r) for r in out[6:]]
+        if self._paged:
+            rings = [_ops.paged_kv_scatter(p, page_table, r, start_pos, n)
+                     for p, r in zip(pools, rings)]
+        return (NDArray(block), NDArray(emitted),
+                NDArray(done.astype(jnp.int32))) + tuple(rings)
+
+
 _DECODE_PATHS = ("baseline", "pallas", "int8")
 
 
@@ -425,7 +601,8 @@ class Generator:
     def __init__(self, model, max_seq=128, batch_buckets=(1, 2, 4),
                  prompt_buckets=None, pad_id=0, name="llama_decode",
                  decode_path=None, paged=None, page_size=None,
-                 kv_pages=None, prefix_cache=None):
+                 kv_pages=None, prefix_cache=None, multistep=None,
+                 decode_steps=None):
         from .. import config
 
         self.model = model
@@ -488,6 +665,33 @@ class Generator:
         # gated on _attr.ENABLED, the object always present for readout
         self.ledger = _attr.Ledger(name)
         self._zero_caches = {}  # batch bucket -> shared zeroed rings
+        # multi-step decode (tentpole PR 19): the super-step lives in its
+        # own InferenceSession (one more compiled signature per batch
+        # bucket, frozen at warmup like everything else). The single-step
+        # session stays — parity tests and the N=1 overhead bound compare
+        # against it, and prefill always runs through it.
+        if multistep is None:
+            multistep = bool(config.get("MXNET_SERVE_MULTISTEP"))
+        self._multistep = bool(multistep)
+        if decode_steps is None:
+            decode_steps = int(config.get("MXNET_SERVE_DECODE_STEPS"))
+        self.decode_steps = max(1, int(decode_steps))
+        self._msession = None
+        self._itl_est = None  # EMA seconds per decode iteration
+        if self._multistep:
+            # paged=self._paged (not _fused_paged): a compiled loop cannot
+            # run eager brackets per iteration, so the super-step fuses
+            # them on every rung including baseline (greedy token-identity
+            # contract, see _MultiStepForward)
+            self._mstep = _MultiStepForward(
+                model, self.max_seq, self.decode_steps,
+                path=self.decode_path, quant=self._quant,
+                qindex=self._qindex, paged=self._paged)
+            self._msession = InferenceSession(
+                self._mstep, batch_buckets=self.batch_buckets,
+                seq_buckets=(1,), pad_value=self.pad_id,
+                name=f"{name}_multi",
+                deterministic=(self.decode_path == "baseline"))
 
     def _fresh_cache(self, batch_bucket):
         """Zeroed rings for one batch bucket, allocated once and shared
@@ -598,6 +802,57 @@ class Generator:
         zeros = _onp.zeros(len(toks), _onp.int32)
         return self._run(toks, _onp.asarray(positions, _onp.int32),
                          zeros, cache)
+
+    def decode_super(self, tokens, positions, steps_limit, remaining,
+                     seeds, temps, top_ks, stops, key_bits, cache,
+                     stamps=None):
+        """One multi-step super-step: up to ``steps_limit`` decode
+        iterations inside the compiled loop (see
+        :class:`_MultiStepForward`). Returns ``(block, valid, done,
+        cache)`` as host numpy — the (B, N) token block, per-lane valid
+        counts and done flags the caller settles in one pass. Fires the
+        same ``serve:decode`` fault site as :meth:`decode_step` (once
+        per super-step — the host-visit granularity).
+
+        ``stamps``: optional list; one ``(perf_counter, thread_wait_ns)``
+        pair is appended right after the executable dispatch returns
+        (before the blocking block fetch), so callers can split
+        dispatch from device time in the attribution ledger without
+        reimplementing the call."""
+        from .. import numpy as mnp
+
+        if self._msession is None:
+            raise MXNetError(
+                "decode_super needs multistep=True (or "
+                "MXNET_SERVE_MULTISTEP=1) at construction")
+        _faults.fault_point("serve:decode",
+                            {"session": self._msession.name})
+        b = len(positions)
+        args = [
+            mnp.array(_onp.asarray(tokens, _onp.int32).reshape(b, 1)),
+            mnp.array(_onp.asarray(positions, _onp.int32)),
+            mnp.array(_onp.asarray([steps_limit], _onp.int32)),
+            mnp.array(_onp.asarray(remaining, _onp.int32)),
+            mnp.array(_onp.asarray(seeds, _onp.int32)),
+            mnp.array(_onp.asarray(temps, _onp.float32)),
+            mnp.array(_onp.asarray(top_ks, _onp.int32)),
+            mnp.array(_onp.asarray(stops, _onp.int32)),
+            mnp.array(_onp.asarray(key_bits, _onp.uint32)),
+        ]
+        if self._paged:
+            out = self._msession.run(*args, cache.table_nd(),
+                                     *cache.flat(), *self._qflat)
+            cache.update_from_flat(out[3:])
+        else:
+            out = self._msession.run(*args, *cache.flat(), *self._qflat)
+            cache = KVCache.from_flat(out[3:], self.max_seq,
+                                      quant=self._quant)
+        if stamps is not None:
+            stamps.append((time.perf_counter(), _attr.thread_wait_ns()))
+        block = _onp.asarray(out[0].asnumpy(), _onp.int32)
+        valid = _onp.asarray(out[1].asnumpy(), _onp.int32)
+        done = _onp.asarray(out[2].asnumpy(), _onp.int32)
+        return block, valid, done, cache
 
     # -- the serving API ----------------------------------------------------
     def _pad_prompts(self, prompts):
@@ -776,7 +1031,15 @@ class Generator:
             positions = lens.copy()  # next write position per row
             stop = set(int(s) for s in stop_ids)
             n_decoded = 0
-            for step in range(max_new):
+            n_visits = 0
+            if self._multistep:
+                cache, n_decoded, n_visits = self._decode_loop_multi(
+                    next_ids, positions, out, stopped, expired, stop,
+                    max_new, temperature, top_k, deadlines, cache,
+                    n_real, b_bucket)
+            # multistep consumed the whole budget above; the single-step
+            # loop below then runs zero iterations
+            for step in range(0 if self._multistep else max_new):
                 th0 = time.perf_counter()
                 for i in range(n_real):
                     if stopped[i]:
@@ -841,6 +1104,7 @@ class Generator:
                 self.metrics.observe_itl((t3 - t1) * 1e3, live=live)
                 positions = positions + 1
                 n_decoded += 1
+                n_visits += 1
             run_ok = True
         finally:
             self._prefix_release(prompts, b_bucket, cache, run_ok)
@@ -856,16 +1120,149 @@ class Generator:
             "prefill_ms": (t_prefill - t_start) * 1e3,
             "decode_ms": decode_s * 1e3,
             "decode_steps": n_decoded,
+            "decode_visits": n_visits,
             "tokens_s": n_tokens / decode_s if decode_s > 0 else 0.0,
             "total_ms": (t_done - t_start) * 1e3,
             "deadline_expired": [i for i in range(n_real) if expired[i]],
         }
         return out, info
 
+    def _steps_limit(self, deadlines, stopped, n_real):
+        """The next super-step's dynamic iteration ceiling: N, degraded
+        to 1 when some live row's deadline could not survive a full
+        N-iteration super-step (estimated off the per-iteration EMA) —
+        the PR-6 504 retirement latency stays bounded by about one
+        decode iteration, through the SAME compiled executable
+        (``steps_limit`` is a traced input, never a new signature)."""
+        n = self.decode_steps
+        if deadlines is None or self._itl_est is None:
+            return n
+        now = time.monotonic()
+        slack = min((deadlines[i] - now for i in range(n_real)
+                     if not stopped[i]), default=None)
+        if slack is not None and slack < self._itl_est * n:
+            return 1
+        return n
+
+    def _decode_loop_multi(self, next_ids, positions, out, stopped,
+                           expired, stop, max_new, temperature, top_k,
+                           deadlines, cache, n_real, b_bucket):
+        """The multi-step decode loop behind :meth:`_generate`: the
+        step-0 token is emitted host-side (exactly like single-step),
+        then every further token comes out of compiled super-steps —
+        one host visit per block of up to ``decode_steps`` tokens,
+        settled by replaying :class:`_Slot`-style emission over the
+        returned token block. Token streams are invariant to the
+        super-step boundary (counter-based in-trace keys), so N=8 and
+        N=1 multistep output is identical, and greedy output matches
+        the single-step loop token for token."""
+        # step-0 emission: the prefill-sampled token, one per row
+        for i in range(n_real):
+            tid = int(next_ids[i])
+            if tid in stop:
+                stopped[i] = True
+            else:
+                out[i].append(tid)
+                if len(out[i]) >= max_new:
+                    stopped[i] = True
+        pending = _onp.zeros(b_bucket, _onp.int32)
+        pending[:len(next_ids)] = _onp.asarray(next_ids, _onp.int32)
+        temp = float(temperature) if temperature is not None else 0.0
+        # greedy runs never consume a host RNG draw (matching the
+        # single-step loop, whose greedy path draws no keys either)
+        key_bits = (_fresh_key_bits() if temp > 0.0
+                    else _onp.zeros(2, _onp.uint32))
+        seeds = _onp.arange(b_bucket, dtype=_onp.int32)
+        temps = _onp.full(b_bucket, max(temp, 0.0), _onp.float32)
+        tks = _onp.full(b_bucket, int(top_k) if top_k else 0, _onp.int32)
+        stops_m = _stop_matrix(b_bucket, [stop] * b_bucket)
+        n_decoded = n_visits = 0
+        while True:
+            if deadlines is not None:
+                now = time.monotonic()
+                for i in range(n_real):
+                    if not stopped[i] and now >= deadlines[i]:
+                        stopped[i] = True
+                        expired[i] = True
+                        self.metrics.observe_deadline("decode")
+            if all(stopped):
+                break
+            th0 = time.perf_counter()
+            remaining = _onp.zeros(b_bucket, _onp.int32)
+            for i in range(n_real):
+                if not stopped[i]:
+                    remaining[i] = max_new - len(out[i])
+            limit = self._steps_limit(deadlines, stopped, n_real)
+            live = n_real - sum(stopped)
+            attributing = _attr.ENABLED
+            if attributing:
+                self.ledger.observe_schedule(
+                    (time.perf_counter() - th0) * 1e3)
+            args = {"steps": limit, "live": live}
+            with _attr.phase_scope("decode"):
+                t1 = time.perf_counter()
+                w1 = _attr.thread_wait_ns() if attributing else 0
+                with _trace.span("serve::decode_step", args):
+                    stamps = []
+                    block, valid, _done, cache = self.decode_super(
+                        pending, positions, limit, remaining, seeds,
+                        temps, tks, stops_m, key_bits, cache,
+                        stamps=stamps)
+                    t3 = time.perf_counter()
+                    w3 = _attr.thread_wait_ns() if attributing else 0
+                    steps_run = int(valid.max()) if valid.size else 0
+                    n_tok = 0
+                    for i in range(n_real):
+                        if stopped[i]:
+                            continue
+                        k = int(valid[i])
+                        n_tok += k
+                        for j in range(k):
+                            tid = int(block[i, j])
+                            if tid in stop:
+                                stopped[i] = True
+                                break
+                            out[i].append(tid)
+                            pending[i] = tid
+                            if len(out[i]) >= max_new:
+                                stopped[i] = True
+                                break
+                        positions[i] += k
+                    if attributing:
+                        t4 = time.perf_counter()
+                        w4 = _attr.thread_wait_ns()
+                        t2, w2 = stamps[0]
+                        dispatch_ms = max(
+                            0.0, (t2 - t1) * 1e3 - (w2 - w1) / 1e6)
+                        device_ms = (t3 - t2) * 1e3
+                        host_ms = max(
+                            0.0, (t4 - t3) * 1e3 - (w4 - w3) / 1e6)
+                        wait_ms = max(
+                            0.0, ((w2 - w1) + (w4 - w3)) / 1e6)
+                        args.update(host_ms=round(host_ms, 4),
+                                    dispatch_ms=round(dispatch_ms, 4),
+                                    device_ms=round(device_ms, 4),
+                                    wait_ms=round(wait_ms, 4),
+                                    tokens=n_tok)
+                        self.ledger.observe_step(
+                            host_ms, dispatch_ms, device_ms, wait_ms,
+                            live=live, tokens=n_tok)
+            if steps_run > 0:
+                # k amortized token-to-token gaps, not one giant gap
+                self.metrics.observe_itl((t3 - t1) * 1e3, live=live,
+                                         tokens=steps_run)
+                est = (t3 - t1) / steps_run
+                self._itl_est = (est if self._itl_est is None
+                                 else 0.5 * self._itl_est + 0.5 * est)
+            n_decoded += steps_run
+            n_visits += 1
+        return cache, n_decoded, n_visits
+
     # -- warmup / invariants -------------------------------------------------
     def warmup(self):
         """Compile every (batch bucket x prompt bucket) prefill and every
-        batch bucket's decode step; freezes the signature set so
+        batch bucket's decode step — plus, in multistep mode, every batch
+        bucket's super-step; freezes the signature sets so
         ``assert_no_recompiles`` guards steady state."""
         t0 = time.perf_counter()
         for bb in self.batch_buckets:
@@ -877,15 +1274,36 @@ class Generator:
                 if pb == self.prompt_buckets[0]:
                     ids = _onp.zeros(bb, _onp.int32)
                     self.decode_step(ids, lens, cache)
+                    if self._multistep:
+                        # remaining=0: the loop replays zero iterations
+                        # but the body still traces/compiles in full
+                        self.decode_super(
+                            ids, lens, self.decode_steps,
+                            _onp.zeros(bb, _onp.int32),
+                            _onp.zeros(bb, _onp.int32),
+                            _onp.zeros(bb, _onp.float32),
+                            _onp.zeros(bb, _onp.int32),
+                            _onp.full((bb, _STOP_WIDTH), -1, _onp.int32),
+                            _onp.zeros(2, _onp.uint32), cache)
         self.session.freeze_signatures()
-        return {"signatures": self.session.signature_count(),
+        sigs = self.session.signature_count()
+        if self._msession is not None:
+            self._msession.freeze_signatures()
+            sigs += self._msession.signature_count()
+        return {"signatures": sigs,
                 "wall_s": time.perf_counter() - t0}
 
     def assert_no_recompiles(self):
         self.session.assert_no_recompiles()
+        if self._msession is not None:
+            self._msession.assert_no_recompiles()
 
     def stats(self):
-        return self.session.stats()
+        out = self.session.stats()
+        if self._msession is not None:
+            out["multistep"] = self._msession.stats()
+            out["decode_steps"] = self.decode_steps
+        return out
 
 
 class SpeculativeGenerator:
@@ -915,24 +1333,36 @@ class SpeculativeGenerator:
     def __init__(self, model, draft_model, k=None, max_seq=128,
                  batch_buckets=(1, 2, 4), prompt_buckets=None, pad_id=0,
                  name="llama_spec", decode_path=None, paged=None,
-                 page_size=None, kv_pages=None, prefix_cache=None):
+                 page_size=None, kv_pages=None, prefix_cache=None,
+                 multistep=None):
         from .. import config
 
         self.k = int(k) if k is not None else int(
             config.get("MXNET_SERVE_SPEC_TOKENS"))
         if self.k < 1:
             raise MXNetError("speculative decoding needs k >= 1")
+        if multistep is None:
+            multistep = bool(config.get("MXNET_SERVE_MULTISTEP"))
+        self._multistep = bool(multistep)
         self.target = Generator(
             model, max_seq=max_seq, batch_buckets=batch_buckets,
             prompt_buckets=prompt_buckets, pad_id=pad_id, name=name,
             decode_path=decode_path, paged=paged, page_size=page_size,
-            kv_pages=kv_pages, prefix_cache=prefix_cache)
+            kv_pages=kv_pages, prefix_cache=prefix_cache,
+            multistep=False)
+        # multistep: the whole draft-propose phase of a round IS one
+        # super-step — k proposal iterations plus the (k+1)-th that
+        # writes d_k's K/V run inside the draft's compiled loop, so a
+        # round costs 2 host visits (draft block + verify) instead of
+        # k+2. The target stays single-step (prefill + verify are its
+        # only executables; it never runs a token loop here).
         self.draft = Generator(
             draft_model, max_seq=max_seq, batch_buckets=batch_buckets,
             prompt_buckets=prompt_buckets, pad_id=pad_id,
             name=f"{name}_draft", decode_path=decode_path, paged=paged,
             page_size=page_size, kv_pages=kv_pages,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, multistep=self._multistep,
+            decode_steps=self.k + 1)
         # draft rounds write k+1 positions past the accepted prefix and
         # the verify block writes k+1 target positions — per-request
         # page budgets in prefix mode must cover that overhang
@@ -1058,17 +1488,39 @@ class SpeculativeGenerator:
                 # draft proposes d_1..d_k; the extra (k+1)-th step writes
                 # d_k's K/V into the draft ring so a fully-accepted round
                 # leaves no hole at position + k
-                cur = pending.copy()
-                dpos = positions.copy()
-                for j in range(self.k + 1):
-                    with _trace.span("serve::draft_step", {"j": j}):
-                        dlog, dcache = self.draft.decode_step(cur, dpos,
-                                                              dcache)
-                    dpos = dpos + 1
-                    draft_steps += 1
-                    if j < self.k:
-                        cur = sample_tokens(dlog)
-                        proposals[:, j] = cur
+                if self._multistep:
+                    # one compiled super-step runs all k+1 draft
+                    # iterations: iteration j feeds d_j at pos+j, writes
+                    # its K/V and greedily samples d_{j+1} — identical to
+                    # the sequential loop below, one host visit instead
+                    # of k+1. No stops, no budget: every lane runs the
+                    # full k+1 iterations (spare proposals for frozen
+                    # lanes are ignored at settle, same as sequential).
+                    with _trace.span("serve::draft_step",
+                                     {"steps": self.k + 1}):
+                        blk_d, _, _, dcache = self.draft.decode_super(
+                            pending, positions, self.k + 1,
+                            _onp.full(b_bucket, self.k + 2, _onp.int32),
+                            _onp.arange(b_bucket, dtype=_onp.int32),
+                            _onp.zeros(b_bucket, _onp.float32),
+                            _onp.zeros(b_bucket, _onp.int32),
+                            _onp.full((b_bucket, _STOP_WIDTH), -1,
+                                      _onp.int32),
+                            _onp.zeros(2, _onp.uint32), dcache)
+                    draft_steps += self.k + 1
+                    proposals[:, :] = blk_d[:, :self.k]
+                else:
+                    cur = pending.copy()
+                    dpos = positions.copy()
+                    for j in range(self.k + 1):
+                        with _trace.span("serve::draft_step", {"j": j}):
+                            dlog, dcache = self.draft.decode_step(
+                                cur, dpos, dcache)
+                        dpos = dpos + 1
+                        draft_steps += 1
+                        if j < self.k:
+                            cur = sample_tokens(dlog)
+                            proposals[:, j] = cur
                 blk = _onp.concatenate(
                     [_onp.asarray(pending).reshape(-1, 1), proposals],
                     axis=1)

@@ -204,6 +204,22 @@ class PagedKVPool:
         n = int(n_tokens)
         return max(1, -(-n // self.page_size))
 
+    def slot_budget(self, slot):
+        """Token positions ``slot``'s assigned pages can hold (pages
+        owned x page_size); 0 for an unassigned slot. The multi-step
+        super-step's scatter bracket writes a static-length block of
+        ``N`` rows per slot starting at its current position — safe
+        because (a) a lane's budget is reserved up front from prompt +
+        max_new, and the device loop freezes the lane at its remaining
+        budget, so every *advancing* write stays inside this bound; and
+        (b) block rows past the lane's write extent scatter back the
+        bytes the bracket gathered (a no-op), while positions past the
+        table row's last page clip to the null page, which the scatter
+        re-zeroes. No page-table view wider than the slot's own row is
+        ever needed for an N-token write."""
+        with self._lock:
+            return len(self._owned[int(slot)]) * self.page_size
+
     def assign(self, slot, n_tokens):
         """Reserve ``pages_for(n_tokens)`` pages for ``slot`` and install
         them in its page-table row (remaining row entries stay null).

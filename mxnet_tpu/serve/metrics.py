@@ -230,20 +230,32 @@ class ServeMetrics:
                                  args={"ms": round(float(ms), 3),
                                        "priority": priority})
 
-    def observe_itl(self, ms, live=1):
-        """Inter-token latency: wall time of one decode iteration,
-        observed once per step for every live slot. Its p99 bounds how
+    def observe_itl(self, ms, live=1, tokens=1):
+        """Inter-token latency: wall time of one decode host visit,
+        observed once per visit for every live slot. Its p99 bounds how
         long any request's token stream can stall — including stalls
         caused by other requests' admissions/prefills. ``live`` is the
         step's live-slot count, so attribution can normalize device
         cost by occupancy (a 1-live step and a 16-live step are not the
-        same sample)."""
+        same sample).
+
+        ``tokens`` is how many decode iterations the visit ran (1 for
+        the classic loop, up to N for a multi-step super-step): a visit
+        producing k tokens records k amortized token-to-token gaps of
+        ``ms/k`` each, because that is what each consumer-visible gap
+        actually was. Recording one giant k-iteration gap instead would
+        silently inflate ITL p50/p99 by ~k and trip the SLO burn-rate
+        monitor on a healthy server."""
+        tokens = max(1, int(tokens))
+        gap = float(ms) / tokens
         with self._lock:
-            self._itl_ms.append(float(ms))
-            self._itl_live.append(int(live))
+            for _ in range(tokens):
+                self._itl_ms.append(gap)
+                self._itl_live.append(int(live))
         slo = self.slo
         if slo is not None:
-            slo.observe("itl_ms", float(ms))
+            for _ in range(tokens):
+                slo.observe("itl_ms", gap)
 
     def observe_prefix(self, matched_tokens):
         """One admission consulted the prefix trie: ``matched_tokens``

@@ -57,8 +57,9 @@ from ..resilience import faults as _faults
 from .batcher import DynamicBatcher
 from .engine import DeadlineExceeded, InferenceSession, PoolExhausted, \
     ServeError, ServiceUnavailable
-from .generate import _CacheForward, _int8_weights_enabled, \
-    _quantize_serving_weights, resolve_decode_path, sample_tokens
+from .generate import _CacheForward, _MultiStepForward, _STOP_WIDTH, \
+    _fresh_key_bits, _int8_weights_enabled, _quantize_serving_weights, \
+    _stop_matrix, resolve_decode_path, sample_tokens
 from ..ops import nn as _ops
 from .kv_blocks import PagedKVPool
 from .prefix_cache import PrefixCache
@@ -74,9 +75,9 @@ class _Slot:
     __slots__ = ("p", "prompt", "consumed", "pos", "decoding", "pending",
                  "tokens", "max_new", "temperature", "top_k", "stop",
                  "finished", "expired", "t_admit", "admit_wait_steps",
-                 "ttft_ms", "decode_steps")
+                 "ttft_ms", "decode_steps", "seed")
 
-    def __init__(self, p, steps_now):
+    def __init__(self, p, steps_now, seed=0):
         payload = p.payload
         self.p = p
         self.prompt = payload["prompt"]
@@ -95,6 +96,10 @@ class _Slot:
         self.admit_wait_steps = steps_now - payload["enq_step"]
         self.ttft_ms = None
         self.decode_steps = 0
+        # per-request sampling-stream id (the engine's admission counter):
+        # the multistep in-trace sampler folds it into its key so two
+        # requests reusing one slot never share a draw stream
+        self.seed = int(seed)
 
     def emit(self, tid):
         """Account one sampled token; flips ``finished`` on stop/budget."""
@@ -135,7 +140,7 @@ class ContinuousEngine:
     def __init__(self, model, max_seq=128, num_slots=None, page_size=None,
                  num_pages=None, prefill_chunk=None, pad_id=0,
                  name="llama_cb", decode_path=None, prefix_cache=None,
-                 **batcher_kwargs):
+                 multistep=None, decode_steps=None, **batcher_kwargs):
         from .. import config
 
         self.model = model
@@ -202,6 +207,34 @@ class ContinuousEngine:
         self._admit_wait_max = 0
         self._thread = None
         self._stop = threading.Event()
+        # multi-step decode (tentpole PR 19): up to N decode iterations
+        # per host visit inside one compiled loop. The super-step lives
+        # in its own session; the engine still compiles exactly two
+        # steady-state signatures — (1, chunk) prefill and the
+        # (num_slots, N-loop) super-step (the classic (num_slots, 1)
+        # decode is simply never compiled in this mode).
+        if multistep is None:
+            multistep = bool(config.get("MXNET_SERVE_MULTISTEP"))
+        self._multistep = bool(multistep)
+        if decode_steps is None:
+            decode_steps = int(config.get("MXNET_SERVE_DECODE_STEPS"))
+        self.decode_steps = max(1, int(decode_steps))
+        self._msession = None
+        self._itl_est = None   # EMA seconds per decode iteration
+        self._seed_seq = 0     # admission counter -> _Slot.seed
+        if self._multistep:
+            self._mstep = _MultiStepForward(
+                model, self.max_seq, self.decode_steps,
+                path=self.decode_path, quant=self._quant,
+                qindex=self._qindex, paged=True)
+            self._msession = InferenceSession(
+                self._mstep, batch_buckets=(self.num_slots,),
+                seq_buckets=(1,), pad_value=self.pad_id,
+                name=f"{name}_multi",
+                deterministic=(self.decode_path == "baseline"))
+            # one key per engine; per-request streams come from folding
+            # each slot's admission seed (and position) into it in-trace
+            self._key_bits = _fresh_key_bits()
 
     # -- admission -----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, temperature=0.0,
@@ -306,7 +339,8 @@ class ContinuousEngine:
                 self._batcher.requeue(p)
                 return
             free.pop(0)
-            slot = _Slot(p, self._steps)
+            slot = _Slot(p, self._steps, seed=self._seed_seq)
+            self._seed_seq += 1
             # a prefix hit: the matched pages already hold these tokens'
             # KV, so chunked prefill starts past them (consumed counts
             # prompt tokens already written)
@@ -505,6 +539,160 @@ class ContinuousEngine:
         self.metrics.observe_itl((t3 - itl_start) * 1e3,
                                  live=len(decoding))
 
+    def _run_multi(self, toks, pos, table, limit, remaining, seeds,
+                   temps, top_ks, stops):
+        """Dispatch one super-step over the full slot lattice; returns
+        ``(block, valid, done, t_dispatch, w_dispatch)`` — the stamp pair
+        is taken right after the executable call returns (dispatch done,
+        device still running) so :meth:`_decode_multi` can split
+        dispatch from device time like :meth:`_decode_once` does."""
+        from .. import numpy as mnp
+
+        args = [
+            mnp.array(_onp.asarray(toks, _onp.int32)),
+            mnp.array(_onp.asarray(pos, _onp.int32)),
+            mnp.array(_onp.asarray([limit], _onp.int32)),
+            mnp.array(_onp.asarray(remaining, _onp.int32)),
+            mnp.array(_onp.asarray(seeds, _onp.int32)),
+            mnp.array(_onp.asarray(temps, _onp.float32)),
+            mnp.array(_onp.asarray(top_ks, _onp.int32)),
+            mnp.array(_onp.asarray(stops, _onp.int32)),
+            mnp.array(_onp.asarray(self._key_bits, _onp.uint32)),
+            mnp.array(_onp.asarray(table, _onp.int32)),
+        ]
+        out = self._msession.run(*args, *self.pool.flat(), *self._qflat)
+        t2 = time.perf_counter()
+        w2 = _attr.thread_wait_ns()
+        self.pool.update_from_flat(out[3:])
+        block = _onp.asarray(out[0].asnumpy(), _onp.int32)
+        valid = _onp.asarray(out[1].asnumpy(), _onp.int32)
+        done = _onp.asarray(out[2].asnumpy(), _onp.int32)
+        return block, valid, done, t2, w2
+
+    def _steps_limit(self, decoding):
+        """The next super-step's iteration ceiling: N, degraded to 1
+        when some live row's deadline could not survive a full
+        N-iteration block (per-iteration EMA estimate), so 504
+        retirement latency stays bounded by about one iteration —
+        through the SAME executable (``steps_limit`` is traced)."""
+        n = self.decode_steps
+        if self._itl_est is None:
+            return n
+        now = time.monotonic()
+        slack = min((self._slots[i].p.deadline - now for i in decoding
+                     if self._slots[i].p.deadline is not None),
+                    default=None)
+        if slack is not None and slack < self._itl_est * n:
+            return 1
+        return n
+
+    def _decode_multi(self):
+        """One super-step over every decoding slot: up to
+        ``decode_steps`` decode iterations inside the compiled loop,
+        settled host-side in one pass by replaying :meth:`_Slot.emit`
+        over each lane's valid token run. Dead/prefilling lanes ride
+        along with ``remaining=0`` — device-side done from iteration 0,
+        writes routed to the (re-zeroed) null page. When every lane is
+        done the loop exits on-device, so an almost-finished lattice
+        never burns N full iterations."""
+        decoding = [i for i, s in enumerate(self._slots)
+                    if s is not None and s.decoding and not s.finished]
+        if not decoding:
+            self._last_emit_t = None
+            return
+        _faults.fault_point("serve:decode",
+                            {"session": self._msession.name})
+        t_build = time.perf_counter()
+        S = self.num_slots
+        toks = _onp.zeros((S, 1), _onp.int32)
+        pos = _onp.zeros(S, _onp.int32)
+        remaining = _onp.zeros(S, _onp.int32)
+        seeds = _onp.zeros(S, _onp.int32)
+        temps = _onp.zeros(S, _onp.float32)
+        tks = _onp.zeros(S, _onp.int32)
+        table = _onp.zeros((S, self.pool.pages_per_slot), _onp.int32)
+        live_table = self.pool.table()
+        stop_sets = [frozenset()] * S
+        for i in decoding:
+            s = self._slots[i]
+            toks[i, 0] = s.pending
+            pos[i] = s.pos
+            remaining[i] = s.max_new - len(s.tokens)
+            seeds[i] = s.seed
+            temps[i] = (s.temperature if s.temperature is not None
+                        and s.temperature > 0.0 else 0.0)
+            tks[i] = int(s.top_k) if s.top_k else 0
+            table[i] = live_table[i]
+            stop_sets[i] = s.stop
+        stops = _stop_matrix(S, stop_sets)
+        limit = self._steps_limit(decoding)
+        attributing = _attr.ENABLED
+        args = {"live": len(decoding), "steps": limit}
+        with _attr.phase_scope("decode"):
+            t1 = time.perf_counter()
+            w1 = _attr.thread_wait_ns() if attributing else 0
+            s0_ns = time.perf_counter_ns()
+            try:
+                block, valid, _done, t2, w2 = self._run_multi(
+                    toks, pos, table, limit, remaining, seeds, temps,
+                    tks, stops)
+                t3 = time.perf_counter()
+                w3 = _attr.thread_wait_ns() if attributing else 0
+                # host settle: replay emit over each lane's token run —
+                # the host stays the source of truth for stop/budget
+                # (device done only bounds the iteration count)
+                n_tok = 0
+                steps_run = 0
+                for i in decoding:
+                    s = self._slots[i]
+                    k = int(valid[i])
+                    n_tok += k
+                    if k > steps_run:
+                        steps_run = k
+                    s.pos += k
+                    s.decode_steps += k
+                    for j in range(k):
+                        s.emit(int(block[i, j]))
+                        if s.finished:
+                            break
+                if attributing:
+                    t4 = time.perf_counter()
+                    w4 = _attr.thread_wait_ns()
+                    dispatch_ms = max(
+                        0.0, (t2 - t1) * 1e3 - (w2 - w1) / 1e6)
+                    device_ms = (t3 - t2) * 1e3
+                    host_ms = max(
+                        0.0, (t4 - t3) * 1e3 - (w4 - w3) / 1e6)
+                    wait_ms = max(0.0, ((w2 - w1) + (w4 - w3)) / 1e6)
+                    args.update(host_ms=round(host_ms, 4),
+                                dispatch_ms=round(dispatch_ms, 4),
+                                device_ms=round(device_ms, 4),
+                                wait_ms=round(wait_ms, 4),
+                                tokens=n_tok)
+                    self.ledger.observe_step(host_ms, dispatch_ms,
+                                             device_ms, wait_ms,
+                                             live=len(decoding),
+                                             tokens=n_tok)
+                    self.ledger.observe_schedule((t1 - t_build) * 1e3)
+            except Exception as e:
+                args["error"] = type(e).__name__
+                raise
+            finally:
+                self._span_fanout("serve::decode_step", s0_ns,
+                                  time.perf_counter_ns(), args, decoding)
+        prev = self._last_emit_t
+        self._last_emit_t = t3
+        itl_start = prev if prev is not None else t1
+        if steps_run > 0:
+            # the visit's wall amortizes over the iterations it ran —
+            # k tokens means k consumer-visible gaps, not one giant one
+            self.metrics.observe_itl((t3 - itl_start) * 1e3,
+                                     live=len(decoding),
+                                     tokens=steps_run)
+            est = (t3 - t1) / steps_run
+            self._itl_est = (est if self._itl_est is None
+                             else 0.5 * self._itl_est + 0.5 * est)
+
     def _span_fanout(self, name, t0_ns, t1_ns, args, slot_idx):
         """Record one span into every listed slot's request trace — an
         iteration-level step is on EACH rider's critical path, and the
@@ -539,7 +727,10 @@ class ContinuousEngine:
             self.ledger.observe_schedule((time.perf_counter() - t0) * 1e3)
         self._prefill_once()
         try:
-            self._decode_once()
+            if self._multistep:
+                self._decode_multi()
+            else:
+                self._decode_once()
         except Exception as exc:  # pylint: disable=broad-except
             for i, s in enumerate(self._slots):
                 if s is not None and s.decoding:
@@ -574,22 +765,39 @@ class ContinuousEngine:
 
     # -- lifecycle -----------------------------------------------------------
     def warmup(self):
-        """Compile BOTH live signatures — one (1, chunk) prefill chunk
-        and one (num_slots, 1) decode step, all-null tables — and freeze
-        the set: every later admit/retire/prefill/decode replays one of
-        these two executables (``assert_no_recompiles`` is the test)."""
+        """Compile BOTH live signatures and freeze the set: one
+        (1, chunk) prefill chunk plus — classic mode — one
+        (num_slots, 1) decode step, or — multistep mode — one
+        (num_slots,) super-step (the classic decode signature is never
+        compiled there; the super-step IS the decode executable). Every
+        later admit/retire/prefill/decode replays one of these two
+        executables (``assert_no_recompiles`` is the test)."""
         t0 = time.perf_counter()
         n = self.pool.pages_per_slot
+        S = self.num_slots
         self._run_step(
             _onp.zeros((1, self.prefill_chunk), _onp.int32), [0], [0],
             _onp.zeros((1, n), _onp.int32))
-        self._run_step(
-            _onp.zeros((self.num_slots, 1), _onp.int32),
-            _onp.zeros(self.num_slots, _onp.int32),
-            _onp.zeros(self.num_slots, _onp.int32),
-            _onp.zeros((self.num_slots, n), _onp.int32))
+        if self._multistep:
+            # remaining=0: zero runtime iterations, full trace/compile
+            self._run_multi(
+                _onp.zeros((S, 1), _onp.int32), _onp.zeros(S, _onp.int32),
+                _onp.zeros((S, n), _onp.int32), self.decode_steps,
+                _onp.zeros(S, _onp.int32), _onp.zeros(S, _onp.int32),
+                _onp.zeros(S, _onp.float32), _onp.zeros(S, _onp.int32),
+                _onp.full((S, _STOP_WIDTH), -1, _onp.int32))
+            self._msession.freeze_signatures()
+        else:
+            self._run_step(
+                _onp.zeros((S, 1), _onp.int32),
+                _onp.zeros(S, _onp.int32),
+                _onp.zeros(S, _onp.int32),
+                _onp.zeros((S, n), _onp.int32))
         self.session.freeze_signatures()
-        return {"signatures": self.session.signature_count(),
+        sigs = self.session.signature_count()
+        if self._msession is not None:
+            sigs += self._msession.signature_count()
+        return {"signatures": sigs,
                 "wall_s": time.perf_counter() - t0}
 
     def start(self):
@@ -639,11 +847,16 @@ class ContinuousEngine:
     # -- invariants / readout ------------------------------------------------
     def assert_no_recompiles(self):
         self.session.assert_no_recompiles()
+        if self._msession is not None:
+            self._msession.assert_no_recompiles()
 
     def stats(self):
         out = self.session.stats()
         out["pool"] = self.pool.stats()
         out["steps"] = self._steps
+        if self._msession is not None:
+            out["multistep"] = self._msession.stats()
+            out["decode_steps"] = self.decode_steps
         out["slots_live"] = len(self._live())
         out["slots_total"] = self.num_slots
         out["admit_wait_steps_max"] = self._admit_wait_max

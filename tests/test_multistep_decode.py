@@ -1,0 +1,290 @@
+"""Device-side multi-step decode (tentpole PR 19): the compiled
+super-step that runs up to ``MXNET_SERVE_DECODE_STEPS`` decode
+iterations per host visit.
+
+Contract ladder:
+
+* greedy token-identity vs the single-step loop on EVERY rung
+  (baseline / pallas / int8, ring and paged KV alike) — the super-step
+  is an execution-schedule change, never a semantics change;
+* sampled streams are invariant to the super-step boundary: with pinned
+  seeds, N=8 and N=1 multistep emit identical tokens (counter-based
+  in-trace keys, not sequential host draws);
+* EOS lands mid-super-step: finished lanes freeze on-device and the
+  host settle truncates at the stop token — no trailing garbage;
+* deadlines degrade ``steps_limit`` to 1 through the SAME executable
+  (traced input, not a new signature), so PR-6 504 retirement latency
+  stays bounded by about one decode iteration;
+* speculative decoding runs the whole draft-propose phase of a round as
+  ONE draft super-step (2 host visits per round instead of k+2) with
+  unchanged output;
+* a multistep ContinuousEngine compiles exactly two steady-state
+  signatures — chunked prefill plus the super-step — and holds them
+  across admit/retire cycles.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.llama import get_llama
+from mxnet_tpu.serve import (ContinuousEngine, Generator,
+                             SpeculativeGenerator)
+
+PROMPTS = [[5, 9, 2], [7, 3, 3, 1]]
+
+
+def _llama(config="llama_tiny_test", **over):
+    net = get_llama(config, **over)
+    net.initialize()
+    return net
+
+
+def _gen(net, name, multistep, steps=8, path="baseline", **over):
+    kw = dict(max_seq=48, batch_buckets=(2,), prompt_buckets=(8,),
+              name=name, decode_path=path, multistep=multistep,
+              decode_steps=steps)
+    kw.update(over)
+    return Generator(net, **kw)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    mx.random.seed(0)
+    return _llama()
+
+
+@pytest.fixture(scope="module")
+def base_pair(tiny):
+    """One single-step reference + one warmed N=8 super-step Generator
+    on the baseline path, shared across the identity / EOS / sampling
+    tests — Generator builds dominate this file's wall clock."""
+    ref = _gen(tiny, "ms_ref_baseline", multistep=False)
+    gen = _gen(tiny, "ms_baseline", multistep=True, steps=8)
+    gen.warmup()
+    return ref, gen
+
+
+# ---------------------------------------------------------------------------
+# Greedy token identity vs the single-step loop
+# ---------------------------------------------------------------------------
+
+
+class TestGreedyIdentity:
+    def _identity(self, ref, gen):
+        o_ref, _ = ref.generate(PROMPTS, max_new_tokens=12)
+        o_ms, info = gen.generate(PROMPTS, max_new_tokens=12)
+        assert o_ms == o_ref
+        gen.assert_no_recompiles()
+        # host visits amortize: 12 tokens/row = 1 from prefill + 11 from
+        # ceil(11/8)=2 super-steps — 2 visits for 22 steady tokens
+        assert info["decode_visits"] == 2
+        toks = sum(len(o) for o in o_ms) - len(o_ms)
+        assert info["decode_visits"] / toks <= 1.0 / 4
+
+    def test_baseline_rung_matches_single_step(self, base_pair):
+        ref, gen = base_pair
+        self._identity(ref, gen)
+
+    @pytest.mark.parametrize("path", ["pallas", "int8"])
+    def test_kernel_rungs_match_single_step(self, tiny, path):
+        ref = _gen(tiny, f"ms_ref_{path}", multistep=False, path=path)
+        gen = _gen(tiny, f"ms_{path}", multistep=True, steps=8, path=path)
+        gen.warmup()
+        self._identity(ref, gen)
+
+    # tier-1 exercises the paged pool under multistep via the
+    # TIER1_MULTISTEP engine smoke (the ContinuousEngine runs paged KV);
+    # the unit-level identity check rides the slow suite.
+    @pytest.mark.slow
+    def test_paged_pool_matches_single_step(self, tiny):
+        ref = _gen(tiny, "msp_ref", multistep=False, path="pallas",
+                   paged=True, page_size=8)
+        gen = _gen(tiny, "msp", multistep=True, steps=4, path="pallas",
+                   paged=True, page_size=8)
+        gen.warmup()
+        o_ref, _ = ref.generate(PROMPTS, max_new_tokens=12)
+        o_ms, _ = gen.generate(PROMPTS, max_new_tokens=12)
+        assert o_ms == o_ref
+        gen.assert_no_recompiles()
+
+
+# ---------------------------------------------------------------------------
+# Sampling: streams invariant to the super-step boundary
+# ---------------------------------------------------------------------------
+
+
+class TestSampling:
+    def test_n8_equals_n1_with_pinned_seeds(self, tiny, base_pair):
+        """The in-trace keys are counter-based (request seed x absolute
+        position), so WHERE the super-step boundary falls cannot change
+        a single draw — N=8 and N=1 emit identical sampled streams."""
+        _, g8 = base_pair
+        g1 = _gen(tiny, "ms_samp1", multistep=True, steps=1)
+        mx.random.seed(7)
+        o8, _ = g8.generate(PROMPTS, max_new_tokens=12,
+                            temperature=0.9, top_k=5)
+        mx.random.seed(7)
+        o1, _ = g1.generate(PROMPTS, max_new_tokens=12,
+                            temperature=0.9, top_k=5)
+        assert o8 == o1
+        g8.assert_no_recompiles()
+        # ...and a different host seed really does change the stream
+        mx.random.seed(8)
+        o8b, _ = g8.generate(PROMPTS, max_new_tokens=12,
+                             temperature=0.9, top_k=5)
+        assert o8b != o8  # astronomically unlikely to collide
+
+
+# ---------------------------------------------------------------------------
+# EOS mid-super-step
+# ---------------------------------------------------------------------------
+
+
+class TestStopTokens:
+    def test_eos_mid_super_step_truncates(self, base_pair):
+        """Pick a stop id straight from the greedy reference stream so it
+        lands INSIDE a super-step; the multistep output must equal the
+        single-step output with the same stop set — the device freezes
+        the lane, the host settle truncates at the stop token."""
+        ref, gen = base_pair
+        o_ref, _ = ref.generate(PROMPTS, max_new_tokens=12)
+        stop = o_ref[0][5]  # 6th emitted token of row 0: mid-block at N=8
+        o_stop, _ = ref.generate(PROMPTS, max_new_tokens=12,
+                                 stop_ids=[stop])
+        o_ms, _ = gen.generate(PROMPTS, max_new_tokens=12,
+                               stop_ids=[stop])
+        assert o_ms == o_stop
+        assert len(o_ms[0]) < 12  # it really did stop early
+        gen.assert_no_recompiles()
+
+
+# ---------------------------------------------------------------------------
+# Deadlines: auto-degrade + 504 semantics
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlines:
+    def test_steps_limit_degrades_to_one(self, tiny):
+        gen = _gen(tiny, "ms_degrade", multistep=True, steps=8)
+        now = time.monotonic()
+        # no estimate yet -> full N (nothing to degrade on)
+        assert gen._steps_limit([now + 0.1], [False], 1) == 8
+        gen._itl_est = 0.050  # 50 ms/iteration EMA
+        # 100 ms of slack cannot survive 8 x 50 ms -> degrade to 1
+        assert gen._steps_limit([now + 0.1], [False], 1) == 1
+        # plenty of slack -> full N
+        assert gen._steps_limit([now + 60.0], [False], 1) == 8
+        # the tight row is already stopped -> it no longer constrains
+        assert gen._steps_limit([now + 0.1, now + 60.0], [True, False],
+                                2) == 8
+        # degrade reuses the SAME executable: no new signature appears
+        gen.warmup()
+        n_sig = gen._msession.signature_count()
+        gen._itl_est = 10.0
+        deadlines = [time.monotonic() + 0.5] * len(PROMPTS)
+        gen.generate(PROMPTS, max_new_tokens=6, deadlines=deadlines)
+        assert gen._msession.signature_count() == n_sig
+        gen.assert_no_recompiles()
+        # already-passed deadlines keep the PR-6 504 taxonomy: every row
+        # retires expired, counted as decode-stage deadline_expired
+        _, info = gen.generate(PROMPTS, max_new_tokens=8,
+                               deadlines=time.monotonic() - 1.0)
+        assert sorted(info["deadline_expired"]) == [0, 1]
+        assert gen.metrics.snapshot()["deadline_expired"].get("decode")
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding: the draft round as one super-step
+# ---------------------------------------------------------------------------
+
+
+class TestSpeculativeSuperStep:
+    def test_bad_draft_identity_and_round_accounting(self, tiny):
+        mx.random.seed(99)
+        draft = _llama(num_layers=1)  # random, unrelated to the target
+        ref = _gen(tiny, "ms_spec_ref", multistep=False)
+        spec = SpeculativeGenerator(tiny, draft, k=3, max_seq=48,
+                                    batch_buckets=(2,), prompt_buckets=(8,),
+                                    name="ms_spec", multistep=True)
+        spec.warmup()
+        # the DRAFT owns the super-step session; the target never runs a
+        # token loop here (prefill + verify are its only executables)
+        assert spec.draft._msession is not None
+        assert spec.target._msession is None
+        assert spec.draft.decode_steps == spec.k + 1
+        o_ref, _ = ref.generate(PROMPTS, max_new_tokens=12)
+        o_spec, info = spec.generate(PROMPTS, max_new_tokens=12)
+        assert o_spec == o_ref
+        spec.assert_no_recompiles()
+        assert 0.0 <= info["acceptance_rate"] <= 1.0
+        # one draft super-step per round: k+1 draft iterations per visit
+        assert info["draft_steps"] == info["rounds"] * (spec.k + 1)
+
+
+# ---------------------------------------------------------------------------
+# ContinuousEngine: the two-signature pin across admit/retire cycles
+# ---------------------------------------------------------------------------
+
+
+class TestEngineTwoSignatures:
+    # tier-1 covers this invariant via the TIER1_MULTISTEP smoke (8
+    # concurrent engine clients, one super-step signature, lockdep
+    # re-run); the assertion-level churn test rides the slow suite.
+    @pytest.mark.slow
+    def test_signatures_hold_across_cycles(self, tiny):
+        eng = ContinuousEngine(tiny, max_seq=48, num_slots=2, page_size=8,
+                               prefill_chunk=8, decode_path="baseline",
+                               multistep=True, decode_steps=8,
+                               name="ms_engine")
+        eng.start()
+        try:
+            sig_prefill = eng.session.signature_count()
+            sig_super = eng._msession.signature_count()
+            assert sig_super == 1  # ONE super-step executable, period
+            outs = []
+            for cyc in range(12):
+                prompt = [3 + (cyc % 5), 9, 2]
+                outs.append(eng.submit(
+                    prompt, max_new_tokens=4).result(120)["tokens"])
+            # the 5 distinct prompts repeat: cycles with the same prompt
+            # must agree (greedy determinism across admit/retire churn)
+            for cyc, toks in enumerate(outs):
+                assert toks == outs[cyc % 5]
+            eng.assert_no_recompiles()
+            assert eng.session.signature_count() == sig_prefill
+            assert eng._msession.signature_count() == sig_super
+            assert eng.stats()["decode_steps"] == 8
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# N=1 overhead bound
+# ---------------------------------------------------------------------------
+
+
+class TestOverheadAtN1:
+    # the llama_multistep_decode bench row keeps the honest N=1 numbers
+    # (PERF.md); this wall-clock guard rides the slow suite so tier-1
+    # stays inside its budget.
+    @pytest.mark.slow
+    def test_n1_super_step_is_not_pathologically_slower(self, tiny):
+        """At N=1 the super-step runs the same single iteration as the
+        classic loop plus a while_loop shell; the bench row tracks the
+        real <5% contract — here we pin against pathological regression
+        only (CI wall clocks are too noisy for a 5% assert)."""
+        ref = _gen(tiny, "ms_oh_ref", multistep=False)
+        gen = _gen(tiny, "ms_oh_n1", multistep=True, steps=1)
+        ref.warmup()
+        gen.warmup()
+        best_ref = best_n1 = float("inf")
+        for _ in range(2):
+            _, i_ref = ref.generate(PROMPTS, max_new_tokens=16)
+            _, i_n1 = gen.generate(PROMPTS, max_new_tokens=16)
+            best_ref = min(best_ref, i_ref["decode_ms"])
+            best_n1 = min(best_n1, i_n1["decode_ms"])
+        assert best_n1 < best_ref * 2.0, (
+            f"N=1 super-step decode {best_n1:.1f}ms vs single-step "
+            f"{best_ref:.1f}ms — more than 2x overhead")

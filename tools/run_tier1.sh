@@ -127,6 +127,29 @@ if [[ "${TIER1_PREFIX:-0}" != "0" ]]; then
         rc=$prefix_rc
     fi
 fi
+# Multi-step decode pass (TIER1_MULTISTEP=1 to enable): serve_smoke
+# --multistep — 8 concurrent ContinuousEngine clients on the PR-19
+# device-side super-step loop (MXNET_SERVE_DECODE_STEPS iterations per
+# host visit) must get greedy output token-identical to the classic
+# one-visit-per-token engine, with exactly one compiled super-step
+# signature, zero recompiles, and a mid-stream deadline settling as 504
+# within one super-step (not one request). Re-run under MXNET_LOCKDEP=1:
+# the settle loop walks pool + metrics locks per super-step and must
+# stay cycle-free.
+if [[ "${TIER1_MULTISTEP:-0}" != "0" ]]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python tools/serve_smoke.py --multistep
+    ms_rc=$?
+    if [[ "$rc" -eq 0 && "$ms_rc" -ne 0 ]]; then
+        rc=$ms_rc
+    fi
+    timeout -k 10 300 env JAX_PLATFORMS=cpu MXNET_LOCKDEP=1 \
+        python tools/serve_smoke.py --multistep
+    ms_rc=$?
+    if [[ "$rc" -eq 0 && "$ms_rc" -ne 0 ]]; then
+        rc=$ms_rc
+    fi
+fi
 # Fleet soak smoke (TIER1_FLEET=0 to skip): ~8s of 64 mixed-priority
 # clients through a Router over 3 replicas under a seeded fault plan,
 # with one deterministic replica kill mid-traffic — asserts fleet-wide

@@ -43,6 +43,17 @@ must be ``ok``, and the flight recorder must have produced zero
 ``slo_burn`` dumps — the guard's false-positive contract on a healthy
 service.
 
+With ``--multistep`` (the ``TIER1_MULTISTEP=1`` pass) the smoke drives
+the PR-19 device-side multi-step decode loop on a ``ContinuousEngine``:
+
+* 8 concurrent clients on an 8-step super-step engine must get greedy
+  output token-identical to the classic one-visit-per-token engine,
+* exactly two compiled signatures (chunked prefill + the super-step)
+  and zero recompiles across every admit/retire cycle,
+* a deadline that expires mid-stream settles as 504
+  (``DeadlineExceeded`` with partial tokens) within a bounded wall —
+  retirement latency is one super-step, not one request.
+
 With ``--prefix`` (the ``TIER1_PREFIX=1`` pass) the smoke drives the
 PR-14 "never redo prior work" stack:
 
@@ -114,6 +125,8 @@ def main():
         return _run_prefix_child(cache_dir)
     if "--prefix" in sys.argv:
         return _run_prefix()
+    if "--multistep" in sys.argv:
+        return _run_multistep()
     if "--decode-path" in sys.argv:
         path = sys.argv[sys.argv.index("--decode-path") + 1]
         return _run_decode(path)
@@ -257,6 +270,118 @@ def _run_prefix():
           f"cold_disk_misses={p1['disk_misses']} "
           f"warm_disk_hits={p2['disk_hits']}")
     return 0
+
+
+def _run_multistep():
+    import time
+
+    import mxnet_tpu as mx  # noqa: F401  (framework init)
+    from mxnet_tpu.models.llama import get_llama
+    from mxnet_tpu.serve import ContinuousEngine, DeadlineExceeded
+
+    mx.random.seed(0)
+    model = get_llama("llama_tiny_test")
+    model.initialize()
+    prompts = [[5 + i, 9, 2, (3 * i) % 11 + 1] for i in range(8)]
+
+    # reference: classic one-visit-per-token engine, sequential requests
+    ref_eng = ContinuousEngine(model, max_seq=64, num_slots=4, page_size=8,
+                               prefill_chunk=8, decode_path="baseline",
+                               multistep=False, name="smoke_ms_ref")
+    ref_eng.start()
+    try:
+        refs = [ref_eng.submit(p, max_new_tokens=12).result(120)["tokens"]
+                for p in prompts]
+    finally:
+        ref_eng.close()
+
+    eng = ContinuousEngine(model, max_seq=64, num_slots=4, page_size=8,
+                           prefill_chunk=8, decode_path="baseline",
+                           multistep=True, decode_steps=8, name="smoke_ms")
+    eng.start()
+    try:
+        outs = [None] * len(prompts)
+        errors = []
+
+        def client(i):
+            try:
+                outs[i] = eng.submit(
+                    prompts[i], max_new_tokens=12).result(120)["tokens"]
+            except Exception as exc:  # noqa: BLE001
+                errors.append((i, exc))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        if errors:
+            i, exc = errors[0]
+            print(f"SERVE_SMOKE_MULTISTEP=FAIL client {i}: "
+                  f"{type(exc).__name__}: {exc}")
+            return 1
+        for i, o in enumerate(outs):
+            if o != refs[i]:
+                print(f"SERVE_SMOKE_MULTISTEP=FAIL client {i} diverged "
+                      f"from the classic engine: {o} != {refs[i]}")
+                return 1
+        try:
+            eng.assert_no_recompiles()
+        except Exception as exc:  # noqa: BLE001
+            print(f"SERVE_SMOKE_MULTISTEP=FAIL {exc}")
+            return 1
+        n_super = eng._msession.signature_count()
+        if n_super != 1:
+            print(f"SERVE_SMOKE_MULTISTEP=FAIL expected exactly one "
+                  f"super-step signature, got {n_super}")
+            return 1
+
+        # 504: a deadline that expires mid-stream settles as
+        # DeadlineExceeded with partial tokens, and retirement is
+        # bounded by one super-step -- not by the request's remaining
+        # budget.  Budget half of a measured 12-token wall so expiry
+        # lands mid-decode on any host speed.
+        t0 = time.monotonic()
+        eng.submit(prompts[0], max_new_tokens=12).result(120)
+        t12 = time.monotonic() - t0
+        budget_ms = max(20.0, t12 * 1e3 * 0.5)
+        t0 = time.monotonic()
+        fut = eng.submit(prompts[1], max_new_tokens=48,
+                         deadline_ms=budget_ms)
+        try:
+            fut.result(120)
+            print("SERVE_SMOKE_MULTISTEP=FAIL mid-stream deadline did "
+                  "not settle as 504")
+            return 1
+        except DeadlineExceeded as exc:
+            settled_s = time.monotonic() - t0
+            partial = list(getattr(exc, "partial", []))
+        if len(partial) >= 48:
+            print(f"SERVE_SMOKE_MULTISTEP=FAIL expired request ran to "
+                  f"completion ({len(partial)} tokens)")
+            return 1
+        slack_s = budget_ms / 1e3 + max(2.0, 2.0 * t12)
+        if settled_s > slack_s:
+            print(f"SERVE_SMOKE_MULTISTEP=FAIL 504 settled {settled_s:.2f}s "
+                  f"after submit (> {slack_s:.2f}s): retirement not "
+                  f"bounded by one super-step")
+            return 1
+        snap = eng.metrics.snapshot()
+        if not snap["deadline_expired"].get("decode"):
+            print(f"SERVE_SMOKE_MULTISTEP=FAIL no decode-stage "
+                  f"deadline_expired metric "
+                  f"({dict(snap['deadline_expired'])})")
+            return 1
+        stats = eng.stats()
+        print(f"SERVE_SMOKE_MULTISTEP=PASS clients={len(prompts)} "
+              f"decode_steps={stats['decode_steps']} "
+              f"super_signatures={n_super} "
+              f"partial_504={len(partial)} "
+              f"deadline_expired={dict(snap['deadline_expired'])}")
+        return 0
+    finally:
+        eng.close()
 
 
 def _run_decode(path):

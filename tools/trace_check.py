@@ -22,7 +22,11 @@ Checks, in order:
   (``host_ms``/``dispatch_ms``/``device_ms``/``wait_ms``) whose sum
   reconciles with the span's own wall time within 10% (floor 0.05 ms)
   — the profiler/attribution contract that the phase partition covers
-  the iteration exactly.
+  the iteration exactly. Multi-step super-step spans (PR 19) carry a
+  ``tokens`` arg on top: it must be a non-negative number bounded by
+  ``steps x live`` (one visit cannot emit more tokens than iterations
+  times live rows), and the PASS line reports the window's
+  ``tokens_per_visit`` so the amortization shows up in CI logs.
 
 Exit 0 on pass; 1 with one reason line per failure.
 """
@@ -35,8 +39,10 @@ _LEDGER_KEYS = ("host_ms", "dispatch_ms", "device_ms", "wait_ms")
 
 
 def check_trace(path, expect_lane=False, min_spans=3, min_threads=2,
-                expect_attribution=False):
-    """Returns a list of failure strings (empty = pass)."""
+                expect_attribution=False, stats=None):
+    """Returns a list of failure strings (empty = pass). ``stats``, if a
+    dict, receives summary readouts (``decode_spans``,
+    ``decode_tokens``, ``tokens_per_visit``) for the caller's report."""
     failures = []
     try:
         with open(path) as f:
@@ -150,7 +156,7 @@ def check_trace(path, expect_lane=False, min_spans=3, min_threads=2,
                 f">= {min_threads} threads")
 
     if expect_attribution:
-        n_spans, n_bad = 0, 0
+        n_spans, n_bad, n_tokens = 0, 0, 0
         for key, rows in sorted(decode_evs.items(),
                                 key=lambda kv: str(kv[0])):
             # pair b/e in ts order (LIFO — spans of one name on one lane
@@ -185,12 +191,47 @@ def check_trace(path, expect_lane=False, min_spans=3, min_threads=2,
                             f"decode_step span (id {key[1]}) at "
                             f"{t0:.3f}us: ledger sum {ledger_ms:.3f}ms "
                             f"vs wall {wall_ms:.3f}ms (tol {tol:.3f}ms)")
+                    continue
+                # multi-step super-step accounting (PR 19): a span that
+                # carries ``tokens`` settled that many tokens in ONE
+                # host visit — non-negative, and never more than
+                # steps x live (iterations times live rows). Single-step
+                # spans carry no tokens arg and default to 1.
+                toks = args.get("tokens")
+                if toks is None:
+                    n_tokens += 1
+                    continue
+                if not isinstance(toks, (int, float)) or toks < 0:
+                    n_bad += 1
+                    if n_bad <= 5:
+                        failures.append(
+                            f"decode_step span (id {key[1]}) at "
+                            f"{t0:.3f}us has bad tokens arg {toks!r}")
+                    continue
+                n_tokens += int(toks)
+                steps = args.get("steps")
+                live = args.get("live")
+                if isinstance(steps, (int, float)) \
+                        and isinstance(live, (int, float)) \
+                        and toks > steps * live:
+                    n_bad += 1
+                    if n_bad <= 5:
+                        failures.append(
+                            f"decode_step span (id {key[1]}) at "
+                            f"{t0:.3f}us emitted {toks} tokens from "
+                            f"{steps} steps x {live} live rows — "
+                            "over-emission is impossible")
         if n_spans == 0:
             failures.append("no serve::decode_step spans found "
                             "(attribution expected)")
         elif n_bad > 5:
             failures.append(f"... and {n_bad - 5} more decode_step "
                             "attribution mismatches")
+        if isinstance(stats, dict):
+            stats["decode_spans"] = n_spans
+            stats["decode_tokens"] = n_tokens
+            stats["tokens_per_visit"] = (round(n_tokens / n_spans, 3)
+                                         if n_spans else 0.0)
     return failures
 
 
@@ -205,15 +246,21 @@ def main(argv=None):
                     help="require serve::decode_step spans carrying the "
                          "four ledger args summing to the span wall")
     args = ap.parse_args(argv)
+    stats = {}
     failures = check_trace(args.trace, expect_lane=args.expect_lane,
                            min_spans=args.min_spans,
                            min_threads=args.min_threads,
-                           expect_attribution=args.expect_attribution)
+                           expect_attribution=args.expect_attribution,
+                           stats=stats)
     if failures:
         for f in failures:
             print(f"TRACE_CHECK=FAIL {f}")
         return 1
-    print(f"TRACE_CHECK=PASS {args.trace}")
+    extra = ""
+    if stats.get("decode_spans"):
+        extra = (f" decode_spans={stats['decode_spans']}"
+                 f" tokens_per_visit={stats['tokens_per_visit']}")
+    print(f"TRACE_CHECK=PASS {args.trace}{extra}")
     return 0
 
 
