@@ -569,3 +569,33 @@ register_flag(
     "MXNET_SLO_MIN_EVENTS", 12,
     "Minimum fast-window events before an SLO objective may alert — a "
     "sparse healthy run cannot false-alarm.", int)
+register_flag(
+    "MXNET_IO_WORKERS", 4,
+    "Default decode-pool width of io.pipeline.RecordPipeline: named "
+    "daemon worker threads pulling record ranges, decoding and "
+    "batchifying into the bounded output queue (the reference's "
+    "iter_image_recordio_2.cc decode-thread pool).", int)
+register_flag(
+    "MXNET_IO_QUEUE_DEPTH", 8,
+    "Bounded output-queue depth (batches) of the RecordPipeline decode "
+    "pool — workers block (backpressure) once this many decoded batches "
+    "are waiting for the consumer.", int)
+register_flag(
+    "MXNET_IO_SHUFFLE_BUFFER", 1024,
+    "Window size of the seedable streaming shuffle in RecordPipeline "
+    "and ShardedRecordDataset epoch-order draws: records are shuffled "
+    "within a sliding window of this many entries (bounded-memory "
+    "approximate shuffle; <= 1 disables shuffling beyond epoch seed "
+    "order).", int)
+register_flag(
+    "MXNET_IO_DEVICE_BUFFERS", 2,
+    "Batches the io.pipeline.DeviceFeeder keeps device-resident via "
+    "async device_put — K=2 double-buffers H2D for batch k+1 under "
+    "step k's compute.", int)
+register_flag(
+    "MXNET_IO_CHECK_INDEX", True,
+    "Integrity-check every RecordIO .idx at open (4-byte-aligned, "
+    "strictly increasing offsets that fit the .rec size); a corrupt "
+    "index raises MXNetError naming the file instead of serving wrong "
+    "records. 0 skips the check (e.g. for deliberately exotic "
+    "hand-built indexes).", _bool)

@@ -8,6 +8,7 @@ so reference training scripts run unchanged.
 """
 from __future__ import annotations
 
+import weakref as _weakref
 from collections import namedtuple
 
 import numpy as _onp
@@ -16,6 +17,40 @@ from ..base import MXNetError
 
 DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
 DataDesc.__new__.__defaults__ = (_onp.float32, "NCHW")
+
+# live PrefetchIters for export.snapshot() pull-discovery (weak, same
+# pattern as profiler.attribution._instances); io.pipeline keeps its own
+# registry for the sharded-pipeline classes
+_prefetch_instances: "_weakref.WeakSet" = _weakref.WeakSet()
+_prefetch_seq = [0]
+
+
+def prefetch_stats_all():
+    """``{name: prefetch_stats()}`` over every live :class:`PrefetchIter`
+    — folded into ``profiler.export.snapshot()`` under ``io.<name>.*``."""
+    return {it.name: it.prefetch_stats() for it in list(_prefetch_instances)}
+
+
+# The sharded RecordIO pipeline subsystem lives in io.pipeline; resolve
+# its public names lazily so `import mxnet_tpu.io` stays light (pipeline
+# pulls in gluon.data and the resilience stack).
+_PIPELINE_NAMES = ("RecordPipeline", "ShardedRecordDataset", "DeviceFeeder",
+                   "io_stats")
+
+
+def __getattr__(name):
+    if name in _PIPELINE_NAMES or name == "pipeline":
+        # importlib.import_module, not `from . import pipeline`: the
+        # from-import form re-enters this __getattr__ through importlib's
+        # hasattr probe before the submodule import starts (infinite
+        # recursion on first attribute access).
+        import importlib
+
+        _pipeline = importlib.import_module(__name__ + ".pipeline")
+        if name == "pipeline":
+            return _pipeline
+        return getattr(_pipeline, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class DataBatch:
@@ -369,6 +404,8 @@ class PrefetchIter(DataIter):
         super().__init__(getattr(data_iter, "batch_size", 0))
         self.data_iter = data_iter
         self.num_prefetch = int(num_prefetch)
+        _prefetch_seq[0] += 1
+        self.name = f"prefetch{_prefetch_seq[0]}"
         self._queue_mod = queue
         self._threading = threading
         self._queue = None
@@ -376,8 +413,17 @@ class PrefetchIter(DataIter):
         self._stop = threading.Event()
         self._done = False
         self._error = None
+        # lifetime stats (survive reset, like the pipeline's): consumer
+        # stalls tell you the producer can't keep up; the queue high-water
+        # proves num_prefetch is honored as TRUE depth (it reaches
+        # num_prefetch whenever the consumer is the slow side)
+        self._stat_served = 0
+        self._stalls = 0
+        self._stall_ns = 0
+        self._queue_highwater = 0
         self._rebase()
         self._start()
+        _prefetch_instances.add(self)
 
     @property
     def provide_data(self):
@@ -401,6 +447,9 @@ class PrefetchIter(DataIter):
                     if self._stop.is_set():
                         return
                     self._queue.put(("batch", batch))
+                    depth = self._queue.qsize()
+                    if depth > self._queue_highwater:
+                        self._queue_highwater = depth
                 self._queue.put(("done", None))
             except Exception as exc:  # pylint: disable=broad-except
                 self._queue.put(("error", exc))
@@ -432,15 +481,36 @@ class PrefetchIter(DataIter):
             if self._error is not None:
                 raise self._error
             raise StopIteration
-        kind, payload = self._queue.get()
+        if self._queue.empty():
+            # the consumer outran the producer: that wait is an input
+            # stall (the number PERF.md's stall accounting reads)
+            import time as _time
+
+            t0 = _time.perf_counter_ns()
+            kind, payload = self._queue.get()
+            self._stalls += 1
+            self._stall_ns += _time.perf_counter_ns() - t0
+        else:
+            kind, payload = self._queue.get()
         if kind == "batch":
             self._served += 1
+            self._stat_served += 1
             return payload
         self._done = True
         if kind == "error":
             self._error = payload
             raise payload
         raise StopIteration
+
+    def prefetch_stats(self):
+        """Lifetime prefetch gauges: batches served, consumer-side stalls
+        (count + ms blocked on an empty queue), and the queue high-water
+        mark — proof the configured ``num_prefetch`` is a true depth."""
+        return {"served": int(self._stat_served),
+                "stalls": int(self._stalls),
+                "stall_ms": round(self._stall_ns / 1e6, 3),
+                "queue_highwater": int(self._queue_highwater),
+                "depth": int(self.num_prefetch)}
 
     def _rebase(self):
         """Re-anchor the resumable position: the inner iterator's state as

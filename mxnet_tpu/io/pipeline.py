@@ -1,0 +1,813 @@
+"""Sharded RecordIO input pipeline: streaming shards, a multi-worker
+decode pool, on-device double-buffering, and elastic checkpointable state.
+
+This is the tf.data/Grain-shaped layer the reference implements as the C++
+threaded ``iter_image_recordio_2.cc`` pipeline: partition ``.rec``/``.idx``
+files across data-parallel shards *by index entries*, decode on a pool of
+named daemon threads into a bounded queue, keep the next K batches
+device-resident so H2D overlaps compute, and carry enough state in the
+checkpoint ``datastate`` section that a preempted — or *resharded* — run
+delivers the epoch's sample multiset exactly once.
+
+Three classes, one per layer:
+
+* :class:`ShardedRecordDataset` — a ``gluon.data.Dataset`` view over one or
+  many RecordIO files partitioned by ``(shard_index, num_shards)``; raw
+  record bytes per item (CRC-checked when the index carries checksums), so
+  it composes with ``DataLoader``/samplers/batchify like any dataset.
+* :class:`RecordPipeline` — the streaming iterator: a seedable windowed
+  shuffle fixes the epoch order, the order is chunked into *ranges* of
+  ``batch_size`` entries, and shard ``i`` owns ranges ``i::num_shards``.
+  Workers pull range ids from a task queue, read+decode each entry behind
+  the ``io:read`` fault site (torn/failed records are skipped and counted
+  as quarantined — never a crash), and push batchified results into the
+  bounded output queue. The consumer serves ranges in order (a reorder
+  buffer smooths worker interleaving), so delivery is deterministic for a
+  fixed seed regardless of pool width. A dead worker (``die``-kind fault,
+  the SIGKILL analog) has its in-flight range requeued and is respawned by
+  the consumer-side liveness check — exactly-once either way.
+* :class:`DeviceFeeder` — wraps any batch iterator and keeps
+  ``MXNET_IO_DEVICE_BUFFERS`` (K=2) batches resident via async
+  ``jax.device_put`` (explicit device/sharding, an mx ``Context``, or the
+  active ``replica_context``), so the host-side pull + H2D for batch k+1
+  runs under step k's compute. Blocking pulls are tagged with the
+  ``input`` attribution phase and counted as ``stall_ms``.
+
+**Elastic reshard rule** (wired into ``ElasticTrainingHandler`` via the
+PR-18 ``datastate`` manifest): each shard's :meth:`RecordPipeline
+.state_dict` records the epoch, the seed-derived order signature, and the
+set of range ids it has *delivered to the consumer* (ranges decoded but
+not yet consumed are the in-flight ledger — treated as undelivered on
+restore, so they are re-read, never lost). On a dp8→dp4 mesh loss the
+survivors merge the shards' states (:meth:`RecordPipeline.merge_states`),
+and each survivor repartitions the *remaining* ranges — every range not in
+the union of delivered sets — round-robin across the new shard count.
+Delivered ranges were consumed exactly once before the loss; remaining
+ranges are owned by exactly one survivor; the epoch's sample multiset is
+delivered exactly once.
+
+Everything here is export-discoverable: live pipelines and feeders sit in
+a weak registry and ``profiler.export.snapshot()`` flattens
+:func:`io_stats` under ``io.<name>.*`` (queue depth, worker utilization,
+bytes/s, stall ms, quarantine counts).
+"""
+from __future__ import annotations
+
+import queue as _queue_mod
+import random as _random
+import threading
+import time
+import weakref
+import zlib
+
+from ..base import MXNetError
+from ..gluon.data.dataset import Dataset
+from ..profiler import core as _prof
+from ..profiler import attribution as _attr
+from ..recordio import compute_crc, load_index, read_record_at
+from ..resilience import counters as _rescounters
+from ..resilience import faults as _faults
+
+# live pipelines/feeders for export.snapshot() pull-discovery (weak: a
+# collected pipeline simply stops being exported)
+_instances: "weakref.WeakSet" = weakref.WeakSet()
+_name_seq = [0]
+_name_lock = threading.Lock()
+
+
+def _auto_name(prefix):
+    with _name_lock:
+        _name_seq[0] += 1
+        return f"{prefix}{_name_seq[0]}"
+
+
+def io_stats():
+    """``{name: stats()}`` over every live pipeline/feeder — the ``io.*``
+    section of ``profiler.export.snapshot()``."""
+    return {obj.name: obj.stats() for obj in list(_instances)}
+
+
+# ---------------------------------------------------------------------------
+# index loading shared by the dataset and the pipeline
+# ---------------------------------------------------------------------------
+
+
+def _load_entries(rec_files):
+    """Flatten one or many ``.rec`` files into a global entry table:
+    ``(paths, [(file_id, key, pos, crc), ...])`` in file order. The
+    ``.idx`` sidecar is required (build one with tools/recordio_check.py
+    --repair when missing) except that an absent index falls back to a
+    full sequential scan, same as :class:`~..recordio.MXIndexedRecordIO`.
+    """
+    import os
+
+    from .. import config as _cfg
+    from ..recordio import MXIndexedRecordIO, check_index
+
+    if isinstance(rec_files, str):
+        rec_files = [rec_files]
+    paths = [str(p) for p in rec_files]
+    if not paths:
+        raise MXNetError("io.pipeline: need at least one .rec file")
+    entries = []
+    for fid, path in enumerate(paths):
+        idx_path = os.path.splitext(path)[0] + ".idx"
+        if os.path.isfile(idx_path):
+            rows = load_index(idx_path)
+            if _cfg.get("MXNET_IO_CHECK_INDEX"):
+                check_index(idx_path, os.path.getsize(path),
+                            [p for _, p, _ in rows], rec_path=path)
+        else:
+            # no sidecar: sequential scan (native scanner when built)
+            rec = MXIndexedRecordIO(idx_path, path, "r")
+            rows = [(k, rec.idx[k], None) for k in rec.keys]
+            rec.close()
+        for key, pos, crc in rows:
+            entries.append((fid, key, pos, crc))
+    return paths, entries
+
+
+def _windowed_shuffle(ids, window, rng):
+    """Streaming shuffle with a bounded window (the tf.data
+    ``shuffle(buffer_size)`` shape): deterministic for a fixed rng, full
+    permutation when ``window >= len(ids)``, identity when ``window <= 1``.
+    """
+    if window <= 1:
+        return list(ids)
+    buf = []
+    out = []
+    for i in ids:
+        buf.append(i)
+        if len(buf) >= window:
+            j = rng.randrange(len(buf))
+            buf[j], buf[-1] = buf[-1], buf[j]
+            out.append(buf.pop())
+    while buf:
+        j = rng.randrange(len(buf))
+        buf[j], buf[-1] = buf[-1], buf[j]
+        out.append(buf.pop())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# layer 1: the Dataset view (DataLoader / sampler composition)
+# ---------------------------------------------------------------------------
+
+
+class ShardedRecordDataset(Dataset):
+    """``gluon.data.Dataset`` over one or many RecordIO files partitioned
+    across ``(shard_index, num_shards)`` **by index entries** (entry ``k``
+    belongs to shard ``k % num_shards``), so shards are disjoint and their
+    union is the whole file set regardless of record sizes — byte-range
+    splits can't promise either.
+
+    Items are raw record bytes (run :func:`~..recordio.unpack` /
+    ``unpack_img`` in a ``transform``), CRC-validated when the index
+    carries the extended ``key\\tpos\\tcrc`` column. Picklable (file
+    handles are reopened per process), so it composes with the
+    multiprocessing ``DataLoader`` unchanged.
+    """
+
+    def __init__(self, rec_files, shard_index=0, num_shards=1,
+                 transform=None):
+        if not 0 <= int(shard_index) < int(num_shards):
+            raise MXNetError(
+                f"shard index {shard_index} out of range "
+                f"[0, {num_shards})")
+        self._paths, entries = _load_entries(rec_files)
+        self.shard_index = int(shard_index)
+        self.num_shards = int(num_shards)
+        self._entries = entries[self.shard_index::self.num_shards]
+        self._transform = transform
+        self._files = {}
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __getitem__(self, idx):
+        fid, key, pos, crc = self._entries[idx]
+        with self._lock:
+            fh = self._files.get(fid)
+            if fh is None:
+                fh = self._files[fid] = open(self._paths[fid], "rb")
+            raw = read_record_at(fh, pos, self._paths[fid])
+        if crc is not None and compute_crc(raw) != crc:
+            raise MXNetError(
+                f"CRC mismatch for record {key} in {self._paths[fid]}: "
+                f"index says {crc:#010x}, payload hashes to "
+                f"{compute_crc(raw):#010x}")
+        return self._transform(raw) if self._transform is not None else raw
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_files"] = {}
+        d["_lock"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._files = {}
+        self._lock = threading.Lock()
+
+    def close(self):
+        with self._lock:
+            for fh in self._files.values():
+                fh.close()
+            self._files = {}
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+# ---------------------------------------------------------------------------
+# layers 2+4: the streaming pipeline with the decode pool + elastic state
+# ---------------------------------------------------------------------------
+
+_MISSING = object()
+
+
+class RecordPipeline:
+    """Sharded streaming RecordIO iterator with an N-worker decode pool.
+
+    ``next()`` yields batches (``batchify_fn`` over ``decode_fn`` of each
+    record's bytes; defaults: identity decode, plain-list batchify — pass
+    ``gluon.data.batchify.Stack()`` or ``dataloader.default_batchify_fn``
+    for array batches). ``StopIteration`` at epoch end is sticky until
+    :meth:`reset`, matching the classic ``DataIter`` contract.
+
+    See the module docstring for the range/ownership model, the fault
+    semantics of the ``io:read`` site, and the elastic reshard rule that
+    :meth:`state_dict` / :meth:`load_state_dict` implement.
+    """
+
+    def __init__(self, rec_files, batch_size, shard_index=0, num_shards=1,
+                 num_workers=None, queue_depth=None, shuffle=False, seed=0,
+                 shuffle_buffer=None, decode_fn=None, batchify_fn=None,
+                 last_batch="keep", name=None):
+        from .. import config as _cfg
+
+        if not 0 <= int(shard_index) < int(num_shards):
+            raise MXNetError(
+                f"shard index {shard_index} out of range "
+                f"[0, {num_shards})")
+        if int(batch_size) < 1:
+            raise MXNetError("batch_size must be >= 1")
+        if last_batch not in ("keep", "discard"):
+            raise MXNetError(
+                f"invalid last_batch {last_batch!r} (use 'keep'/'discard')")
+        self._paths, self._entries = _load_entries(rec_files)
+        self.batch_size = int(batch_size)
+        self.shard_index = int(shard_index)
+        self.num_shards = int(num_shards)
+        self.num_workers = int(num_workers if num_workers is not None
+                               else _cfg.get("MXNET_IO_WORKERS"))
+        if self.num_workers < 1:
+            raise MXNetError("num_workers must be >= 1")
+        self.queue_depth = int(queue_depth if queue_depth is not None
+                               else _cfg.get("MXNET_IO_QUEUE_DEPTH"))
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.shuffle_buffer = int(
+            shuffle_buffer if shuffle_buffer is not None
+            else _cfg.get("MXNET_IO_SHUFFLE_BUFFER"))
+        self._decode_fn = decode_fn
+        self._batchify_fn = batchify_fn
+        self.last_batch = last_batch
+        self.name = name or _auto_name("pipeline")
+
+        self._lock = threading.Lock()
+        self._threads = []
+        self._deaths = []        # (worker_name, exc) — kept for stats/tests
+        self._worker_seq = 0
+        self._respawns = 0
+        self._closing = False
+        self._epoch = 0
+        self._t_start = time.perf_counter()
+        # stats accumulators (under _lock)
+        self._busy_ns = 0
+        self._bytes_read = 0
+        self._records_read = 0
+        self._quarantined = 0
+        self._batches = 0
+        self._stall_ns = 0
+
+        self._plan_epoch(owned=None, delivered=set())
+        _instances.add(self)
+
+    # -- epoch planning / elastic state -----------------------------------
+
+    def _epoch_order(self):
+        """The epoch's global entry order — identical on every shard for a
+        fixed (seed, epoch), which is what makes range ids a shared
+        coordinate system that reshard can repartition."""
+        ids = list(range(len(self._entries)))
+        if not self.shuffle:
+            return ids
+        rng = _random.Random((self.seed << 20) ^ self._epoch)
+        return _windowed_shuffle(ids, self.shuffle_buffer, rng)
+
+    def _plan_epoch(self, owned, delivered):
+        """(Re)build the epoch plan: order -> ranges -> ownership; then
+        arm the task queue with the still-undelivered owned ranges."""
+        order = self._epoch_order()
+        bs = self.batch_size
+        ranges = [order[i:i + bs] for i in range(0, len(order), bs)]
+        if self.last_batch == "discard" and ranges \
+                and len(ranges[-1]) < bs:
+            ranges.pop()
+        self._ranges = ranges
+        if owned is None:
+            owned = list(range(self.shard_index, len(ranges),
+                               self.num_shards))
+        self._delivered = set(int(r) for r in delivered)
+        self._owned = [rid for rid in owned if rid not in self._delivered]
+        self._serve_pos = 0
+        self._completed = {}
+        self._inflight = {}
+        self._done = False
+        self._tasks = _queue_mod.Queue()
+        self._out = _queue_mod.Queue(maxsize=self.queue_depth)
+        for rid in self._owned:
+            self._tasks.put(rid)
+
+    def _signature(self):
+        import os
+
+        return {"files": [os.path.basename(p) for p in self._paths],
+                "entries": len(self._entries),
+                "batch_size": self.batch_size,
+                "seed": self.seed,
+                "shuffle": self.shuffle,
+                "shuffle_buffer": self.shuffle_buffer,
+                "last_batch": self.last_batch}
+
+    def state_dict(self):
+        """Elastic checkpointable position: the epoch, the order
+        signature, the range ids this shard has DELIVERED to its
+        consumer, and (informationally) the in-flight ledger — ranges
+        decoded or assigned but not yet consumed, which a restore treats
+        as undelivered (re-read, never lost, never double-counted)."""
+        with self._lock:
+            inflight = sorted(set(self._inflight.values())
+                              | set(self._completed))
+            return {"type": "RecordPipeline",
+                    "signature": self._signature(),
+                    "epoch": int(self._epoch),
+                    "num_shards": int(self.num_shards),
+                    "shard_index": int(self.shard_index),
+                    "delivered": sorted(self._delivered),
+                    "inflight": inflight,
+                    "quarantined": int(self._quarantined)}
+
+    @classmethod
+    def merge_states(cls, states):
+        """Merge per-shard states (same epoch/signature) into one: the
+        union of delivered ranges. This is the reshard hand-off — on mesh
+        loss every survivor loads the merged state and repartitions what
+        remains (see :meth:`load_state_dict`)."""
+        states = list(states)
+        if not states:
+            raise MXNetError("merge_states: need at least one shard state")
+        base = states[0]
+        delivered = set()
+        for s in states:
+            if s.get("type") != "RecordPipeline":
+                raise MXNetError(
+                    f"merge_states: state is for {s.get('type')!r}, "
+                    "not RecordPipeline")
+            if s.get("signature") != base.get("signature") \
+                    or int(s.get("epoch", 0)) != int(base.get("epoch", 0)):
+                raise MXNetError(
+                    "merge_states: shard states disagree on epoch or "
+                    "dataset signature — they are not one epoch's shards")
+            delivered.update(int(r) for r in s.get("delivered", ()))
+        merged = dict(base)
+        merged["delivered"] = sorted(delivered)
+        merged["inflight"] = []
+        merged["merged_from"] = len(states)
+        return merged
+
+    def load_state_dict(self, state):
+        """Restore a position — possibly onto a DIFFERENT shard layout.
+
+        Same ``num_shards``: this shard keeps its modulo-partition and
+        simply drops the delivered ranges from its task list (sample-exact
+        resume). Different ``num_shards`` (the dp8→dp4 reshard): the
+        remaining ranges — every range not in ``delivered``, which for a
+        merged state is the union over the old shards — are repartitioned
+        round-robin across the new shard count, so each remaining range
+        has exactly one owner and the epoch's multiset completes exactly
+        once."""
+        if state.get("type") != "RecordPipeline":
+            raise MXNetError(
+                f"RecordPipeline.load_state_dict: state is for "
+                f"{state.get('type')!r}, not RecordPipeline")
+        sig = state.get("signature")
+        if sig != self._signature():
+            raise MXNetError(
+                "RecordPipeline.load_state_dict: checkpoint signature "
+                f"{sig!r} does not match this pipeline "
+                f"{self._signature()!r} — different dataset or pipeline "
+                "config")
+        self._stop_workers()
+        self._epoch = int(state.get("epoch", 0))
+        delivered = set(int(r) for r in state.get("delivered", ()))
+        if int(state.get("num_shards", self.num_shards)) == self.num_shards:
+            owned = None  # default modulo partition, planner drops delivered
+        else:
+            order = self._epoch_order()
+            bs = self.batch_size
+            n_ranges = len(order) // bs if self.last_batch == "discard" \
+                else (len(order) + bs - 1) // bs
+            remaining = [rid for rid in range(n_ranges)
+                         if rid not in delivered]
+            owned = remaining[self.shard_index::self.num_shards]
+        self._plan_epoch(owned=owned, delivered=delivered)
+
+    # -- worker pool -------------------------------------------------------
+
+    def _spawn_worker(self):
+        """Create+register one worker thread; caller holds ``_lock``.
+        Returns the thread — ``start()`` it OUTSIDE the lock
+        (``Thread.start`` blocks on a Condition until the child runs,
+        a blocking-under-lock violation if done here). Until started,
+        ``th.ident`` is None, which is how the liveness scans tell a
+        not-yet-started thread from a dead one."""
+        self._worker_seq += 1
+        wname = f"mxtpu-io-{self.name}-w{self._worker_seq}"
+        th = threading.Thread(target=self._worker_run, args=(wname,),
+                              daemon=True, name=wname)
+        self._threads.append(th)
+        return th
+
+    def _start_workers(self):
+        with self._lock:
+            if self._threads or self._closing:
+                return
+            fresh = [self._spawn_worker() for _ in range(self.num_workers)]
+        for th in fresh:
+            th.start()
+
+    def _stop_workers(self):
+        with self._lock:
+            threads, self._threads = self._threads, []
+            self._closing = True
+        for _ in threads:
+            self._tasks.put(None)
+        for th in threads:
+            while th.is_alive():
+                # drain the bounded output queue so a worker blocked on a
+                # full put can reach its sentinel
+                try:
+                    self._out.get_nowait()
+                except _queue_mod.Empty:
+                    pass
+                th.join(timeout=0.05)
+        with self._lock:
+            self._closing = False
+            self._inflight.clear()
+
+    def _worker_run(self, wname):
+        _prof.register_thread_name()
+        files = {}
+        try:
+            while True:
+                task = self._tasks.get()
+                if task is None:
+                    return
+                with self._lock:
+                    if self._closing:
+                        return
+                    self._inflight[wname] = task
+                t0 = time.perf_counter_ns()
+                batch = self._process_range(task, files)
+                # blocking put OUTSIDE the ledger lock: this is the
+                # backpressure point and must not hold anything
+                self._out.put((task, batch))
+                with self._lock:
+                    self._inflight.pop(wname, None)
+                    self._busy_ns += time.perf_counter_ns() - t0
+        except BaseException as exc:  # noqa: B036 — die-kind faults land here
+            # worker death (SimulatedWorkerDeath or a genuine crash):
+            # record the corpse, requeue the in-flight range, exit; the
+            # consumer-side liveness check respawns a replacement
+            with self._lock:
+                self._deaths.append((wname, exc))
+                rid = self._inflight.pop(wname, None)
+            if rid is not None:
+                self._tasks.put(rid)
+        finally:
+            for fh in files.values():
+                fh.close()
+
+    def _process_range(self, rid, files):
+        """Read+decode one range's entries. Per-entry failures — injected
+        ``io:read`` faults, torn/truncated records, CRC mismatches, decode
+        errors — skip that entry and bump the quarantine counter; a range
+        whose every entry is quarantined still completes (as ``None``) so
+        the in-order consumer never stalls on it."""
+        items = []
+        nbytes = 0
+        for eid in self._ranges[rid]:
+            fid, key, pos, crc = self._entries[eid]
+            try:
+                marker = _faults.fault_point(
+                    "io:read", {"shard": self.shard_index, "entry": eid})
+                if isinstance(marker, dict) \
+                        and marker.get("kind") == "torn":
+                    raise MXNetError(
+                        f"injected torn record (entry {eid} of "
+                        f"{self._paths[fid]})")
+                fh = files.get(fid)
+                if fh is None:
+                    fh = files[fid] = open(self._paths[fid], "rb")
+                raw = read_record_at(fh, pos, self._paths[fid])
+                if crc is not None and compute_crc(raw) != crc:
+                    raise MXNetError(
+                        f"CRC mismatch for record {key} in "
+                        f"{self._paths[fid]}")
+                item = (self._decode_fn(raw)
+                        if self._decode_fn is not None else raw)
+            except _faults.SimulatedWorkerDeath:
+                raise
+            except Exception as exc:  # noqa: BLE001 — skip+quarantine
+                self._note_quarantine(eid, exc)
+                continue
+            items.append(item)
+            nbytes += len(raw)
+        with self._lock:
+            self._bytes_read += nbytes
+            self._records_read += len(items)
+        if not items:
+            return None
+        if self._batchify_fn is not None:
+            return self._batchify_fn(items)
+        return items
+
+    def _note_quarantine(self, eid, exc):
+        with self._lock:
+            self._quarantined += 1
+            n = self._quarantined
+        _rescounters.incr("resilience.io_records_quarantined")
+        if _rescounters.should_warn(n):
+            import warnings
+
+            warnings.warn(
+                f"io.pipeline {self.name}: quarantined record (entry "
+                f"{eid}): {type(exc).__name__}: {exc} "
+                f"({n} quarantined so far)", RuntimeWarning, stacklevel=2)
+
+    def _check_workers(self):
+        """Consumer-side liveness probe: respawn workers that died (their
+        in-flight range was requeued by the corpse handler)."""
+        with self._lock:
+            dead = [th for th in self._threads
+                    if th.ident is not None and not th.is_alive()]
+            for th in dead:
+                self._threads.remove(th)
+            if dead and self._respawns > 16 + 4 * self.num_workers:
+                last = self._deaths[-1][1] if self._deaths else None
+                raise MXNetError(
+                    f"io.pipeline {self.name}: worker respawn storm "
+                    f"({self._respawns} respawns); last death: "
+                    f"{type(last).__name__ if last else '?'}: {last}")
+            fresh = []
+            for _ in dead:
+                self._respawns += 1
+                fresh.append(self._spawn_worker())
+        for th in fresh:
+            th.start()
+
+    # -- the consumer ------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        self._start_workers()
+        while True:
+            with self._lock:
+                respawn_due = any(th.ident is not None and not th.is_alive()
+                                  for th in self._threads)
+            if respawn_due:
+                self._check_workers()
+            with self._lock:
+                if self._done:
+                    raise StopIteration
+                if self._serve_pos >= len(self._owned):
+                    # sticky terminal state, same contract as NDArrayIter
+                    self._done = True
+                    raise StopIteration
+                rid = self._owned[self._serve_pos]
+                batch = self._completed.pop(rid, _MISSING)
+                if batch is not _MISSING:
+                    self._serve_pos += 1
+                    self._delivered.add(rid)
+                    if batch is None:
+                        continue  # fully-quarantined range: nothing to serve
+                    self._batches += 1
+                    return batch
+            # the next in-order range isn't decoded yet: drain the output
+            # queue (any range counts — the reorder buffer holds strays)
+            # and probe worker liveness while we wait
+            t0 = time.perf_counter_ns()
+            try:
+                done_rid, done_batch = self._out.get(timeout=0.05)
+                with self._lock:
+                    self._completed[done_rid] = done_batch
+            except _queue_mod.Empty:
+                self._check_workers()
+            dt = time.perf_counter_ns() - t0
+            with self._lock:
+                self._stall_ns += dt
+            _attr.note_wait(dt, "input")
+
+    def reset(self):
+        """Advance to the next epoch (fresh shuffle order from the same
+        seed) and restart the pool."""
+        self._stop_workers()
+        self._epoch += 1
+        self._plan_epoch(owned=None, delivered=set())
+
+    def __len__(self):
+        return len(self._owned)
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    def stats(self):
+        """Export-facing gauges (``io.<name>.*`` in
+        ``export.snapshot()``)."""
+        wall_ns = max(1e-9, time.perf_counter() - self._t_start) * 1e9
+        with self._lock:
+            alive = sum(1 for th in self._threads if th.is_alive())
+            return {
+                "epoch": self._epoch,
+                "shard_index": self.shard_index,
+                "num_shards": self.num_shards,
+                "workers": self.num_workers,
+                "workers_alive": alive,
+                "worker_respawns": self._respawns,
+                "worker_utilization": round(
+                    self._busy_ns / (wall_ns * self.num_workers), 4),
+                "queue_depth": self._out.qsize(),
+                "queue_capacity": self.queue_depth,
+                "ranges_total": len(self._owned),
+                "ranges_delivered": self._serve_pos,
+                "batches_served": self._batches,
+                "records_read": self._records_read,
+                "records_quarantined": self._quarantined,
+                "bytes_read": self._bytes_read,
+                "bytes_per_s": round(self._bytes_read / (wall_ns / 1e9), 1),
+                "stall_ms": round(self._stall_ns / 1e6, 3),
+            }
+
+    def close(self):
+        self._stop_workers()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+# ---------------------------------------------------------------------------
+# layer 3: on-device double-buffering
+# ---------------------------------------------------------------------------
+
+
+class DeviceFeeder:
+    """Keep the next K batches device-resident via async ``device_put``.
+
+    Wraps any batch iterator (a :class:`RecordPipeline`, a ``DataLoader``,
+    a ``DataIter``). Each ``next()`` tops the buffer up to ``depth``
+    (``MXNET_IO_DEVICE_BUFFERS``, K=2) — issuing the host pull and the H2D
+    transfer for batch k+1 *before* returning batch k — so the transfer
+    overlaps the consumer's compute and the steady-state input stall is
+    the time the host pipeline couldn't hide, counted in ``stall_ms`` and
+    tagged with the ``input`` attribution phase.
+
+    Placement, first match wins: an explicit JAX ``sharding`` (mesh-aware
+    placement for sharded trainers), an explicit JAX ``device``, an mx
+    ``ctx`` (``Context.jax_device()``), the active ``replica_context``
+    (per-replica dp trainers), else JAX's default device.
+    """
+
+    def __init__(self, source, depth=None, device=None, sharding=None,
+                 ctx=None, name=None):
+        from .. import config as _cfg
+
+        self._source = source
+        self._it = iter(source)
+        self.depth = int(depth if depth is not None
+                         else _cfg.get("MXNET_IO_DEVICE_BUFFERS"))
+        if self.depth < 1:
+            raise MXNetError("DeviceFeeder depth must be >= 1")
+        self._device = device
+        self._sharding = sharding
+        self._ctx = ctx
+        self.name = name or _auto_name("feeder")
+        self._buf = []
+        self._exhausted = False
+        self._batches = 0
+        self._stall_ns = 0
+        _instances.add(self)
+
+    def _target(self):
+        if self._sharding is not None:
+            return self._sharding
+        if self._device is not None:
+            return self._device
+        if self._ctx is not None:
+            return self._ctx.jax_device()
+        from ..gluon.parameter import _active_replica_ctx
+
+        rctx = _active_replica_ctx()
+        if rctx is not None:
+            return rctx.jax_device()
+        return None
+
+    def _place(self, x):
+        import jax
+
+        from ..ndarray.ndarray import NDArray
+
+        target = self._target()
+
+        def put(arr):
+            if target is None:
+                return jax.device_put(arr)
+            return jax.device_put(arr, target)
+
+        def walk(v):
+            if isinstance(v, NDArray):
+                return type(v)(put(v._data))
+            if isinstance(v, dict):
+                return {k: walk(u) for k, u in v.items()}
+            if isinstance(v, (list, tuple)):
+                return type(v)(walk(u) for u in v)
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                return put(v)
+            return v
+
+        from . import DataBatch
+
+        if isinstance(x, DataBatch):
+            return DataBatch(data=walk(x.data), label=walk(x.label),
+                             pad=x.pad, index=x.index,
+                             provide_data=x.provide_data,
+                             provide_label=x.provide_label)
+        return walk(x)
+
+    def _fill(self):
+        while not self._exhausted and len(self._buf) < self.depth:
+            t0 = time.perf_counter_ns()
+            with _attr.phase_scope("input"):
+                try:
+                    batch = next(self._it)
+                except StopIteration:
+                    self._exhausted = True
+                    return
+                placed = self._place(batch)  # async dispatch, no block
+            dt = time.perf_counter_ns() - t0
+            self._stall_ns += dt
+            _attr.note_wait(dt, "input")
+            self._buf.append(placed)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._fill()
+        if not self._buf:
+            raise StopIteration
+        batch = self._buf.pop(0)
+        self._batches += 1
+        # top the buffer back up NOW so batch k+1's pull + H2D overlaps
+        # the consumer's step k
+        self._fill()
+        return batch
+
+    def next(self):
+        return self.__next__()
+
+    def reset(self):
+        resetter = getattr(self._source, "reset", None)
+        if resetter is not None:
+            resetter()
+        self._it = iter(self._source)
+        self._buf = []
+        self._exhausted = False
+
+    def stats(self):
+        return {"depth": self.depth,
+                "buffered": len(self._buf),
+                "batches": self._batches,
+                "stall_ms": round(self._stall_ns / 1e6, 3)}

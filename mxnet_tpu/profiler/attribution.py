@@ -59,7 +59,7 @@ _wait_ns_by_phase: "collections.Counter" = collections.Counter()
 # engine's ledger is simply no longer exported)
 _instances: "weakref.WeakSet" = weakref.WeakSet()
 
-PHASES = ("decode", "prefill", "train", "other")
+PHASES = ("decode", "prefill", "train", "input", "other")
 
 
 def enable():
@@ -110,8 +110,8 @@ class _PhaseCtx:
 
 def phase_scope(phase):
     """Label the calling thread's active phase (``decode`` / ``prefill``
-    / ``train`` / ``other``) for the ``with`` body. Engine wait stalls
-    inside the scope are tagged with it."""
+    / ``train`` / ``input`` / ``other``) for the ``with`` body. Engine
+    wait stalls inside the scope are tagged with it."""
     return _PhaseCtx(phase)
 
 

@@ -143,6 +143,18 @@ def snapshot(include_aggregates=True):
         for name, snap in slo_mod.all_snapshots().items():
             _flatten(f"slo.{name}", snap, out)
 
+    # input pipeline: io.<name>.* gauges from live RecordPipelines /
+    # DeviceFeeders (queue depth, worker utilization, bytes/s, stall ms)
+    # and PrefetchIter prefetch_stats()
+    iomod = sys.modules.get("mxnet_tpu.io.pipeline")
+    if iomod is not None:
+        for name, snap in iomod.io_stats().items():
+            _flatten(f"io.{name}", snap, out)
+    io_pkg = sys.modules.get("mxnet_tpu.io")
+    if io_pkg is not None:
+        for name, snap in io_pkg.prefetch_stats_all().items():
+            _flatten(f"io.{name}", snap, out)
+
     attr_mod = sys.modules.get("mxnet_tpu.profiler.attribution")
     if attr_mod is not None:
         for name, snap in attr_mod.all_snapshots().items():

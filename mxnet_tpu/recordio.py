@@ -16,6 +16,7 @@ import ctypes
 import io
 import os
 import struct
+import zlib
 from collections import namedtuple
 
 import numpy as _onp
@@ -24,6 +25,136 @@ from .base import MXNetError
 
 _MAGIC = 0xCED7230A
 _LREC_MASK = (1 << 29) - 1
+
+
+def compute_crc(data):
+    """CRC32 of one record's full payload bytes — the checksum stored in
+    the optional third ``.idx`` column (``key\\tpos\\tcrc``). The dmlc
+    format itself carries no per-record checksum, so torn/bit-rotted
+    payloads that keep valid framing are otherwise undetectable; an index
+    written by ``tools/recordio_check.py --crc`` closes that gap."""
+    return zlib.crc32(bytes(data)) & 0xFFFFFFFF
+
+
+def read_record_at(fileobj, pos, uri="?"):
+    """Read the complete record starting at byte offset ``pos`` from an
+    open binary file object (multi-part records are reassembled). Stateless
+    random access for concurrent readers that keep one file handle per
+    thread — the decode-pool path (``io.pipeline``) where sharing one
+    seek+read ``MXRecordIO`` would serialize every worker."""
+    fileobj.seek(pos)
+    parts = []
+    while True:
+        head = fileobj.read(8)
+        if len(head) < 8:
+            raise MXNetError(f"truncated record at offset {pos} in {uri}")
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise MXNetError(
+                f"invalid record magic {magic:#x} at offset {pos} in {uri}")
+        n = _length(lrec)
+        flag = _cflag(lrec)
+        data = fileobj.read(n)
+        if len(data) < n:
+            raise MXNetError(f"truncated record at offset {pos} in {uri}")
+        pad = (4 - (n & 3)) & 3
+        if pad:
+            fileobj.read(pad)
+        parts.append(data)
+        if flag in (0, 3):  # complete or end-of-multipart
+            return b"".join(parts)
+
+
+def load_index(idx_path, key_type=int):
+    """Parse a ``.idx`` file into ``[(key, pos, crc-or-None), ...]`` in
+    file order. Accepts both the reference two-column ``key\\tpos`` format
+    and the extended three-column ``key\\tpos\\tcrc`` format written by
+    ``tools/recordio_check.py --crc``; malformed lines are skipped (same
+    tolerance as :class:`MXIndexedRecordIO`)."""
+    entries = []
+    with open(idx_path) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) not in (2, 3) or not parts[0]:
+                continue
+            try:
+                key = key_type(parts[0])
+                pos = int(parts[1])
+                crc = int(parts[2]) if len(parts) == 3 else None
+            except ValueError:
+                continue
+            entries.append((key, pos, crc))
+    return entries
+
+
+def _skip_record(fileobj, pos):
+    """Walk the record framing at ``pos`` without reading payloads and
+    return the offset one past its final (padded) part, or ``None`` when
+    the bytes there are not a complete well-formed record (torn tail,
+    garbage, EOF)."""
+    fileobj.seek(0, 2)
+    size = fileobj.tell()
+    while True:
+        if pos + 8 > size:
+            return None
+        fileobj.seek(pos)
+        head = fileobj.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            return None
+        n = _length(lrec)
+        pos += 8 + n + ((4 - (n & 3)) & 3)
+        if pos > size:
+            return None
+        if _cflag(lrec) in (0, 3):  # complete or end-of-multipart
+            return pos
+
+
+def check_index(idx_path, rec_size, positions=None, rec_path=None):
+    """Integrity-check a parsed index against its ``.rec`` file size:
+    every offset must be 4-byte aligned (the format pads records to 4),
+    strictly increasing in file order (records are written sequentially),
+    and leave room for at least a record header before EOF. When
+    ``rec_path`` is given, additionally probe past the LAST indexed
+    record: a complete well-formed record sitting there unindexed means
+    the index is stale/truncated (a torn tail — partial write after a
+    crash — is tolerated; ``tools/recordio_check.py`` reports those).
+    Raises a loud :class:`MXNetError` naming the index file — a
+    silently-wrong index turns into silently-wrong training data, so
+    this fails fast instead.
+    """
+    if positions is None:
+        positions = [p for _, p, _ in load_index(idx_path)]
+    prev = -1
+    for i, pos in enumerate(positions):
+        if pos & 3:
+            raise MXNetError(
+                f"corrupt index {idx_path}: entry {i} offset {pos} is not "
+                "4-byte aligned (RecordIO records are padded to 4 bytes)")
+        if pos <= prev:
+            raise MXNetError(
+                f"corrupt index {idx_path}: entry {i} offset {pos} is not "
+                f"strictly increasing (previous entry at {prev}) — the "
+                "index does not match a sequentially-written .rec file")
+        if pos + 8 > rec_size:
+            raise MXNetError(
+                f"corrupt index {idx_path}: entry {i} offset {pos} leaves "
+                f"no room for a record header before EOF ({rec_size} "
+                "bytes) — the .rec file is truncated or the index is "
+                "stale; run tools/recordio_check.py --repair")
+        prev = pos
+    if rec_path is not None and positions:
+        with open(rec_path, "rb") as fin:
+            end = _skip_record(fin, positions[-1])
+            if end is not None and end < rec_size \
+                    and _skip_record(fin, end) is not None:
+                raise MXNetError(
+                    f"corrupt index {idx_path}: complete record(s) after "
+                    f"the last indexed entry (offset {end} of {rec_size} "
+                    "bytes) — the index is truncated or stale; run "
+                    "tools/recordio_check.py --repair")
 
 
 def _cflag(lrec):
@@ -140,6 +271,7 @@ class MXIndexedRecordIO(MXRecordIO):
         self.idx_path = idx_path
         self.idx = {}
         self.keys = []
+        self.crcs = {}
         self.key_type = key_type
         super().__init__(uri, flag)
 
@@ -147,16 +279,22 @@ class MXIndexedRecordIO(MXRecordIO):
         super().open()
         self.idx = {}
         self.keys = []
+        self.crcs = {}
         if self.flag == "r":
             if os.path.isfile(self.idx_path):
-                with open(self.idx_path) as fin:
-                    for line in fin:
-                        parts = line.strip().split("\t")
-                        if len(parts) != 2:
-                            continue
-                        key = self.key_type(parts[0])
-                        self.idx[key] = int(parts[1])
-                        self.keys.append(key)
+                for key, pos, crc in load_index(self.idx_path,
+                                                self.key_type):
+                    self.idx[key] = pos
+                    self.keys.append(key)
+                    if crc is not None:
+                        self.crcs[key] = crc
+                from . import config as _cfg
+
+                if _cfg.get("MXNET_IO_CHECK_INDEX"):
+                    check_index(self.idx_path,
+                                os.path.getsize(self.uri),
+                                [self.idx[k] for k in self.keys],
+                                rec_path=self.uri)
             else:
                 # no .idx: rebuild by scanning the file — native C++ scanner
                 # when available (the reference's C++ path), python otherwise
@@ -199,7 +337,15 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def read_idx(self, idx):
         self.seek(idx)
-        return self.read()
+        rec = self.read()
+        crc = self.crcs.get(idx)
+        if crc is not None and rec is not None \
+                and compute_crc(rec) != crc:
+            raise MXNetError(
+                f"CRC mismatch for record {idx} in {self.uri}: the index "
+                f"says {crc:#010x}, the payload hashes to "
+                f"{compute_crc(rec):#010x} — torn or bit-rotted record")
+        return rec
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

@@ -182,6 +182,16 @@ KNOWN_SITES = (
                             # the FINAL name (the bit-rot / partial-sync
                             # state os.replace normally rules out), so
                             # the CRC-quarantine rollback is testable
+    "io:read",              # io.pipeline decode workers, once per record
+                            # read, with info={"shard", "entry"} — a
+                            # 'transient'/'fatal' or 'torn' marker makes
+                            # the worker SKIP that record and bump the
+                            # resilience.io_records_quarantined counter
+                            # (a torn record must never crash the
+                            # pipeline); a 'die' kills the worker thread
+                            # mid-range (the range is requeued and the
+                            # pool respawns a replacement — exactly-once
+                            # delivery either way)
     "preempt:deliver",      # resilience.preemption.PreemptionHandler,
                             # once per batch with info={"batch": n} — a
                             # 'preempt' marker is an injected SIGTERM-

@@ -108,8 +108,10 @@ def test_kill_during_async_save_loads_last_good(tmp_path):
     assert m.wait()
     faults.install_plan({"rules": [
         {"site": "ckpt:write", "kind": "die", "at": [0]}]})
-    m.save(2, params=_params())
+    # the capture context must wrap save(): the writer thread can emit
+    # its warning before a context entered afterwards starts recording
     with pytest.warns(RuntimeWarning, match="async checkpoint write"):
+        m.save(2, params=_params())
         assert m.wait() is False  # the in-flight write died
     faults.clear_plan()
     assert m.list_steps() == [1]
@@ -305,6 +307,65 @@ def test_dataloader_state_rejects_foreign_type():
                     batch_size=2)
     with pytest.raises(MXNetError, match="DataLoader"):
         dl.load_state_dict({"type": "NDArrayIter", "cursor": 0})
+
+
+def _make_rec(tmp_path, n=24):
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "p.rec")
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "p.idx"), rec, "w")
+    for i in range(n):
+        w.write_idx(i, b"%d" % i)
+    w.close()
+    return rec
+
+
+@pytest.mark.parametrize("cut", [1, 3])
+def test_recordpipeline_resume_sample_exact(cut, tmp_path):
+    from mxnet_tpu.io.pipeline import RecordPipeline
+
+    rec = _make_rec(tmp_path)
+
+    def make():
+        return RecordPipeline([rec], batch_size=4, num_workers=2,
+                              shuffle=True, seed=13)
+
+    ref_pipe = make()
+    ref = [int(x) for b in ref_pipe for x in b]
+    ref_pipe.close()
+
+    p1 = make()
+    head = [int(x) for _ in range(cut) for x in next(p1)]
+    state = p1.state_dict()
+    p1.close()
+    p2 = make()
+    p2.load_state_dict(state)
+    tail = [int(x) for b in p2 for x in b]
+    p2.close()
+    assert head + tail == ref
+    assert sorted(head + tail) == list(range(24))
+
+
+def test_recordpipeline_datastate_rides_in_checkpoint(tmp_path):
+    from mxnet_tpu.io.pipeline import RecordPipeline
+
+    rec = _make_rec(tmp_path)
+
+    def make():
+        return RecordPipeline([rec], batch_size=4, num_workers=2,
+                              shuffle=True, seed=17)
+
+    p1 = make()
+    next(p1), next(p1)
+    ckpt.save_checkpoint(str(tmp_path / "c.ckpt"), params=_params(),
+                         meta={"step": 2}, data_state=p1.state_dict())
+    rest_ref = [int(x) for b in p1 for x in b]
+    p1.close()
+
+    p2 = make()
+    ckpt.load_checkpoint(str(tmp_path / "c.ckpt"), data_iter=p2)
+    assert [int(x) for b in p2 for x in b] == rest_ref
+    p2.close()
 
 
 def test_datastate_rides_in_checkpoint_and_restores(tmp_path):

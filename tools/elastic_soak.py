@@ -600,6 +600,69 @@ def _run_corrupt_leg(seed, n_batches, say):
                         "cadence": cadence}
 
 
+def _run_data_leg(seed, say):
+    """Sharded-input reshard leg: four shard-owning RecordPipelines
+    stream one epoch of a synthetic crc-indexed ``.rec``; after a few
+    batches two shards are killed, the survivors ``merge_states`` +
+    ``load_state_dict`` (dp4 -> dp2 on the data axis) and finish the
+    epoch. Asserted: the epoch's sample multiset is delivered exactly
+    once across the cut — nothing replayed, nothing skipped — which is
+    the ``data_parity=exact`` contract of the PR-20 reshard rule."""
+    import tempfile
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io.pipeline import RecordPipeline
+
+    violations = []
+    n_records, batch = 96, 4
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="elastic_data.") as d:
+        rec = os.path.join(d, "soak.rec")
+        w = recordio.MXIndexedRecordIO(os.path.join(d, "soak.idx"),
+                                       rec, "w")
+        for i in range(n_records):
+            w.write_idx(i, b"%d" % i)
+        w.close()
+
+        def mk(shard, shards, tag):
+            return RecordPipeline(
+                [rec], batch_size=batch, shard_index=shard,
+                num_shards=shards, num_workers=2, shuffle=True,
+                seed=seed, name=f"soak-data-{tag}{shard}")
+
+        pipes = [mk(s, 4, "pre") for s in range(4)]
+        head = []
+        for p in pipes:
+            for _ in range(2):
+                head.extend(int(x) for x in next(p))
+        states = [p.state_dict() for p in pipes]
+        for p in pipes:
+            p.close()
+        merged = RecordPipeline.merge_states(states)
+        survivors = [mk(s, 2, "post") for s in range(2)]
+        tail = []
+        for p in survivors:
+            p.load_state_dict(merged)
+            for b in p:
+                tail.extend(int(x) for x in b)
+            p.close()
+    got = sorted(head + tail)
+    parity = got == list(range(n_records))
+    if not parity:
+        dupes = len(got) - len(set(got))
+        violations.append(
+            f"data: reshard multiset wrong — {len(got)} samples with "
+            f"{dupes} dupes across the 4->2 cut (want {n_records} "
+            "exactly once)")
+    row = {"records": n_records, "shards_from": 4, "shards_to": 2,
+           "delivered_pre": len(head), "delivered_post": len(tail),
+           "data_parity": "exact" if parity else "DIVERGED",
+           "leg_wall_s": time.perf_counter() - t0}
+    say(f"data leg: 4->2 shard reshard data={row['data_parity']} "
+        f"({len(head)} pre-cut + {len(tail)} post-cut)")
+    return violations, row
+
+
 def run_soak(seed=7, n_batches=12, verbose=True, legs="all"):
     """One full seeded kill/lag/corrupt/kill-3d sweep; returns a report
     dict with ``ok``/``violations`` plus the per-leg numbers.
@@ -632,12 +695,15 @@ def run_soak(seed=7, n_batches=12, verbose=True, legs="all"):
         else:
             os.environ["MXNET_ELASTIC"] = prev
     v4, kill3d_row = run_kill_reshard_3d(seed, n_batches, say)
-    violations += v2 + v3 + v4
+    v5, data_row = _run_data_leg(seed, say)
+    violations += v2 + v3 + v4 + v5
     report = {"ok": not violations, "violations": violations,
               "seed": seed, "kill": kill_row, "lag": lag_row,
-              "corrupt": corrupt_row, "kill_3d": kill3d_row}
+              "corrupt": corrupt_row, "kill_3d": kill3d_row,
+              "data": data_row}
     say(f"seed {seed}: {'PASS' if report['ok'] else 'FAIL'} "
-        f"kill={kill_row} corrupt={corrupt_row} kill_3d={kill3d_row}")
+        f"kill={kill_row} corrupt={corrupt_row} kill_3d={kill3d_row} "
+        f"data={data_row}")
     return report
 
 

@@ -40,7 +40,7 @@ DOC_FILES = ("README.md", "SERVING.md", "RESILIENCE.md",
 # must literally appear (as "<ns>.") in export.py or we flag drift
 COUNTER_NAMESPACES = ("profiler", "engine", "cachedop", "kvstore",
                       "resilience", "serve", "fleet", "recorder", "trace",
-                      "registry", "slo", "attribution")
+                      "registry", "slo", "attribution", "io")
 
 _FLAG_TOKEN = re.compile(r"^MXNET_[A-Z0-9_]+$")
 

@@ -48,7 +48,7 @@ _LOWER_BETTER_UNITS = ("ms", "us", "ns", "s", "s/iter", "ms/token",
 
 # metric-name fallback for rows whose unit went missing in an old
 # emission: elastic recovery time (elastic_resume/_3d) is lower-better
-_LOWER_BETTER_METRIC_SUFFIXES = ("recovery_ms",)
+_LOWER_BETTER_METRIC_SUFFIXES = ("recovery_ms", "stall_ms")
 
 
 def extract_rows(text):

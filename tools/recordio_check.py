@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Validate (and repair) a RecordIO ``.idx`` against its ``.rec`` file.
+
+A stale or hand-mangled index turns into silently-wrong training data, and
+a torn ``.rec`` tail (partial last record after a crashed writer) makes the
+sequential reader blow up mid-epoch. This tool scans the ``.rec`` framing
+front to back — the ground truth — and compares it with the sidecar index:
+
+    python tools/recordio_check.py data.rec            # validate
+    python tools/recordio_check.py data.rec --repair   # rewrite .idx
+    python tools/recordio_check.py data.rec --repair --crc   # + checksums
+
+``--crc`` writes the extended three-column ``key\\tpos\\tcrc`` format
+(crc32 of each record's payload); readers that know the column
+(``MXIndexedRecordIO``, ``io.pipeline``) verify it on every read and
+quarantine/refuse mismatching records.
+
+Exit status: 0 — index matches (or was repaired); 1 — problems found and
+not repaired; 2 — the ``.rec`` itself is unreadable.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.recordio import (  # noqa: E402
+    _LREC_MASK,
+    _MAGIC,
+    compute_crc,
+    load_index,
+)
+
+
+def scan_rec(path):
+    """Walk the ``.rec`` framing front to back. Returns
+    ``(records, torn_at)``: ``records`` is ``[(pos, payload_bytes), ...]``
+    for every complete record, ``torn_at`` the byte offset of a torn tail
+    (``None`` when the file ends cleanly on a record boundary)."""
+    size = os.path.getsize(path)
+    records = []
+    with open(path, "rb") as fh:
+        pos = 0
+        while pos < size:
+            start = pos
+            parts = []
+            try:
+                while True:  # one (possibly multi-part) record
+                    head = fh.read(8)
+                    if len(head) < 8:
+                        raise MXNetError("truncated header")
+                    magic, lrec = struct.unpack("<II", head)
+                    if magic != _MAGIC:
+                        raise MXNetError(f"bad magic {magic:#x}")
+                    n = lrec & _LREC_MASK
+                    cflag = lrec >> 29
+                    data = fh.read(n)
+                    if len(data) < n:
+                        raise MXNetError("truncated payload")
+                    pad = (4 - (n & 3)) & 3
+                    if pad:
+                        fh.read(pad)
+                    parts.append(data)
+                    if cflag in (0, 3):
+                        break
+            except MXNetError:
+                return records, start
+            records.append((start, b"".join(parts)))
+            pos = fh.tell()
+    return records, None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="validate/repair a RecordIO .idx from its .rec")
+    ap.add_argument("rec", help="path to the .rec file")
+    ap.add_argument("--idx", default=None,
+                    help="index path (default: <rec stem>.idx)")
+    ap.add_argument("--repair", action="store_true",
+                    help="rewrite the .idx from the .rec scan")
+    ap.add_argument("--crc", action="store_true",
+                    help="write per-record crc32 as a third index column")
+    args = ap.parse_args(argv)
+
+    rec = args.rec
+    idx = args.idx or os.path.splitext(rec)[0] + ".idx"
+    if not os.path.isfile(rec):
+        print(f"recordio_check: {rec}: no such file", file=sys.stderr)
+        return 2
+
+    try:
+        records, torn_at = scan_rec(rec)
+    except OSError as e:
+        print(f"recordio_check: {rec}: {e}", file=sys.stderr)
+        return 2
+
+    problems = []
+    if torn_at is not None:
+        problems.append(
+            f"torn tail: framing breaks at offset {torn_at} "
+            f"({len(records)} complete records before it)")
+
+    existing = load_index(idx) if os.path.isfile(idx) else None
+    if existing is None:
+        problems.append(f"index {idx} is missing")
+    else:
+        if len(existing) != len(records):
+            problems.append(
+                f"entry count mismatch: index has {len(existing)}, "
+                f".rec holds {len(records)} complete records")
+        scanned = {pos: payload for pos, payload in records}
+        for key, pos, crc in existing:
+            payload = scanned.get(pos)
+            if payload is None:
+                problems.append(
+                    f"key {key}: offset {pos} is not a record boundary")
+                continue
+            if crc is not None and compute_crc(payload) != crc:
+                problems.append(
+                    f"key {key}: crc mismatch at offset {pos} "
+                    f"(index {crc:#010x}, payload "
+                    f"{compute_crc(payload):#010x})")
+
+    for p in problems:
+        print(f"recordio_check: {rec}: {p}")
+
+    if args.repair:
+        # ground truth is the scan; keep the old keys when the counts
+        # line up (labels often live in the key), else renumber 0..n-1
+        keys = ([k for k, _, _ in existing]
+                if existing is not None and len(existing) == len(records)
+                else list(range(len(records))))
+        with open(idx, "w") as fout:
+            for key, (pos, payload) in zip(keys, records):
+                if args.crc:
+                    fout.write(f"{key}\t{pos}\t{compute_crc(payload)}\n")
+                else:
+                    fout.write(f"{key}\t{pos}\n")
+        print(f"recordio_check: wrote {idx}: {len(records)} entries"
+              + (" with crc32" if args.crc else ""))
+        if torn_at is not None:
+            print(f"recordio_check: NOTE: the torn tail at offset "
+                  f"{torn_at} is still in {rec}; the repaired index "
+                  "simply does not reference it")
+        return 0
+
+    if problems:
+        print(f"recordio_check: {len(problems)} problem(s); "
+              "re-run with --repair to rewrite the index")
+        return 1
+    print(f"recordio_check: {rec}: OK ({len(records)} records, "
+          f"index verified{', crc' if existing and existing[0][2] is not None else ''})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

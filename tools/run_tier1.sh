@@ -331,4 +331,27 @@ if [[ "${TIER1_PREEMPT:-0}" != "0" ]]; then
         rc=$preempt_rc
     fi
 fi
+# Input-pipeline smoke (TIER1_DATA=1 to enable): a synthetic crc-indexed
+# .rec streamed through sharded RecordPipelines ×4 decode workers under
+# a seeded io:read plan (transient + torn + worker kill) — asserts
+# exactly-once sample delivery (delivered ∪ quarantined, no dupes, kill
+# requeued + respawned), worker-count-independent delivery order,
+# sample-exact 2->1 reshard resume, zero recompiles through the
+# DeviceFeeder double-buffer, and the io.* export surface. Re-run under
+# MXNET_LOCKDEP=1: the worker pool's queue/lock traffic must stay
+# cycle-free with no blocking calls under the pipeline lock.
+if [[ "${TIER1_DATA:-0}" != "0" ]]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python tools/data_smoke.py
+    data_rc=$?
+    if [[ "$rc" -eq 0 && "$data_rc" -ne 0 ]]; then
+        rc=$data_rc
+    fi
+    timeout -k 10 180 env JAX_PLATFORMS=cpu MXNET_LOCKDEP=1 \
+        python tools/data_smoke.py
+    data_rc=$?
+    if [[ "$rc" -eq 0 && "$data_rc" -ne 0 ]]; then
+        rc=$data_rc
+    fi
+fi
 exit "$rc"
